@@ -1,0 +1,10 @@
+"""tpu-serving: a TPU-native model-serving framework.
+
+Capability-parity rebuild of clearml-serving (reference: /root/reference) with a
+JAX/XLA/Pallas engine tier. See SURVEY.md for the reference layer map this
+package reproduces, re-designed TPU-first.
+"""
+
+from .version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so the
+full stack (including multi-chip sharding) runs without TPU hardware.
+
+Must set the env vars before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def state_root(tmp_path):
+    """Isolated control-plane state root per test."""
+    root = tmp_path / "state"
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    yield root
+    os.environ.pop("TPUSERVE_STATE_ROOT", None)

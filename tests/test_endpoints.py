@@ -1,0 +1,94 @@
+import pytest
+
+from clearml_serving_tpu.serving.endpoints import (
+    CanaryEP,
+    EndpointMetricLogging,
+    MetricType,
+    ModelEndpoint,
+    ModelMonitoring,
+)
+
+
+def test_model_endpoint_roundtrip():
+    ep = ModelEndpoint(
+        engine_type="sklearn",
+        serving_url="test_model_sklearn",
+        model_id="abc",
+        input_size=[1, 4],
+        input_type="float32",
+        input_name="features",
+        output_size=[1],
+        output_type="float32",
+    )
+    d = ep.as_dict()
+    ep2 = ModelEndpoint.from_dict(d)
+    assert ep2 == ep
+    # scalar wrapping
+    assert ep.input_type == ["float32"]
+    assert ep.input_size == [[1, 4]]
+
+
+def test_model_endpoint_bad_engine():
+    with pytest.raises(ValueError):
+        ModelEndpoint(engine_type="nope", serving_url="x")
+
+
+def test_model_endpoint_bad_dtype():
+    with pytest.raises(ValueError):
+        ModelEndpoint(engine_type="custom", serving_url="x", input_type=["notatype"])
+
+
+def test_model_endpoint_requires_url():
+    with pytest.raises(ValueError):
+        ModelEndpoint(engine_type="custom", serving_url="")
+
+
+def test_multi_io_spec():
+    ep = ModelEndpoint(
+        engine_type="jax",
+        serving_url="multi",
+        input_size=[[3], [5, 5]],
+        input_type=["float32", "int32"],
+        input_name=["a", "b"],
+    )
+    assert ep.input_size == [[3], [5, 5]]
+    assert len(ep.input_type) == 2
+
+
+def test_canary_validation():
+    with pytest.raises(ValueError):
+        CanaryEP(endpoint="x", weights=[1], load_endpoints=["a"], load_endpoint_prefix="p")
+    with pytest.raises(ValueError):
+        CanaryEP(endpoint="x", weights=[1])
+    c = CanaryEP(endpoint="x", weights=[0.9, 0.1], load_endpoints=["a/1", "a/2"])
+    assert CanaryEP.from_dict(c.as_dict()) == c
+
+
+def test_monitoring():
+    m = ModelMonitoring(
+        base_serving_url="auto_model",
+        engine_type="jax",
+        monitor_project="proj",
+        max_versions=3,
+    )
+    assert ModelMonitoring.from_dict(m.as_dict()) == m
+
+
+def test_metric_logging():
+    ml = EndpointMetricLogging(
+        endpoint="ep",
+        log_frequency=0.5,
+        metrics={
+            "x0": {"type": "scalar", "buckets": [0, 1, 2]},
+            "label": MetricType(type="enum", buckets=["cat", "dog"]),
+            "out": {"type": "value"},
+        },
+    )
+    d = ml.as_dict()
+    ml2 = EndpointMetricLogging.from_dict(d)
+    assert ml2.metrics["x0"].type == "scalar"
+    assert ml2.metrics["label"].buckets == ["cat", "dog"]
+    with pytest.raises(ValueError):
+        MetricType(type="scalar", buckets=None)
+    with pytest.raises(ValueError):
+        EndpointMetricLogging(endpoint="ep", log_frequency=2.0)

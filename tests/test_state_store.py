@@ -1,0 +1,73 @@
+from pathlib import Path
+
+from clearml_serving_tpu.state import ModelRegistry, StateStore
+
+
+def test_service_lifecycle(state_root):
+    store = StateStore(state_root)
+    svc = store.create_service("my-serving", project="DevOps")
+    assert svc.exists
+    assert store.get_service(svc.id).name == "my-serving"
+
+    svc.update_parameters({"serving_base_url": "http://127.0.0.1:8080/serve"})
+    assert svc.get_parameters()["serving_base_url"].endswith("/serve")
+
+    svc.set_configuration_objects({"endpoints": {"a": {"x": 1}}})
+    assert svc.get_configuration_object("endpoints") == {"a": {"x": 1}}
+    assert svc.get_configuration_object("missing") is None
+
+    c0 = svc.update_counter
+    svc.set_runtime_properties({"version": "9.9"})
+    assert svc.update_counter == c0 + 1
+
+    svc.ping(instance_id="inst-1")
+    listed = store.list_services()
+    assert len(listed) == 1 and listed[0]["id"] == svc.id
+    assert store.find_service("my-serving").id == svc.id
+    assert store.find_service("unknown") is None
+
+
+def test_artifacts(state_root, tmp_path):
+    store = StateStore(state_root)
+    svc = store.create_service("svc")
+    code = tmp_path / "preprocess.py"
+    code.write_text("def preprocess(x):\n    return x\n")
+    svc.upload_artifact("py_code_ep1", code)
+    stored = svc.get_artifact("py_code_ep1")
+    assert stored and stored.is_file()
+    assert "def preprocess" in stored.read_text()
+    assert svc.artifact_hash("py_code_ep1")
+    assert svc.list_artifacts() == ["py_code_ep1"]
+
+    # package dir becomes a zip
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("x = 1\n")
+    svc.upload_artifact("py_code_pkg", pkg)
+    assert svc.get_artifact("py_code_pkg").suffix == ".zip"
+
+
+def test_model_registry(state_root, tmp_path):
+    reg = ModelRegistry(state_root)
+    f = tmp_path / "model.pkl"
+    f.write_bytes(b"weights")
+    m1 = reg.register("iris-clf", project="examples", path=f, framework="sklearn")
+    m2 = reg.register("iris-clf", project="examples", path=f, publish=True)
+    reg.register("other", project="elsewhere", path=f)
+
+    got = reg.get(m1.id)
+    assert got and got.name == "iris-clf"
+    assert Path(got.get_local_copy()).read_bytes() == b"weights"
+
+    res = reg.query(project="examples", name="iris-clf")
+    assert [m.id for m in res] == [m2.id, m1.id]  # newest first
+    assert [m.id for m in reg.query(project="examples", only_published=True)] == [m2.id]
+    assert len(reg.query(max_results=2)) == 2
+
+    m1.publish()
+    assert reg.get(m1.id).published
+
+    # tag query
+    m2.set_metadata(tags=["prod"])
+    assert [m.id for m in reg.query(tags=["prod"])] == [m2.id]
