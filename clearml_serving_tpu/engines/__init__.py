@@ -1,0 +1,12 @@
+from .base import BaseEngineRequest, get_engine_cls, load_engine_modules, register_engine
+
+# Import engine implementations so they self-register.
+from . import cpu_engines  # noqa: F401
+from . import jax_engine  # noqa: F401
+
+__all__ = [
+    "BaseEngineRequest",
+    "get_engine_cls",
+    "load_engine_modules",
+    "register_engine",
+]

@@ -1,0 +1,133 @@
+"""BERT encoder for token classification (BASELINE.md config 4).
+
+Replaces the reference's Triton-hosted HuggingFace/ONNX path
+(reference examples/huggingface) with a native JAX encoder: one big QKV matmul
+per layer, fused GELU FFN, fp32 layernorm accumulation — all static-shape so a
+single jit specialization serves each (batch-bucket, seq-bucket) pair.
+
+HuggingFace `bert-base-*` checkpoints convert via
+clearml_serving_tpu.engines.importers.convert_hf_bert.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import register_model
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "bert-base": dict(
+        vocab_size=30522, dim=768, n_layers=12, n_heads=12, ffn_dim=3072,
+        max_seq_len=512, type_vocab_size=2, norm_eps=1e-12,
+    ),
+    "bert-tiny": dict(
+        vocab_size=512, dim=64, n_layers=2, n_heads=2, ffn_dim=128,
+        max_seq_len=128, type_vocab_size=2, norm_eps=1e-12,
+    ),
+}
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+@register_model("bert")
+def build(config: dict) -> SimpleNamespace:
+    cfg = dict(PRESETS.get(config.get("preset", ""), {}))
+    cfg.update({k: v for k, v in config.items() if k != "preset"})
+    cfg.setdefault("dtype", "bfloat16")
+    cfg.setdefault("num_labels", 9)  # CoNLL-2003 NER default
+
+    vocab = int(cfg["vocab_size"])
+    dim = int(cfg["dim"])
+    n_layers = int(cfg["n_layers"])
+    n_heads = int(cfg["n_heads"])
+    ffn_dim = int(cfg["ffn_dim"])
+    max_len = int(cfg["max_seq_len"])
+    eps = float(cfg["norm_eps"])
+    num_labels = int(cfg["num_labels"])
+    dtype = jnp.dtype(cfg["dtype"])
+    head_dim = dim // n_heads
+
+    def init(rng) -> Dict[str, Any]:
+        def dense(key, shape, fan_in):
+            return (
+                jax.random.normal(key, shape, dtype=jnp.float32) * fan_in ** -0.5
+            ).astype(dtype)
+
+        keys = jax.random.split(rng, 4 + n_layers)
+        params: Dict[str, Any] = {
+            "word_embed": dense(keys[0], (vocab, dim), dim),
+            "pos_embed": dense(keys[1], (max_len, dim), dim),
+            "type_embed": dense(keys[2], (int(cfg["type_vocab_size"]), dim), dim),
+            "embed_norm": {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)},
+            "layers": [],
+            "classifier": {
+                "w": dense(keys[3], (dim, num_labels), dim),
+                "b": jnp.zeros((num_labels,), dtype),
+            },
+        }
+        for i in range(n_layers):
+            k = jax.random.split(keys[4 + i], 6)
+            params["layers"].append(
+                {
+                    "wqkv": dense(k[0], (dim, 3 * dim), dim),
+                    "bqkv": jnp.zeros((3 * dim,), dtype),
+                    "wo": dense(k[1], (dim, dim), dim),
+                    "bo": jnp.zeros((dim,), dtype),
+                    "attn_norm": {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)},
+                    "w1": dense(k[2], (dim, ffn_dim), dim),
+                    "b1": jnp.zeros((ffn_dim,), dtype),
+                    "w2": dense(k[3], (ffn_dim, dim), ffn_dim),
+                    "b2": jnp.zeros((dim,), dtype),
+                    "ffn_norm": {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)},
+                }
+            )
+        return params
+
+    def apply(params, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None):
+        """input_ids [B, S] int32; attention_mask [B, S] (1 = keep) ->
+        per-token label logits [B, S, num_labels]."""
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.int32)
+        pos = jnp.arange(s, dtype=jnp.int32)
+        x = (
+            params["word_embed"][input_ids]
+            + params["pos_embed"][pos][None]
+            + params["type_embed"][jnp.zeros((b, s), jnp.int32)]
+        )
+        x = _layer_norm(x, params["embed_norm"]["scale"], params["embed_norm"]["bias"], eps)
+        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -jnp.inf).astype(jnp.float32)
+        for layer in params["layers"]:
+            qkv = x @ layer["wqkv"] + layer["bqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, n_heads, head_dim)
+            k = k.reshape(b, s, n_heads, head_dim)
+            v = v.reshape(b, s, n_heads, head_dim)
+            scores = jnp.einsum(
+                "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+            ) * (head_dim ** -0.5)
+            probs = jax.nn.softmax(scores + bias, axis=-1).astype(v.dtype)
+            attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(b, s, dim)
+            x = _layer_norm(
+                x + attn @ layer["wo"] + layer["bo"],
+                layer["attn_norm"]["scale"], layer["attn_norm"]["bias"], eps,
+            )
+            h = jax.nn.gelu(x @ layer["w1"] + layer["b1"])
+            x = _layer_norm(
+                x + h @ layer["w2"] + layer["b2"],
+                layer["ffn_norm"]["scale"], layer["ffn_norm"]["bias"], eps,
+            )
+        logits = x @ params["classifier"]["w"] + params["classifier"]["b"]
+        return logits.astype(jnp.float32)
+
+    return SimpleNamespace(init=init, apply=apply, config=cfg)

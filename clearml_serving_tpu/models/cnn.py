@@ -1,0 +1,72 @@
+"""Small conv net (MNIST-CNN class of workloads; BASELINE.md config 3).
+
+NHWC layout (XLA's preferred TPU convolution layout) with
+`lax.conv_general_dilated` so the convs tile onto the MXU.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import register_model
+
+
+@register_model("cnn")
+def build(config: dict) -> SimpleNamespace:
+    in_hw = tuple(config.get("in_hw", (28, 28)))
+    in_ch = int(config.get("in_ch", 1))
+    channels = [int(c) for c in config.get("channels", [32, 64])]
+    dense = int(config.get("dense", 128))
+    out_dim = int(config.get("out_dim", 10))
+    dtype = jnp.dtype(config.get("dtype", "float32"))
+
+    def init(rng):
+        params = {"conv": [], "dense": []}
+        ch = in_ch
+        for c in channels:
+            rng, sub = jax.random.split(rng)
+            k = jax.random.normal(sub, (3, 3, ch, c), dtype=jnp.float32)
+            k = k * (2.0 / (9 * ch)) ** 0.5
+            params["conv"].append({"k": k.astype(dtype), "b": jnp.zeros((c,), dtype)})
+            ch = c
+        # Each conv is followed by a 2x2 max-pool.
+        h = in_hw[0] // (2 ** len(channels))
+        w = in_hw[1] // (2 ** len(channels))
+        flat = h * w * ch
+        rng, s1, s2 = jax.random.split(rng, 3)
+        params["dense"] = [
+            {
+                "w": (jax.random.normal(s1, (flat, dense)) * (2.0 / flat) ** 0.5).astype(dtype),
+                "b": jnp.zeros((dense,), dtype),
+            },
+            {
+                "w": (jax.random.normal(s2, (dense, out_dim)) * (2.0 / dense) ** 0.5).astype(dtype),
+                "b": jnp.zeros((out_dim,), dtype),
+            },
+        ]
+        return params
+
+    def apply(params, x):
+        # x: [B, H, W, C] (a [B, H, W] input gets a channel dim appended).
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(dtype)
+        for layer in params["conv"]:
+            x = lax.conv_general_dilated(
+                x, layer["k"], window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = jax.nn.relu(x + layer["b"])
+            x = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+        x = x.reshape((x.shape[0], -1))
+        d1, d2 = params["dense"]
+        x = jax.nn.relu(x @ d1["w"] + d1["b"])
+        return x @ d2["w"] + d2["b"]
+
+    return SimpleNamespace(init=init, apply=apply, config=config)

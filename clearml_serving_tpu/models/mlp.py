@@ -1,0 +1,47 @@
+"""Tabular MLP (iris-classifier class of workloads).
+
+TPU-first: pure functional params pytree, bf16-friendly matmuls, batch-leading
+shapes so the router's bucketed auto-batching maps straight onto the MXU.
+Covers the reference's sklearn/xgboost/lightgbm tabular acceptance configs when
+served through the `jax` engine (BASELINE.md configs 1-2).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+
+from . import register_model
+
+
+def _dtype(name):
+    return jnp.dtype(name) if name else jnp.float32
+
+
+@register_model("mlp")
+def build(config: dict) -> SimpleNamespace:
+    in_dim = int(config.get("in_dim", 4))
+    hidden = [int(h) for h in config.get("hidden", [64, 64])]
+    out_dim = int(config.get("out_dim", 3))
+    dtype = _dtype(config.get("dtype", "float32"))
+    dims = [in_dim] + hidden + [out_dim]
+
+    def init(rng):
+        params = []
+        for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+            rng, sub = jax.random.split(rng)
+            w = jax.random.normal(sub, (a, b), dtype=jnp.float32) * (2.0 / a) ** 0.5
+            params.append({"w": w.astype(dtype), "b": jnp.zeros((b,), dtype=dtype)})
+        return {"layers": params}
+
+    def apply(params, x):
+        x = x.astype(dtype)
+        layers = params["layers"]
+        for layer in layers[:-1]:
+            x = jax.nn.relu(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    return SimpleNamespace(init=init, apply=apply, config=config)

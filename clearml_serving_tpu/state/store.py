@@ -91,6 +91,11 @@ class ServingService:
 
     # -- reference-Task-equivalent surface ---------------------------------
 
+    def get_snapshot(self) -> Dict[str, Any]:
+        """One consistent read of the whole service document (atomic replace on
+        the writer side guarantees a torn-free view)."""
+        return self._read()
+
     def get_parameters(self) -> Dict[str, Any]:
         return dict(self._read().get("parameters") or {})
 

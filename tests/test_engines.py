@@ -1,0 +1,154 @@
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.engines import get_engine_cls, load_engine_modules
+from clearml_serving_tpu.engines.base import EndpointModelError
+from clearml_serving_tpu.engines.jax_engine import bucket_for, save_bundle
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.state import ModelRegistry, StateStore
+from clearml_serving_tpu import models
+
+CUSTOM_CODE = """
+class Preprocess:
+    def __init__(self):
+        self.loaded = False
+    def load(self, path):
+        self.loaded = True
+        return lambda x: [v * 2 for v in x]
+    def preprocess(self, body, state, collect_fn):
+        state["n"] = len(body["x"])
+        return body["x"]
+    def process(self, data, state, collect_fn):
+        return self._model_fn(data) if hasattr(self, "_model_fn") else [v * 2 for v in data]
+    def postprocess(self, data, state, collect_fn):
+        return {"y": data, "n": state["n"]}
+"""
+
+ASYNC_CODE = """
+import asyncio
+class Preprocess:
+    async def preprocess(self, body, state, collect_fn):
+        await asyncio.sleep(0)
+        return body["x"]
+    async def process(self, data, state, collect_fn):
+        return [v + 1 for v in data]
+    def postprocess(self, data, state, collect_fn):
+        return {"y": data}
+"""
+
+
+@pytest.fixture()
+def service(state_root, tmp_path):
+    store = StateStore(state_root)
+    svc = store.create_service("svc")
+    return svc, ModelRegistry(state_root), tmp_path
+
+
+def _upload_code(svc, tmp_path, code, name="py_code_ep"):
+    f = tmp_path / (name + ".py")
+    f.write_text(code)
+    svc.upload_artifact(name, f)
+    return name
+
+
+def test_custom_engine(service):
+    svc, reg, tmp_path = service
+    art = _upload_code(svc, tmp_path, CUSTOM_CODE)
+    ep = ModelEndpoint(engine_type="custom", serving_url="c1", preprocess_artifact=art)
+    proc = get_engine_cls("custom")(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+    state = {}
+    data = proc.preprocess({"x": [1, 2, 3]}, state, None)
+    out = proc.process(data, state, None)
+    res = proc.postprocess(out, state, None)
+    assert res == {"y": [2, 4, 6], "n": 3}
+
+
+def test_custom_engine_requires_process(service):
+    svc, reg, tmp_path = service
+    ep = ModelEndpoint(engine_type="custom", serving_url="c2")
+    proc = get_engine_cls("custom")(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+    with pytest.raises(EndpointModelError):
+        proc.process([1], {}, None)
+
+
+def test_hot_reload_on_artifact_change(service):
+    svc, reg, tmp_path = service
+    art = _upload_code(svc, tmp_path, CUSTOM_CODE)
+    ep = ModelEndpoint(engine_type="custom", serving_url="c3", preprocess_artifact=art)
+    proc = get_engine_cls("custom")(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+    assert proc.process([1], {}, None) == [2]
+    # operator uploads new code under the same artifact name
+    _upload_code(svc, tmp_path, CUSTOM_CODE.replace("v * 2", "v * 10"))
+    proc._load_user_code()
+    assert proc.process([1], {}, None) == [10]
+
+
+def test_custom_async_engine(service):
+    svc, reg, tmp_path = service
+    art = _upload_code(svc, tmp_path, ASYNC_CODE, "py_code_async")
+    ep = ModelEndpoint(engine_type="custom_async", serving_url="a1", preprocess_artifact=art)
+    cls = get_engine_cls("custom_async")
+    assert cls.is_process_async
+    proc = cls(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+
+    async def run():
+        state = {}
+        data = await proc.preprocess({"x": [1, 2]}, state, None)
+        out = await proc.process(data, state, None)
+        return await proc.postprocess(out, state, None)
+
+    assert asyncio.run(run()) == {"y": [2, 3]}
+
+
+def test_sklearn_engine(service):
+    svc, reg, tmp_path = service
+    sklearn = pytest.importorskip("sklearn")
+    import joblib
+    from sklearn.linear_model import LogisticRegression
+
+    X = np.array([[0.0], [1.0], [2.0], [3.0]])
+    y = np.array([0, 0, 1, 1])
+    model = LogisticRegression().fit(X, y)
+    mf = tmp_path / "model.pkl"
+    joblib.dump(model, mf)
+    rec = reg.register("clf", path=mf, framework="sklearn")
+    ep = ModelEndpoint(engine_type="sklearn", serving_url="s1", model_id=rec.id)
+    proc = get_engine_cls("sklearn")(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+    out = proc.process(np.array([[0.0], [3.0]]), {}, None)
+    assert out.tolist() == [0, 1]
+
+
+def test_jax_engine_bundle(service):
+    svc, reg, tmp_path = service
+    bundle = models.build_model("mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3})
+    params = bundle.init(jax.random.PRNGKey(0))
+    bdir = tmp_path / "bundle"
+    save_bundle(bdir, "mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3}, params)
+    rec = reg.register("mlp-iris", path=bdir, framework="jax")
+    ep = ModelEndpoint(
+        engine_type="jax", serving_url="j1", model_id=rec.id,
+        input_name="features", input_type="float32",
+    )
+    proc = get_engine_cls("jax")(ep, service=svc, registry=reg, cache_dir=str(tmp_path / "cache"))
+    out = proc.process({"features": [[1, 2, 3, 4], [4, 3, 2, 1], [0, 0, 0, 0]]}, {}, None)
+    # batch of 3 padded to bucket 4 internally, but only 3 rows returned
+    assert np.asarray(out[0] if isinstance(out, list) else out).shape == (3, 3)
+    res = proc.postprocess(out, {}, None)
+    assert isinstance(res, list) and len(res) == 3
+
+    # reference output must match direct apply
+    direct = bundle.apply(params, np.array([[1, 2, 3, 4], [4, 3, 2, 1], [0, 0, 0, 0]], np.float32))
+    np.testing.assert_allclose(np.asarray(res), np.asarray(direct), rtol=1e-5)
+
+
+def test_bucketing():
+    assert bucket_for(1, [1, 2, 4]) == 1
+    assert bucket_for(3, [1, 2, 4]) == 4
+    assert bucket_for(9, [1, 2, 4]) == 9  # beyond largest bucket: exact
+
+
+def test_load_modules_noop():
+    load_engine_modules()  # gated imports must never raise

@@ -1,0 +1,89 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+
+
+def test_mlp_shapes():
+    b = models.build_model("mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3})
+    params = b.init(jax.random.PRNGKey(0))
+    out = b.apply(params, jnp.ones((5, 4)))
+    assert out.shape == (5, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_cnn_shapes():
+    b = models.build_model("cnn", {"in_hw": (28, 28), "channels": [4, 8], "dense": 16})
+    params = b.init(jax.random.PRNGKey(0))
+    out = b.apply(params, jnp.ones((2, 28, 28)))  # channel dim auto-added
+    assert out.shape == (2, 10)
+
+
+def test_bert_shapes_and_masking():
+    b = models.build_model("bert", {"preset": "bert-tiny", "num_labels": 5, "dtype": "float32"})
+    params = b.init(jax.random.PRNGKey(0))
+    ids = jnp.ones((2, 16), jnp.int32)
+    mask = jnp.array([[1] * 16, [1] * 4 + [0] * 12], jnp.int32)
+    out = b.apply(params, ids, mask)
+    assert out.shape == (2, 16, 5)
+    # masked positions must not influence unmasked token outputs:
+    ids2 = ids.at[1, 8].set(7)  # change a masked-out token
+    out2 = b.apply(params, ids2, mask)
+    np.testing.assert_allclose(out[1, :4], out2[1, :4], rtol=2e-4, atol=2e-4)
+
+
+def test_unknown_arch():
+    with pytest.raises(ValueError):
+        models.build_model("nope", {})
+
+
+class TestLlama:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        b = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
+        params = b.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 512)
+        return b, params, tokens
+
+    def test_causal_forward(self, setup):
+        b, params, tokens = setup
+        logits = b.apply(params, tokens)
+        assert logits.shape == (2, 12, 512)
+        # causality: changing a later token must not affect earlier logits
+        tokens2 = tokens.at[:, 9].set(3)
+        logits2 = b.apply(params, tokens2)
+        np.testing.assert_allclose(logits[:, :9], logits2[:, :9], rtol=1e-4, atol=1e-4)
+        assert not np.allclose(logits[:, 9:], logits2[:, 9:])
+
+    def test_prefill_matches_forward(self, setup):
+        b, params, tokens = setup
+        full = b.apply(params, tokens)
+        cache = b.init_cache(batch=2, max_len=32)
+        seq_lens = jnp.array([12, 12], jnp.int32)
+        last, cache = b.prefill(params, tokens, seq_lens, cache)
+        np.testing.assert_allclose(last, full[:, -1], rtol=1e-3, atol=1e-3)
+
+    def test_ragged_prefill(self, setup):
+        b, params, tokens = setup
+        # sequence 1 is only 5 tokens (right-padded): last logits must equal
+        # a dense forward over just those 5 tokens.
+        cache = b.init_cache(batch=2, max_len=32)
+        seq_lens = jnp.array([12, 5], jnp.int32)
+        last, cache = b.prefill(params, tokens, seq_lens, cache)
+        short = b.apply(params, tokens[1:2, :5])
+        np.testing.assert_allclose(last[1], short[0, -1], rtol=1e-3, atol=1e-3)
+
+    def test_decode_matches_forward(self, setup):
+        b, params, tokens = setup
+        full = b.apply(params, tokens)
+        cache = b.init_cache(batch=2, max_len=32)
+        seq_lens = jnp.array([8, 8], jnp.int32)
+        last, cache = b.prefill(params, tokens[:, :8], seq_lens, cache)
+        np.testing.assert_allclose(last, full[:, 7], rtol=1e-3, atol=1e-3)
+        # feed the true next tokens; decode logits must match the dense forward
+        for t in range(8, 12):
+            logits, cache = b.decode(params, tokens[:, t], cache)
+            np.testing.assert_allclose(logits, full[:, t], rtol=1e-3, atol=1e-3)
+        assert np.asarray(cache["length"]).tolist() == [12, 12]

@@ -1,0 +1,220 @@
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.serving.endpoints import (
+    CanaryEP,
+    EndpointMetricLogging,
+    ModelEndpoint,
+    ModelMonitoring,
+)
+from clearml_serving_tpu.serving.model_request_processor import (
+    EndpointNotFoundException,
+    FastWriteCounter,
+    ModelRequestProcessor,
+)
+from clearml_serving_tpu.state import ModelRegistry, StateStore
+
+ECHO_CODE = """
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        return {"echo": data}
+"""
+
+DOUBLE_CODE = """
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        return {"y": [v * 2 for v in data["x"]]}
+"""
+
+
+@pytest.fixture()
+def mrp(state_root, tmp_path):
+    proc = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="t")
+    code = tmp_path / "echo.py"
+    code.write_text(ECHO_CODE)
+    proc.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="echo"),
+        preprocess_code=str(code),
+    )
+    proc.serialize()
+    return proc
+
+
+def test_fast_write_counter():
+    c = FastWriteCounter()
+    assert c.value() == 0
+    c.inc(); c.inc(); c.dec()
+    assert c.value() == 1
+    assert c.value() == 1  # reading must not drift
+
+
+def test_process_request(mrp):
+    out = asyncio.run(mrp.process_request("echo", None, {"a": 1}))
+    assert out == {"echo": {"a": 1}}
+
+
+def test_missing_endpoint(mrp):
+    with pytest.raises(EndpointNotFoundException):
+        asyncio.run(mrp.process_request("nope", None, {}))
+
+
+def test_serialize_roundtrip(mrp, state_root):
+    mrp.serialize()
+    other = ModelRequestProcessor(service_id=mrp.get_id(), state_root=str(state_root))
+    assert other.deserialize(skip_sync=True)
+    assert "echo" in other.list_endpoints()
+    # no-op when unchanged (config-hash detection)
+    assert not other.deserialize(skip_sync=True)
+    out = asyncio.run(other.process_request("echo", None, [1, 2]))
+    assert out == {"echo": [1, 2]}
+
+
+def test_remove_endpoint(mrp):
+    assert mrp.remove_endpoint("echo")
+    assert not mrp.remove_endpoint("echo")
+    with pytest.raises(EndpointNotFoundException):
+        asyncio.run(mrp.process_request("echo", None, {}))
+
+
+def test_canary_routing(mrp, state_root, tmp_path):
+    code = tmp_path / "double.py"
+    code.write_text(DOUBLE_CODE)
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="m/1"), preprocess_code=str(code)
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="m/2"), preprocess_code=str(code)
+    )
+    mrp.add_canary_endpoint(
+        CanaryEP(endpoint="m", weights=[1.0, 0.0], load_endpoints=["m/2", "m/1"])
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    # all traffic -> m/2 (weight 1.0)
+    out = asyncio.run(mrp.process_request("m", None, {"x": [3]}))
+    assert out == {"y": [6]}
+
+    # prefix mode resolves to highest numeric version first
+    mrp.add_canary_endpoint(CanaryEP(endpoint="p", weights=[1.0], load_endpoint_prefix="m/"))
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    assert mrp._canary_route["p"]["endpoints"] == ["m/2"]
+
+    # missing endpoints are skipped + weights renormalized
+    mrp.add_canary_endpoint(
+        CanaryEP(endpoint="q", weights=[0.5, 0.5], load_endpoints=["m/1", "gone/9"])
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    assert mrp._canary_route["q"]["endpoints"] == ["m/1"]
+    assert mrp._canary_route["q"]["weights"] == [1.0]
+
+
+def test_monitoring_auto_deploy(mrp, state_root, tmp_path):
+    reg = mrp.registry
+    f = tmp_path / "m.txt"
+    f.write_text("payload")
+    code = tmp_path / "echo2.py"
+    code.write_text(ECHO_CODE)
+    mrp.add_model_monitoring(
+        ModelMonitoring(
+            base_serving_url="auto", engine_type="custom",
+            monitor_project="prod", max_versions=2,
+        ),
+        preprocess_code=str(code),
+    )
+    r1 = reg.register("model-a", project="prod", path=f)
+    time.sleep(0.02)
+    assert mrp._update_monitored_models()
+    assert "auto/1" in mrp._model_monitoring_endpoints
+
+    r2 = reg.register("model-b", project="prod", path=f)
+    time.sleep(0.02)
+    assert mrp._update_monitored_models()
+    # monotone version numbers: newest model gets version 2
+    eps = mrp._model_monitoring_endpoints
+    assert set(eps) == {"auto/1", "auto/2"}
+    assert eps["auto/2"].model_id == r2.id
+
+    # a third model rolls the window (max_versions=2): auto/1 disappears
+    r3 = reg.register("model-c", project="prod", path=f)
+    time.sleep(0.02)
+    assert mrp._update_monitored_models()
+    eps = mrp._model_monitoring_endpoints
+    assert set(eps) == {"auto/2", "auto/3"}
+    assert eps["auto/3"].model_id == r3.id
+
+    # monitored endpoints are servable
+    out = asyncio.run(mrp.process_request("auto", "3", {"k": 1}))
+    assert out == {"echo": {"k": 1}}
+
+
+def test_stats_sampling(mrp, state_root, tmp_path):
+    broker_dir = tmp_path / "broker"
+    mrp.configure(external_stats_broker="file://{}".format(broker_dir))
+    mrp.add_metric_logging(
+        EndpointMetricLogging(endpoint="echo", log_frequency=1.0, metrics={})
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    for _ in range(5):
+        asyncio.run(mrp.process_request("echo", None, {"x": 1}))
+    batch = mrp._stats_queue.get_all(timeout=0.1)
+    assert len(batch) == 5
+    assert all(s["_url"] == "echo" and "_latency" in s and s["_count"] == 1 for s in batch)
+
+
+def test_zero_downtime_swap_under_load(mrp):
+    """Concurrent requests + a config swap: nothing drops, nothing errors."""
+
+    async def run():
+        async def client(n):
+            results = []
+            for i in range(n):
+                results.append(await mrp.process_request("echo", None, i))
+                await asyncio.sleep(0.001)
+            return results
+
+        async def swapper():
+            await asyncio.sleep(0.01)
+            mrp._last_update_hash = None  # force re-apply
+            await asyncio.to_thread(mrp.deserialize)
+
+        res, _ = await asyncio.gather(client(30), swapper())
+        return res
+
+    results = asyncio.run(run())
+    assert len(results) == 30
+    assert all(r == {"echo": i} for i, r in enumerate(results))
+
+
+def test_hot_reload_preprocess_via_sync(mrp, tmp_path):
+    """Re-uploading preprocess code under the same artifact name must take
+    effect after the next sync (processor cache eviction on hash change)."""
+    assert asyncio.run(mrp.process_request("echo", None, [1])) == {"echo": [1]}
+    new_code = tmp_path / "echo_v2.py"
+    new_code.write_text(ECHO_CODE.replace('{"echo": data}', '{"echo2": data}'))
+    mrp.service.upload_artifact("py_code_echo", new_code)
+    mrp._last_update_hash = None
+    mrp.deserialize()
+    assert asyncio.run(mrp.process_request("echo", None, [1])) == {"echo2": [1]}
+
+
+def test_wildcard_no_cross_family(mrp):
+    mrp.add_metric_logging(
+        EndpointMetricLogging(endpoint="model/*", log_frequency=0.5, metrics={})
+    )
+    assert mrp.get_endpoint_metric_logging("model/3") is not None
+    assert mrp.get_endpoint_metric_logging("model2/3") is None
+
+
+def test_metric_wildcard(mrp):
+    mrp.add_metric_logging(
+        EndpointMetricLogging(endpoint="m/*", log_frequency=0.5, metrics={})
+    )
+    assert mrp.get_endpoint_metric_logging("m/7").log_frequency == 0.5
+    assert mrp.get_endpoint_metric_logging("other") is None
