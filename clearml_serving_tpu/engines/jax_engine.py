@@ -27,12 +27,14 @@ import os
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from .base import BaseEngineRequest, EndpointModelError, register_engine
 from ..utils.files import atomic_write_json, read_json
+
+# NOTE: jax is imported lazily inside functions — engines/__init__ imports this
+# module unconditionally, and CLI/statistics processes must not pay JAX/libtpu
+# initialization (or contend for the TPU device lock) just to mutate config.
 
 _DEFAULT_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128]
 _compilation_cache_ready = False
@@ -42,6 +44,8 @@ def enable_persistent_compilation_cache() -> None:
     global _compilation_cache_ready
     if _compilation_cache_ready:
         return
+    import jax
+
     cache_dir = os.environ.get("TPUSERVE_COMPILE_CACHE") or str(
         Path.home() / ".tpu-serving" / "xla-cache"
     )
@@ -58,6 +62,7 @@ def enable_persistent_compilation_cache() -> None:
 
 def save_bundle(path, arch: str, config: dict, params) -> None:
     """Write a jax model bundle directory."""
+    import jax
     from flax import serialization
 
     path = Path(path)
@@ -70,6 +75,8 @@ def save_bundle(path, arch: str, config: dict, params) -> None:
 
 def load_bundle(path) -> Tuple[Any, Any]:
     """Returns (model_bundle namespace, params)."""
+    import jax
+    import jax.numpy as jnp
     from flax import serialization
     from .. import models
 
@@ -135,6 +142,8 @@ class JaxEngineRequest(BaseEngineRequest):
             )
 
     def _compiled(self, bucket: int) -> Callable:
+        import jax
+
         fn = self._jitted.get(bucket)
         if fn is None:
             if self._params is not None:
@@ -191,6 +200,8 @@ class JaxEngineRequest(BaseEngineRequest):
                 pad = [(0, bucket - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
                 a = np.pad(a, pad)
             padded.append(a)
+        import jax
+
         fn = self._compiled(bucket)
         if self._params is not None:
             out = fn(self._params, *padded)
@@ -202,11 +213,15 @@ class JaxEngineRequest(BaseEngineRequest):
     def postprocess(self, data: Any, state: dict, collect_fn=None) -> Any:
         if self._preprocess is not None and hasattr(self._preprocess, "postprocess"):
             return self._preprocess.postprocess(data, state, collect_fn)
-        # numpy -> JSON-friendly
+        # numpy -> JSON-friendly (recursive; no jax needed here)
         def _to_list(x):
-            return x.tolist() if isinstance(x, np.ndarray) else x
-        if isinstance(data, dict):
-            return {k: _to_list(v) for k, v in data.items()}
+            if isinstance(x, np.ndarray):
+                return x.tolist()
+            if isinstance(x, dict):
+                return {k: _to_list(v) for k, v in x.items()}
+            if isinstance(x, (list, tuple)):
+                return [_to_list(v) for v in x]
+            return x
         if isinstance(data, (list, tuple)) and len(data) == 1:
             return _to_list(data[0])
-        return jax.tree.map(_to_list, data) if not isinstance(data, np.ndarray) else _to_list(data)
+        return _to_list(data)

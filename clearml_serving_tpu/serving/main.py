@@ -1,0 +1,273 @@
+"""Serving HTTP router (aiohttp).
+
+Route surface parity with the reference FastAPI app
+(clearml_serving/serving/main.py:1-233):
+
+- ``POST /serve/{endpoint}``, ``/serve/{endpoint}/{version}``;
+- OpenAI-compatible ``POST|GET /serve/openai/{endpoint_type...}`` where the
+  path tail (e.g. ``v1/chat/completions``) becomes the serve type and
+  ``body["model"]`` names the endpoint;
+- transparent gzip request decompression;
+- error taxonomy: 404 endpoint-not-found, 422 model/backend/value errors,
+  500 internal (with the instance id in the payload);
+- hardware-OOM policy: crash-and-restart (``os._exit(1)``) unless dev mode
+  (reference main.py:111-123 for CUDA; here RESOURCE_EXHAUSTED / HBM OOM);
+- streaming: engines may return a ``StreamingOutput`` (async generator) which
+  is forwarded as an SSE response through the router unchanged — preserving the
+  pre/process/post hook contract the same way the reference passes vLLM's
+  StreamingResponse through.
+
+The route prefix is configurable via ``TPUSERVE_DEFAULT_SERVE_SUFFIX``
+(default "serve"). Process model: single process, or ``TPUSERVE_NUM_PROCESS``
+forked workers sharing the port via SO_REUSEPORT (gunicorn-equivalent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import json
+import os
+import traceback
+from typing import Any, AsyncIterator, Optional
+
+from aiohttp import web
+
+from .model_request_processor import (
+    EndpointBackendError,
+    EndpointNotFoundException,
+    ModelRequestProcessor,
+    ServingInitializationError,
+)
+from ..engines.base import EndpointModelError
+
+
+class StreamingOutput:
+    """Engine phases may return this to stream SSE chunks through the router.
+
+    ``generator`` yields str (already SSE-framed or raw data lines) or bytes.
+    """
+
+    def __init__(self, generator: AsyncIterator, content_type: str = "text/event-stream"):
+        self.generator = generator
+        self.content_type = content_type
+
+
+class JSONOutput:
+    """Engine phases may return this to control the status code."""
+
+    def __init__(self, payload: Any, status: int = 200):
+        self.payload = payload
+        self.status = status
+
+
+def _instance_id(processor: Optional[ModelRequestProcessor]) -> str:
+    return getattr(processor, "_instance_id", "unknown") if processor else "unknown"
+
+
+def _is_hbm_oom(ex: BaseException) -> bool:
+    """Only XLA allocation failures qualify — never user-code error text
+    (a user exception mentioning 'out of memory' must not kill the process)."""
+    if type(ex).__name__ not in ("XlaRuntimeError", "RuntimeError"):
+        return False
+    text = str(ex)
+    return "RESOURCE_EXHAUSTED" in text and ("hbm" in text.lower() or "allocat" in text.lower())
+
+
+async def _read_body(request: web.Request) -> Any:
+    raw = await request.read()
+    # aiohttp transparently decompresses Content-Encoding: gzip; only
+    # decompress here if the payload still carries the gzip magic (e.g. a
+    # proxy stripped the header, or double-compressed clients).
+    if raw[:2] == b"\x1f\x8b" and (
+        request.headers.get("Content-Encoding", "").lower() == "gzip"
+        or "gzip" in request.headers.get("Content-Type", "")
+    ):
+        raw = gzip.decompress(raw)
+    if not raw:
+        return None
+    content_type = request.headers.get("Content-Type", "")
+    if content_type and "application/json" not in content_type and "text/" not in content_type:
+        return raw  # binary passthrough (e.g. image payloads, reference pytorch example)
+    try:
+        return json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return raw
+
+
+def build_app(processor: ModelRequestProcessor) -> web.Application:
+    app = web.Application(client_max_size=int(os.environ.get("TPUSERVE_MAX_BODY", 64 * 1024 * 1024)))
+    app["processor"] = processor
+    serve_suffix = os.environ.get("TPUSERVE_DEFAULT_SERVE_SUFFIX", "serve").strip("/")
+    dev_mode = bool(os.environ.get("TPUSERVE_DEV_MODE"))
+
+    async def process_with_exceptions(
+        base_url: str, version: Optional[str], body: Any, serve_type: str
+    ) -> web.StreamResponse:
+        try:
+            out = await processor.process_request(
+                base_url=base_url, version=version, request_body=body, serve_type=serve_type
+            )
+        except EndpointNotFoundException as ex:
+            return web.json_response(
+                {"detail": "Error processing request: {}".format(ex)}, status=404
+            )
+        except (EndpointModelError, EndpointBackendError, ValueError) as ex:
+            return web.json_response(
+                {
+                    "detail": "Error processing request: {} {}".format(
+                        type(ex).__name__, ex
+                    ),
+                    "instance": _instance_id(processor),
+                },
+                status=422,
+            )
+        except ServingInitializationError as ex:
+            return web.json_response(
+                {"detail": "Service not ready: {}".format(ex)}, status=500
+            )
+        except Exception as ex:
+            if _is_hbm_oom(ex):
+                # HBM OOM: the compiled state may be poisoned — crash so the
+                # container restart loop brings up a clean process
+                # (reference CUDA-OOM policy, main.py:111-123).
+                if not dev_mode:
+                    traceback.print_exc()
+                    os._exit(1)
+            traceback.print_exc()
+            return web.json_response(
+                {
+                    "detail": "Internal error: {} {}".format(type(ex).__name__, ex),
+                    "instance": _instance_id(processor),
+                },
+                status=500,
+            )
+        if isinstance(out, StreamingOutput):
+            resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": out.content_type,
+                    "Cache-Control": "no-cache",
+                },
+            )
+            return resp, out  # handled by caller (needs the request to prepare)
+        if isinstance(out, JSONOutput):
+            return web.json_response(out.payload, status=out.status)
+        if isinstance(out, (bytes, bytearray)):
+            return web.Response(body=bytes(out), content_type="application/octet-stream")
+        try:
+            return web.json_response(out)
+        except (TypeError, ValueError) as ex:
+            return web.json_response(
+                {
+                    "detail": "Endpoint returned a non-JSON-serializable response "
+                    "({}); return bytes or JSON-compatible types".format(ex),
+                    "instance": _instance_id(processor),
+                },
+                status=500,
+            )
+
+    async def _respond(request: web.Request, result) -> web.StreamResponse:
+        if isinstance(result, tuple):  # streaming
+            resp, out = result
+            await resp.prepare(request)
+            try:
+                async for chunk in out.generator:
+                    if isinstance(chunk, str):
+                        chunk = chunk.encode("utf-8")
+                    await resp.write(chunk)
+            except ConnectionResetError:
+                pass
+            await resp.write_eof()
+            return resp
+        return result
+
+    async def serve_model(request: web.Request) -> web.StreamResponse:
+        tail = request.match_info["tail"].strip("/")
+        body = await _read_body(request)
+        if tail.startswith("openai/"):
+            # OpenAI-compatible: serve type is the path, endpoint is body.model
+            serve_type = tail[len("openai/"):]
+            if not isinstance(body, dict) or not body.get("model"):
+                return web.json_response(
+                    {"detail": "OpenAI route requires a JSON body with a 'model' field"},
+                    status=422,
+                )
+            result = await process_with_exceptions(
+                base_url=str(body["model"]), version=None, body=body, serve_type=serve_type
+            )
+            return await _respond(request, result)
+        parts = tail.split("/")
+        # longest-match: try full tail as endpoint, else endpoint/version split
+        version = None
+        base_url = tail
+        if len(parts) > 1:
+            # membership-only check on the live dicts (no per-request copies)
+            if tail not in processor._endpoints and tail not in processor._model_monitoring_endpoints:
+                base_url, version = "/".join(parts[:-1]), parts[-1]
+        result = await process_with_exceptions(
+            base_url=base_url, version=version, body=body, serve_type="process"
+        )
+        return await _respond(request, result)
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "status": "ok",
+                "instance": _instance_id(processor),
+                "endpoints": sorted(processor.list_endpoints()),
+            }
+        )
+
+    app.router.add_post("/{}/{{tail:.+}}".format(serve_suffix), serve_model)
+    app.router.add_get("/{}/{{tail:openai/.+}}".format(serve_suffix), serve_model)
+    app.router.add_get("/health", health)
+    app.router.add_get("/", health)
+    return app
+
+
+def setup_processor() -> ModelRequestProcessor:
+    """Resolve the control-plane service (env TPUSERVE_SERVICE_ID, or the most
+    recent service) and launch the sync/stats daemons
+    (reference init.py setup_task + startup_event)."""
+    from ..engines import load_engine_modules
+
+    load_engine_modules()
+    service_id = os.environ.get("TPUSERVE_SERVICE_ID") or os.environ.get(
+        "CLEARML_SERVING_TASK_ID"
+    )
+    processor = ModelRequestProcessor(service_id=service_id or None)
+    poll_freq_min = float(os.environ.get("TPUSERVE_POLL_FREQ", 5.0))
+    processor.launch(poll_frequency_sec=poll_freq_min * 60.0)
+    return processor
+
+
+def main() -> None:
+    port = int(os.environ.get("TPUSERVE_PORT", 8080))
+    host = os.environ.get("TPUSERVE_HOST", "0.0.0.0")
+    num_proc = int(os.environ.get("TPUSERVE_NUM_PROCESS", 1))
+
+    if num_proc > 1:
+        # gunicorn-equivalent pre-fork model: N workers share the port via
+        # SO_REUSEPORT; each builds its own processor post-fork.
+        import multiprocessing
+
+        def _worker():
+            processor = setup_processor()
+            web.run_app(
+                build_app(processor), host=host, port=port, reuse_port=True,
+                print=None,
+            )
+
+        procs = [multiprocessing.Process(target=_worker) for _ in range(num_proc)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+    else:
+        processor = setup_processor()
+        web.run_app(build_app(processor), host=host, port=port)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ import gc
 import itertools
 import os
 import random
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -49,6 +50,10 @@ from ..engines.base import BaseEngineRequest
 from ..state import ModelRegistry, ServingService, StateStore
 from ..utils.files import sha256_obj
 from ..version import __version__
+
+
+# serve-type dispatch allowlist: v1_chat_completions, v2_embeddings, ...
+_SERVE_TYPE_RE = re.compile(r"^v\d+_[a-z][a-z0-9_]*$")
 
 
 class EndpointNotFoundException(Exception):
@@ -127,12 +132,16 @@ class ModelRequestProcessor:
         state_root: Optional[str] = None,
         force_create: bool = False,
         name: Optional[str] = None,
+        project: Optional[str] = None,
+        tags: Optional[List[str]] = None,
         update_lock_guard: Optional[threading.Lock] = None,
     ):
         self._store = StateStore(state_root)
         self._registry = ModelRegistry(self._store.root)
         if force_create:
-            self._service = self._store.create_service(name or "tpu-serving", project="DevOps")
+            self._service = self._store.create_service(
+                name or "tpu-serving", project=project or "DevOps", tags=tags
+            )
         elif service_id:
             self._service = self._store.get_service(service_id)
         else:
@@ -647,7 +656,17 @@ class ModelRequestProcessor:
             # e.g. "v1/chat/completions" -> processor.v1_chat_completions
             # (reference :1327-1339).
             method_name = serve_type.replace("/", "_").replace(".", "_")
+            # Allowlist: only versioned API handler names (v1_*, v2_* ...) are
+            # dispatchable — a URL-derived name must never reach lifecycle or
+            # dunder attributes (e.g. /serve/openai/__class__ or /unload).
+            if not _SERVE_TYPE_RE.match(method_name):
+                raise EndpointBackendError(
+                    "invalid serve type {!r}".format(serve_type)
+                )
             method = getattr(processor, method_name, None)
+            if method is None and processor._preprocess is not None:
+                # user Preprocess code may implement the OpenAI-style handler
+                method = getattr(processor._preprocess, method_name, None)
             if method is None:
                 raise EndpointBackendError(
                     "endpoint engine {!r} does not support serve type {!r}".format(
@@ -692,7 +711,9 @@ class ModelRequestProcessor:
         """Initial sync + background sync daemon + stats sender
         (reference :951-1047)."""
         self._poll_frequency_sec = poll_frequency_sec
-        self.deserialize(prefetch_artifacts=False)
+        # Prefetch at startup: engine construction (model load + jit compile)
+        # must happen here, not lazily on the event loop's first request.
+        self.deserialize(prefetch_artifacts=True)
         self._update_monitored_models()
         self._stop_event.clear()
         self._sync_daemon = threading.Thread(target=self._sync_daemon_loop, daemon=True)

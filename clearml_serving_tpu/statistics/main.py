@@ -1,0 +1,47 @@
+"""Statistics service entrypoint (reference clearml_serving/statistics/main.py).
+
+Consumes the stats broker and exposes a Prometheus scrape endpoint on
+``TPUSERVE_STATS_PORT`` (default 9999, same as the reference). Prometheus
+scrapes this + Grafana dashboards sit on top (docker/ provisioning).
+"""
+
+from __future__ import annotations
+
+import os
+
+from prometheus_client import start_http_server
+
+from .metrics import StatisticsController
+from ..serving.model_request_processor import ModelRequestProcessor
+
+
+def main() -> None:
+    service_id = os.environ.get("TPUSERVE_SERVICE_ID") or None
+    broker_url = os.environ.get("TPUSERVE_STATS_BROKER", "")
+    port = int(os.environ.get("TPUSERVE_STATS_PORT", 9999))
+    poll_freq_min = float(os.environ.get("TPUSERVE_POLL_FREQ", 1.0))
+
+    processor = None
+    try:
+        processor = ModelRequestProcessor(service_id=service_id)
+        if not broker_url:
+            broker_url = processor._service.get_parameters().get("stats_broker") or ""
+    except Exception as ex:
+        print("statistics: no control-plane service ({}) — reserved metrics only".format(ex))
+
+    if not broker_url:
+        raise SystemExit(
+            "statistics: no stats broker configured "
+            "(TPUSERVE_STATS_BROKER or `tpu-serving config --stats-broker`)"
+        )
+
+    start_http_server(port)
+    print("statistics: Prometheus scrape endpoint on :{}".format(port))
+    controller = StatisticsController(
+        broker_url, processor=processor, poll_frequency_sec=poll_freq_min * 60.0
+    )
+    controller.start()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,234 @@
+"""Statistics controller: broker → Prometheus collectors.
+
+Capability parity with the reference's StatisticsController
+(clearml_serving/statistics/metrics.py:188-373):
+
+- consumes the stats topic, lazily creating one Prometheus collector per
+  (endpoint, variable), named ``{endpoint}:{variable}`` sanitized;
+- reserved variables: ``_latency`` → histogram with the reference's 5ms…5s
+  buckets, ``_count`` → counter (weighted by the sampling-unbias factor);
+- metric-spec types: scalar → bucketed Histogram, enum → labeled Counter,
+  value → Gauge, counter → Counter;
+- endpoints it doesn't know get auto-added with reserved-only logging and a
+  throttled config re-sync;
+- a sync daemon polls the control plane for metric-spec updates.
+
+TPU addition (SURVEY.md §5.1/§5.5): per-chip HBM gauges sourced from
+``jax.local_devices()[i].memory_stats()`` — the bytes-in-use / bytes-limit
+pair is the serving fleet's north-star memory signal.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from prometheus_client import Counter, Gauge, Histogram, REGISTRY
+
+from .broker import make_consumer
+
+_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.25, 0.5, 0.75,
+    1.0, 2.5, 5.0, float("inf"),
+)
+
+_name_re = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    return _name_re.sub("_", name)
+
+
+class StatisticsController:
+    _sync_threshold_sec = 30.0
+
+    def __init__(
+        self,
+        broker_url: str,
+        processor=None,  # ModelRequestProcessor for metric-spec sync (optional)
+        registry=REGISTRY,
+        poll_frequency_sec: float = 60.0,
+    ):
+        self._consumer = make_consumer(broker_url)
+        self._processor = processor
+        self._registry = registry
+        self._poll_frequency_sec = poll_frequency_sec
+        self._collectors: Dict[str, Dict[str, Any]] = {}
+        self._metric_specs: Dict[str, Dict[str, dict]] = {}
+        self._last_sync = 0.0
+        self._stop_event = threading.Event()
+        self._device_gauges_ready = False
+
+    # -- spec sync -----------------------------------------------------------
+
+    def sync_specs(self) -> None:
+        if self._processor is None:
+            return
+        try:
+            self._processor.deserialize(skip_sync=True)
+        except Exception:
+            pass
+        specs: Dict[str, Dict[str, dict]] = {}
+        for name, spec in self._processor.list_endpoint_logging().items():
+            specs[name] = {k: v.as_dict() for k, v in spec.metrics.items()}
+        self._metric_specs = specs
+        self._last_sync = time.time()
+        # Drop cached "no spec" sentinels so variables whose spec arrived after
+        # their first observation start exporting without a restart.
+        for per_ep in self._collectors.values():
+            for variable in [k for k, v in per_ep.items() if v is None]:
+                del per_ep[variable]
+
+    def _spec_for(self, url: str) -> Dict[str, dict]:
+        if url in self._metric_specs:
+            return self._metric_specs[url]
+        for name, metrics in self._metric_specs.items():
+            if name.endswith("/*") and url.startswith(name[:-1]):
+                return metrics
+        # unknown endpoint: reserved-only logging + throttled re-sync
+        if time.time() - self._last_sync > self._sync_threshold_sec:
+            self.sync_specs()
+            if url in self._metric_specs:
+                return self._metric_specs[url]
+        return {}
+
+    # -- collectors -----------------------------------------------------------
+
+    def _collector(self, url: str, variable: str) -> Optional[Any]:
+        per_ep = self._collectors.setdefault(url, {})
+        if variable in per_ep:
+            return per_ep[variable]
+        full_name = _sanitize("{}:{}".format(url, variable))
+        collector = None
+        if variable == "_latency":
+            collector = ("histogram", Histogram(
+                full_name, "Request latency for {}".format(url),
+                buckets=_LATENCY_BUCKETS, registry=self._registry,
+            ))
+        elif variable == "_count":
+            collector = ("counter", Counter(
+                full_name, "Estimated request count for {}".format(url),
+                registry=self._registry,
+            ))
+        else:
+            spec = self._spec_for(url).get(variable)
+            if spec is None:
+                per_ep[variable] = None
+                return None
+            mtype = spec.get("type", "value")
+            if mtype == "scalar":
+                buckets = sorted(float(b) for b in (spec.get("buckets") or []))
+                if not buckets:
+                    buckets = list(_LATENCY_BUCKETS)
+                if buckets[-1] != float("inf"):
+                    buckets.append(float("inf"))
+                collector = ("histogram", Histogram(
+                    full_name, "scalar {} for {}".format(variable, url),
+                    buckets=buckets, registry=self._registry,
+                ))
+            elif mtype == "enum":
+                collector = ("enum", Counter(
+                    full_name, "enum {} for {}".format(variable, url),
+                    labelnames=("value",), registry=self._registry,
+                ))
+            elif mtype == "counter":
+                collector = ("counter", Counter(
+                    full_name, "counter {} for {}".format(variable, url),
+                    registry=self._registry,
+                ))
+            else:
+                collector = ("gauge", Gauge(
+                    full_name, "value {} for {}".format(variable, url),
+                    registry=self._registry,
+                ))
+        per_ep[variable] = collector
+        return collector
+
+    def _observe(self, url: str, variable: str, value: Any, count_weight: int) -> None:
+        entry = self._collector(url, variable)
+        if entry is None:
+            return
+        kind, collector = entry
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for v in values:
+            try:
+                if kind == "histogram":
+                    collector.observe(float(v))
+                elif kind == "enum":
+                    collector.labels(value=str(v)).inc()
+                elif kind == "counter":
+                    collector.inc(float(v))
+                else:
+                    collector.set(float(v))
+            except (TypeError, ValueError):
+                continue
+
+    # -- consumption -----------------------------------------------------------
+
+    def process_batch(self, batch) -> int:
+        n = 0
+        for stats in batch:
+            url = stats.get("_url")
+            if not url:
+                continue
+            count_weight = int(stats.get("_count", 1))
+            for variable, value in stats.items():
+                if variable == "_url":
+                    continue
+                if variable == "_count":
+                    entry = self._collector(url, "_count")
+                    if entry:
+                        entry[1].inc(count_weight)
+                    continue
+                self._observe(url, variable, value, count_weight)
+            n += 1
+        return n
+
+    def update_device_gauges(self) -> None:
+        """Per-chip HBM gauges (no-op on backends without memory_stats)."""
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return
+        if not self._device_gauges_ready:
+            self._hbm_used = Gauge(
+                "tpu_hbm_bytes_in_use", "HBM bytes in use", labelnames=("device",),
+                registry=self._registry,
+            )
+            self._hbm_limit = Gauge(
+                "tpu_hbm_bytes_limit", "HBM bytes limit", labelnames=("device",),
+                registry=self._registry,
+            )
+            self._device_gauges_ready = True
+        for d in devices:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                continue
+            if "bytes_in_use" in stats:
+                self._hbm_used.labels(device=str(d.id)).set(stats["bytes_in_use"])
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if limit:
+                self._hbm_limit.labels(device=str(d.id)).set(limit)
+
+    def start(self) -> None:
+        """Blocking consume loop (run in the statistics container main)."""
+        self.sync_specs()
+        last_spec_sync = time.time()
+        while not self._stop_event.is_set():
+            batch = self._consumer.poll() if self._consumer else []
+            if batch:
+                self.process_batch(batch)
+            self.update_device_gauges()
+            if time.time() - last_spec_sync > self._poll_frequency_sec:
+                self.sync_specs()
+                last_spec_sync = time.time()
+            if not batch:
+                self._stop_event.wait(timeout=1.0)
+
+    def stop(self) -> None:
+        self._stop_event.set()

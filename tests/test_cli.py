@@ -1,0 +1,124 @@
+import json
+
+import pytest
+
+from clearml_serving_tpu.__main__ import cli
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+ECHO_CODE = """
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        return {"echo": data}
+"""
+
+
+@pytest.fixture()
+def svc_id(state_root, capsys):
+    assert cli(["create", "--name", "cli-test"]) == 0
+    out = capsys.readouterr().out
+    return out.strip().rsplit("id=", 1)[-1]
+
+
+def test_create_and_list(svc_id, capsys):
+    assert cli(["list"]) == 0
+    services = json.loads(capsys.readouterr().out)
+    assert any(s["id"] == svc_id for s in services)
+
+
+def test_model_upload_add_remove(svc_id, tmp_path, capsys):
+    code = tmp_path / "pre.py"
+    code.write_text(ECHO_CODE)
+    payload = tmp_path / "model.bin"
+    payload.write_bytes(b"x")
+
+    assert cli(["--yes", "--id", svc_id, "model", "upload", "--name", "m1",
+                "--project", "p", "--path", str(payload), "--publish"]) == 0
+    model_id = capsys.readouterr().out.strip().split("id=")[1].split()[0]
+
+    assert cli(["--yes", "--id", svc_id, "model", "add", "--engine", "custom",
+                "--endpoint", "test_model", "--model-id", model_id,
+                "--preprocess", str(code)]) == 0
+    capsys.readouterr()
+
+    # model query path (--name instead of --model-id)
+    assert cli(["--yes", "--id", svc_id, "model", "add", "--engine", "custom",
+                "--endpoint", "test_model2", "--name", "m1", "--project", "p",
+                "--published", "--preprocess", str(code)]) == 0
+    out = capsys.readouterr().out
+    assert model_id in out
+
+    assert cli(["--yes", "--id", svc_id, "model", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert "test_model" in listed["endpoints"]
+    assert listed["endpoints"]["test_model"]["model_id"] == model_id
+
+    assert cli(["--yes", "--id", svc_id, "model", "remove",
+                "--endpoint", "test_model"]) == 0
+    capsys.readouterr()
+    assert cli(["--yes", "--id", svc_id, "model", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert "test_model" not in listed["endpoints"]
+
+    with pytest.raises(SystemExit):
+        cli(["--yes", "--id", svc_id, "model", "remove", "--endpoint", "ghost"])
+
+
+def test_canary_and_auto_update(svc_id, tmp_path, capsys):
+    code = tmp_path / "pre.py"
+    code.write_text(ECHO_CODE)
+    assert cli(["--yes", "--id", svc_id, "model", "auto-update", "--engine", "custom",
+                "--endpoint", "auto_m", "--project", "prod", "--max-versions", "2",
+                "--preprocess", str(code)]) == 0
+    assert cli(["--yes", "--id", svc_id, "model", "canary", "--endpoint", "auto_m",
+                "--weights", "0.1", "0.9",
+                "--input-endpoint-prefix", "auto_m/"]) == 0
+    capsys.readouterr()
+    assert cli(["--yes", "--id", svc_id, "model", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert "auto_m" in listed["model_monitoring"]
+    assert "auto_m" in listed["canary"]
+
+
+def test_config_and_metrics(svc_id, tmp_path, capsys):
+    assert cli(["--yes", "--id", svc_id, "config",
+                "--base-serve-url", "http://127.0.0.1:9090/serve",
+                "--metric-log-freq", "0.5"]) == 0
+    assert cli(["--yes", "--id", svc_id, "metrics", "add", "--endpoint", "test_model",
+                "--log-freq", "1.0",
+                "--variable-scalar", "x0=0/1/0.25", "x1=0,1,2,5",
+                "--variable-enum", "label=cat,dog",
+                "--variable-value", "rawval"]) == 0
+    capsys.readouterr()
+    assert cli(["--yes", "--id", svc_id, "metrics", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    spec = listed["test_model"]
+    assert spec["metrics"]["x0"]["buckets"] == [0.0, 0.25, 0.5, 0.75, 1.0]
+    assert spec["metrics"]["x1"]["buckets"] == [0.0, 1.0, 2.0, 5.0]
+    assert spec["metrics"]["label"]["type"] == "enum"
+    assert spec["metrics"]["rawval"]["type"] == "value"
+
+    # verify the config param round-trips into a processor
+    mrp = ModelRequestProcessor(service_id=svc_id)
+    mrp.deserialize(skip_sync=True)
+    assert mrp._serving_base_url == "http://127.0.0.1:9090/serve"
+    assert mrp._metric_log_freq == 0.5
+
+    assert cli(["--yes", "--id", svc_id, "metrics", "remove", "--endpoint", "test_model",
+                "--variable", "x1"]) == 0
+    capsys.readouterr()
+    assert cli(["--yes", "--id", svc_id, "metrics", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    assert "x1" not in listed["test_model"]["metrics"]
+
+
+def test_aux_config_kv(svc_id, tmp_path, capsys):
+    code = tmp_path / "pre.py"
+    code.write_text(ECHO_CODE)
+    assert cli(["--yes", "--id", svc_id, "model", "add", "--engine", "custom",
+                "--endpoint", "aux_ep", "--preprocess", str(code),
+                "--aux-config", "batching.buckets=[1,2,4]", "mesh.tp=8"]) == 0
+    capsys.readouterr()
+    assert cli(["--yes", "--id", svc_id, "model", "list"]) == 0
+    listed = json.loads(capsys.readouterr().out)
+    aux = listed["endpoints"]["aux_ep"]["auxiliary_cfg"]
+    assert aux == {"batching": {"buckets": [1, 2, 4]}, "mesh": {"tp": 8}}

@@ -1,0 +1,193 @@
+import asyncio
+import gzip
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.main import build_app
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+ECHO_CODE = """
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        return {"echo": data}
+"""
+
+STREAM_CODE = """
+from clearml_serving_tpu.serving.main import StreamingOutput
+
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        async def gen():
+            for i in range(3):
+                yield f"data: chunk{i}\\n\\n"
+        return StreamingOutput(gen())
+"""
+
+OPENAI_CODE = """
+class Preprocess:
+    def v1_chat_completions(self, data, state, collect_fn):
+        return {"choices": [{"message": {"content": "hi from " + data["model"]}}]}
+"""
+
+
+@pytest.fixture()
+def served(state_root, tmp_path):
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="t")
+    for name, code in (("echo", ECHO_CODE), ("stream", STREAM_CODE), ("oai", OPENAI_CODE)):
+        f = tmp_path / (name + ".py")
+        f.write_text(code)
+        mrp.add_endpoint(
+            ModelEndpoint(engine_type="custom", serving_url=name),
+            preprocess_code=str(f),
+        )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def _run(served, fn):
+    async def runner():
+        app = build_app(served)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_serve_endpoint(served):
+    async def fn(client):
+        r = await client.post("/serve/echo", json={"x": 1})
+        assert r.status == 200
+        return await r.json()
+
+    assert _run(served, fn) == {"echo": {"x": 1}}
+
+
+def test_404(served):
+    async def fn(client):
+        r = await client.post("/serve/ghost", json={})
+        assert r.status == 404
+        body = await r.json()
+        assert "not found" in body["detail"]
+
+    _run(served, fn)
+
+
+def test_422_on_custom_without_process(served, tmp_path):
+    f = tmp_path / "empty.py"
+    f.write_text("class Preprocess:\n    pass\n")
+    served.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="noproc"), preprocess_code=str(f)
+    )
+
+    async def fn(client):
+        r = await client.post("/serve/noproc", json={})
+        assert r.status == 422
+
+    _run(served, fn)
+
+
+def test_gzip_request(served):
+    async def fn(client):
+        payload = gzip.compress(json.dumps({"z": 9}).encode())
+        r = await client.post(
+            "/serve/echo",
+            data=payload,
+            headers={"Content-Encoding": "gzip", "Content-Type": "application/json"},
+        )
+        assert r.status == 200
+        return await r.json()
+
+    assert _run(served, fn) == {"echo": {"z": 9}}
+
+
+def test_sse_streaming(served):
+    async def fn(client):
+        r = await client.post("/serve/stream", json={})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        text = await r.text()
+        return text
+
+    text = _run(served, fn)
+    assert text == "data: chunk0\n\ndata: chunk1\n\ndata: chunk2\n\n"
+
+
+def test_openai_route(served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json={"model": "oai", "messages": [{"role": "user", "content": "hello"}]},
+        )
+        assert r.status == 200
+        return await r.json()
+
+    out = _run(served, fn)
+    assert out["choices"][0]["message"]["content"] == "hi from oai"
+
+
+def test_openai_route_requires_model(served):
+    async def fn(client):
+        r = await client.post("/serve/openai/v1/chat/completions", json={"messages": []})
+        assert r.status == 422
+
+    _run(served, fn)
+
+
+def test_openai_unsupported_serve_type(served):
+    async def fn(client):
+        r = await client.post("/serve/openai/v1/embeddings", json={"model": "oai"})
+        assert r.status == 422
+        body = await r.json()
+        assert "does not support serve type" in body["detail"]
+
+    _run(served, fn)
+
+
+def test_health(served):
+    async def fn(client):
+        r = await client.get("/health")
+        assert r.status == 200
+        return await r.json()
+
+    body = _run(served, fn)
+    assert body["status"] == "ok"
+    assert "echo" in body["endpoints"]
+
+
+def test_versioned_endpoint_path(served, tmp_path):
+    f = tmp_path / "v.py"
+    f.write_text(ECHO_CODE)
+    served.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="vmod", version="3"),
+        preprocess_code=str(f),
+    )
+
+    async def fn(client):
+        r = await client.post("/serve/vmod/3", json={"ok": True})
+        assert r.status == 200
+        return await r.json()
+
+    assert _run(served, fn) == {"echo": {"ok": True}}
+
+
+def test_binary_body_passthrough(served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/echo", data=b"\x89PNG...", headers={"Content-Type": "application/octet-stream"}
+        )
+        assert r.status == 500
+        return await r.text()
+
+    # The echo preprocess wraps raw bytes in a dict, which is not
+    # JSON-serializable — the router must degrade to a clean 500 JSON payload,
+    # not an unhandled exception.
+    text = _run(served, fn)
+    assert "non-JSON-serializable" in text

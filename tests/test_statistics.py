@@ -1,0 +1,91 @@
+import pytest
+from prometheus_client import CollectorRegistry
+
+from clearml_serving_tpu.serving.endpoints import EndpointMetricLogging, MetricType
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+from clearml_serving_tpu.statistics.broker import (
+    FileBrokerConsumer,
+    FileBrokerProducer,
+    make_consumer,
+    make_producer,
+)
+from clearml_serving_tpu.statistics.metrics import StatisticsController
+
+
+def test_file_broker_roundtrip(tmp_path):
+    producer = FileBrokerProducer(str(tmp_path / "b"))
+    consumer = FileBrokerConsumer(str(tmp_path / "b"))
+    producer.send_batch([{"_url": "e", "_latency": 0.1}, {"_url": "e", "_count": 2}])
+    out = consumer.poll()
+    assert len(out) == 2
+    # offsets: re-poll returns nothing new
+    assert consumer.poll() == []
+    producer.send_batch([{"_url": "e2"}])
+    assert len(consumer.poll()) == 1
+
+
+def test_broker_url_scheme(tmp_path):
+    assert make_producer("") is None
+    assert make_consumer("") is None
+    p = make_producer("file://{}".format(tmp_path / "x"))
+    c = make_consumer("file://{}".format(tmp_path / "x"))
+    p.send_batch([{"_url": "a"}])
+    assert c.poll() == [{"_url": "a"}]
+
+
+def _get_sample(registry, name, suffix="", labels=None):
+    value = registry.get_sample_value(name + suffix, labels or {})
+    return value
+
+
+def test_statistics_controller(tmp_path, state_root):
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="s")
+    mrp.add_metric_logging(
+        EndpointMetricLogging(
+            endpoint="ep1",
+            metrics={
+                "x0": MetricType(type="scalar", buckets=[0, 1, 2, 3]),
+                "label": MetricType(type="enum", buckets=["cat", "dog"]),
+                "conf": MetricType(type="value"),
+                "hits": MetricType(type="counter"),
+            },
+        )
+    )
+    mrp.serialize()
+
+    registry = CollectorRegistry()
+    ctl = StatisticsController("file://{}".format(tmp_path / "b"), processor=mrp, registry=registry)
+    ctl.sync_specs()
+    n = ctl.process_batch(
+        [
+            {"_url": "ep1", "_latency": 0.05, "_count": 10, "x0": 1.5,
+             "label": "cat", "conf": 0.9, "hits": 3},
+            {"_url": "ep1", "_latency": 0.2, "_count": 10, "x0": [0.5, 2.5],
+             "label": "dog", "conf": 0.4, "hits": 2},
+        ]
+    )
+    assert n == 2
+    assert _get_sample(registry, "ep1__latency", "_count") == 2.0
+    assert _get_sample(registry, "ep1__count", "_total") == 20.0
+    assert _get_sample(registry, "ep1_x0", "_count") == 3.0  # list observed per-value
+    assert _get_sample(registry, "ep1_label", "_total", {"value": "cat"}) == 1.0
+    assert _get_sample(registry, "ep1_conf") == 0.4  # gauge keeps last
+    assert _get_sample(registry, "ep1_hits", "_total") == 5.0
+
+
+def test_unknown_endpoint_reserved_only(tmp_path, state_root):
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="s2")
+    mrp.serialize()
+    registry = CollectorRegistry()
+    ctl = StatisticsController("file://{}".format(tmp_path / "b"), processor=mrp, registry=registry)
+    ctl.sync_specs()
+    ctl.process_batch([{"_url": "mystery", "_latency": 0.1, "_count": 1, "custom": 5}])
+    assert _get_sample(registry, "mystery__latency", "_count") == 1.0
+    # unknown variable without a spec is dropped
+    assert _get_sample(registry, "mystery_custom") is None
+
+
+def test_device_gauges_no_crash(tmp_path):
+    registry = CollectorRegistry()
+    ctl = StatisticsController("", registry=registry)
+    ctl.update_device_gauges()  # CPU backend: must not raise
