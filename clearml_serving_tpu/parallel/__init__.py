@@ -1,0 +1,14 @@
+from .mesh import make_mesh, mesh_from_aux_cfg
+from .sharding import (
+    llama_param_sharding,
+    llama_cache_sharding,
+    shard_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "mesh_from_aux_cfg",
+    "llama_param_sharding",
+    "llama_cache_sharding",
+    "shard_params",
+]

@@ -1,0 +1,74 @@
+"""Device-mesh construction for the serving engines.
+
+The reference scales out with replica containers + vLLM-internal TP configured
+opaquely through engine-args JSON (SURVEY.md §2.9 "Parallelism strategies").
+Here parallelism is first-class: every tensor engine accepts a per-endpoint
+``aux_config["mesh"]`` block (e.g. ``{"dp": 2, "tp": 4}``) that maps onto a
+`jax.sharding.Mesh` whose collectives ride ICI within a slice.
+
+Axis vocabulary (used consistently across sharding rules and kernels):
+  dp — data/batch parallel     tp — tensor parallel (heads / ffn)
+  sp — sequence/context parallel (ring attention)   ep — expert parallel (MoE)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+AXES = ("dp", "tp", "sp", "ep")
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh over `devices` (default: all local devices).
+
+    ``axis_sizes`` maps axis name -> size; a single axis may be -1 meaning
+    "whatever is left". Axes of size 1 are kept (so sharding rules can always
+    reference every axis name).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axis_sizes or {})
+    for ax in AXES:
+        sizes.setdefault(ax, 1)
+    # resolve a single -1
+    unknown = [ax for ax, s in sizes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    known = int(np.prod([s for s in sizes.values() if s != -1]))
+    if unknown:
+        if n % known:
+            raise ValueError(
+                "cannot infer {}: {} devices not divisible by {}".format(unknown[0], n, known)
+            )
+        sizes[unknown[0]] = n // known
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            "mesh {} needs {} devices, have {}".format(sizes, total, n)
+        )
+    shape = tuple(sizes[ax] for ax in AXES)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def mesh_from_aux_cfg(aux_cfg: Optional[dict]):
+    """Per-endpoint mesh from the aux-config block (None -> single-device-style
+    mesh over all local devices with tp=-1 if >1 device and no spec given)."""
+    spec = {}
+    if isinstance(aux_cfg, dict):
+        spec = dict(aux_cfg.get("mesh") or {})
+    if not spec:
+        spec = {"tp": -1}  # default: pure tensor-parallel over the local slice
+    return make_mesh(spec)

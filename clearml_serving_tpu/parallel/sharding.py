@@ -1,0 +1,79 @@
+"""Sharding rules: parameter/cache PartitionSpecs for the model zoo.
+
+Megatron-style tensor parallelism for the Llama decoder, expressed as GSPMD
+sharding annotations — XLA inserts the all-reduces over ICI; no hand-written
+collectives (SURVEY.md §5.8 "TPU-native equivalent"):
+
+- wq/wk/wv: shard the head (output) dimension over `tp`;
+- wo: shard the input dimension over `tp` (row-parallel; XLA emits one
+  all-reduce per layer after the attention output matmul);
+- w_gate/w_up column-parallel, w_down row-parallel (second all-reduce);
+- embed/lm_head: shard the vocab dimension;
+- KV cache: shard the kv-head dimension over `tp`, batch over `dp`.
+
+Weights replicate over `dp`; activations shard batch over `dp` via the data
+layout (requests land on their dp shard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    """NamedSharding pytree matching a llama param pytree."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer_spec = {
+        "attn_norm": ns(),
+        "wq": ns(None, "tp"),
+        "wk": ns(None, "tp"),
+        "wv": ns(None, "tp"),
+        "wo": ns("tp", None),
+        "ffn_norm": ns(),
+        "w_gate": ns(None, "tp"),
+        "w_up": ns(None, "tp"),
+        "w_down": ns("tp", None),
+    }
+    out: Dict[str, Any] = {
+        "embed": ns("tp", None),        # vocab-sharded lookup; gathered by XLA
+        "final_norm": ns(),
+        "layers": [dict(layer_spec) for _ in params["layers"]],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def llama_cache_sharding(mesh) -> Dict[str, Any]:
+    """Dense KV cache [L, B, T, Hkv, D]: batch over dp, kv heads over tp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kv = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+    return {"k": kv, "v": kv, "length": NamedSharding(mesh, P("dp"))}
+
+
+def shard_params(mesh, params: Dict[str, Any], shardings: Dict[str, Any]):
+    """Place a param pytree onto the mesh per the sharding pytree."""
+    import jax
+
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, s), params, shardings,
+        is_leaf=lambda x: not isinstance(x, (dict, list)),
+    )
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh):
+    """Activations/tokens: shard the leading batch dim over dp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("dp"))

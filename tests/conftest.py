@@ -13,6 +13,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The serving image preloads jax via sitecustomize, so the env vars above can
+# arrive after import. The config knobs below still apply as long as the
+# backend itself has not been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import pytest  # noqa: E402
 
 
