@@ -1,0 +1,340 @@
+"""Continuous-batching LLM engine core (JetStream-style; replaces vLLM).
+
+Design (TPU-first, SURVEY.md §7 step 6):
+
+- **Slot-based decode batch**: a fixed ``max_batch`` of cache slots; the decode
+  step is ONE jitted function over the full slot batch (static shapes — no
+  recompilation as requests come and go). Inactive slots compute garbage that
+  is never read; occupancy, not shapes, varies.
+- **Bucketed prefill**: prompts pad to the next seq-len bucket; one compiled
+  prefill per bucket. Prefill emits KV shaped [L,1,bucket,H,D] which a jitted
+  donate-insert writes into the slot's region of the big cache — the cache
+  lives in HBM across the whole request lifetime, is donated through every
+  step, and is never copied host-side.
+- **Continuous batching loop**: an asyncio task interleaves admissions
+  (prefill) with decode steps; each step's sampled tokens fan out to
+  per-request queues (SSE streaming sits directly on top).
+- **Sampling as data**: per-slot temperature/top-k/top-p arrays — one compiled
+  sampler for any mix of requests.
+- Optional ``jax.sharding.Mesh``: params/cache get TP/DP shardings from
+  parallel/sharding.py; GSPMD handles the collectives; the loop is unchanged.
+
+The reference's equivalent surface is vLLM's AsyncLLM behind
+VllmPreprocessRequest (reference preprocess_service.py:619-1348).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import SamplingParams, sample_tokens
+
+_DEFAULT_PREFILL_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048]
+
+
+@dataclass
+class GenRequest:
+    prompt_ids: List[int]
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token_ids: Optional[List[int]] = None
+    # filled by the engine:
+    out_queue: "asyncio.Queue" = field(default_factory=asyncio.Queue)
+    produced: int = 0
+    prompt_len: int = 0
+    submitted_at: float = field(default_factory=time.time)
+    first_token_at: Optional[float] = None
+    error: Optional[BaseException] = None
+
+
+_FINISHED = object()
+
+
+class LLMEngineCore:
+    """Slot-based continuous batching over a dense per-slot KV cache."""
+
+    def __init__(
+        self,
+        bundle,
+        params,
+        *,
+        max_batch: int = 8,
+        max_seq_len: int = 2048,
+        prefill_buckets: Optional[List[int]] = None,
+        mesh=None,
+        eos_token_id: Optional[int] = None,
+        rng_seed: int = 0,
+    ):
+        self.bundle = bundle
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.eos_token_id = eos_token_id
+        self._buckets = sorted(
+            b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
+        ) or [max_seq_len]
+        self._mesh = mesh
+
+        if mesh is not None:
+            from ..parallel.sharding import (
+                llama_cache_sharding,
+                llama_param_sharding,
+                shard_params,
+            )
+
+            self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
+            self._cache_sharding = llama_cache_sharding(mesh)
+        else:
+            self.params = params
+            self._cache_sharding = None
+
+        self.cache = bundle.init_cache(self.max_batch, self.max_seq_len)
+        if self._cache_sharding is not None:
+            self.cache = {
+                k: jax.device_put(v, self._cache_sharding[k]) for k, v in self.cache.items()
+            }
+
+        # slot bookkeeping (host side)
+        self._slot_req: List[Optional[GenRequest]] = [None] * self.max_batch
+        self._next_token = np.zeros(self.max_batch, np.int32)
+        self._temperature = np.zeros(self.max_batch, np.float32)
+        self._top_k = np.zeros(self.max_batch, np.int32)
+        self._top_p = np.ones(self.max_batch, np.float32)
+
+        self._pending: "asyncio.Queue[GenRequest]" = asyncio.Queue()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._step_counter = itertools.count()
+        self._stopped = False
+        self._prefill_templates: Dict[int, Any] = {}
+
+        # -- compiled functions --------------------------------------------
+
+        def _prefill(params, tokens, seq_lens, cache_template):
+            return bundle.prefill(params, tokens, seq_lens, cache_template)
+
+        self._prefill_jit = jax.jit(_prefill)
+
+        def _insert(cache, k_new, v_new, length, slot):
+            k = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(cache["v"], v_new, (0, slot, 0, 0, 0))
+            lengths = jax.lax.dynamic_update_slice(
+                cache["length"], length[None].astype(jnp.int32), (slot,)
+            )
+            return {"k": k, "v": v, "length": lengths}
+
+        self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+
+        def _decode(params, tokens, cache, active):
+            old_len = cache["length"]
+            logits, cache = bundle.decode(params, tokens, cache)
+            # inactive slots: keep their length frozen (their garbage KV write
+            # sits beyond `length` and is masked / later overwritten)
+            cache["length"] = jnp.where(active, cache["length"], old_len)
+            return logits, cache
+
+        self._decode_jit = jax.jit(_decode, donate_argnums=(2,))
+        self._sample_jit = sample_tokens
+
+    # -- public API ----------------------------------------------------------
+
+    def validate(self, request: GenRequest) -> None:
+        """Raises ValueError for inadmissible requests. Callers that stream
+        MUST call this before sending response headers."""
+        if len(request.prompt_ids) >= self.max_seq_len:
+            raise ValueError(
+                "prompt length {} exceeds engine max_seq_len {}".format(
+                    len(request.prompt_ids), self.max_seq_len
+                )
+            )
+
+    async def generate(self, request: GenRequest) -> AsyncIterator[int]:
+        """Submit a request; yields sampled token ids as they decode."""
+        if self._stopped:
+            raise RuntimeError("engine is stopped")
+        self.validate(request)
+        request.prompt_len = len(request.prompt_ids)
+        request.out_queue = asyncio.Queue()
+        await self._pending.put(request)
+        self._ensure_loop()
+        while True:
+            token = await request.out_queue.get()
+            if token is _FINISHED:
+                if request.error is not None:
+                    raise request.error
+                return
+            yield token
+
+    def stop(self) -> None:
+        """Stop the loop and fail out every active/pending request (their
+        consumers must never hang on a dead engine)."""
+        self._stopped = True
+        err = RuntimeError("engine stopped")
+        for slot, request in enumerate(self._slot_req):
+            if request is not None:
+                request.error = err
+                request.out_queue.put_nowait(_FINISHED)
+                self._slot_req[slot] = None
+        while not self._pending.empty():
+            request = self._pending.get_nowait()
+            request.error = err
+            request.out_queue.put_nowait(_FINISHED)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for r in self._slot_req if r is not None)
+
+    # -- internals -------------------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(self._run_loop())
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self.max_seq_len
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit(self, request: GenRequest, slot: int) -> int:
+        """Prefill the prompt into `slot`; returns the first sampled token.
+        Runs in a worker thread (pure device work + slot bookkeeping) — token
+        emission happens on the event-loop thread (asyncio.Queue is not
+        thread-safe)."""
+        ids = request.prompt_ids
+        bucket = self._bucket_for(len(ids))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(ids)] = ids
+        seq_lens = jnp.asarray([len(ids)], jnp.int32)
+        # prefill KV sized to the bucket: one cached, never-mutated template per
+        # bucket (prefill reads only its shape; re-allocating [L,1,bucket,H,D]
+        # per admission would put hundreds of MB of HBM traffic on the
+        # admission path for 8B-class models)
+        template = self._prefill_templates.get(bucket)
+        if template is None:
+            template = self.bundle.init_cache(1, bucket)
+            self._prefill_templates[bucket] = template
+        last_logits, mini_cache = self._prefill_jit(
+            self.params, jnp.asarray(tokens), seq_lens, template
+        )
+        first = self._sample_jit(
+            last_logits.astype(jnp.float32),
+            SamplingParams(
+                temperature=jnp.asarray([request.temperature], jnp.float32),
+                top_k=jnp.asarray([request.top_k], jnp.int32),
+                top_p=jnp.asarray([request.top_p], jnp.float32),
+            ),
+            self._next_rng(),
+        )
+        self.cache = self._insert_jit(
+            self.cache,
+            mini_cache["k"],
+            mini_cache["v"],
+            jnp.asarray(len(ids), jnp.int32),
+            slot,
+        )
+        first_id = int(np.asarray(first)[0])
+        self._slot_req[slot] = request
+        self._next_token[slot] = first_id
+        self._temperature[slot] = request.temperature
+        self._top_k[slot] = request.top_k
+        self._top_p[slot] = request.top_p
+        request.first_token_at = time.time()
+        return first_id
+
+    def _emit(self, slot: int, token_id: int) -> None:
+        request = self._slot_req[slot]
+        if request is None:
+            return
+        request.produced += 1
+        request.out_queue.put_nowait(token_id)
+        stop_ids = request.stop_token_ids or (
+            [self.eos_token_id] if self.eos_token_id is not None else []
+        )
+        total_len = request.prompt_len + request.produced
+        if (
+            token_id in stop_ids
+            or request.produced >= request.max_new_tokens
+            or total_len >= self.max_seq_len
+        ):
+            request.out_queue.put_nowait(_FINISHED)
+            self._slot_req[slot] = None
+
+    def _fail_all(self, err: BaseException) -> None:
+        """Terminate every active request with `err` (nothing may hang)."""
+        for slot, request in enumerate(self._slot_req):
+            if request is not None:
+                request.error = err
+                request.out_queue.put_nowait(_FINISHED)
+                self._slot_req[slot] = None
+
+    async def _run_loop(self) -> None:
+        try:
+            await self._run_loop_inner()
+        except BaseException as ex:
+            self._fail_all(ex)
+            raise
+
+    async def _run_loop_inner(self) -> None:
+        """The continuous-batching loop: admit -> decode -> emit."""
+        while not self._stopped:
+            # admit pending requests into free slots
+            free = self._free_slots()
+            while free and not self._pending.empty():
+                request = self._pending.get_nowait()
+                slot = free.pop(0)
+                try:
+                    first_id = await asyncio.to_thread(self._admit, request, slot)
+                except Exception as ex:
+                    # a failed admission fails only its own request
+                    request.error = ex
+                    request.out_queue.put_nowait(_FINISHED)
+                    self._slot_req[slot] = None
+                    continue
+                self._emit(slot, first_id)
+            active_mask = np.array([r is not None for r in self._slot_req])
+            if not active_mask.any():
+                if self._pending.empty():
+                    return  # drained; a new generate() restarts the loop
+                continue
+            # one decode step over the whole slot batch
+            logits, self.cache = self._decode_jit(
+                self.params,
+                jnp.asarray(self._next_token),
+                self.cache,
+                jnp.asarray(active_mask),
+            )
+            sampled = self._sample_jit(
+                logits.astype(jnp.float32),
+                SamplingParams(
+                    temperature=jnp.asarray(self._temperature),
+                    top_k=jnp.asarray(self._top_k),
+                    top_p=jnp.asarray(self._top_p),
+                ),
+                self._next_rng(),
+            )
+            sampled_np = await asyncio.to_thread(np.asarray, sampled)  # device sync off-loop
+            for slot in np.nonzero(active_mask)[0]:
+                token_id = int(sampled_np[slot])
+                self._next_token[slot] = token_id
+                self._emit(slot, token_id)
+            await asyncio.sleep(0)  # let HTTP handlers interleave

@@ -1,0 +1,66 @@
+"""Batched token sampling — one jitted function for the whole decode batch.
+
+Per-slot temperature / top-k / top-p as data (arrays over the batch), never as
+Python branches, so a single XLA executable covers every mix of sampling
+settings in the continuous batch (recompilation-free, SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    temperature: jnp.ndarray  # [B] float32; 0 => greedy
+    top_k: jnp.ndarray        # [B] int32; 0 => disabled
+    top_p: jnp.ndarray        # [B] float32; 1.0 => disabled
+
+
+def make_sampling_params(batch, temperature=0.0, top_k=0, top_p=1.0):
+    import numpy as np
+
+    return SamplingParams(
+        temperature=jnp.asarray(np.full(batch, temperature, np.float32)),
+        top_k=jnp.asarray(np.full(batch, top_k, np.int32)),
+        top_p=jnp.asarray(np.full(batch, top_p, np.float32)),
+    )
+
+
+@partial(jax.jit, donate_argnums=())
+def sample_tokens(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array):
+    """logits: [B, V] float32 -> token ids [B] int32.
+
+    Rows with temperature == 0 take the argmax; others sample from the
+    temperature-scaled, top-k/top-p-filtered distribution.
+    """
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k mask (k == 0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]              # [B, V]
+    k = jnp.where(params.top_k > 0, params.top_k, v)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.minimum(k - 1, v - 1)[:, None], axis=-1
+    )                                                              # [B, 1]
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus) mask over the sorted distribution
+    sorted_scaled = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
+    cumulative = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens while cumulative(prev) < top_p  (always keep the first)
+    keep_sorted = (cumulative - probs_sorted) < params.top_p[:, None]
+    cutoff = jnp.where(
+        keep_sorted, sorted_scaled, jnp.inf
+    ).min(axis=-1, keepdims=True)                                  # lowest kept logit
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)

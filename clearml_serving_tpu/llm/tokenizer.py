@@ -1,0 +1,80 @@
+"""Tokenizer wrapper: HuggingFace fast tokenizers when the model bundle ships
+one, byte-level fallback otherwise (zero-dependency, fits any vocab ≥ 259).
+
+Replaces the reference's reliance on vLLM's internal tokenizer handling
+(preprocess_service.py:688-710 chat-template resolution).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+
+class ByteTokenizer:
+    """Bytes 0..255 as tokens + bos/eos/pad specials. Deterministic and
+    dependency-free — the CI/test tokenizer, and the fallback when a bundle has
+    no tokenizer files."""
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 259, "byte tokenizer needs vocab >= 259"
+        self.vocab_size = vocab_size
+        self.bos_token_id = 256
+        self.eos_token_id = 257
+        self.pad_token_id = 258
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_token_id] if add_bos else []) + ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        parts = []
+        for m in messages:
+            parts.append("<|{}|>\n{}\n".format(m.get("role", "user"), m.get("content", "")))
+        parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """transformers AutoTokenizer adapter (same surface as ByteTokenizer)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = int(self._tok.vocab_size)
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+        self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Iterable[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+
+
+def load_tokenizer(model_path: Optional[str], vocab_size: int):
+    """HF tokenizer if the bundle directory carries tokenizer files, else
+    byte-level fallback."""
+    if model_path:
+        p = Path(model_path)
+        base = p if p.is_dir() else p.parent
+        if (base / "tokenizer.json").exists() or (base / "tokenizer_config.json").exists():
+            try:
+                return HFTokenizer(str(base))
+            except Exception:
+                pass
+    return ByteTokenizer(vocab_size=max(vocab_size, 259))

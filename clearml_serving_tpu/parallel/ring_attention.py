@@ -67,29 +67,33 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     zeros_mask = jnp.zeros((s_q, s_q), jnp.float32)
     neginf_mask = jnp.full((s_q, s_q), -jnp.inf, jnp.float32)
 
+    def _mask_for(step):
+        if not causal:
+            return zeros_mask
+        # which global block the current k/v came from: future blocks are
+        # fully masked, the diagonal block gets the intra-block causal mask,
+        # past blocks attend densely. Additive-mask select keeps the traced
+        # structure identical across ring steps (shard_map-friendly).
+        kv_idx = (my_idx - step) % axis_size
+        return jnp.where(
+            kv_idx == my_idx,
+            causal_mask,
+            jnp.where(kv_idx > my_idx, neginf_mask, zeros_mask),
+        )
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
     def body(step, carry):
         k_cur, v_cur, o, m, l = carry
-        if causal:
-            # which global block the current k/v came from: future blocks are
-            # fully masked, the diagonal block gets the intra-block causal
-            # mask, past blocks attend densely. Additive-mask select keeps the
-            # traced structure identical across ring steps (shard_map-friendly).
-            kv_idx = (my_idx - step) % axis_size
-            mask = jnp.where(
-                kv_idx == my_idx,
-                causal_mask,
-                jnp.where(kv_idx > my_idx, neginf_mask, zeros_mask),
-            )
-        else:
-            mask = zeros_mask
-        o, m, l = _stream_block(q, k_cur, v_cur, o, m, l, mask)
+        o, m, l = _stream_block(q, k_cur, v_cur, o, m, l, _mask_for(step))
         # rotate kv to the next device (ring neighbor exchange over ICI)
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return k_next, v_next, o, m, l
 
-    _, _, o, m, l = lax.fori_loop(0, axis_size, body, (k, v, o, m, l))
+    # last block computes without the (discarded) final rotation
+    k, v, o, m, l = lax.fori_loop(0, axis_size - 1, body, (k, v, o, m, l))
+    o, m, l = _stream_block(q, k, v, o, m, l, _mask_for(axis_size - 1))
     # all-masked rows (can happen only if s_q rows saw nothing) -> zero output
     safe_l = jnp.where(l == 0.0, 1.0, l)
     return (o / safe_l[..., None]).astype(q.dtype)

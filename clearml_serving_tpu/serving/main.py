@@ -39,26 +39,8 @@ from .model_request_processor import (
     ModelRequestProcessor,
     ServingInitializationError,
 )
+from .responses import JSONOutput, StreamingOutput
 from ..engines.base import EndpointModelError
-
-
-class StreamingOutput:
-    """Engine phases may return this to stream SSE chunks through the router.
-
-    ``generator`` yields str (already SSE-framed or raw data lines) or bytes.
-    """
-
-    def __init__(self, generator: AsyncIterator, content_type: str = "text/event-stream"):
-        self.generator = generator
-        self.content_type = content_type
-
-
-class JSONOutput:
-    """Engine phases may return this to control the status code."""
-
-    def __init__(self, payload: Any, status: int = 200):
-        self.payload = payload
-        self.status = status
 
 
 def _instance_id(processor: Optional[ModelRequestProcessor]) -> str:

@@ -1,0 +1,28 @@
+"""Response wrapper types engines can return through the router.
+
+Leaf module (no intra-package imports) so engines and the HTTP app can both
+depend on it without cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+
+class StreamingOutput:
+    """Engine phases may return this to stream SSE chunks through the router.
+
+    ``generator`` yields str (already SSE-framed or raw data lines) or bytes.
+    """
+
+    def __init__(self, generator: AsyncIterator, content_type: str = "text/event-stream"):
+        self.generator = generator
+        self.content_type = content_type
+
+
+class JSONOutput:
+    """Engine phases may return this to control the HTTP status code."""
+
+    def __init__(self, payload: Any, status: int = 200):
+        self.payload = payload
+        self.status = status

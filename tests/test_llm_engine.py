@@ -1,0 +1,141 @@
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.sampling import make_sampling_params, sample_tokens
+from clearml_serving_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    bundle = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+def _make_engine(bundle, params, **kwargs):
+    kwargs.setdefault("max_batch", 4)
+    kwargs.setdefault("max_seq_len", 128)
+    kwargs.setdefault("prefill_buckets", [16, 32])
+    kwargs.setdefault("eos_token_id", 257)
+    return LLMEngineCore(bundle, params, **kwargs)
+
+
+async def _collect(engine, req):
+    out = []
+    async for token in engine.generate(req):
+        out.append(token)
+    return out
+
+
+def test_greedy_generation_deterministic(tiny_engine_parts):
+    bundle, params = tiny_engine_parts
+
+    async def run():
+        engine = _make_engine(bundle, params)
+        prompt = [256, 10, 20, 30]
+        r1 = await _collect(engine, GenRequest(prompt_ids=prompt, max_new_tokens=8))
+        r2 = await _collect(engine, GenRequest(prompt_ids=prompt, max_new_tokens=8))
+        return r1, r2
+
+    r1, r2 = asyncio.run(run())
+    assert len(r1) == 8 or (257 in r1)
+    assert r1 == r2
+
+
+def test_continuous_batching_matches_sequential(tiny_engine_parts):
+    bundle, params = tiny_engine_parts
+    prompts = [[256, 1, 2, 3], [256, 9, 8, 7, 6, 5], [256, 42]]
+
+    async def sequential():
+        engine = _make_engine(bundle, params)
+        return [
+            await _collect(engine, GenRequest(prompt_ids=p, max_new_tokens=6))
+            for p in prompts
+        ]
+
+    async def concurrent():
+        engine = _make_engine(bundle, params)
+        return await asyncio.gather(
+            *[
+                _collect(engine, GenRequest(prompt_ids=p, max_new_tokens=6))
+                for p in prompts
+            ]
+        )
+
+    seq = asyncio.run(sequential())
+    conc = asyncio.run(concurrent())
+    assert seq == conc
+
+
+def test_more_requests_than_slots(tiny_engine_parts):
+    bundle, params = tiny_engine_parts
+
+    async def run():
+        engine = _make_engine(bundle, params, max_batch=2)
+        results = await asyncio.gather(
+            *[
+                _collect(engine, GenRequest(prompt_ids=[256, i], max_new_tokens=4))
+                for i in range(5)
+            ]
+        )
+        return results
+
+    results = asyncio.run(run())
+    assert len(results) == 5
+    assert all(len(r) >= 1 for r in results)
+
+
+def test_prompt_too_long(tiny_engine_parts):
+    bundle, params = tiny_engine_parts
+
+    async def run():
+        engine = _make_engine(bundle, params)
+        req = GenRequest(prompt_ids=list(range(200)), max_new_tokens=4)
+        async for _ in engine.generate(req):
+            pass
+
+    with pytest.raises(ValueError):
+        asyncio.run(run())
+
+
+def test_sampling_greedy_vs_random():
+    logits = np.full((2, 16), -10.0, np.float32)
+    logits[0, 3] = 10.0
+    logits[1, 7] = 10.0
+    out = sample_tokens(
+        np.asarray(logits), make_sampling_params(2, temperature=0.0), jax.random.PRNGKey(0)
+    )
+    assert np.asarray(out).tolist() == [3, 7]
+    # temperature sampling with a dominant peak still picks it
+    out = sample_tokens(
+        np.asarray(logits), make_sampling_params(2, temperature=0.5, top_p=0.9),
+        jax.random.PRNGKey(1),
+    )
+    assert np.asarray(out).tolist() == [3, 7]
+
+
+def test_top_k_masks_tail():
+    logits = np.zeros((1, 8), np.float32)
+    logits[0] = [5, 4, 3, -50, -50, -50, -50, -50]
+    params = make_sampling_params(1, temperature=1.0, top_k=2)
+    outs = {
+        int(np.asarray(sample_tokens(np.asarray(logits), params, jax.random.PRNGKey(i)))[0])
+        for i in range(20)
+    }
+    assert outs.issubset({0, 1})
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(512)
+    ids = tok.encode("hello world")
+    assert ids[0] == tok.bos_token_id
+    assert tok.decode(ids) == "hello world"
+    text = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+    assert "<|assistant|>" in text
+    assert load_tokenizer(None, 512).vocab_size == 512

@@ -1,0 +1,151 @@
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.main import build_app
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+
+@pytest.fixture(scope="module")
+def llm_served(tmp_path_factory):
+    import os
+
+    root = tmp_path_factory.mktemp("state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="llm")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32],
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def _run(mrp, fn):
+    async def runner():
+        client = TestClient(TestServer(build_app(mrp)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_chat_completion(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json={
+                "model": "tiny_llm",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6,
+            },
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    assert out["object"] == "chat.completion"
+    assert out["choices"][0]["message"]["role"] == "assistant"
+    assert out["usage"]["completion_tokens"] >= 1
+    assert out["usage"]["prompt_tokens"] > 0
+
+
+def test_chat_completion_streaming(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json={
+                "model": "tiny_llm",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+                "stream": True,
+            },
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        return await r.text()
+
+    text = _run(llm_served, fn)
+    lines = [l for l in text.split("\n\n") if l.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    first = json.loads(lines[0][len("data: "):])
+    assert first["object"] == "chat.completion.chunk"
+    assert first["choices"][0]["delta"].get("role") == "assistant"
+
+
+def test_completions_and_tokenize(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "abc", "max_tokens": 4},
+        )
+        assert r.status == 200
+        comp = await r.json()
+
+        r = await client.post(
+            "/serve/openai/v1/tokenize", json={"model": "tiny_llm", "prompt": "abc"}
+        )
+        tok = await r.json()
+        r = await client.post(
+            "/serve/openai/v1/detokenize",
+            json={"model": "tiny_llm", "tokens": tok["tokens"]},
+        )
+        detok = await r.json()
+
+        r = await client.post(
+            "/serve/openai/v1/models", json={"model": "tiny_llm"}
+        )
+        mods = await r.json()
+        return comp, tok, detok, mods
+
+    comp, tok, detok, mods = _run(llm_served, fn)
+    assert comp["object"] == "text_completion"
+    assert tok["count"] == 4  # bos + 3 bytes
+    assert detok["prompt"] == "abc"
+    assert mods["data"][0]["id"] == "tiny_llm"
+
+
+def test_unsupported_capability(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_llm", "input": "x"},
+        )
+        assert r.status == 422
+        body = await r.json()
+        assert "does not support" in body["detail"]
+
+    _run(llm_served, fn)
+
+
+def test_plain_serve_route(llm_served):
+    """POST /serve/tiny_llm behaves as a non-streaming chat completion."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/tiny_llm",
+            json={"messages": [{"role": "user", "content": "yo"}], "max_tokens": 3},
+        )
+        assert r.status == 200
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    assert out["object"] == "chat.completion"
