@@ -14,6 +14,12 @@ Design (TPU-first, SURVEY.md §7 step 6):
 - **Continuous batching loop**: an asyncio task interleaves admissions
   (prefill) with decode steps; each step's sampled tokens fan out to
   per-request queues (SSE streaming sits directly on top).
+- **Multi-step decode**: ``decode_steps`` tokens are generated per dispatch
+  with an on-device ``lax.scan`` (sampling included). Host dispatch overhead
+  is amortized over the whole chunk — measured ~90 ms per dispatch through a
+  tunneled TPU vs 8 ms of device time per step, so chunking is the difference
+  between ~160 tok/s and ~1500+ tok/s. Finished sequences inside a chunk are
+  truncated host-side; their slots free at the chunk boundary.
 - **Sampling as data**: per-slot temperature/top-k/top-p arrays — one compiled
   sampler for any mix of requests.
 - Optional ``jax.sharding.Mesh``: params/cache get TP/DP shardings from
@@ -77,11 +83,13 @@ class LLMEngineCore:
         mesh=None,
         eos_token_id: Optional[int] = None,
         rng_seed: int = 0,
+        decode_steps: int = 4,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.eos_token_id = eos_token_id
+        self.decode_steps = max(1, int(decode_steps))
         self._buckets = sorted(
             b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
         ) or [max_seq_len]
@@ -137,15 +145,27 @@ class LLMEngineCore:
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
-        def _decode(params, tokens, cache, active):
-            old_len = cache["length"]
-            logits, cache = bundle.decode(params, tokens, cache)
-            # inactive slots: keep their length frozen (their garbage KV write
-            # sits beyond `length` and is masked / later overwritten)
-            cache["length"] = jnp.where(active, cache["length"], old_len)
-            return logits, cache
+        def _decode_chunk(params, tokens, cache, active, sampling, rng):
+            """`decode_steps` decode+sample steps fused in one executable
+            (lax.scan) — host dispatch overhead amortizes over the chunk."""
 
-        self._decode_jit = jax.jit(_decode, donate_argnums=(2,))
+            def body(carry, step_rng):
+                tokens, cache = carry
+                old_len = cache["length"]
+                logits, cache = bundle.decode(params, tokens, cache)
+                # inactive slots: keep their length frozen (their garbage KV
+                # write sits beyond `length` and is masked / later overwritten)
+                cache["length"] = jnp.where(active, cache["length"], old_len)
+                sampled = sample_tokens(
+                    logits.astype(jnp.float32), sampling, step_rng
+                )
+                return (sampled, cache), sampled
+
+            rngs = jax.random.split(rng, self.decode_steps)
+            (_, cache), toks = jax.lax.scan(body, (tokens, cache), rngs)
+            return toks.T, cache  # [B, decode_steps]
+
+        self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
         self._sample_jit = sample_tokens
 
     # -- public API ----------------------------------------------------------
@@ -179,14 +199,11 @@ class LLMEngineCore:
 
     def stop(self) -> None:
         """Stop the loop and fail out every active/pending request (their
-        consumers must never hang on a dead engine)."""
+        consumers must never hang on a dead engine). A request mid-admission is
+        caught by the loop's post-exit drain (_run_loop's stopped check)."""
         self._stopped = True
         err = RuntimeError("engine stopped")
-        for slot, request in enumerate(self._slot_req):
-            if request is not None:
-                request.error = err
-                request.out_queue.put_nowait(_FINISHED)
-                self._slot_req[slot] = None
+        self._fail_all(err)
         while not self._pending.empty():
             request = self._pending.get_nowait()
             request.error = err
@@ -293,6 +310,11 @@ class LLMEngineCore:
         except BaseException as ex:
             self._fail_all(ex)
             raise
+        finally:
+            if self._stopped:
+                # catch requests admitted while stop() was racing the loop
+                # (popped from _pending before stop drained it)
+                self._fail_all(RuntimeError("engine stopped"))
 
     async def _run_loop_inner(self) -> None:
         """The continuous-batching loop: admit -> decode -> emit."""
@@ -316,15 +338,12 @@ class LLMEngineCore:
                 if self._pending.empty():
                     return  # drained; a new generate() restarts the loop
                 continue
-            # one decode step over the whole slot batch
-            logits, self.cache = self._decode_jit(
+            # one fused decode chunk over the whole slot batch
+            chunk, self.cache = self._decode_chunk_jit(
                 self.params,
                 jnp.asarray(self._next_token),
                 self.cache,
                 jnp.asarray(active_mask),
-            )
-            sampled = self._sample_jit(
-                logits.astype(jnp.float32),
                 SamplingParams(
                     temperature=jnp.asarray(self._temperature),
                     top_k=jnp.asarray(self._top_k),
@@ -332,9 +351,11 @@ class LLMEngineCore:
                 ),
                 self._next_rng(),
             )
-            sampled_np = await asyncio.to_thread(np.asarray, sampled)  # device sync off-loop
+            chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
             for slot in np.nonzero(active_mask)[0]:
-                token_id = int(sampled_np[slot])
-                self._next_token[slot] = token_id
-                self._emit(slot, token_id)
+                self._next_token[slot] = int(chunk_np[slot, -1])
+                for token_id in chunk_np[slot]:
+                    # _emit frees the slot on finish; the rest of the chunk for
+                    # that slot is dropped by the None check inside _emit
+                    self._emit(int(slot), int(token_id))
             await asyncio.sleep(0)  # let HTTP handlers interleave

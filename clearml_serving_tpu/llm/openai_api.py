@@ -99,6 +99,7 @@ class LLMEngineRequest(BaseEngineRequest):
             prefill_buckets=engine_cfg.get("prefill_buckets"),
             mesh=mesh,
             eos_token_id=self.tokenizer.eos_token_id,
+            decode_steps=int(engine_cfg.get("decode_steps", 4)),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine
@@ -116,7 +117,7 @@ class LLMEngineRequest(BaseEngineRequest):
             top_p=float(body.get("top_p", 1.0) or 1.0),
         )
 
-    async def _collect_text(self, request: GenRequest) -> Dict[str, Any]:
+    async def _collect_text(self, request) -> Dict[str, Any]:
         ids: List[int] = []
         async for token in self.engine.generate(request):
             ids.append(token)
@@ -125,7 +126,7 @@ class LLMEngineRequest(BaseEngineRequest):
             ids = ids[:-1]
             finish = "stop"
         else:
-            finish = "length" if request.produced >= request.max_new_tokens else "stop"
+            finish = self._finish_reason(request)
         return {"text": self.tokenizer.decode(ids), "ids": ids, "finish_reason": finish}
 
     async def _stream_deltas(self, request) -> AsyncIterator[Dict[str, Any]]:
@@ -145,9 +146,14 @@ class LLMEngineRequest(BaseEngineRequest):
                 yield {"delta": text[len(sent):]}
                 sent = text
 
-    @staticmethod
-    def _finish_reason(request) -> str:
-        return "length" if request.produced >= request.max_new_tokens else "stop"
+    def _finish_reason(self, request) -> str:
+        """OpenAI semantics: "length" covers BOTH max_tokens truncation and
+        hitting the model's context limit."""
+        if request.produced >= request.max_new_tokens:
+            return "length"
+        if request.prompt_len + request.produced >= self.engine.max_seq_len:
+            return "length"
+        return "stop"
 
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
@@ -219,21 +225,33 @@ class LLMEngineRequest(BaseEngineRequest):
             },
         }
 
+    def _encode_prompts(self, prompt) -> List[List[int]]:
+        """OpenAI completions `prompt` polymorphism: str | [str] | [int] |
+        [[int]] — token-id forms pass through without re-encoding."""
+        if isinstance(prompt, str):
+            return [self.tokenizer.encode(prompt)]
+        if isinstance(prompt, list):
+            if not prompt:
+                return [self.tokenizer.encode("")]
+            if all(isinstance(p, int) for p in prompt):
+                return [list(prompt)]
+            if all(isinstance(p, list) for p in prompt):
+                return [[int(t) for t in p] for p in prompt]
+            return [self.tokenizer.encode(str(p)) for p in prompt]
+        return [self.tokenizer.encode(str(prompt))]
+
     async def v1_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
-        prompt = body.get("prompt") or ""
-        prompts = [str(p) for p in prompt] if isinstance(prompt, list) else [str(prompt)]
+        prompt_id_lists = self._encode_prompts(body.get("prompt") or "")
         model = body.get("model", self._model_name)
         completion_id = _gen_id("cmpl")
         created = _now()
 
         if body.get("stream"):
-            if len(prompts) != 1:
+            if len(prompt_id_lists) != 1:
                 raise EndpointModelError(
                     "streaming completions support a single prompt per request"
                 )
-            request = self._gen_request_from_body(
-                body, self.tokenizer.encode(prompts[0])
-            )
+            request = self._gen_request_from_body(body, prompt_id_lists[0])
             self.engine.validate(request)
 
             async def sse():
@@ -250,6 +268,15 @@ class LLMEngineRequest(BaseEngineRequest):
                     yield "data: {}\n\n".format(json.dumps(
                         {"error": {"message": str(ex), "type": type(ex).__name__}}
                     ))
+                    yield "data: [DONE]\n\n"
+                    return
+                final = {
+                    "id": completion_id, "object": "text_completion",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "text": "",
+                                 "finish_reason": self._finish_reason(request)}],
+                }
+                yield "data: {}\n\n".format(json.dumps(final))
                 yield "data: [DONE]\n\n"
 
             return StreamingOutput(sse())
@@ -257,7 +284,7 @@ class LLMEngineRequest(BaseEngineRequest):
         # one choice per prompt, generated concurrently through the continuous
         # batch (OpenAI batched-prompt semantics)
         requests = [
-            self._gen_request_from_body(body, self.tokenizer.encode(p)) for p in prompts
+            self._gen_request_from_body(body, ids) for ids in prompt_id_lists
         ]
         results = await asyncio.gather(*[self._collect_text(r) for r in requests])
         return {

@@ -48,6 +48,9 @@ def resolve_config(config: dict) -> dict:
     cfg.update({k: v for k, v in config.items() if k != "preset"})
     cfg.setdefault("dtype", "bfloat16")
     cfg.setdefault("tie_embeddings", False)
+    cfg.setdefault("rope_theta", 10000.0)
+    cfg.setdefault("norm_eps", 1e-5)
+    cfg.setdefault("max_seq_len", 4096)
     return cfg
 
 
