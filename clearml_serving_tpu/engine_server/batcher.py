@@ -1,0 +1,118 @@
+"""Dynamic request batcher — the Triton `dynamic_batching` equivalent.
+
+Requests for one model queue up; a batch fires when it reaches
+``preferred_batch_size`` or the oldest request has waited
+``max_queue_delay_us`` (same two knobs the reference exposes through aux-pbtxt,
+SURVEY.md §2.9). The batch is concatenated on the leading axis, padded up to
+the model's bucket (so arbitrary traffic shapes hit a small set of compiled
+signatures — no XLA recompilation storms), executed once, and split back.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        run_batch: Callable[[List[np.ndarray]], List[np.ndarray]],
+        preferred_batch_size: int = 8,
+        max_queue_delay_us: int = 2000,
+        max_batch_size: int = 64,
+    ):
+        self._run_batch = run_batch  # takes list of input arrays (batch-concat'd)
+        self.preferred = int(preferred_batch_size)
+        self.max_delay_s = float(max_queue_delay_us) / 1e6
+        self.max_batch = int(max_batch_size)
+        self._queue: "asyncio.Queue[Tuple[List[np.ndarray], asyncio.Future, int]]" = (
+            asyncio.Queue()
+        )
+        self._task: Optional[asyncio.Task] = None
+        # observability
+        self.batches_executed = 0
+        self.requests_served = 0
+        self.batch_size_sum = 0
+
+    async def infer(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
+        """Submit one request's input list; rows = inputs[i].shape[0]."""
+        rows = int(inputs[0].shape[0]) if inputs and inputs[0].ndim > 0 else 1
+        if rows > self.max_batch:
+            raise ValueError(
+                "request batch {} exceeds max_batch_size {}".format(rows, self.max_batch)
+            )
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((inputs, future, rows))
+        self._ensure_task()
+        return await future
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        carry = None  # item popped but deferred to the next batch (row cap)
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                try:
+                    first = await asyncio.wait_for(self._queue.get(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    # Idle shutdown without stranding: no awaits between the
+                    # emptiness check and clearing _task, so (single-threaded
+                    # loop) any infer() either enqueued before this check or
+                    # will see _task None and start a fresh task.
+                    if self._queue.empty():
+                        self._task = None
+                        return
+                    continue
+            batch = [first]
+            total_rows = first[2]
+            deadline = time.monotonic() + self.max_delay_s
+            while total_rows < self.preferred:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    break
+                if total_rows + item[2] > self.max_batch:
+                    carry = item  # keep the row cap honest; execute next round
+                    break
+                batch.append(item)
+                total_rows += item[2]
+                if total_rows >= self.preferred:
+                    break
+            await self._execute(batch)
+
+    async def _execute(self, batch) -> None:
+        inputs_list = [b[0] for b in batch]
+        futures = [b[1] for b in batch]
+        rows = [b[2] for b in batch]
+        try:
+            n_inputs = len(inputs_list[0])
+            concat = [
+                np.concatenate([req[i] for req in inputs_list], axis=0)
+                for i in range(n_inputs)
+            ]
+            outputs = await asyncio.to_thread(self._run_batch, concat)
+            self.batches_executed += 1
+            self.requests_served += len(batch)
+            self.batch_size_sum += sum(rows)
+            # split each output back per-request along the leading axis
+            offset = 0
+            for fut, n in zip(futures, rows):
+                per_request = [out[offset: offset + n] for out in outputs]
+                if not fut.done():
+                    fut.set_result(per_request)
+                offset += n
+        except Exception as ex:
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(ex)

@@ -1,0 +1,154 @@
+"""Engine-server model repository: control-plane reconciler + compiled models.
+
+The reference's Triton sidecar materializes a filesystem model repo from the
+stored control-plane state and lets tritonserver poll it
+(engines/triton/triton_helper.py:91-224). Here the reconciler loads **jax
+bundles** directly: every endpoint with engine type ``jax_grpc`` becomes a
+CompiledModel — bucket-compiled XLA executables behind a DynamicBatcher — and
+config changes hot-swap the entry atomically while in-flight requests finish on
+the old one (it stays alive until the last reference drops).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .batcher import DynamicBatcher
+from ..serving.endpoints import ModelEndpoint
+
+
+class CompiledModel:
+    """One endpoint's executable: jit-per-bucket + dynamic batcher."""
+
+    def __init__(self, endpoint: ModelEndpoint, bundle, params):
+        import jax
+
+        self.endpoint = endpoint
+        self.bundle = bundle
+        self.params = params
+        aux = endpoint.auxiliary_cfg if isinstance(endpoint.auxiliary_cfg, dict) else {}
+        batching = aux.get("batching") or {}
+        self.buckets = sorted(int(b) for b in batching.get("buckets", [1, 2, 4, 8, 16, 32, 64]))
+        self._jit = jax.jit(lambda params, *xs: bundle.apply(params, *xs))
+        self.batcher = DynamicBatcher(
+            self.run_batch,
+            preferred_batch_size=int(batching.get("preferred_batch_size", 8)),
+            max_queue_delay_us=int(batching.get("max_queue_delay_us", 2000)),
+            max_batch_size=int(batching.get("max_batch_size", 64)),
+        )
+        self.input_names = endpoint.input_name or []
+        self.input_types = endpoint.input_type or []
+        self.output_names = endpoint.output_name or ["output_0"]
+
+    def run_batch(self, concat_inputs: List[np.ndarray]) -> List[np.ndarray]:
+        """Batch-concat'd inputs -> list of outputs (leading axis = batch)."""
+        import jax
+
+        batch = int(concat_inputs[0].shape[0])
+        bucket = next((b for b in self.buckets if batch <= b), batch)
+        padded = []
+        for a in concat_inputs:
+            if a.shape[0] != bucket:
+                pad = [(0, bucket - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                a = np.pad(a, pad)
+            padded.append(a)
+        out = self._jit(self.params, *padded)
+        leaves = out if isinstance(out, (list, tuple)) else [out]
+        return [np.asarray(o)[:batch] for o in leaves]
+
+    def warmup(self) -> None:
+        """Compile the smallest bucket ahead of traffic."""
+        if not self.input_names:
+            return
+        try:
+            shapes = self.endpoint.input_size or []
+            inputs = []
+            for i in range(len(self.input_names)):
+                shape = [self.buckets[0]] + [int(d) for d in (shapes[i] if i < len(shapes) else [1])]
+                dtype = np.dtype(self.input_types[i]) if i < len(self.input_types) else np.float32
+                inputs.append(np.zeros(shape, dtype))
+            self.run_batch(inputs)
+        except Exception:
+            pass
+
+
+class EngineModelRepo:
+    """Reconciles the control-plane endpoint set into compiled models."""
+
+    ENGINE_TYPES = ("jax_grpc",)
+
+    def __init__(self, processor):
+        # processor: ModelRequestProcessor (control-plane reader + registry)
+        self._processor = processor
+        self._models: Dict[str, CompiledModel] = {}
+        self._hashes: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def model_key(serving_url: str, version: Optional[str] = None) -> str:
+        key = serving_url.strip("/")
+        if version:
+            key = "{}/{}".format(key, version)
+        return key
+
+    def get(self, model: str, version: Optional[str] = None) -> Optional[CompiledModel]:
+        return self._models.get(self.model_key(model, version))
+
+    def list_models(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for key, cm in self._models.items():
+            out[key] = {
+                "engine": cm.endpoint.engine_type,
+                "model_id": cm.endpoint.model_id,
+                "buckets": cm.buckets,
+                "requests_served": cm.batcher.requests_served,
+                "batches_executed": cm.batcher.batches_executed,
+            }
+        return out
+
+    def sync(self) -> int:
+        """One reconcile pass; returns number of (re)loaded models."""
+        from ..engines.jax_engine import load_bundle
+
+        self._processor.deserialize(skip_sync=True)
+        wanted: Dict[str, ModelEndpoint] = {}
+        for url, ep in {
+            **self._processor._model_monitoring_endpoints,
+            **self._processor.list_endpoints(),
+        }.items():
+            if ep.engine_type in self.ENGINE_TYPES:
+                wanted[url] = ep
+
+        loaded = 0
+        registry = self._processor.registry
+        for url, ep in wanted.items():
+            record = registry.get(ep.model_id) if ep.model_id else None
+            content_hash = "{}:{}".format(
+                hash(str(sorted(ep.as_dict().items()))),
+                (record.as_dict().get("hash") if record else None),
+            )
+            if self._hashes.get(url) == content_hash and url in self._models:
+                continue
+            if record is None:
+                continue
+            try:
+                bundle, params = load_bundle(record.get_local_copy())
+            except Exception as ex:
+                print("engine-server: failed loading {}: {}".format(url, ex))
+                continue
+            model = CompiledModel(ep, bundle, params)
+            model.warmup()
+            with self._lock:
+                self._models[url] = model  # atomic swap; old entry GC'd
+                self._hashes[url] = content_hash
+            loaded += 1
+
+        stale = set(self._models) - set(wanted)
+        for url in stale:
+            with self._lock:
+                self._models.pop(url, None)
+                self._hashes.pop(url, None)
+        return loaded

@@ -1,0 +1,170 @@
+"""JAX engine server: gRPC tensor-infer service over the model repo.
+
+Replaces the tritonserver C++ process in the reference topology (SURVEY.md
+§2.9 row 1): the router's ``jax_grpc`` client engine sends named typed tensors;
+this process owns the TPU devices, runs bucket-compiled XLA executables behind
+per-model dynamic batchers, polls the control plane for model changes (hot
+swap), and exports Prometheus metrics (request/batch counters + per-chip HBM
+gauges) on a sidecar port — the same scrape surface tritonserver exposes
+on :8002.
+
+Run: ``python -m clearml_serving_tpu.engine_server.server`` with
+``TPUSERVE_SERVICE_ID`` (and optionally ``TPUSERVE_ENGINE_PORT``,
+``TPUSERVE_ENGINE_METRICS_PORT``, ``TPUSERVE_POLL_FREQ``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from . import protocol
+from .repo import EngineModelRepo
+
+
+class _EngineHandler(grpc.GenericRpcHandler):
+    """Generic byte-level handler — no protoc codegen (protocol.py docs)."""
+
+    def __init__(self, servicer: "EngineServer"):
+        self._servicer = servicer
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == protocol.INFER_METHOD:
+            return grpc.unary_unary_rpc_method_handler(
+                self._servicer.infer,
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        if method == protocol.STATUS_METHOD:
+            return grpc.unary_unary_rpc_method_handler(
+                self._servicer.status,
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        return None
+
+
+class EngineServer:
+    def __init__(self, repo: EngineModelRepo):
+        self.repo = repo
+
+    async def infer(self, request_bytes: bytes, context) -> bytes:
+        try:
+            request = protocol.decode_infer_request(request_bytes)
+        except Exception as ex:
+            await context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, "bad request encoding: {}".format(ex)
+            )
+        model = self.repo.get(request["model"], request.get("version") or None)
+        if model is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                "model {!r} version {!r} not loaded (have: {})".format(
+                    request["model"], request.get("version"), sorted(self.repo.list_models())
+                ),
+            )
+        inputs_by_name = request["inputs"]
+        # order inputs per the endpoint spec; single-input models accept any name
+        if model.input_names:
+            try:
+                ordered = [inputs_by_name[name] for name in model.input_names]
+            except KeyError as ex:
+                await context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "missing input {} (expected {})".format(ex, model.input_names),
+                )
+        else:
+            ordered = list(inputs_by_name.values())
+        try:
+            outputs = await model.batcher.infer(ordered)
+        except Exception as ex:
+            await context.abort(
+                grpc.StatusCode.INTERNAL, "inference failed: {}".format(ex)
+            )
+        names = model.output_names
+        named = {
+            (names[i] if i < len(names) else "output_{}".format(i)): np.asarray(out)
+            for i, out in enumerate(outputs)
+        }
+        return protocol.encode_infer_response(named)
+
+    async def status(self, request_bytes: bytes, context) -> bytes:
+        import jax
+
+        return protocol.encode_obj(
+            {
+                "models": self.repo.list_models(),
+                "devices": [str(d) for d in jax.devices()],
+                "time": time.time(),
+            }
+        )
+
+
+def make_server(repo: EngineModelRepo, port: int = 0) -> "tuple[grpc.aio.Server, int]":
+    server = grpc.aio.server(
+        options=[
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+        ]
+    )
+    server.add_generic_rpc_handlers((_EngineHandler(EngineServer(repo)),))
+    bound_port = server.add_insecure_port("[::]:{}".format(port))
+    return server, bound_port
+
+
+async def serve(service_id: Optional[str] = None) -> None:
+    from prometheus_client import Counter, Gauge, start_http_server
+
+    from ..serving.model_request_processor import ModelRequestProcessor
+    from ..statistics.metrics import StatisticsController
+
+    processor = ModelRequestProcessor(service_id=service_id)
+    repo = EngineModelRepo(processor)
+    repo.sync()
+
+    port = int(os.environ.get("TPUSERVE_ENGINE_PORT", 8001))
+    metrics_port = int(os.environ.get("TPUSERVE_ENGINE_METRICS_PORT", 8002))
+    poll_freq_sec = float(os.environ.get("TPUSERVE_POLL_FREQ", 1.0)) * 60.0
+
+    server, bound = make_server(repo, port)
+    await server.start()
+    print("engine server: gRPC on :{} ({} models)".format(bound, len(repo.list_models())))
+
+    try:
+        start_http_server(metrics_port)
+        requests_g = Gauge("engine_requests_served", "requests served", ["model"])
+        batches_g = Gauge("engine_batches_executed", "batches executed", ["model"])
+        hbm = StatisticsController("", processor=None)
+    except OSError:
+        requests_g = batches_g = hbm = None
+
+    async def reconcile_loop():
+        while True:
+            await asyncio.sleep(poll_freq_sec)
+            try:
+                await asyncio.to_thread(repo.sync)
+                if requests_g is not None:
+                    for name, info in repo.list_models().items():
+                        requests_g.labels(model=name).set(info["requests_served"])
+                        batches_g.labels(model=name).set(info["batches_executed"])
+                    hbm.update_device_gauges()
+            except Exception as ex:
+                print("engine server reconcile error: {}".format(ex))
+
+    asyncio.get_running_loop().create_task(reconcile_loop())
+    await server.wait_for_termination()
+
+
+def main() -> None:
+    service_id = os.environ.get("TPUSERVE_SERVICE_ID") or None
+    asyncio.run(serve(service_id))
+
+
+if __name__ == "__main__":
+    main()

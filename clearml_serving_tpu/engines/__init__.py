@@ -3,6 +3,7 @@ from .base import BaseEngineRequest, get_engine_cls, load_engine_modules, regist
 # Import engine implementations so they self-register.
 from . import cpu_engines  # noqa: F401
 from . import jax_engine  # noqa: F401
+from . import grpc_client  # noqa: F401
 from ..llm import openai_api as _llm_engine  # noqa: F401
 
 __all__ = [

@@ -225,18 +225,27 @@ class LLMEngineRequest(BaseEngineRequest):
             },
         }
 
+    def _check_token_ids(self, ids: List[int]) -> List[int]:
+        vocab = int(self.engine.bundle.config.get("vocab_size", 0))
+        for t in ids:
+            if not (0 <= int(t) < vocab):
+                raise ValueError(
+                    "token id {} out of range for vocab size {}".format(t, vocab)
+                )
+        return [int(t) for t in ids]
+
     def _encode_prompts(self, prompt) -> List[List[int]]:
         """OpenAI completions `prompt` polymorphism: str | [str] | [int] |
-        [[int]] — token-id forms pass through without re-encoding."""
+        [[int]] — token-id forms pass through (range-checked, not re-encoded)."""
         if isinstance(prompt, str):
             return [self.tokenizer.encode(prompt)]
         if isinstance(prompt, list):
             if not prompt:
                 return [self.tokenizer.encode("")]
             if all(isinstance(p, int) for p in prompt):
-                return [list(prompt)]
+                return [self._check_token_ids(prompt)]
             if all(isinstance(p, list) for p in prompt):
-                return [[int(t) for t in p] for p in prompt]
+                return [self._check_token_ids(p) for p in prompt]
             return [self.tokenizer.encode(str(p)) for p in prompt]
         return [self.tokenizer.encode(str(prompt))]
 

@@ -1,0 +1,162 @@
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.engine_server import protocol
+from clearml_serving_tpu.engine_server.batcher import DynamicBatcher
+from clearml_serving_tpu.engine_server.repo import EngineModelRepo
+from clearml_serving_tpu.engine_server.server import make_server
+from clearml_serving_tpu.engines import get_engine_cls
+from clearml_serving_tpu.engines.jax_engine import save_bundle
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+
+def test_protocol_roundtrip():
+    inputs = {
+        "a": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "b": np.array([[1, 2]], dtype=np.int64),
+    }
+    data = protocol.encode_infer_request("m", inputs, version="2", output_names=["y"])
+    req = protocol.decode_infer_request(data)
+    assert req["model"] == "m" and req["version"] == "2" and req["outputs"] == ["y"]
+    np.testing.assert_array_equal(req["inputs"]["a"], inputs["a"])
+    assert req["inputs"]["b"].dtype == np.int64
+
+    resp = protocol.decode_infer_response(
+        protocol.encode_infer_response({"y": np.ones((2, 1), np.float32)})
+    )
+    assert resp["y"].shape == (2, 1)
+
+
+def test_dynamic_batcher_batches_concurrent_requests():
+    calls = []
+
+    def run_batch(concat):
+        calls.append(int(concat[0].shape[0]))
+        return [concat[0] * 2]
+
+    async def run():
+        batcher = DynamicBatcher(run_batch, preferred_batch_size=4, max_queue_delay_us=50_000)
+        outs = await asyncio.gather(
+            *[batcher.infer([np.full((1, 2), i, np.float32)]) for i in range(4)]
+        )
+        return outs, batcher
+
+    outs, batcher = asyncio.run(run())
+    assert [o[0].tolist() for o in outs] == [[[2 * i, 2 * i]] for i in range(4)]
+    # the four concurrent single-row requests must coalesce (not 4x batch=1)
+    assert batcher.batches_executed < 4
+    assert batcher.requests_served == 4
+
+
+def test_dynamic_batcher_error_propagates():
+    def run_batch(concat):
+        raise RuntimeError("boom")
+
+    async def run():
+        batcher = DynamicBatcher(run_batch, preferred_batch_size=2, max_queue_delay_us=100)
+        with pytest.raises(RuntimeError):
+            await batcher.infer([np.zeros((1, 2), np.float32)])
+
+    asyncio.run(run())
+
+
+@pytest.fixture()
+def grpc_setup(state_root, tmp_path):
+    """Control plane + jax_grpc endpoint + in-process engine server."""
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="es")
+    bundle = models.build_model("mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3})
+    params = bundle.init(jax.random.PRNGKey(0))
+    bdir = tmp_path / "bundle"
+    save_bundle(bdir, "mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3}, params)
+    rec = mrp.registry.register("mlp", path=bdir, framework="jax")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="jax_grpc",
+            serving_url="grpc_mlp",
+            model_id=rec.id,
+            input_name="features",
+            input_type="float32",
+            input_size=[4],
+            output_type="float32",
+            output_name="logits",
+        )
+    )
+    mrp.serialize()
+    return mrp, bundle, params
+
+
+def test_engine_server_end_to_end(grpc_setup, state_root):
+    mrp, bundle, params = grpc_setup
+
+    async def run():
+        repo = EngineModelRepo(
+            ModelRequestProcessor(service_id=mrp.get_id(), state_root=str(state_root))
+        )
+        assert repo.sync() == 1
+        server, port = make_server(repo, 0)
+        await server.start()
+        try:
+            # point the router config at the in-process server
+            mrp.configure(external_engine_grpc_address="127.0.0.1:{}".format(port))
+            client_mrp = ModelRequestProcessor(service_id=mrp.get_id(), state_root=str(state_root))
+            client_mrp.deserialize(skip_sync=True)
+            out = await client_mrp.process_request(
+                "grpc_mlp", None, {"features": [[1, 2, 3, 4], [4, 3, 2, 1]]}
+            )
+            # unknown model -> 422-class EndpointModelError
+            from clearml_serving_tpu.engines.base import EndpointModelError
+
+            proc = client_mrp._engine_processor_lookup["grpc_mlp"]
+            import dataclasses
+
+            bad_ep = dataclasses.replace(proc.endpoint, serving_url="ghost")
+            bad = get_engine_cls("jax_grpc")(bad_ep, service=client_mrp._service,
+                                             registry=client_mrp.registry)
+            try:
+                await bad.process({"features": [[1, 2, 3, 4]]}, {}, None)
+                raised = False
+            except EndpointModelError:
+                raised = True
+            return out, raised
+        finally:
+            await server.stop(None)
+
+    out, raised = asyncio.run(run())
+    expected = bundle.apply(params, np.array([[1, 2, 3, 4], [4, 3, 2, 1]], np.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5)
+    assert raised
+
+
+def test_hot_swap_on_model_change(grpc_setup, state_root, tmp_path):
+    mrp, bundle, params = grpc_setup
+    repo = EngineModelRepo(
+        ModelRequestProcessor(service_id=mrp.get_id(), state_root=str(state_root))
+    )
+    assert repo.sync() == 1
+    assert repo.sync() == 0  # unchanged -> no reload
+
+    # register a new model and repoint the endpoint at it
+    params2 = bundle.init(jax.random.PRNGKey(7))
+    bdir2 = tmp_path / "bundle2"
+    save_bundle(bdir2, "mlp", {"in_dim": 4, "hidden": [8], "out_dim": 3}, params2)
+    rec2 = mrp.registry.register("mlp-v2", path=bdir2, framework="jax")
+    ep = mrp.list_endpoints()["grpc_mlp"]
+    ep.model_id = rec2.id
+    mrp.add_endpoint(ep)
+    mrp.serialize()
+
+    assert repo.sync() == 1  # hot swap
+    x = np.ones((1, 4), np.float32)
+    out = repo.get("grpc_mlp").run_batch([x])[0]
+    np.testing.assert_allclose(out, np.asarray(bundle.apply(params2, x)), rtol=1e-5)
+
+    # removing the endpoint drops the model
+    mrp.remove_endpoint("grpc_mlp")
+    mrp.serialize()
+    repo.sync()
+    assert repo.get("grpc_mlp") is None
