@@ -1,0 +1,169 @@
+"""Paged KV cache: page pool + per-sequence page tables.
+
+vLLM's PagedAttention memory model rebuilt for TPU/HBM (SURVEY.md §2.9 row 2):
+the cache is a fixed pool of fixed-size pages per layer; sequences own page
+lists, so HBM holds only the tokens that exist and slots never reserve
+max_seq_len. Allocation is host-side (cheap integer bookkeeping); the device
+side sees dense pools + int32 page tables, which feed
+ops/paged_attention.paged_attention.
+
+Device layout per layer:   k_pool/v_pool [Hkv, num_pages, page_size, D]
+(head-major — the layout ops/paged_attention.py's kernel tiles over)
+Host bookkeeping:          free-page stack + per-slot page lists
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class PagePool:
+    """Host-side page allocator for a fixed pool."""
+
+    def __init__(self, num_pages: int, page_size: int, max_slots: int):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+        self._slot_len: List[int] = [0] * max_slots
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def can_allocate(self, tokens: int) -> bool:
+        return self.pages_needed(tokens) <= len(self._free)
+
+    def allocate(self, slot: int, tokens: int) -> List[int]:
+        """Give `slot` enough pages for `tokens` total; returns new page ids."""
+        have = len(self._slot_pages[slot])
+        need = self.pages_needed(tokens) - have
+        if need > len(self._free):
+            raise MemoryError(
+                "page pool exhausted: need {} pages, {} free".format(need, len(self._free))
+            )
+        new = [self._free.pop() for _ in range(max(0, need))]
+        self._slot_pages[slot].extend(new)
+        self._slot_len[slot] = tokens
+        return new
+
+    def extend(self, slot: int, extra_tokens: int = 1) -> List[int]:
+        """Grow a sequence; returns ALL newly allocated page ids (possibly
+        several when `extra_tokens` spans page boundaries; empty if none)."""
+        return self.allocate(slot, self._slot_len[slot] + extra_tokens)
+
+    def free(self, slot: int) -> None:
+        self._free.extend(reversed(self._slot_pages[slot]))
+        self._slot_pages[slot] = []
+        self._slot_len[slot] = 0
+
+    def slot_length(self, slot: int) -> int:
+        return self._slot_len[slot]
+
+    def page_table(self, pages_per_seq: int) -> np.ndarray:
+        """Dense [max_slots, pages_per_seq] table (unused entries point at
+        page 0 — they are masked by lengths on the device side). Raises if any
+        slot owns more pages than the table can express — silently truncating
+        would drop the newest tokens from attention."""
+        table = np.zeros((self.max_slots, pages_per_seq), np.int32)
+        for slot, pages in enumerate(self._slot_pages):
+            if len(pages) > pages_per_seq:
+                raise ValueError(
+                    "slot {} holds {} pages > table width {}".format(
+                        slot, len(pages), pages_per_seq
+                    )
+                )
+            table[slot, : len(pages)] = pages
+        return table
+
+    def lengths(self) -> np.ndarray:
+        return np.asarray(self._slot_len, np.int32)
+
+
+class PagedKVCache:
+    """Device pools for all layers + the shared host-side PagePool.
+
+    Pools are ONE stacked array per side — ``k``/``v`` [L, Hkv, N, P, D] — and
+    every write goes through a jitted, buffer-donating scatter: the pool is
+    updated in place in HBM, never copied (an eager ``.at[].set`` would copy
+    the whole multi-GB pool per token)."""
+
+    def __init__(
+        self,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        *,
+        num_pages: int,
+        page_size: int = 16,
+        max_slots: int = 8,
+        dtype="bfloat16",
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.pool = PagePool(num_pages, page_size, max_slots)
+        self.n_layers = n_layers
+        shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
+        self.k = jnp.zeros(shape, jnp.dtype(dtype))
+        self.v = jnp.zeros(shape, jnp.dtype(dtype))
+
+        def _write_page(pool, chunk, page):
+            # chunk [L, Hkv, P, D] -> pool[:, :, page]
+            return jax.lax.dynamic_update_slice(
+                pool, chunk[:, :, None], (0, 0, page, 0, 0)
+            )
+
+        def _write_token(pool, kv, page, offset):
+            # kv [L, Hkv, D] -> pool[:, :, page, offset]
+            return jax.lax.dynamic_update_slice(
+                pool, kv[:, :, None, None], (0, 0, page, offset, 0)
+            )
+
+        self._write_page = jax.jit(_write_page, donate_argnums=(0,))
+        self._write_token = jax.jit(_write_token, donate_argnums=(0,))
+
+    def layer(self, li: int):
+        """Per-layer head-major views for ops.paged_attention."""
+        return self.k[li], self.v[li]
+
+    def max_pages_per_seq(self, max_seq_len: int) -> int:
+        return self.pool.pages_needed(max_seq_len)
+
+    def write_prompt(self, slot: int, k_stack, v_stack, length: int) -> None:
+        """Scatter a prefilled prompt's KV (stacked [L, S, Hkv, D]) into this
+        slot's pages via donated jitted writes."""
+        import jax.numpy as jnp
+
+        self.pool.free(slot)
+        self.pool.allocate(slot, length)
+        pages = self.pool._slot_pages[slot]
+        page_size = self.pool.page_size
+        k_hm = jnp.moveaxis(jnp.asarray(k_stack), 2, 1)  # [L, Hkv, S, D]
+        v_hm = jnp.moveaxis(jnp.asarray(v_stack), 2, 1)
+        for i, page in enumerate(pages):
+            lo = i * page_size
+            hi = min(lo + page_size, length)
+            pad = page_size - (hi - lo)
+            k_chunk = jnp.pad(k_hm[:, :, lo:hi], ((0, 0), (0, 0), (0, pad), (0, 0)))
+            v_chunk = jnp.pad(v_hm[:, :, lo:hi], ((0, 0), (0, 0), (0, pad), (0, 0)))
+            self.k = self._write_page(self.k, k_chunk, page)
+            self.v = self._write_page(self.v, v_chunk, page)
+
+    def append_token(self, slot: int, k_token, v_token) -> None:
+        """Append one token's KV (stacked [L, Hkv, D]) to the slot."""
+        import jax.numpy as jnp
+
+        length = self.pool.slot_length(slot)
+        self.pool.extend(slot, 1)
+        page_idx = length // self.pool.page_size
+        offset = length % self.pool.page_size
+        page = self.pool._slot_pages[slot][page_idx]
+        self.k = self._write_token(self.k, jnp.asarray(k_token), page, offset)
+        self.v = self._write_token(self.v, jnp.asarray(v_token), page, offset)
