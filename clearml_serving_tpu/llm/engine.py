@@ -84,6 +84,7 @@ class LLMEngineCore:
         eos_token_id: Optional[int] = None,
         rng_seed: int = 0,
         decode_steps: int = 4,
+        quantize: Optional[str] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -95,6 +96,20 @@ class LLMEngineCore:
         ) or [max_seq_len]
         self._mesh = mesh
 
+        # int8 weight quantization: params live in HBM as int8 + scales; the
+        # jitted step functions dequantize INSIDE the traced computation, so
+        # XLA fuses dequant next to each consumer matmul (no full bf16
+        # materialization at rest; weights-at-rest HBM ~halves).
+        self._dequant = None
+        if quantize == "int8":
+            from ..ops.quant import dequant_llama_params, quantize_llama_params
+
+            params = quantize_llama_params(params)
+            dtype = jnp.dtype(bundle.config.get("dtype", "bfloat16"))
+            self._dequant = lambda p: dequant_llama_params(p, dtype)
+        elif quantize:
+            raise ValueError("unsupported quantize mode {!r}".format(quantize))
+
         if mesh is not None:
             from ..parallel.sharding import (
                 llama_cache_sharding,
@@ -102,7 +117,10 @@ class LLMEngineCore:
                 shard_params,
             )
 
-            self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
+            if self._dequant is None:
+                self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
+            else:
+                self.params = params  # quantized tree: replicate (TP-shard in a later round)
             self._cache_sharding = llama_cache_sharding(mesh)
         else:
             self.params = params
@@ -130,8 +148,11 @@ class LLMEngineCore:
 
         # -- compiled functions --------------------------------------------
 
+        def _materialize(params):
+            return params if self._dequant is None else self._dequant(params)
+
         def _prefill(params, tokens, seq_lens, cache_template):
-            return bundle.prefill(params, tokens, seq_lens, cache_template)
+            return bundle.prefill(_materialize(params), tokens, seq_lens, cache_template)
 
         self._prefill_jit = jax.jit(_prefill)
 
@@ -148,6 +169,7 @@ class LLMEngineCore:
         def _decode_chunk(params, tokens, cache, active, sampling, rng):
             """`decode_steps` decode+sample steps fused in one executable
             (lax.scan) — host dispatch overhead amortizes over the chunk."""
+            params = _materialize(params)
 
             def body(carry, step_rng):
                 tokens, cache = carry

@@ -114,11 +114,12 @@ class PagedKVCache:
         self.k = jnp.zeros(shape, jnp.dtype(dtype))
         self.v = jnp.zeros(shape, jnp.dtype(dtype))
 
-        def _write_page(pool, chunk, page):
-            # chunk [L, Hkv, P, D] -> pool[:, :, page]
-            return jax.lax.dynamic_update_slice(
-                pool, chunk[:, :, None], (0, 0, page, 0, 0)
-            )
+        def _write_pages(pool, chunks, pages):
+            # chunks [NP, L, Hkv, P, D], pages [NP] -> scatter all pages in ONE
+            # dispatch (a per-page Python loop would put O(prompt/page_size)
+            # host->device roundtrips on the TTFT-critical prefill path)
+            chunks = jnp.moveaxis(chunks, 0, 2)          # [L, Hkv, NP, P, D]
+            return pool.at[:, :, pages].set(chunks)
 
         def _write_token(pool, kv, page, offset):
             # kv [L, Hkv, D] -> pool[:, :, page, offset]
@@ -126,7 +127,7 @@ class PagedKVCache:
                 pool, kv[:, :, None, None], (0, 0, page, offset, 0)
             )
 
-        self._write_page = jax.jit(_write_page, donate_argnums=(0,))
+        self._write_pages = jax.jit(_write_pages, donate_argnums=(0,))
         self._write_token = jax.jit(_write_token, donate_argnums=(0,))
 
     def layer(self, li: int):
@@ -145,16 +146,19 @@ class PagedKVCache:
         self.pool.allocate(slot, length)
         pages = self.pool._slot_pages[slot]
         page_size = self.pool.page_size
+        n_pages = len(pages)
         k_hm = jnp.moveaxis(jnp.asarray(k_stack), 2, 1)  # [L, Hkv, S, D]
         v_hm = jnp.moveaxis(jnp.asarray(v_stack), 2, 1)
-        for i, page in enumerate(pages):
-            lo = i * page_size
-            hi = min(lo + page_size, length)
-            pad = page_size - (hi - lo)
-            k_chunk = jnp.pad(k_hm[:, :, lo:hi], ((0, 0), (0, 0), (0, pad), (0, 0)))
-            v_chunk = jnp.pad(v_hm[:, :, lo:hi], ((0, 0), (0, 0), (0, pad), (0, 0)))
-            self.k = self._write_page(self.k, k_chunk, page)
-            self.v = self._write_page(self.v, v_chunk, page)
+        pad_to = n_pages * page_size
+        k_hm = jnp.pad(k_hm, ((0, 0), (0, 0), (0, pad_to - k_hm.shape[2]), (0, 0)))
+        v_hm = jnp.pad(v_hm, ((0, 0), (0, 0), (0, pad_to - v_hm.shape[2]), (0, 0)))
+        l, hkv, _, d = k_hm.shape
+        # [L,Hkv,NP*P,D] -> [NP, L, Hkv, P, D]
+        k_chunks = k_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
+        v_chunks = v_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
+        page_ids = jnp.asarray(pages, jnp.int32)
+        self.k = self._write_pages(self.k, k_chunks, page_ids)
+        self.v = self._write_pages(self.v, v_chunks, page_ids)
 
     def append_token(self, slot: int, k_token, v_token) -> None:
         """Append one token's KV (stacked [L, Hkv, D]) to the slot."""

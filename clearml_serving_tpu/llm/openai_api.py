@@ -100,6 +100,7 @@ class LLMEngineRequest(BaseEngineRequest):
             mesh=mesh,
             eos_token_id=self.tokenizer.eos_token_id,
             decode_steps=int(engine_cfg.get("decode_steps", 4)),
+            quantize=engine_cfg.get("quantize"),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine

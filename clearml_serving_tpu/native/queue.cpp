@@ -1,0 +1,186 @@
+// Lock-free bounded MPSC byte-message ring queue + latency histogram.
+//
+// Native backend for the serving runtime's statistics hot path: request
+// handlers (multiple producers: gunicorn-style worker threads) push serialized
+// stat packets without taking a lock; the single stats-sender thread drains
+// batches. The reference achieves this in Python with GIL-atomic counters
+// (clearml-serving model_request_processor.py FastWriteCounter/FastSimpleQueue);
+// here the hot path is C++ with C11-atomic semantics, exposed through a plain
+// C ABI for ctypes (no pybind11 dependency in the image).
+//
+// Layout: a ring of fixed-size cells. Each cell has a sequence number
+// (Vyukov MPMC algorithm, specialised to MPSC drain) plus a length-prefixed
+// payload buffer. Push is wait-free absent contention; a full queue drops the
+// message (statistics are best-effort by contract).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct Cell {
+    std::atomic<uint64_t> seq;
+    uint32_t len;
+    // payload bytes follow the header in the arena
+};
+
+struct Queue {
+    uint64_t capacity;       // number of cells (power of two)
+    uint64_t mask;
+    uint64_t cell_bytes;     // payload capacity per cell
+    uint64_t stride;         // bytes between cell headers
+    std::atomic<uint64_t> head;  // consumer position
+    std::atomic<uint64_t> tail;  // producer position
+    std::atomic<uint64_t> dropped;
+    unsigned char* arena;
+
+    Cell* cell(uint64_t idx) {
+        return reinterpret_cast<Cell*>(arena + (idx & mask) * stride);
+    }
+};
+
+struct Histogram {
+    // fixed latency buckets in microseconds; last bucket = +inf
+    static const int kBuckets = 16;
+    uint64_t bounds_us[kBuckets - 1];
+    std::atomic<uint64_t> counts[kBuckets];
+    std::atomic<uint64_t> total_count;
+    std::atomic<uint64_t> total_us;
+};
+
+}  // namespace
+
+extern "C" {
+
+Queue* tpuserve_queue_create(uint64_t capacity_pow2, uint64_t cell_bytes) {
+    uint64_t cap = 1;
+    while (cap < capacity_pow2) cap <<= 1;
+    Queue* q = new (std::nothrow) Queue();
+    if (!q) return nullptr;
+    q->capacity = cap;
+    q->mask = cap - 1;
+    q->cell_bytes = cell_bytes;
+    // align cell stride to 64 bytes (cache line) to avoid false sharing
+    uint64_t stride = sizeof(Cell) + cell_bytes;
+    q->stride = (stride + 63) & ~uint64_t(63);
+    q->arena = new (std::nothrow) unsigned char[q->stride * cap];
+    if (!q->arena) { delete q; return nullptr; }
+    for (uint64_t i = 0; i < cap; ++i) {
+        q->cell(i)->seq.store(i, std::memory_order_relaxed);
+        q->cell(i)->len = 0;
+    }
+    q->head.store(0, std::memory_order_relaxed);
+    q->tail.store(0, std::memory_order_relaxed);
+    q->dropped.store(0, std::memory_order_relaxed);
+    return q;
+}
+
+void tpuserve_queue_destroy(Queue* q) {
+    if (!q) return;
+    delete[] q->arena;
+    delete q;
+}
+
+// Returns 1 on success, 0 when full (message dropped) or oversized.
+int tpuserve_queue_push(Queue* q, const unsigned char* data, uint32_t len) {
+    if (len > q->cell_bytes) return 0;
+    uint64_t pos = q->tail.load(std::memory_order_relaxed);
+    for (;;) {
+        Cell* c = q->cell(pos);
+        uint64_t seq = c->seq.load(std::memory_order_acquire);
+        int64_t diff = (int64_t)seq - (int64_t)pos;
+        if (diff == 0) {
+            if (q->tail.compare_exchange_weak(
+                    pos, pos + 1, std::memory_order_relaxed)) {
+                std::memcpy(reinterpret_cast<unsigned char*>(c) + sizeof(Cell),
+                            data, len);
+                c->len = len;
+                c->seq.store(pos + 1, std::memory_order_release);
+                return 1;
+            }
+        } else if (diff < 0) {
+            q->dropped.fetch_add(1, std::memory_order_relaxed);
+            return 0;  // full
+        } else {
+            pos = q->tail.load(std::memory_order_relaxed);
+        }
+    }
+}
+
+// Single consumer: pops one message into out (size out_cap). Returns payload
+// length, 0 if empty, or -1 if out_cap too small (message left in place).
+int64_t tpuserve_queue_pop(Queue* q, unsigned char* out, uint64_t out_cap) {
+    uint64_t pos = q->head.load(std::memory_order_relaxed);
+    Cell* c = q->cell(pos);
+    uint64_t seq = c->seq.load(std::memory_order_acquire);
+    int64_t diff = (int64_t)seq - (int64_t)(pos + 1);
+    if (diff < 0) return 0;  // empty
+    if (c->len > out_cap) return -1;
+    uint32_t len = c->len;
+    std::memcpy(out, reinterpret_cast<unsigned char*>(c) + sizeof(Cell), len);
+    c->seq.store(pos + q->capacity, std::memory_order_release);
+    q->head.store(pos + 1, std::memory_order_relaxed);
+    return (int64_t)len;
+}
+
+uint64_t tpuserve_queue_size(Queue* q) {
+    uint64_t tail = q->tail.load(std::memory_order_relaxed);
+    uint64_t head = q->head.load(std::memory_order_relaxed);
+    return tail > head ? tail - head : 0;
+}
+
+uint64_t tpuserve_queue_dropped(Queue* q) {
+    return q->dropped.load(std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------- histogram
+
+Histogram* tpuserve_hist_create() {
+    Histogram* h = new (std::nothrow) Histogram();
+    if (!h) return nullptr;
+    // 5ms..5s-style default ladder, in microseconds (reference bucket range)
+    static const uint64_t bounds[Histogram::kBuckets - 1] = {
+        500, 1000, 2500, 5000, 10000, 25000, 50000, 75000, 100000,
+        250000, 500000, 750000, 1000000, 2500000, 5000000,
+    };
+    std::memcpy(h->bounds_us, bounds, sizeof(bounds));
+    for (int i = 0; i < Histogram::kBuckets; ++i)
+        h->counts[i].store(0, std::memory_order_relaxed);
+    h->total_count.store(0, std::memory_order_relaxed);
+    h->total_us.store(0, std::memory_order_relaxed);
+    return h;
+}
+
+void tpuserve_hist_destroy(Histogram* h) { delete h; }
+
+void tpuserve_hist_observe(Histogram* h, uint64_t us) {
+    int lo = 0, hi = Histogram::kBuckets - 1;
+    while (lo < hi) {
+        int mid = (lo + hi) / 2;
+        if (us <= h->bounds_us[mid]) hi = mid; else lo = mid + 1;
+    }
+    h->counts[lo].fetch_add(1, std::memory_order_relaxed);
+    h->total_count.fetch_add(1, std::memory_order_relaxed);
+    h->total_us.fetch_add(us, std::memory_order_relaxed);
+}
+
+// Fills counts[kBuckets], returns total_count; bounds via tpuserve_hist_bounds.
+uint64_t tpuserve_hist_snapshot(Histogram* h, uint64_t* counts_out) {
+    for (int i = 0; i < Histogram::kBuckets; ++i)
+        counts_out[i] = h->counts[i].load(std::memory_order_relaxed);
+    return h->total_count.load(std::memory_order_relaxed);
+}
+
+int tpuserve_hist_num_buckets() { return Histogram::kBuckets; }
+
+void tpuserve_hist_bounds(Histogram* h, uint64_t* bounds_out) {
+    std::memcpy(bounds_out, h->bounds_us, sizeof(h->bounds_us));
+}
+
+uint64_t tpuserve_hist_total_us(Histogram* h) {
+    return h->total_us.load(std::memory_order_relaxed);
+}
+
+}  // extern "C"

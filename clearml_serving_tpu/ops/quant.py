@@ -34,8 +34,11 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarr
 
 def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize every projection matrix of a llama param pytree to int8;
-    norms/embeddings stay bf16. Result is served by `dequant_llama_params`
-    streaming (layer-at-a-time dequant keeps peak HBM at int8 + one layer)."""
+    norms/embeddings stay bf16. Serve by calling `dequant_llama_params`
+    INSIDE the jitted step function (see llm/engine.py) — XLA then fuses each
+    dequant next to its consumer matmul and frees the bf16 buffer after use,
+    so weights at rest stay int8. Calling dequant eagerly (outside jit)
+    materializes a full bf16 copy and defeats the purpose."""
     quant_keys = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
 
     def _q(tree):

@@ -201,9 +201,13 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
             }
         )
 
+    async def dashboard(request: web.Request) -> web.Response:
+        return web.json_response(processor.get_serving_layout())
+
     app.router.add_post("/{}/{{tail:.+}}".format(serve_suffix), serve_model)
     app.router.add_get("/{}/{{tail:openai/.+}}".format(serve_suffix), serve_model)
     app.router.add_get("/health", health)
+    app.router.add_get("/dashboard", dashboard)
     app.router.add_get("/", health)
     return app
 

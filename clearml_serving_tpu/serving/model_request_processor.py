@@ -89,21 +89,47 @@ class FastWriteCounter:
 
 
 class FastSimpleQueue:
-    """Deque-backed stats queue with batched wakeups: the notifier only fires
-    the Event every `_notify_every` seconds, trading latency for throughput on
-    the hot path (reference :73-101)."""
+    """Stats queue with batched wakeups: the notifier only fires the Event
+    every `_notify_every` seconds, trading latency for throughput on the hot
+    path (reference :73-101).
+
+    Backend: a plain deque by default (GIL-atomic append/popleft — fastest in
+    CPython). Setting ``TPUSERVE_NATIVE_QUEUE=1`` switches to the native
+    lock-free MPSC ring (clearml_serving_tpu/native) for free-threaded /
+    subinterpreter builds where the deque path contends; packets are JSON on
+    the wire either way."""
 
     _notify_every = 10.0
 
     def __init__(self):
+        import json as _json
         from collections import deque
 
+        self._json = _json
+        self._native = None
+        if os.environ.get("TPUSERVE_NATIVE_QUEUE"):
+            try:
+                from ..native import NativeQueue
+
+                self._native = NativeQueue(capacity=1024, cell_bytes=4096)
+            except Exception:
+                pass
         self._q = deque()
         self._event = threading.Event()
         self._last_notify = time.time()
 
     def put(self, item) -> None:
+        if self._native is not None:
+            try:
+                if self._native.push(self._json.dumps(item).encode("utf-8")):
+                    self._maybe_notify()
+                    return
+            except (TypeError, ValueError):
+                pass  # non-JSON stat packet: deque fallback below
         self._q.append(item)
+        self._maybe_notify()
+
+    def _maybe_notify(self) -> None:
         if time.time() - self._last_notify > self._notify_every:
             self._last_notify = time.time()
             self._event.set()
@@ -111,7 +137,13 @@ class FastSimpleQueue:
     def get_all(self, timeout: float) -> List[Any]:
         self._event.wait(timeout=timeout)
         self._event.clear()
-        out = []
+        out: List[Any] = []
+        if self._native is not None:
+            for raw in self._native.pop_all():
+                try:
+                    out.append(self._json.loads(raw))
+                except ValueError:
+                    pass
         while True:
             try:
                 out.append(self._q.popleft())
@@ -730,6 +762,9 @@ class ModelRequestProcessor:
                 self._service.ping(instance_id=self._instance_id)
                 self.deserialize()
                 self._update_monitored_models()
+                self._service.set_runtime_properties(
+                    {"layout": self.get_serving_layout()}
+                )
             except Exception as ex:
                 print("sync daemon error: {}".format(ex))
 
@@ -756,6 +791,44 @@ class ModelRequestProcessor:
             except Exception as ex:
                 print("stats send error: {}".format(ex))
                 time.sleep(5.0)
+
+    # -- observability ---------------------------------------------------------
+
+    def get_serving_layout(self) -> Dict[str, Any]:
+        """Endpoint table + routing graph — the reference's endpoint-table /
+        Sankey plot data (reference :1141-1278) as a JSON document. Exposed by
+        the router's /dashboard route; the sync daemon also persists it to the
+        service document's runtime properties each poll."""
+        table = []
+        for url, ep in sorted({**self._model_monitoring_endpoints, **self._endpoints}.items()):
+            table.append(
+                {
+                    "endpoint": url,
+                    "engine": ep.engine_type,
+                    "model_id": ep.model_id,
+                    "version": ep.version,
+                    "preprocess": ep.preprocess_artifact,
+                    "monitored": url in self._model_monitoring_endpoints,
+                    "loaded": url in self._engine_processor_lookup,
+                }
+            )
+        # routing graph: external -> canary -> versions, monitoring -> versions
+        edges = []
+        for name, route in self._canary_route.items():
+            for target, weight in zip(route["endpoints"], route["weights"]):
+                edges.append({"from": "canary:{}".format(name), "to": target,
+                              "weight": round(weight, 4)})
+        for name in self._model_monitoring:
+            for url in self._model_monitoring_endpoints:
+                if url.startswith(name + "/"):
+                    edges.append({"from": "monitor:{}".format(name), "to": url, "weight": 1.0})
+        return {
+            "service_id": self._service.id,
+            "instance": self._instance_id,
+            "endpoints": table,
+            "routing": edges,
+            "metrics": {k: v.as_dict() for k, v in self._metric_logging.items()},
+        }
 
     # -- validation ------------------------------------------------------------
 

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Role-selecting entrypoint with the reference's restart-on-failure loop
+# (reference serving/entrypoint.sh CLEARML_SERVING_RESTART_ON_FAILURE).
+set -uo pipefail
+
+ROLE="${1:-inference}"
+RESTART="${TPUSERVE_RESTART_ON_FAILURE:-1}"
+
+if [ -n "${TPUSERVE_EXTRA_PYTHON_PACKAGES:-}" ]; then
+    pip install --no-cache-dir ${TPUSERVE_EXTRA_PYTHON_PACKAGES}
+fi
+
+run_role() {
+    case "$ROLE" in
+        inference)  exec_cmd="tpu-serving-inference" ;;
+        engine)     exec_cmd="tpu-serving-engine" ;;
+        statistics) exec_cmd="tpu-serving-statistics" ;;
+        *) echo "unknown role: $ROLE" >&2; exit 2 ;;
+    esac
+    $exec_cmd
+}
+
+while true; do
+    run_role
+    code=$?
+    if [ "$RESTART" != "1" ]; then
+        exit $code
+    fi
+    echo "service exited ($code); restarting in 5s..." >&2
+    sleep 5
+done

@@ -1,0 +1,81 @@
+"""Build a bert-base token-classification jax bundle.
+
+With a local HuggingFace checkpoint this copies real weights; without one it
+falls back to random init (identical serving path; reference parity is the
+conversion flow: reference examples/huggingface exports ONNX for Triton,
+here HF state-dict -> jax pytree)."""
+
+import sys
+
+import jax
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.engines.jax_engine import save_bundle
+
+CONFIG = {"preset": "bert-base", "num_labels": 9}
+
+
+def convert_from_hf(hf_dir: str):
+    """Map a HF BertForTokenClassification state dict into our param pytree."""
+    import numpy as np
+    import torch
+
+    from transformers import AutoModelForTokenClassification
+
+    hf = AutoModelForTokenClassification.from_pretrained(hf_dir, local_files_only=True)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    bundle = models.build_model("bert", CONFIG)
+    params = bundle.init(jax.random.PRNGKey(0))
+
+    def t(name):
+        return np.asarray(sd[name])
+
+    params["word_embed"] = t("bert.embeddings.word_embeddings.weight")
+    params["pos_embed"] = t("bert.embeddings.position_embeddings.weight")
+    params["type_embed"] = t("bert.embeddings.token_type_embeddings.weight")
+    params["embed_norm"] = {
+        "scale": t("bert.embeddings.LayerNorm.weight"),
+        "bias": t("bert.embeddings.LayerNorm.bias"),
+    }
+    for i, layer in enumerate(params["layers"]):
+        pre = "bert.encoder.layer.{}.".format(i)
+        wq = t(pre + "attention.self.query.weight").T
+        wk = t(pre + "attention.self.key.weight").T
+        wv = t(pre + "attention.self.value.weight").T
+        layer["wqkv"] = np.concatenate([wq, wk, wv], axis=1)
+        layer["bqkv"] = np.concatenate(
+            [t(pre + "attention.self.query.bias"), t(pre + "attention.self.key.bias"),
+             t(pre + "attention.self.value.bias")]
+        )
+        layer["wo"] = t(pre + "attention.output.dense.weight").T
+        layer["bo"] = t(pre + "attention.output.dense.bias")
+        layer["attn_norm"] = {
+            "scale": t(pre + "attention.output.LayerNorm.weight"),
+            "bias": t(pre + "attention.output.LayerNorm.bias"),
+        }
+        layer["w1"] = t(pre + "intermediate.dense.weight").T
+        layer["b1"] = t(pre + "intermediate.dense.bias")
+        layer["w2"] = t(pre + "output.dense.weight").T
+        layer["b2"] = t(pre + "output.dense.bias")
+        layer["ffn_norm"] = {
+            "scale": t(pre + "output.LayerNorm.weight"),
+            "bias": t(pre + "output.LayerNorm.bias"),
+        }
+    params["classifier"] = {"w": t("classifier.weight").T, "b": t("classifier.bias")}
+    return params
+
+
+def main():
+    bundle = models.build_model("bert", CONFIG)
+    if len(sys.argv) > 1:
+        params = convert_from_hf(sys.argv[1])
+        print("converted weights from", sys.argv[1])
+    else:
+        params = bundle.init(jax.random.PRNGKey(0))
+        print("no checkpoint given: random init (serving-path demo)")
+    save_bundle("bert-bundle", "bert", CONFIG, params)
+    print("saved ./bert-bundle")
+
+
+if __name__ == "__main__":
+    main()

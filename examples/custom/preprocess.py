@@ -1,0 +1,22 @@
+"""Fully-custom model: load() builds it, process() runs it."""
+
+from typing import Any
+
+
+class Preprocess(object):
+    def load(self, local_file_name) -> Any:
+        # build/load anything; keep a reference for process() (per-endpoint
+        # instance — safe), and return it so the engine tracks lifetime
+        self.model = lambda xs: [x * 2 for x in xs]
+        return self.model
+
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        return body.get("x", [])
+
+    def process(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> Any:
+        if collect_custom_statistics_fn:
+            collect_custom_statistics_fn({"x0": data[0] if data else 0})
+        return self.model(data)
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        return {"y": data}

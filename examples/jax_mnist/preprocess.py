@@ -1,0 +1,19 @@
+"""MNIST endpoint hooks: accept a nested-list image, return digit + probs."""
+
+from typing import Any
+
+import numpy as np
+
+
+class Preprocess(object):
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        image = np.asarray(body["image"], dtype=np.float32)
+        if image.ndim == 2:           # single image -> batch of one
+            image = image[None]
+        return {"image": image}
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        logits = np.asarray(data)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        return {"digit": int(probs[0].argmax()), "probs": probs[0].tolist()}

@@ -1,0 +1,20 @@
+"""Build an MNIST-CNN jax bundle (random-init here; swap in real training or a
+converted checkpoint for accuracy — the serving path is identical)."""
+
+import jax
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.engines.jax_engine import save_bundle
+
+CONFIG = {"in_hw": [28, 28], "in_ch": 1, "channels": [32, 64], "dense": 128, "out_dim": 10}
+
+
+def main():
+    bundle = models.build_model("cnn", CONFIG)
+    params = bundle.init(jax.random.PRNGKey(0))
+    save_bundle("mnist-bundle", "cnn", CONFIG, params)
+    print("saved ./mnist-bundle")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Load-test harness — the reference's ApacheBench recipe as a script
+(reference examples/huggingface readme "Benchmarking": ab -l -n 8000 -c 128).
+
+Reports req/s, p50/p99 latency, and for OpenAI streaming endpoints p50/p99
+TTFT — the BASELINE.md per-endpoint metrics.
+
+    python examples/loadtest/loadtest.py http://127.0.0.1:8080/serve/test_model \
+        --payload '{"x0":1,"x1":2,"x2":3,"x3":4}' -n 1000 -c 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import aiohttp
+import numpy as np
+
+
+async def worker(session, url, payload, results, ttfts, n_done, n_total, stream):
+    while True:
+        i = next(n_done)
+        if i >= n_total:
+            return
+        t0 = time.perf_counter()
+        try:
+            async with session.post(url, json=payload) as resp:
+                if stream:
+                    first = True
+                    async for _ in resp.content.iter_any():
+                        if first:
+                            ttfts.append(time.perf_counter() - t0)
+                            first = False
+                else:
+                    await resp.read()
+                results.append((time.perf_counter() - t0, resp.status))
+        except Exception:
+            results.append((time.perf_counter() - t0, -1))
+
+
+async def run(args):
+    payload = json.loads(args.payload)
+    stream = bool(payload.get("stream"))
+    results, ttfts = [], []
+    counter = iter(range(10**9))
+    timeout = aiohttp.ClientTimeout(total=args.timeout)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *[
+                worker(session, args.url, payload, results, ttfts, counter, args.n, stream)
+                for _ in range(args.concurrency)
+            ]
+        )
+        wall = time.perf_counter() - t0
+    lat = np.array([r[0] for r in results if r[1] == 200])
+    errors = sum(1 for r in results if r[1] != 200)
+    out = {
+        "requests": len(results),
+        "errors": errors,
+        "req_per_sec": round(len(lat) / wall, 2),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1000, 2) if len(lat) else None,
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1000, 2) if len(lat) else None,
+    }
+    if ttfts:
+        out["ttft_p50_ms"] = round(float(np.percentile(ttfts, 50)) * 1000, 2)
+        out["ttft_p99_ms"] = round(float(np.percentile(ttfts, 99)) * 1000, 2)
+    print(json.dumps(out))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("url")
+    parser.add_argument("--payload", default="{}")
+    parser.add_argument("-n", type=int, default=1000)
+    parser.add_argument("-c", "--concurrency", type=int, default=32)
+    parser.add_argument("--timeout", type=float, default=120.0)
+    asyncio.run(run(parser.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Async pipeline: fan out to stage_a and stage_b concurrently, merge."""
+
+import asyncio
+
+
+class Preprocess(object):
+    async def process(self, data, state, collect_custom_statistics_fn=None):
+        a, b = await asyncio.gather(
+            self.send_request("stage_a", data=data),
+            self.send_request("stage_b", data=data),
+        )
+        return {"a": a, "b": b}

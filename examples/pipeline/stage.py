@@ -1,0 +1,3 @@
+class Preprocess(object):
+    def process(self, data, state, collect_custom_statistics_fn=None):
+        return {"sum": sum(data.get("x", []))}

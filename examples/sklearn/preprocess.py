@@ -1,0 +1,12 @@
+"""Iris endpoint pre/post-processing (same contract as the reference example)."""
+
+from typing import Any
+
+
+class Preprocess(object):
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        # {"x0": .., "x1": .., "x2": .., "x3": ..} -> [[x0, x1, x2, x3]]
+        return [[body.get("x0", 0), body.get("x1", 0), body.get("x2", 0), body.get("x3", 0)]]
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        return {"y": data.tolist() if hasattr(data, "tolist") else data}

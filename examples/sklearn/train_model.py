@@ -1,0 +1,16 @@
+"""Train a toy iris classifier and save it with joblib."""
+
+import joblib
+from sklearn.datasets import load_iris
+from sklearn.linear_model import LogisticRegression
+
+
+def main():
+    x, y = load_iris(return_X_y=True)
+    model = LogisticRegression(max_iter=200).fit(x, y)
+    joblib.dump(model, "sklearn-model.pkl")
+    print("saved sklearn-model.pkl (train acc {:.3f})".format(model.score(x, y)))
+
+
+if __name__ == "__main__":
+    main()

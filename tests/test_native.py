@@ -1,0 +1,90 @@
+import threading
+
+import pytest
+
+from clearml_serving_tpu.native import NativeHistogram, NativeQueue, load_native
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native library unavailable (no toolchain)"
+)
+
+
+def test_queue_roundtrip():
+    q = NativeQueue(capacity=16, cell_bytes=64)
+    assert q.pop() is None
+    assert q.push(b"hello")
+    assert q.push(b"world")
+    assert len(q) == 2
+    assert q.pop() == b"hello"
+    assert q.pop() == b"world"
+    assert q.pop() is None
+
+
+def test_queue_oversize_and_full():
+    q = NativeQueue(capacity=4, cell_bytes=8)
+    assert not q.push(b"x" * 9)  # oversized
+    for i in range(4):
+        assert q.push(bytes([i]))
+    assert not q.push(b"full")   # ring full -> rejected
+    assert q.rejected >= 1
+    assert q.pop_all() == [bytes([i]) for i in range(4)]
+
+
+def test_queue_concurrent_producers():
+    q = NativeQueue(capacity=8192, cell_bytes=32)
+    n_threads, per_thread = 4, 2000
+    received = []
+
+    def producer(tid):
+        for i in range(per_thread):
+            while not q.push("{}:{}".format(tid, i).encode()):
+                pass
+
+    consumer_done = threading.Event()
+
+    def consumer():
+        while len(received) < n_threads * per_thread:
+            item = q.pop()
+            if item is not None:
+                received.append(item)
+        consumer_done.set()
+
+    threads = [threading.Thread(target=producer, args=(t,)) for t in range(n_threads)]
+    ct = threading.Thread(target=consumer)
+    ct.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ct.join(timeout=30)
+    assert consumer_done.is_set()
+    assert len(received) == n_threads * per_thread
+    # per-producer FIFO order is preserved
+    for tid in range(n_threads):
+        seq = [int(r.split(b":")[1]) for r in received if r.startswith(str(tid).encode())]
+        assert seq == sorted(seq)
+
+
+def test_histogram():
+    h = NativeHistogram()
+    h.observe_seconds(0.003)
+    h.observe_seconds(0.05)
+    h.observe_seconds(10.0)  # beyond last bound -> +inf bucket
+    snap = h.snapshot()
+    assert snap["total"] == 3
+    assert sum(snap["counts"]) == 3
+    assert snap["counts"][-1] == 1
+    assert snap["total_us"] >= int(10.0e6)
+
+
+def test_stats_queue_uses_native(state_root, monkeypatch):
+    from clearml_serving_tpu.serving.model_request_processor import FastSimpleQueue
+
+    monkeypatch.setenv("TPUSERVE_NATIVE_QUEUE", "1")
+    q = FastSimpleQueue()
+    assert q._native is not None
+    q.put({"_url": "e", "_latency": 0.1})
+    q.put({"not-json": object()})  # non-serializable -> deque fallback
+    out = q.get_all(timeout=0.05)
+    assert {"_url": "e", "_latency": 0.1} in out
+    assert len(out) == 2

@@ -162,6 +162,17 @@ def test_health(served):
     assert "echo" in body["endpoints"]
 
 
+def test_dashboard(served):
+    async def fn(client):
+        r = await client.get("/dashboard")
+        assert r.status == 200
+        return await r.json()
+
+    layout = _run(served, fn)
+    assert any(e["endpoint"] == "echo" for e in layout["endpoints"])
+    assert "routing" in layout and "metrics" in layout
+
+
 def test_versioned_endpoint_path(served, tmp_path):
     f = tmp_path / "v.py"
     f.write_text(ECHO_CODE)
