@@ -85,12 +85,18 @@ class LLMEngineCore:
         rng_seed: int = 0,
         decode_steps: int = 4,
         quantize: Optional[str] = None,
+        cache_mode: str = "dense",
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.eos_token_id = eos_token_id
         self.decode_steps = max(1, int(decode_steps))
+        if cache_mode not in ("dense", "paged"):
+            raise ValueError("cache_mode must be 'dense' or 'paged'")
+        self.cache_mode = cache_mode
         self._buckets = sorted(
             b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
         ) or [max_seq_len]
@@ -126,11 +132,37 @@ class LLMEngineCore:
             self.params = params
             self._cache_sharding = None
 
-        self.cache = bundle.init_cache(self.max_batch, self.max_seq_len)
-        if self._cache_sharding is not None:
-            self.cache = {
-                k: jax.device_put(v, self._cache_sharding[k]) for k, v in self.cache.items()
-            }
+        if self.cache_mode == "paged":
+            from .kv_cache import PagedKVCache
+
+            # default pool: every slot can hold max_seq_len + one decode chunk
+            # (no oversubscription by default; page 0 is the reserved null page)
+            pages_per_slot = -(-(self.max_seq_len + self.decode_steps) // page_size)
+            total_pages = num_pages or (self.max_batch * pages_per_slot + 1)
+            self.paged_cache = PagedKVCache(
+                bundle.n_layers, bundle.n_kv_heads, bundle.head_dim,
+                num_pages=total_pages, page_size=page_size,
+                max_slots=self.max_batch,
+                dtype=bundle.config.get("dtype", "bfloat16"),
+            )
+            if mesh is not None:
+                # shard the pools' kv-head dim over tp (pools [L,Hkv,N,P,D]) —
+                # without this every chip replicates the full pool
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                pool_sharding = NamedSharding(mesh, P(None, "tp", None, None, None))
+                self.paged_cache.k = jax.device_put(self.paged_cache.k, pool_sharding)
+                self.paged_cache.v = jax.device_put(self.paged_cache.v, pool_sharding)
+            self._pages_per_seq = pages_per_slot
+            self.cache = None
+        else:
+            self.paged_cache = None
+            self.cache = bundle.init_cache(self.max_batch, self.max_seq_len)
+            if self._cache_sharding is not None:
+                self.cache = {
+                    k: jax.device_put(v, self._cache_sharding[k])
+                    for k, v in self.cache.items()
+                }
 
         # slot bookkeeping (host side)
         self._slot_req: List[Optional[GenRequest]] = [None] * self.max_batch
@@ -188,6 +220,37 @@ class LLMEngineCore:
             return toks.T, cache  # [B, decode_steps]
 
         self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
+
+        def _decode_paged_chunk(
+            params, tokens, k_pools, v_pools, page_table, lengths0,
+            write_pages, write_offsets, sampling, rng,
+        ):
+            """Paged-cache variant of the fused decode chunk. Page/offset
+            write coordinates for every step come pre-computed from the host
+            page allocator (write_pages/offsets: [B, steps])."""
+            params = _materialize(params)
+
+            def body(carry, xs):
+                tokens, k_pools, v_pools, step = carry
+                step_rng, wp, wo = xs
+                logits, k_pools, v_pools = bundle.decode_paged(
+                    params, tokens, k_pools, v_pools, page_table,
+                    lengths0 + step, wp, wo,
+                )
+                sampled = sample_tokens(logits.astype(jnp.float32), sampling, step_rng)
+                return (sampled, k_pools, v_pools, step + 1), sampled
+
+            rngs = jax.random.split(rng, self.decode_steps)
+            (_, k_pools, v_pools, _), toks = jax.lax.scan(
+                body,
+                (tokens, k_pools, v_pools, jnp.int32(0)),
+                (rngs, write_pages.T, write_offsets.T),
+            )
+            return toks.T, k_pools, v_pools
+
+        self._decode_paged_chunk_jit = jax.jit(
+            _decode_paged_chunk, donate_argnums=(2, 3)
+        )
         self._sample_jit = sample_tokens
 
     # -- public API ----------------------------------------------------------
@@ -284,13 +347,7 @@ class LLMEngineCore:
             ),
             self._next_rng(),
         )
-        self.cache = self._insert_jit(
-            self.cache,
-            mini_cache["k"],
-            mini_cache["v"],
-            jnp.asarray(len(ids), jnp.int32),
-            slot,
-        )
+        self._insert_prefill(slot, mini_cache, len(ids))
         first_id = int(np.asarray(first)[0])
         self._slot_req[slot] = request
         self._next_token[slot] = first_id
@@ -299,6 +356,19 @@ class LLMEngineCore:
         self._top_p[slot] = request.top_p
         request.first_token_at = time.time()
         return first_id
+
+    def _insert_prefill(self, slot, mini_cache, n_tokens: int) -> None:
+        """Route the prefilled prompt KV into the active cache backend."""
+        if self.cache_mode == "paged":
+            # mini_cache k/v: [L, 1, bucket, Hkv, D] -> stacked [L, S, Hkv, D]
+            k_stack = mini_cache["k"][:, 0, :n_tokens]
+            v_stack = mini_cache["v"][:, 0, :n_tokens]
+            self.paged_cache.write_prompt(slot, k_stack, v_stack, n_tokens)
+        else:
+            self.cache = self._insert_jit(
+                self.cache, mini_cache["k"], mini_cache["v"],
+                jnp.asarray(n_tokens, jnp.int32), slot,
+            )
 
     def _emit(self, slot: int, token_id: int) -> None:
         request = self._slot_req[slot]
@@ -317,6 +387,8 @@ class LLMEngineCore:
         ):
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
+            if self.paged_cache is not None:
+                self.paged_cache.pool.free(slot)  # recycle the slot's pages
 
     def _fail_all(self, err: BaseException) -> None:
         """Terminate every active request with `err` (nothing may hang)."""
@@ -325,6 +397,51 @@ class LLMEngineCore:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
+                if self.paged_cache is not None:
+                    self.paged_cache.pool.free(slot)
+
+    def _run_paged_chunk(self, active_mask: np.ndarray, sampling):
+        """One fused paged-decode chunk (blocking device work; runs in a
+        worker thread). Pre-allocates each active slot's pages for the whole
+        chunk host-side, hands the per-step write coordinates to the scan.
+
+        Returns (chunk tokens [B, n], exhausted_slots): slots whose page
+        allocation failed are excluded from this chunk (their writes hit the
+        null page and their tokens are discarded) and reported back so the
+        loop can fail ONLY those requests — one sequence hitting pool
+        capacity must not take the engine down."""
+        pool = self.paged_cache.pool
+        n = self.decode_steps
+        lengths0 = pool.lengths().copy()          # pre-extension lengths
+        write_pages = np.zeros((self.max_batch, n), np.int32)   # null page 0
+        write_offsets = np.zeros((self.max_batch, n), np.int32)
+        exhausted = []
+        for slot in np.nonzero(active_mask)[0]:
+            slot = int(slot)
+            start = pool.slot_length(slot)
+            try:
+                pool.extend(slot, n)
+            except MemoryError:
+                exhausted.append(slot)
+                active_mask[slot] = False
+                continue
+            for i, (page, offset) in enumerate(pool.token_coords(slot, start, n)):
+                write_pages[slot, i] = page
+                write_offsets[slot, i] = offset
+        page_table = pool.page_table(self._pages_per_seq)
+        chunk, self.paged_cache.k, self.paged_cache.v = self._decode_paged_chunk_jit(
+            self.params,
+            jnp.asarray(self._next_token),
+            self.paged_cache.k,
+            self.paged_cache.v,
+            jnp.asarray(page_table),
+            jnp.asarray(lengths0),
+            jnp.asarray(write_pages),
+            jnp.asarray(write_offsets),
+            sampling,
+            self._next_rng(),
+        )
+        return np.asarray(chunk), exhausted
 
     async def _run_loop(self) -> None:
         try:
@@ -361,19 +478,34 @@ class LLMEngineCore:
                     return  # drained; a new generate() restarts the loop
                 continue
             # one fused decode chunk over the whole slot batch
-            chunk, self.cache = self._decode_chunk_jit(
-                self.params,
-                jnp.asarray(self._next_token),
-                self.cache,
-                jnp.asarray(active_mask),
-                SamplingParams(
-                    temperature=jnp.asarray(self._temperature),
-                    top_k=jnp.asarray(self._top_k),
-                    top_p=jnp.asarray(self._top_p),
-                ),
-                self._next_rng(),
+            sampling = SamplingParams(
+                temperature=jnp.asarray(self._temperature),
+                top_k=jnp.asarray(self._top_k),
+                top_p=jnp.asarray(self._top_p),
             )
-            chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
+            if self.cache_mode == "paged":
+                chunk_np, exhausted = await asyncio.to_thread(
+                    self._run_paged_chunk, active_mask, sampling
+                )
+                for slot in exhausted:
+                    request = self._slot_req[slot]
+                    if request is not None:
+                        request.error = MemoryError(
+                            "kv page pool exhausted for this sequence"
+                        )
+                        request.out_queue.put_nowait(_FINISHED)
+                        self._slot_req[slot] = None
+                        self.paged_cache.pool.free(slot)
+            else:
+                chunk, self.cache = self._decode_chunk_jit(
+                    self.params,
+                    jnp.asarray(self._next_token),
+                    self.cache,
+                    jnp.asarray(active_mask),
+                    sampling,
+                    self._next_rng(),
+                )
+                chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
             for slot in np.nonzero(active_mask)[0]:
                 self._next_token[slot] = int(chunk_np[slot, -1])
                 for token_id in chunk_np[slot]:

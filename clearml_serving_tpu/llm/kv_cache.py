@@ -20,13 +20,17 @@ import numpy as np
 
 
 class PagePool:
-    """Host-side page allocator for a fixed pool."""
+    """Host-side page allocator for a fixed pool.
+
+    Page 0 is RESERVED as the null page: unused page-table entries point at it
+    and inactive batch slots write their garbage KV there — it is never
+    allocated to a sequence."""
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int):
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.max_slots = int(max_slots)
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
         self._slot_len: List[int] = [0] * max_slots
 
@@ -65,6 +69,16 @@ class PagePool:
 
     def slot_length(self, slot: int) -> int:
         return self._slot_len[slot]
+
+    def token_coords(self, slot: int, start: int, count: int):
+        """(page_id, offset) for token positions [start, start+count) of a
+        slot. The single source of the page//offset math for engine, cache,
+        and tests."""
+        pages = self._slot_pages[slot]
+        out = []
+        for pos in range(start, start + count):
+            out.append((pages[pos // self.page_size], pos % self.page_size))
+        return out
 
     def page_table(self, pages_per_seq: int) -> np.ndarray:
         """Dense [max_slots, pages_per_seq] table (unused entries point at
@@ -166,8 +180,6 @@ class PagedKVCache:
 
         length = self.pool.slot_length(slot)
         self.pool.extend(slot, 1)
-        page_idx = length // self.pool.page_size
-        offset = length % self.pool.page_size
-        page = self.pool._slot_pages[slot][page_idx]
+        ((page, offset),) = self.pool.token_coords(slot, length, 1)
         self.k = self._write_token(self.k, jnp.asarray(k_token), page, offset)
         self.v = self._write_token(self.v, jnp.asarray(v_token), page, offset)

@@ -101,6 +101,9 @@ class LLMEngineRequest(BaseEngineRequest):
             eos_token_id=self.tokenizer.eos_token_id,
             decode_steps=int(engine_cfg.get("decode_steps", 4)),
             quantize=engine_cfg.get("quantize"),
+            cache_mode=engine_cfg.get("cache", "dense"),
+            page_size=int(engine_cfg.get("page_size", 16)),
+            num_pages=int(engine_cfg["num_pages"]) if engine_cfg.get("num_pages") else None,
         )
         self._model_name = self.endpoint.serving_url
         return self.engine

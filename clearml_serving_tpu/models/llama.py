@@ -260,12 +260,57 @@ def build(config: dict) -> SimpleNamespace:
         }
         return logits, cache
 
+    # -- paged KV serving path (pools from llm/kv_cache.PagedKVCache) --------
+
+    def decode_paged(
+        params,
+        tokens,        # [B] int32
+        k_pools,       # [L, Hkv, N, P, D]
+        v_pools,       # [L, Hkv, N, P, D]
+        page_table,    # [B, PP] int32
+        lengths,       # [B] int32 tokens present BEFORE this step
+        write_page,    # [B] int32 page id for the new token
+        write_offset,  # [B] int32 offset within that page
+    ):
+        """One decode step over paged KV: writes the new token's K/V into the
+        pools (scatter by (page, offset)), then attends via
+        ops.paged_attention. Returns (logits [B, vocab], k_pools, v_pools)."""
+        from ..ops.paged_attention import paged_attention
+
+        b = tokens.shape[0]
+        positions = lengths[:, None]                               # [B, 1]
+        cos, sin = _rope(positions, head_dim, theta)
+        x = params["embed"][tokens][:, None]                       # [B, 1, dim]
+        for li, layer in enumerate(params["layers"]):
+            h = _rms_norm(x, layer["attn_norm"], eps)
+            q, k, v = _qkv(layer, h, cos, sin)                     # q [B,1,H,D]
+            # scatter new K/V: pools[li, h, write_page[b], write_offset[b]] = k.
+            # NB: the advanced indices (li, write_page, write_offset) are
+            # separated by the head slice, so their broadcast dim [B] comes
+            # FIRST in the indexed shape -> set() takes [B, Hkv, D].
+            k_pools = k_pools.at[li, :, write_page, write_offset].set(
+                k[:, 0].astype(k_pools.dtype)
+            )
+            v_pools = v_pools.at[li, :, write_page, write_offset].set(
+                v[:, 0].astype(v_pools.dtype)
+            )
+            q_grouped = q[:, 0].reshape(b, n_kv, group, head_dim)
+            attn = paged_attention(
+                q_grouped, k_pools[li], v_pools[li], page_table, lengths + 1
+            )                                                      # [B,Hkv,G,D]
+            attn = attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
+            x = x + attn @ layer["wo"]
+            h = _rms_norm(x, layer["ffn_norm"], eps)
+            x = x + _ffn(layer, h)
+        return _logits(params, x)[:, 0], k_pools, v_pools
+
     return SimpleNamespace(
         init=init,
         apply=apply,
         init_cache=init_cache,
         prefill=prefill,
         decode=decode,
+        decode_paged=decode_paged,
         config=cfg,
         head_dim=head_dim,
         n_kv_heads=n_kv,

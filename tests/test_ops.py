@@ -68,18 +68,20 @@ def test_paged_attention_single_token_sequence():
 class TestPagePool:
     def test_alloc_free_cycle(self):
         pool = PagePool(num_pages=10, page_size=4, max_slots=3)
+        assert pool.free_pages == 9  # page 0 reserved as the null page
         pages = pool.allocate(0, 10)  # 3 pages
-        assert len(pages) == 3 and pool.free_pages == 7
+        assert len(pages) == 3 and pool.free_pages == 6
+        assert 0 not in pages
         pool.allocate(1, 4)
-        assert pool.free_pages == 6
+        assert pool.free_pages == 5
         pool.free(0)
-        assert pool.free_pages == 9
+        assert pool.free_pages == 8
         assert pool.slot_length(0) == 0
 
     def test_extend_allocates_on_boundary(self):
-        pool = PagePool(num_pages=4, page_size=4, max_slots=1)
+        pool = PagePool(num_pages=5, page_size=4, max_slots=1)
         pool.allocate(0, 4)
-        assert pool.free_pages == 3
+        assert pool.free_pages == 3  # 5 pages - null page - 1 allocated
         assert len(pool.extend(0, 1)) == 1    # crosses into page 2
         assert pool.slot_length(0) == 5
         assert pool.extend(0, 1) == []        # still inside page 2
@@ -93,8 +95,8 @@ class TestPagePool:
             pool.page_table(pages_per_seq=2)
 
     def test_exhaustion(self):
-        pool = PagePool(num_pages=2, page_size=4, max_slots=2)
-        pool.allocate(0, 8)
+        pool = PagePool(num_pages=3, page_size=4, max_slots=2)
+        pool.allocate(0, 8)  # 2 of the 2 allocatable pages (page 0 reserved)
         assert not pool.can_allocate(1)
         with pytest.raises(MemoryError):
             pool.allocate(1, 4)
