@@ -35,15 +35,36 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
 
+    import os
+
     if on_tpu:
-        cfg = {"preset": "llama3-1b", "dtype": "bfloat16"}
-        batch, seq_len, chunk, rounds = 16, 1024, 25, 4
+        # overridable for larger-model runs: BENCH_PRESET=llama3-8b
+        # BENCH_QUANTIZE=int8 BENCH_SCAN_LAYERS=1 BENCH_BATCH=8
+        cfg = {
+            "preset": os.environ.get("BENCH_PRESET", "llama3-1b"),
+            "dtype": "bfloat16",
+            "scan_layers": os.environ.get("BENCH_SCAN_LAYERS", "").lower()
+            in ("1", "true", "yes"),
+        }
+        batch = int(os.environ.get("BENCH_BATCH", 16))
+        seq_len, chunk, rounds = 1024, 25, 4
     else:  # CPU smoke mode so the bench is runnable anywhere
         cfg = {"preset": "llama-tiny", "dtype": "float32"}
         batch, seq_len, chunk, rounds = 4, 128, 5, 2
 
-    bundle = models.build_model("llama", cfg)
-    params = bundle.init(jax.random.PRNGKey(0))
+    from clearml_serving_tpu.engines.jax_engine import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
+    quantize = os.environ.get("BENCH_QUANTIZE")
+    if quantize == "int8":
+        # int8 tree built directly (never materializes full-precision 8B);
+        # the model's weight accessor dequantizes per layer inside the scan
+        from clearml_serving_tpu.ops.quant import random_quantized_llama
+
+        bundle, params = random_quantized_llama(cfg, seed=0)
+    else:
+        bundle = models.build_model("llama", cfg)
+        params = bundle.init(jax.random.PRNGKey(0))
     cache = bundle.init_cache(batch, seq_len)
     # mid-sequence state: decode cost grows with cache occupancy; measure at
     # half-full for a steady-state figure
@@ -85,8 +106,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "llm_decode_throughput_{}_b{}".format(
-                    cfg.get("preset", "llama"), batch
+                "metric": "llm_decode_throughput_{}{}_b{}".format(
+                    cfg.get("preset", "llama"),
+                    "-int8" if quantize == "int8" else "",
+                    batch,
                 ),
                 "value": round(tok_per_sec, 2),
                 "unit": "tok/s/chip",

@@ -162,7 +162,22 @@ async def serve(service_id: Optional[str] = None) -> None:
 
 
 def main() -> None:
+    from ..parallel.distributed import initialize_distributed, is_primary_host
+
+    initialize_distributed()  # no-op single-host; TPUSERVE_COORDINATOR multi-host
     service_id = os.environ.get("TPUSERVE_SERVICE_ID") or None
+    if not is_primary_host():
+        # Secondary hosts must NOT bind any service port: dispatching
+        # inference on a non-primary controller of a multi-controller SPMD
+        # job enters collectives the other hosts never join and deadlocks the
+        # slice. A true multi-host serving loop (host 0 broadcasting request
+        # batches to peers) is not implemented yet — refuse loudly instead of
+        # half-participating.
+        raise SystemExit(
+            "engine server: process_index != 0; multi-host request dispatch "
+            "is not implemented yet — run the engine server on host 0 only "
+            "(secondary hosts will join via the planned broadcast loop)"
+        )
     asyncio.run(serve(service_id))
 
 

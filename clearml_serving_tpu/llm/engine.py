@@ -103,16 +103,16 @@ class LLMEngineCore:
         self._mesh = mesh
 
         # int8 weight quantization: params live in HBM as int8 + scales; the
-        # jitted step functions dequantize INSIDE the traced computation, so
-        # XLA fuses dequant next to each consumer matmul (no full bf16
-        # materialization at rest; weights-at-rest HBM ~halves).
-        self._dequant = None
+        # model's weight accessor (models/llama.py `_w`) dequantizes each
+        # weight INSIDE the traced layer body — per layer even under
+        # scan_layers — so XLA fuses dequant next to each consumer matmul and
+        # weights at rest stay int8 (HBM ~halves).
+        self._quantized = False
         if quantize == "int8":
-            from ..ops.quant import dequant_llama_params, quantize_llama_params
+            from ..ops.quant import quantize_llama_params
 
             params = quantize_llama_params(params)
-            dtype = jnp.dtype(bundle.config.get("dtype", "bfloat16"))
-            self._dequant = lambda p: dequant_llama_params(p, dtype)
+            self._quantized = True
         elif quantize:
             raise ValueError("unsupported quantize mode {!r}".format(quantize))
 
@@ -123,7 +123,7 @@ class LLMEngineCore:
                 shard_params,
             )
 
-            if self._dequant is None:
+            if not self._quantized:
                 self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
             else:
                 self.params = params  # quantized tree: replicate (TP-shard in a later round)
@@ -180,11 +180,8 @@ class LLMEngineCore:
 
         # -- compiled functions --------------------------------------------
 
-        def _materialize(params):
-            return params if self._dequant is None else self._dequant(params)
-
         def _prefill(params, tokens, seq_lens, cache_template):
-            return bundle.prefill(_materialize(params), tokens, seq_lens, cache_template)
+            return bundle.prefill(params, tokens, seq_lens, cache_template)
 
         self._prefill_jit = jax.jit(_prefill)
 
@@ -201,7 +198,6 @@ class LLMEngineCore:
         def _decode_chunk(params, tokens, cache, active, sampling, rng):
             """`decode_steps` decode+sample steps fused in one executable
             (lax.scan) — host dispatch overhead amortizes over the chunk."""
-            params = _materialize(params)
 
             def body(carry, step_rng):
                 tokens, cache = carry
@@ -228,7 +224,6 @@ class LLMEngineCore:
             """Paged-cache variant of the fused decode chunk. Page/offset
             write coordinates for every step come pre-computed from the host
             page allocator (write_pages/offsets: [B, steps])."""
-            params = _materialize(params)
 
             def body(carry, xs):
                 tokens, k_pools, v_pools, step = carry
@@ -391,14 +386,16 @@ class LLMEngineCore:
                 self.paged_cache.pool.free(slot)  # recycle the slot's pages
 
     def _fail_all(self, err: BaseException) -> None:
-        """Terminate every active request with `err` (nothing may hang)."""
+        """Terminate every active request with `err` (nothing may hang).
+
+        Does NOT touch the page pool: _fail_all can run (via stop()) while a
+        worker thread is inside _run_paged_chunk mutating the pool — the loop
+        frees all slots itself when it exits (sole-owner point)."""
         for slot, request in enumerate(self._slot_req):
             if request is not None:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
-                if self.paged_cache is not None:
-                    self.paged_cache.pool.free(slot)
 
     def _run_paged_chunk(self, active_mask: np.ndarray, sampling):
         """One fused paged-decode chunk (blocking device work; runs in a
@@ -454,6 +451,12 @@ class LLMEngineCore:
                 # catch requests admitted while stop() was racing the loop
                 # (popped from _pending before stop drained it)
                 self._fail_all(RuntimeError("engine stopped"))
+            if self.paged_cache is not None:
+                # loop exit = no worker thread alive -> safe to reclaim every
+                # slot whose request was failed out without freeing its pages
+                for slot in range(self.max_batch):
+                    if self._slot_req[slot] is None:
+                        self.paged_cache.pool.free(slot)
 
     async def _run_loop_inner(self) -> None:
         """The continuous-batching loop: admit -> decode -> emit."""

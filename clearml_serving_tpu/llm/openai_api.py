@@ -121,6 +121,18 @@ class LLMEngineRequest(BaseEngineRequest):
             top_p=float(body.get("top_p", 1.0) or 1.0),
         )
 
+    @staticmethod
+    def _report_gen_stats(request, collect_fn) -> None:
+        """TTFT + token counts into the sampled-stats pipeline (BASELINE.md
+        per-endpoint metrics). Streaming responses bypass this (the stats
+        packet is emitted before the stream body runs)."""
+        if collect_fn is None:
+            return
+        stats = {"gen_tokens": request.produced, "prompt_tokens": request.prompt_len}
+        if request.first_token_at is not None:
+            stats["ttft"] = round(request.first_token_at - request.submitted_at, 6)
+        collect_fn(stats)
+
     async def _collect_text(self, request) -> Dict[str, Any]:
         ids: List[int] = []
         async for token in self.engine.generate(request):
@@ -210,6 +222,7 @@ class LLMEngineRequest(BaseEngineRequest):
             return StreamingOutput(sse())
 
         result = await self._collect_text(request)
+        self._report_gen_stats(request, collect_fn)
         return {
             "id": completion_id,
             "object": "chat.completion",
@@ -300,6 +313,8 @@ class LLMEngineRequest(BaseEngineRequest):
             self._gen_request_from_body(body, ids) for ids in prompt_id_lists
         ]
         results = await asyncio.gather(*[self._collect_text(r) for r in requests])
+        for r in requests:
+            self._report_gen_stats(r, collect_fn)
         return {
             "id": completion_id,
             "object": "text_completion",

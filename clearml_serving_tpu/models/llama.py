@@ -98,44 +98,72 @@ def build(config: dict) -> SimpleNamespace:
 
     # -- init ---------------------------------------------------------------
 
+    # scan_layers: stack layer params [L, ...] and lax.scan over them — XLA
+    # compiles ONE layer instead of n_layers unrolled copies. Essential for
+    # deep models: the unrolled 32-layer 8B graph takes many minutes to
+    # compile; the scanned one compiles like a 1-layer model.
+    scan_layers = bool(cfg.get("scan_layers", False))
+
+    def _init_layer(key):
+        def dense(k, shape, fan_in):
+            return (
+                jax.random.normal(k, shape, dtype=jnp.float32) * fan_in ** -0.5
+            ).astype(dtype)
+
+        k = jax.random.split(key, 7)
+        return {
+            "attn_norm": jnp.ones((dim,), dtype),
+            "wq": dense(k[0], (dim, n_heads * head_dim), dim),
+            "wk": dense(k[1], (dim, n_kv * head_dim), dim),
+            "wv": dense(k[2], (dim, n_kv * head_dim), dim),
+            "wo": dense(k[3], (n_heads * head_dim, dim), n_heads * head_dim),
+            "ffn_norm": jnp.ones((dim,), dtype),
+            "w_gate": dense(k[4], (dim, ffn_dim), dim),
+            "w_up": dense(k[5], (dim, ffn_dim), dim),
+            "w_down": dense(k[6], (ffn_dim, dim), ffn_dim),
+        }
+
     def init(rng) -> Dict[str, Any]:
         def dense(key, shape, fan_in):
             return (
                 jax.random.normal(key, shape, dtype=jnp.float32) * fan_in ** -0.5
             ).astype(dtype)
 
-        keys = jax.random.split(rng, 2 + n_layers)
+        keys = jax.random.split(rng, 3)
         params: Dict[str, Any] = {
             "embed": dense(keys[0], (vocab, dim), dim),
             "final_norm": jnp.ones((dim,), dtype),
-            "layers": [],
         }
         if not cfg["tie_embeddings"]:
             params["lm_head"] = dense(keys[1], (dim, vocab), dim)
-        for i in range(n_layers):
-            k = jax.random.split(keys[2 + i], 7)
-            params["layers"].append(
-                {
-                    "attn_norm": jnp.ones((dim,), dtype),
-                    "wq": dense(k[0], (dim, n_heads * head_dim), dim),
-                    "wk": dense(k[1], (dim, n_kv * head_dim), dim),
-                    "wv": dense(k[2], (dim, n_kv * head_dim), dim),
-                    "wo": dense(k[3], (n_heads * head_dim, dim), n_heads * head_dim),
-                    "ffn_norm": jnp.ones((dim,), dtype),
-                    "w_gate": dense(k[4], (dim, ffn_dim), dim),
-                    "w_up": dense(k[5], (dim, ffn_dim), dim),
-                    "w_down": dense(k[6], (ffn_dim, dim), ffn_dim),
-                }
-            )
+        layer_keys = jax.random.split(keys[2], n_layers)
+        if scan_layers:
+            params["layers"] = jax.vmap(_init_layer)(layer_keys)
+        else:
+            params["layers"] = [_init_layer(k) for k in layer_keys]
         return params
+
 
     # -- shared layer math ----------------------------------------------------
 
+    def _w(container, name):
+        """Weight accessor with inline int8 dequantization: a leaf may be a
+        plain array or {"_q8": int8, "_scale": f32} (ops/quant.py). Because
+        this runs INSIDE the (possibly scanned) layer body, XLA dequantizes
+        one layer at a time next to its consumer matmul — weights at rest
+        stay int8 in HBM even under scan_layers."""
+        w = container[name]
+        if isinstance(w, dict) and "_q8" in w:
+            from ..ops.quant import dequantize
+
+            return dequantize(w["_q8"], w["_scale"], dtype)
+        return w
+
     def _qkv(layer, x, cos, sin):
         b, s, _ = x.shape
-        q = (x @ layer["wq"]).reshape(b, s, n_heads, head_dim)
-        k = (x @ layer["wk"]).reshape(b, s, n_kv, head_dim)
-        v = (x @ layer["wv"]).reshape(b, s, n_kv, head_dim)
+        q = (x @ _w(layer, "wq")).reshape(b, s, n_heads, head_dim)
+        k = (x @ _w(layer, "wk")).reshape(b, s, n_kv, head_dim)
+        v = (x @ _w(layer, "wv")).reshape(b, s, n_kv, head_dim)
         return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
 
     def _attend(q, k, v, mask):
@@ -153,13 +181,13 @@ def build(config: dict) -> SimpleNamespace:
         return out.reshape(b, s, n_heads * head_dim)
 
     def _ffn(layer, x):
-        return (jax.nn.silu(x @ layer["w_gate"]) * (x @ layer["w_up"])) @ layer["w_down"]
+        return (
+            jax.nn.silu(x @ _w(layer, "w_gate")) * (x @ _w(layer, "w_up"))
+        ) @ _w(layer, "w_down")
 
     def _logits(params, x):
         x = _rms_norm(x, params["final_norm"], eps)
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T
+        head = _w(params, "lm_head") if "lm_head" in params else params["embed"].T
         return (x @ head).astype(jnp.float32)
 
     # -- full causal forward (training / no-cache prefill) -------------------
@@ -171,14 +199,26 @@ def build(config: dict) -> SimpleNamespace:
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta)
         causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-        mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None]
+        mask = jnp.broadcast_to(
+            jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None],
+            (b, 1, s, s),
+        )
         x = params["embed"][tokens]
-        for layer in params["layers"]:
+
+        def layer_body(x, layer):
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)
-            x = x + _attend(q, k, v, jnp.broadcast_to(mask, (b, 1, s, s))) @ layer["wo"]
+            x = x + _attend(q, k, v, mask) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            x = x + _ffn(layer, h)
+            return x + _ffn(layer, h)
+
+        if scan_layers:
+            x, _ = jax.lax.scan(
+                lambda x, layer: (layer_body(x, layer), None), x, params["layers"]
+            )
+        else:
+            for layer in params["layers"]:
+                x = layer_body(x, layer)
         return _logits(params, x)
 
     # -- dense KV cache serving path -----------------------------------------
@@ -202,22 +242,29 @@ def build(config: dict) -> SimpleNamespace:
         mask_b = causal & valid[:, None, :]                        # [B, S, T]
         mask = jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
         x = params["embed"][tokens]
-        new_k, new_v = [], []
-        for layer in params["layers"]:
+
+        def layer_body(x, layer):
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)
-            new_k.append(k)
-            new_v.append(v)
-            x = x + _attend(q, k, v, mask) @ layer["wo"]
+            x = x + _attend(q, k, v, mask) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            x = x + _ffn(layer, h)
+            return x + _ffn(layer, h), (k, v)
+
+        if scan_layers:
+            x, (k_stack, v_stack) = jax.lax.scan(layer_body, x, params["layers"])
+        else:
+            new_k, new_v = [], []
+            for layer in params["layers"]:
+                x, (k, v) = layer_body(x, layer)
+                new_k.append(k)
+                new_v.append(v)
+            k_stack = jnp.stack(new_k)                             # [L,B,S,Hkv,D]
+            v_stack = jnp.stack(new_v)
         logits = _logits(params, x)                                # [B, S, vocab]
         last = jnp.take_along_axis(
             logits, (seq_lens - 1)[:, None, None].clip(0), axis=1
         )[:, 0]
         max_len = cache["k"].shape[2]
-        k_stack = jnp.stack(new_k)                                 # [L,B,S,Hkv,D]
-        v_stack = jnp.stack(new_v)
         pad = max_len - s
         k_full = jnp.pad(k_stack, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
         v_full = jnp.pad(v_stack, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
@@ -238,24 +285,38 @@ def build(config: dict) -> SimpleNamespace:
         attn_valid = t_idx <= cache["length"][:, None]             # [B, T]
         mask = jnp.where(attn_valid, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
         x = params["embed"][tokens][:, None]                       # [B, 1, dim]
-        ks, vs = [], []
-        for li, layer in enumerate(params["layers"]):
+        # Per-sequence scatter at each sequence's own length (overwrite, so
+        # stale values from a recycled batch slot cannot leak through).
+        write = (t_idx == cache["length"][:, None])[:, :, None, None]  # [B,T,1,1]
+
+        def layer_body(x, xs):
+            layer, k_cache_l, v_cache_l = xs
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)                     # k,v: [B,1,Hkv,D]
-            # Per-sequence scatter at each sequence's own length (overwrite, so
-            # stale values from a recycled batch slot cannot leak through).
-            write = (t_idx == cache["length"][:, None])[:, :, None, None]  # [B,T,1,1]
-            k_cache = jnp.where(write, k, cache["k"][li])
-            v_cache = jnp.where(write, v, cache["v"][li])
-            ks.append(k_cache)
-            vs.append(v_cache)
-            x = x + _attend(q, k_cache, v_cache, mask) @ layer["wo"]
+            k_cache = jnp.where(write, k, k_cache_l)
+            v_cache = jnp.where(write, v, v_cache_l)
+            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            x = x + _ffn(layer, h)
+            return x + _ffn(layer, h), (k_cache, v_cache)
+
+        if scan_layers:
+            x, (k_new, v_new) = jax.lax.scan(
+                layer_body, x, (params["layers"], cache["k"], cache["v"])
+            )
+        else:
+            ks, vs = [], []
+            for li, layer in enumerate(params["layers"]):
+                x, (k_cache, v_cache) = layer_body(
+                    x, (layer, cache["k"][li], cache["v"][li])
+                )
+                ks.append(k_cache)
+                vs.append(v_cache)
+            k_new = jnp.stack(ks)
+            v_new = jnp.stack(vs)
         logits = _logits(params, x)[:, 0]
         cache = {
-            "k": jnp.stack(ks),
-            "v": jnp.stack(vs),
+            "k": k_new,
+            "v": v_new,
             "length": cache["length"] + 1,
         }
         return logits, cache
@@ -281,27 +342,45 @@ def build(config: dict) -> SimpleNamespace:
         positions = lengths[:, None]                               # [B, 1]
         cos, sin = _rope(positions, head_dim, theta)
         x = params["embed"][tokens][:, None]                       # [B, 1, dim]
-        for li, layer in enumerate(params["layers"]):
+
+        def layer_body(x, layer, k_pool_l, v_pool_l):
+            """One layer on its own pool slice [Hkv, N, P, D]; returns the
+            updated pool slice (scatter of the new token's K/V)."""
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)                     # q [B,1,H,D]
-            # scatter new K/V: pools[li, h, write_page[b], write_offset[b]] = k.
-            # NB: the advanced indices (li, write_page, write_offset) are
-            # separated by the head slice, so their broadcast dim [B] comes
-            # FIRST in the indexed shape -> set() takes [B, Hkv, D].
-            k_pools = k_pools.at[li, :, write_page, write_offset].set(
-                k[:, 0].astype(k_pools.dtype)
-            )
-            v_pools = v_pools.at[li, :, write_page, write_offset].set(
-                v[:, 0].astype(v_pools.dtype)
-            )
+            # index tuple (:, wp, wo): the advanced indices are CONTIGUOUS, so
+            # the broadcast dim [B] lands after the sliced head dim ->
+            # set() takes [Hkv, B, D].
+            k_hm = k[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
+            v_hm = v[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
+            k_pool_l = k_pool_l.at[:, write_page, write_offset].set(k_hm)
+            v_pool_l = v_pool_l.at[:, write_page, write_offset].set(v_hm)
             q_grouped = q[:, 0].reshape(b, n_kv, group, head_dim)
             attn = paged_attention(
-                q_grouped, k_pools[li], v_pools[li], page_table, lengths + 1
+                q_grouped, k_pool_l, v_pool_l, page_table, lengths + 1
             )                                                      # [B,Hkv,G,D]
             attn = attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
-            x = x + attn @ layer["wo"]
+            x = x + attn @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            x = x + _ffn(layer, h)
+            return x + _ffn(layer, h), k_pool_l, v_pool_l
+
+        if scan_layers:
+            def scan_body(x, xs):
+                layer, k_pool_l, v_pool_l = xs
+                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pool_l, v_pool_l)
+                return x, (k_pool_l, v_pool_l)
+
+            x, (k_pools, v_pools) = jax.lax.scan(
+                scan_body, x, (params["layers"], k_pools, v_pools)
+            )
+        else:
+            new_k, new_v = [], []
+            for li, layer in enumerate(params["layers"]):
+                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pools[li], v_pools[li])
+                new_k.append(k_pool_l)
+                new_v.append(v_pool_l)
+            k_pools = jnp.stack(new_k)
+            v_pools = jnp.stack(new_v)
         return _logits(params, x)[:, 0], k_pools, v_pools
 
     return SimpleNamespace(

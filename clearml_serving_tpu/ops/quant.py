@@ -46,7 +46,9 @@ def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
             out = {}
             for key, value in tree.items():
                 if key in quant_keys:
-                    qv, s = quantize_int8(value, axis=0)
+                    # axis=-2 is the input (reduction) dim for both plain
+                    # [in, out] matrices and scan_layers-stacked [L, in, out]
+                    qv, s = quantize_int8(value, axis=-2)
                     out[key] = {"_q8": qv, "_scale": s}
                 else:
                     out[key] = _q(value)
@@ -56,6 +58,55 @@ def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
         return tree
 
     return _q(params)
+
+
+def random_quantized_llama(config: dict, seed: int = 0):
+    """(bundle, params) with the int8 tree built DIRECTLY — full-precision
+    weights are never materialized, so an 8B model initializes inside a single
+    chip's HBM. For benchmarks and weightless demo endpoints (throughput is
+    weight-value-independent); real checkpoints go through
+    quantize_llama_params instead."""
+    import jax
+
+    from ..models import llama
+
+    cfg = llama.resolve_config(dict(config, scan_layers=True))
+    bundle = llama.build(dict(config, scan_layers=True))
+    dim = int(cfg["dim"])
+    n_layers = int(cfg["n_layers"])
+    heads_dim = dim  # wq output
+    n_kv_dim = int(cfg["n_kv_heads"]) * (dim // int(cfg["n_heads"]))
+    ffn = int(cfg["ffn_dim"])
+    vocab = int(cfg["vocab_size"])
+    dtype = jnp.dtype(cfg["dtype"])
+
+    def qstack(key, shape):
+        return {
+            "_q8": jax.random.randint(key, (n_layers,) + shape, -127, 128, jnp.int8),
+            "_scale": jnp.full((n_layers, 1, shape[1]), 0.01, jnp.float32),
+        }
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 9)
+    params = {
+        "embed": (jax.random.normal(ks[0], (vocab, dim)) * 0.02).astype(dtype),
+        "lm_head": {
+            "_q8": jax.random.randint(ks[1], (dim, vocab), -127, 128, jnp.int8),
+            "_scale": jnp.full((1, vocab), 0.01, jnp.float32),
+        },
+        "final_norm": jnp.ones((dim,), dtype),
+        "layers": {
+            "attn_norm": jnp.ones((n_layers, dim), dtype),
+            "wq": qstack(ks[2], (dim, heads_dim)),
+            "wk": qstack(ks[3], (dim, n_kv_dim)),
+            "wv": qstack(ks[4], (dim, n_kv_dim)),
+            "wo": qstack(ks[5], (heads_dim, dim)),
+            "ffn_norm": jnp.ones((n_layers, dim), dtype),
+            "w_gate": qstack(ks[6], (dim, ffn)),
+            "w_up": qstack(ks[7], (dim, ffn)),
+            "w_down": qstack(ks[8], (ffn, dim)),
+        },
+    }
+    return bundle, params
 
 
 def dequant_llama_params(params: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str, Any]:

@@ -4,6 +4,7 @@ from .sharding import (
     llama_cache_sharding,
     shard_params,
 )
+from .distributed import global_mesh, initialize_distributed, is_primary_host
 
 __all__ = [
     "make_mesh",
@@ -11,4 +12,7 @@ __all__ = [
     "llama_param_sharding",
     "llama_cache_sharding",
     "shard_params",
+    "global_mesh",
+    "initialize_distributed",
+    "is_primary_host",
 ]

@@ -1,0 +1,73 @@
+"""Multi-host distributed bootstrap (SURVEY.md §5.8 build obligation).
+
+Topology for multi-host TPU slices (e.g. v5e-16 = 2 hosts x 8 chips):
+
+- one **engine-server process per host**, all calling
+  :func:`initialize_distributed` so jax sees the global device set;
+- pjit/GSPMD shardings span the global mesh — XLA routes collectives over ICI
+  within the slice and DCN across slices; no NCCL/MPI analog is written here
+  (the compiler inserts all collectives);
+- the **router targets only host 0's gRPC endpoint** (the process whose
+  ``jax.process_index() == 0``); other hosts participate purely through the
+  collectives their compiled executables contain — they run the same
+  executables triggered by host 0's dispatch (multi-controller SPMD);
+- across replicas (independent slices), scale-out stays plain HTTP/gRPC load
+  balancing, exactly like the reference's replica containers.
+
+Single-process usage is a no-op, so every entrypoint can call
+:func:`initialize_distributed` unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Initialize jax.distributed from args or TPUSERVE_* / default envs.
+
+    Returns the process index (0 for single-process). Safe to call twice.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("TPUSERVE_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("TPUSERVE_NUM_HOSTS", 0)) or None
+    if process_id is None:
+        pid_env = os.environ.get("TPUSERVE_HOST_ID")
+        process_id = int(pid_env) if pid_env is not None else None
+
+    if not coordinator_address and not num_processes:
+        return 0  # single process
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as ex:
+        if "already initialized" not in str(ex):
+            raise
+    return jax.process_index()
+
+
+def global_mesh(axis_sizes: Optional[Dict[str, int]] = None):
+    """Mesh over the GLOBAL device set (all hosts). Axis sizes default to
+    pure tensor-parallel over every chip in the slice."""
+    import jax
+
+    from .mesh import make_mesh
+
+    return make_mesh(axis_sizes or {"tp": -1}, devices=jax.devices())
+
+
+def is_primary_host() -> bool:
+    """True on the process that should expose the service port (host 0)."""
+    import jax
+
+    return jax.process_index() == 0

@@ -27,21 +27,30 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    stacked = isinstance(params["layers"], dict)  # scan_layers: [L, ...] arrays
+
+    def col(*spec):
+        # stacked layer params carry a leading layer dim that stays unsharded
+        return ns(None, *spec) if stacked else ns(*spec)
+
     layer_spec = {
-        "attn_norm": ns(),
-        "wq": ns(None, "tp"),
-        "wk": ns(None, "tp"),
-        "wv": ns(None, "tp"),
-        "wo": ns("tp", None),
-        "ffn_norm": ns(),
-        "w_gate": ns(None, "tp"),
-        "w_up": ns(None, "tp"),
-        "w_down": ns("tp", None),
+        "attn_norm": col(),
+        "wq": col(None, "tp"),
+        "wk": col(None, "tp"),
+        "wv": col(None, "tp"),
+        "wo": col("tp", None),
+        "ffn_norm": col(),
+        "w_gate": col(None, "tp"),
+        "w_up": col(None, "tp"),
+        "w_down": col("tp", None),
     }
     out: Dict[str, Any] = {
         "embed": ns("tp", None),        # vocab-sharded lookup; gathered by XLA
         "final_norm": ns(),
-        "layers": [dict(layer_spec) for _ in params["layers"]],
+        "layers": (
+            dict(layer_spec) if stacked
+            else [dict(layer_spec) for _ in params["layers"]]
+        ),
     }
     if "lm_head" in params:
         out["lm_head"] = ns(None, "tp")

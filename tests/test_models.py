@@ -75,6 +75,34 @@ class TestLlama:
         short = b.apply(params, tokens[1:2, :5])
         np.testing.assert_allclose(last[1], short[0, -1], rtol=1e-3, atol=1e-3)
 
+    def test_scan_layers_matches_unrolled(self, setup):
+        """scan_layers=True (stacked params + lax.scan) must be numerically
+        identical to the unrolled python-loop build, across apply, prefill,
+        decode, and decode_paged."""
+        b_unroll, params_u, tokens = setup
+        b_scan = models.build_model(
+            "llama", {"preset": "llama-tiny", "dtype": "float32", "scan_layers": True}
+        )
+        # stack the unrolled params so both builds share weights
+        params_s = dict(params_u)
+        params_s["layers"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *params_u["layers"]
+        )
+        np.testing.assert_allclose(
+            b_scan.apply(params_s, tokens), b_unroll.apply(params_u, tokens),
+            rtol=1e-4, atol=1e-4,
+        )
+        seq_lens = jnp.array([12, 7], jnp.int32)
+        cache_u = b_unroll.init_cache(2, 32)
+        cache_s = b_scan.init_cache(2, 32)
+        last_u, cache_u = b_unroll.prefill(params_u, tokens, seq_lens, cache_u)
+        last_s, cache_s = b_scan.prefill(params_s, tokens, seq_lens, cache_s)
+        np.testing.assert_allclose(last_s, last_u, rtol=1e-4, atol=1e-4)
+        step = jnp.array([3, 4], jnp.int32)
+        logits_u, _ = b_unroll.decode(params_u, step, cache_u)
+        logits_s, _ = b_scan.decode(params_s, step, cache_s)
+        np.testing.assert_allclose(logits_s, logits_u, rtol=1e-4, atol=1e-4)
+
     def test_decode_matches_forward(self, setup):
         b, params, tokens = setup
         full = b.apply(params, tokens)

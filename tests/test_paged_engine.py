@@ -74,6 +74,42 @@ def _collect(engine, req):
     return asyncio.run(run())
 
 
+def test_scan_layers_paged_engine_matches(tiny):
+    """scan_layers + paged cache produce the same greedy tokens as the plain
+    unrolled dense engine; and with int8 on BOTH engines (same quantized
+    weights, list vs stacked) outputs still agree — the exact configuration
+    the 8B bench runs (BENCH_QUANTIZE=int8 BENCH_SCAN_LAYERS=1)."""
+    bundle_u, params_u = tiny
+    bundle_s = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32", "scan_layers": True}
+    )
+    params_s = dict(params_u)
+    params_s["layers"] = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *params_u["layers"]
+    )
+    common = dict(max_batch=2, max_seq_len=64, prefill_buckets=[16],
+                  eos_token_id=257, decode_steps=3)
+    p = [256, 11, 12, 13]
+
+    dense = LLMEngineCore(bundle_u, params_u, cache_mode="dense", **common)
+    paged_scan = LLMEngineCore(
+        bundle_s, params_s, cache_mode="paged", page_size=4, **common
+    )
+    assert _collect(dense, GenRequest(prompt_ids=p, max_new_tokens=6)) == _collect(
+        paged_scan, GenRequest(prompt_ids=p, max_new_tokens=6)
+    )
+
+    dense_q = LLMEngineCore(
+        bundle_u, params_u, cache_mode="dense", quantize="int8", **common
+    )
+    paged_scan_q = LLMEngineCore(
+        bundle_s, params_s, cache_mode="paged", page_size=4, quantize="int8", **common
+    )
+    assert _collect(dense_q, GenRequest(prompt_ids=p, max_new_tokens=6)) == _collect(
+        paged_scan_q, GenRequest(prompt_ids=p, max_new_tokens=6)
+    )
+
+
 def test_paged_engine_matches_dense_engine(tiny):
     bundle, params = tiny
     prompts = [[256, 1, 2, 3], [256, 9, 8, 7, 6, 5], [256, 42]]

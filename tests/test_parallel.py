@@ -74,6 +74,19 @@ def test_llama_tp_sharded_forward_matches_single():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-3, atol=2e-3)
 
 
+def test_distributed_single_process_noop():
+    from clearml_serving_tpu.parallel import (
+        global_mesh,
+        initialize_distributed,
+        is_primary_host,
+    )
+
+    assert initialize_distributed() == 0  # no coordinator configured -> no-op
+    assert is_primary_host()
+    mesh = global_mesh()
+    assert mesh.shape["tp"] == 8
+
+
 def test_llama_cache_sharding_spec():
     mesh = make_mesh({"dp": 2, "tp": 4})
     spec = llama_cache_sharding(mesh)
