@@ -92,6 +92,11 @@ def load_bundle(path) -> Tuple[Any, Any]:
     params_bytes = (path / "params.msgpack").read_bytes()
     params = serialization.msgpack_restore(bytearray(params_bytes))
     params = jax.tree.map(jnp.asarray, params)
+    # architectures may adapt the stored layout to the build (e.g. stacking
+    # per-layer dicts for scan_layers)
+    prepare = getattr(bundle, "prepare_params", None)
+    if prepare is not None:
+        params = prepare(params)
     return bundle, params
 
 

@@ -61,11 +61,39 @@ def _rms_norm(x, weight, eps):
     return (norm * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def _rope(positions: jnp.ndarray, head_dim: int, theta: float):
-    """cos/sin tables for given positions: [..., head_dim//2]."""
+def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
     freqs = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if not rope_scaling:
+        return freqs
+    rope_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
+    if rope_type != "llama3":
+        raise ValueError(
+            "unsupported rope_scaling type {!r} (supported: llama3)".format(rope_type)
+        )
+    # Llama-3.1 frequency-dependent scaling: long wavelengths scale by
+    # 1/factor, short ones stay, the middle band interpolates smoothly.
+    factor = float(rope_scaling["factor"])
+    low = float(rope_scaling.get("low_freq_factor", 1.0))
+    high = float(rope_scaling.get("high_freq_factor", 4.0))
+    orig = float(rope_scaling.get("original_max_position_embeddings", 8192))
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wavelen = orig / low
+    high_wavelen = orig / high
+    smooth = (orig / wavelen - low) / (high - low)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * freqs / factor + smooth * freqs
+    return jnp.where(
+        wavelen > low_wavelen, freqs / factor,
+        jnp.where(wavelen < high_wavelen, freqs, scaled),
+    )
+
+
+def _rope(positions: jnp.ndarray, head_dim: int, theta: float,
+          rope_scaling: Optional[dict] = None):
+    """cos/sin tables for given positions: [..., head_dim//2]."""
+    freqs = _rope_freqs(head_dim, theta, rope_scaling)
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., hd/2]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -90,6 +118,8 @@ def build(config: dict) -> SimpleNamespace:
     n_kv = int(cfg["n_kv_heads"])
     ffn_dim = int(cfg["ffn_dim"])
     theta = float(cfg["rope_theta"])
+    rope_scaling = cfg.get("rope_scaling") or None
+    _rope_freqs(dim // int(cfg["n_heads"]), theta, rope_scaling)  # fail fast on bad cfg
     eps = float(cfg["norm_eps"])
     dtype = jnp.dtype(cfg["dtype"])
     head_dim = dim // n_heads
@@ -197,7 +227,7 @@ def build(config: dict) -> SimpleNamespace:
         b, s = tokens.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        cos, sin = _rope(positions, head_dim, theta)
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         causal = jnp.tril(jnp.ones((s, s), dtype=bool))
         mask = jnp.broadcast_to(
             jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None],
@@ -236,7 +266,7 @@ def build(config: dict) -> SimpleNamespace:
         returns (last-token logits [B, vocab], cache)."""
         b, s = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        cos, sin = _rope(positions, head_dim, theta)
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         valid = positions < seq_lens[:, None]                      # [B, S]
         causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
         mask_b = causal & valid[:, None, :]                        # [B, S, T]
@@ -279,7 +309,7 @@ def build(config: dict) -> SimpleNamespace:
         """One decode step. tokens: [B] int32. Returns (logits [B, vocab], cache)."""
         b = tokens.shape[0]
         positions = cache["length"][:, None]                       # [B, 1]
-        cos, sin = _rope(positions, head_dim, theta)
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         max_len = cache["k"].shape[2]
         t_idx = jnp.arange(max_len, dtype=jnp.int32)[None]         # [1, T]
         attn_valid = t_idx <= cache["length"][:, None]             # [B, T]
@@ -293,8 +323,10 @@ def build(config: dict) -> SimpleNamespace:
             layer, k_cache_l, v_cache_l = xs
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)                     # k,v: [B,1,Hkv,D]
-            k_cache = jnp.where(write, k, k_cache_l)
-            v_cache = jnp.where(write, v, v_cache_l)
+            # cast to the cache dtype: params may be a different precision
+            # than the cache (e.g. f32 checkpoint into a bf16 cache)
+            k_cache = jnp.where(write, k.astype(k_cache_l.dtype), k_cache_l)
+            v_cache = jnp.where(write, v.astype(v_cache_l.dtype), v_cache_l)
             x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
             return x + _ffn(layer, h), (k_cache, v_cache)
@@ -340,7 +372,7 @@ def build(config: dict) -> SimpleNamespace:
 
         b = tokens.shape[0]
         positions = lengths[:, None]                               # [B, 1]
-        cos, sin = _rope(positions, head_dim, theta)
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         x = params["embed"][tokens][:, None]                       # [B, 1, dim]
 
         def layer_body(x, layer, k_pool_l, v_pool_l):
@@ -383,6 +415,21 @@ def build(config: dict) -> SimpleNamespace:
             v_pools = jnp.stack(new_v)
         return _logits(params, x)[:, 0], k_pools, v_pools
 
+    def prepare_params(params):
+        """Adapt a loaded param pytree to this build's layout: under
+        scan_layers, a list/tuple of per-layer dicts (e.g. from a checkpoint
+        converter) is stacked into the [L, ...] pytree lax.scan consumes."""
+        layers = params.get("layers")
+        if scan_layers and isinstance(layers, (list, tuple)):
+            params = dict(params)
+            params["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+        elif not scan_layers and isinstance(layers, dict) and "wq" in layers:
+            params = dict(params)
+            params["layers"] = [
+                jax.tree.map(lambda x: x[i], layers) for i in range(n_layers)
+            ]
+        return params
+
     return SimpleNamespace(
         init=init,
         apply=apply,
@@ -390,6 +437,7 @@ def build(config: dict) -> SimpleNamespace:
         prefill=prefill,
         decode=decode,
         decode_paged=decode_paged,
+        prepare_params=prepare_params,
         config=cfg,
         head_dim=head_dim,
         n_kv_heads=n_kv,

@@ -1,0 +1,91 @@
+"""Convert a HuggingFace Llama checkpoint into a jax bundle.
+
+    python examples/llm/convert_model.py /path/to/hf-llama-dir [out-bundle-dir]
+
+The mapping is validated in tests/test_hf_convert.py by comparing logits
+against transformers' LlamaForCausalLM on a tiny random-init config —
+our decoder is numerically faithful to the HF implementation
+(RoPE half-split convention, GQA head grouping, fp32 RMSNorm).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
+    """(config_dict, params) from a transformers LlamaForCausalLM instance.
+    `dtype` sets both the stored weight dtype and the bundle's compute dtype
+    (serving default: pass "bfloat16")."""
+    hf_cfg = hf_model.config
+    if getattr(hf_cfg, "attention_bias", False):
+        raise ValueError("attention_bias=True checkpoints are not supported yet")
+    rope_scaling = getattr(hf_cfg, "rope_scaling", None)
+    config = {
+        "vocab_size": int(hf_cfg.vocab_size),
+        "dim": int(hf_cfg.hidden_size),
+        "n_layers": int(hf_cfg.num_hidden_layers),
+        "n_heads": int(hf_cfg.num_attention_heads),
+        "n_kv_heads": int(hf_cfg.num_key_value_heads),
+        "ffn_dim": int(hf_cfg.intermediate_size),
+        "rope_theta": float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        "norm_eps": float(hf_cfg.rms_norm_eps),
+        "max_seq_len": int(getattr(hf_cfg, "max_position_embeddings", 4096)),
+        "tie_embeddings": bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        "dtype": dtype,
+    }
+    if rope_scaling:
+        # validated by the model build (llama3 scaling supported; others raise)
+        config["rope_scaling"] = dict(rope_scaling)
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    import jax.numpy as jnp
+
+    np_dtype = jnp.dtype(dtype)
+
+    def t(name):
+        return np.asarray(sd[name]).astype(np_dtype)
+
+    params = {
+        "embed": t("model.embed_tokens.weight"),
+        "final_norm": t("model.norm.weight"),
+        "layers": [],
+    }
+    if not config["tie_embeddings"]:
+        params["lm_head"] = t("lm_head.weight").T
+    for i in range(config["n_layers"]):
+        pre = "model.layers.{}.".format(i)
+        params["layers"].append(
+            {
+                "attn_norm": t(pre + "input_layernorm.weight"),
+                "wq": t(pre + "self_attn.q_proj.weight").T,
+                "wk": t(pre + "self_attn.k_proj.weight").T,
+                "wv": t(pre + "self_attn.v_proj.weight").T,
+                "wo": t(pre + "self_attn.o_proj.weight").T,
+                "ffn_norm": t(pre + "post_attention_layernorm.weight"),
+                "w_gate": t(pre + "mlp.gate_proj.weight").T,
+                "w_up": t(pre + "mlp.up_proj.weight").T,
+                "w_down": t(pre + "mlp.down_proj.weight").T,
+            }
+        )
+    return config, params
+
+
+def main():
+    from transformers import AutoModelForCausalLM
+
+    from clearml_serving_tpu.engines.jax_engine import save_bundle
+
+    src = sys.argv[1]
+    out = sys.argv[2] if len(sys.argv) > 2 else "llama-bundle"
+    dtype = sys.argv[3] if len(sys.argv) > 3 else "bfloat16"
+    hf = AutoModelForCausalLM.from_pretrained(src, local_files_only=True)
+    config, params = convert_hf_llama(hf, dtype=dtype)
+    save_bundle(out, "llama", config, params)
+    print("saved {} ({} layers, dim {})".format(out, config["n_layers"], config["dim"]))
+    print("serve with: tpu-serving model upload --name llama --path {} ...".format(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Architecture fidelity: our llama forward must match transformers'
+LlamaForCausalLM logits on the same (random) weights."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "examples", "llm")
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(config)
+    model.eval()
+    return model
+
+
+def test_converted_llama_matches_hf_logits(tiny_hf_llama):
+    from convert_model import convert_hf_llama
+
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu import models
+
+    config, params = convert_hf_llama(tiny_hf_llama)
+    config["dtype"] = "float32"
+    bundle = models.build_model("llama", config)
+    params = {
+        k: (jnp.asarray(v) if not isinstance(v, list)
+            else [{kk: jnp.asarray(vv) for kk, vv in layer.items()} for layer in v])
+        for k, v in params.items()
+    }
+
+    tokens = np.array([[1, 5, 9, 77, 3, 42, 8, 11]], np.int32)
+    with torch.no_grad():
+        hf_logits = tiny_hf_llama(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(bundle.apply(params, jnp.asarray(tokens)))
+
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_rope_scaling_llama3_matches_hf():
+    """Llama-3.1-style rope_scaling must match HF's scaled implementation."""
+    from convert_model import convert_hf_llama
+
+    import jax.numpy as jnp
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from clearml_serving_tpu import models
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(1)
+    hf = LlamaForCausalLM(config)
+    hf.eval()
+    cfg, params = convert_hf_llama(hf)
+    cfg["dtype"] = "float32"
+    assert cfg["rope_scaling"]["rope_type"] == "llama3"
+    bundle = models.build_model("llama", cfg)
+    params = {
+        k: (jnp.asarray(v) if not isinstance(v, list)
+            else [{kk: jnp.asarray(vv) for kk, vv in layer.items()} for layer in v])
+        for k, v in params.items()
+    }
+    tokens = np.array([[1, 5, 9, 77, 3, 42, 8, 11, 64, 100]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(bundle.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_converted_bundle_scan_layers_roundtrip(tiny_hf_llama, tmp_path):
+    """A converted (list-layers) bundle saved with scan_layers=True must load
+    into the stacked layout via prepare_params and still match HF."""
+    from convert_model import convert_hf_llama
+
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu.engines.jax_engine import load_bundle, save_bundle
+
+    config, params = convert_hf_llama(tiny_hf_llama)
+    config["dtype"] = "float32"
+    config["scan_layers"] = True
+    save_bundle(tmp_path / "b", "llama", config, params)
+    bundle, loaded = load_bundle(tmp_path / "b")
+    assert isinstance(loaded["layers"], dict)  # stacked for lax.scan
+    tokens = np.array([[1, 5, 9, 77]], np.int32)
+    with torch.no_grad():
+        hf_logits = tiny_hf_llama(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(bundle.apply(loaded, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
