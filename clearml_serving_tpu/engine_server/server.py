@@ -124,6 +124,9 @@ async def serve(service_id: Optional[str] = None) -> None:
     from ..serving.model_request_processor import ModelRequestProcessor
     from ..statistics.metrics import StatisticsController
 
+    from ..serving.main import maybe_start_profiler
+
+    maybe_start_profiler()
     processor = ModelRequestProcessor(service_id=service_id)
     repo = EngineModelRepo(processor)
     repo.sync()

@@ -212,6 +212,21 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
     return app
 
 
+def maybe_start_profiler() -> None:
+    """XLA profiler capture server (SURVEY.md §5.1): set
+    TPUSERVE_PROFILER_PORT and connect TensorBoard / `jax.profiler` tooling to
+    capture device traces from the live service."""
+    port = os.environ.get("TPUSERVE_PROFILER_PORT")
+    if port:
+        try:
+            import jax
+
+            jax.profiler.start_server(int(port))
+            print("jax profiler server on :{}".format(port))
+        except Exception as ex:
+            print("profiler server failed: {}".format(ex))
+
+
 def setup_processor() -> ModelRequestProcessor:
     """Resolve the control-plane service (env TPUSERVE_SERVICE_ID, or the most
     recent service) and launch the sync/stats daemons
@@ -219,6 +234,7 @@ def setup_processor() -> ModelRequestProcessor:
     from ..engines import load_engine_modules
 
     load_engine_modules()
+    maybe_start_profiler()
     service_id = os.environ.get("TPUSERVE_SERVICE_ID") or os.environ.get(
         "CLEARML_SERVING_TASK_ID"
     )
