@@ -207,6 +207,10 @@ class ModelRequestProcessor:
         self._stats_producer = None
         self._stats_producer_url: Optional[str] = None
         self._instance_id = "inst_{:x}".format(random.getrandbits(48))
+        # per-endpoint telemetry counters (reference endpoint_telemetry,
+        # :165-251): request/error counts + cumulative latency, surfaced via
+        # /dashboard. Plain dicts mutated GIL-atomically per key.
+        self._telemetry: Dict[str, Dict[str, float]] = {}
 
     # ------------------------------------------------------------------ API
 
@@ -437,6 +441,7 @@ class ModelRequestProcessor:
 
         # Evict engine processors whose endpoint disappeared or changed.
         self._cleanup_processor_cache()
+        self._prune_telemetry()
         if prefetch_artifacts:
             for url in list(self._endpoints) + list(self._model_monitoring_endpoints):
                 try:
@@ -444,6 +449,13 @@ class ModelRequestProcessor:
                 except Exception:
                     pass
         return True
+
+    def _prune_telemetry(self) -> None:
+        """Drop counters for endpoints that no longer exist (bounded growth
+        across removed endpoints / churned monitored versions)."""
+        live = set(self._endpoints) | set(self._model_monitoring_endpoints)
+        for url in [u for u in list(self._telemetry) if u not in live]:
+            self._telemetry.pop(url, None)
 
     def _cleanup_processor_cache(self) -> None:
         """Evict processors whose endpoint disappeared, changed, or whose
@@ -654,7 +666,22 @@ class ModelRequestProcessor:
                     )
                 )
             processor = self._get_processor(url)
-            return await self._process_request(processor, url, request_body, serve_type)
+            tic = time.monotonic()
+            entry = self._telemetry.setdefault(
+                url, {"requests": 0, "errors": 0, "latency_sum": 0.0}
+            )
+            # "requests" counts every attempt (errors included), so
+            # errors/requests is a true error rate
+            entry["requests"] += 1
+            try:
+                result = await self._process_request(
+                    processor, url, request_body, serve_type
+                )
+            except Exception:
+                entry["errors"] += 1
+                raise
+            entry["latency_sum"] += time.monotonic() - tic
+            return result
         finally:
             self._inflight.dec()
 
@@ -822,12 +849,23 @@ class ModelRequestProcessor:
             for url in self._model_monitoring_endpoints:
                 if url.startswith(name + "/"):
                     edges.append({"from": "monitor:{}".format(name), "to": url, "weight": 1.0})
+        telemetry = {}
+        # snapshot: the event-loop thread inserts keys while the sync daemon
+        # may be iterating from its own thread
+        for url, entry in list(self._telemetry.items()):
+            ok = entry["requests"] - entry["errors"]
+            telemetry[url] = {
+                "requests": entry["requests"],
+                "errors": entry["errors"],
+                "mean_latency_ms": round(entry["latency_sum"] / ok * 1000, 3) if ok else None,
+            }
         return {
             "service_id": self._service.id,
             "instance": self._instance_id,
             "endpoints": table,
             "routing": edges,
             "metrics": {k: v.as_dict() for k, v in self._metric_logging.items()},
+            "telemetry": telemetry,
         }
 
     # -- validation ------------------------------------------------------------

@@ -164,6 +164,8 @@ def test_health(served):
 
 def test_dashboard(served):
     async def fn(client):
+        await client.post("/serve/echo", json={"t": 1})
+        await client.post("/serve/ghost", json={})  # 404: not an engine error
         r = await client.get("/dashboard")
         assert r.status == 200
         return await r.json()
@@ -171,6 +173,11 @@ def test_dashboard(served):
     layout = _run(served, fn)
     assert any(e["endpoint"] == "echo" for e in layout["endpoints"])
     assert "routing" in layout and "metrics" in layout
+    tele = layout["telemetry"]["echo"]
+    assert tele["requests"] >= 1 and tele["mean_latency_ms"] is not None
+    assert tele["errors"] == 0
+    # a 404 (endpoint-not-found) must not create a telemetry entry
+    assert "ghost" not in layout["telemetry"]
 
 
 def test_versioned_endpoint_path(served, tmp_path):
