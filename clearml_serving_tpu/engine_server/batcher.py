@@ -29,7 +29,8 @@ class DynamicBatcher:
         self.preferred = int(preferred_batch_size)
         self.max_delay_s = float(max_queue_delay_us) / 1e6
         self.max_batch = int(max_batch_size)
-        self._queue: "asyncio.Queue[Tuple[List[np.ndarray], asyncio.Future, int]]" = (
+        # items: (inputs, future, rows, enqueue_time)
+        self._queue: "asyncio.Queue[Tuple[List[np.ndarray], asyncio.Future, int, float]]" = (
             asyncio.Queue()
         )
         self._task: Optional[asyncio.Task] = None
@@ -37,6 +38,10 @@ class DynamicBatcher:
         self.batches_executed = 0
         self.requests_served = 0
         self.batch_size_sum = 0
+        # queue-time hook (enqueue -> batch execution start), feeding the
+        # engine server's queue-delay histogram (Triton exports the
+        # equivalent nv_inference_queue_duration)
+        self.on_queue_delay = None  # optional callable(seconds)
 
     async def infer(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
         """Submit one request's input list; rows = inputs[i].shape[0]."""
@@ -46,7 +51,7 @@ class DynamicBatcher:
                 "request batch {} exceeds max_batch_size {}".format(rows, self.max_batch)
             )
         future: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((inputs, future, rows))
+        await self._queue.put((inputs, future, rows, time.monotonic()))
         self._ensure_task()
         return await future
 
@@ -95,6 +100,10 @@ class DynamicBatcher:
         inputs_list = [b[0] for b in batch]
         futures = [b[1] for b in batch]
         rows = [b[2] for b in batch]
+        if self.on_queue_delay is not None:
+            now = time.monotonic()
+            for b in batch:
+                self.on_queue_delay(now - b[3])
         try:
             n_inputs = len(inputs_list[0])
             concat = [

@@ -50,31 +50,81 @@ class _EngineHandler(grpc.GenericRpcHandler):
         return None
 
 
+class EngineMetrics:
+    """Per-model gRPC-path observability: latency + queue-delay histograms and
+    outcome-labelled request counters (the Triton server exports the
+    equivalent nv_inference_{request_duration,queue_duration,count} series —
+    triton_helper.py relays them; gauges alone lose rate()/quantile query
+    power)."""
+
+    def __init__(self, registry=None):
+        from prometheus_client import REGISTRY, Counter, Histogram
+
+        registry = registry if registry is not None else REGISTRY
+        self.latency = Histogram(
+            "engine_infer_latency_seconds",
+            "end-to-end gRPC infer latency",
+            ["model"],
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+            registry=registry,
+        )
+        self.queue_delay = Histogram(
+            "engine_queue_delay_seconds",
+            "dynamic-batcher queue wait (enqueue to batch start)",
+            ["model"],
+            buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25),
+            registry=registry,
+        )
+        self.requests = Counter(
+            "engine_infer_requests_total",
+            "infer RPCs by outcome",
+            ["model", "outcome"],
+            registry=registry,
+        )
+
+    def wire_batcher(self, name: str, batcher) -> None:
+        if batcher.on_queue_delay is None:
+            observe = self.queue_delay.labels(model=name).observe
+            batcher.on_queue_delay = observe
+
+
 class EngineServer:
-    def __init__(self, repo: EngineModelRepo):
+    def __init__(self, repo: EngineModelRepo, metrics: Optional[EngineMetrics] = None):
         self.repo = repo
+        self.metrics = metrics
+
+    def _count(self, model_name: str, outcome: str) -> None:
+        if self.metrics is not None:
+            self.metrics.requests.labels(model=model_name, outcome=outcome).inc()
 
     async def infer(self, request_bytes: bytes, context) -> bytes:
+        tic = time.monotonic()
         try:
             request = protocol.decode_infer_request(request_bytes)
         except Exception as ex:
+            self._count("_undecodable", "bad_request")
             await context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT, "bad request encoding: {}".format(ex)
             )
-        model = self.repo.get(request["model"], request.get("version") or None)
+        model_name = request["model"]
+        model = self.repo.get(model_name, request.get("version") or None)
         if model is None:
+            self._count(model_name, "not_found")
             await context.abort(
                 grpc.StatusCode.NOT_FOUND,
                 "model {!r} version {!r} not loaded (have: {})".format(
-                    request["model"], request.get("version"), sorted(self.repo.list_models())
+                    model_name, request.get("version"), sorted(self.repo.list_models())
                 ),
             )
+        if self.metrics is not None:
+            self.metrics.wire_batcher(model_name, model.batcher)
         inputs_by_name = request["inputs"]
         # order inputs per the endpoint spec; single-input models accept any name
         if model.input_names:
             try:
                 ordered = [inputs_by_name[name] for name in model.input_names]
             except KeyError as ex:
+                self._count(model_name, "bad_request")
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     "missing input {} (expected {})".format(ex, model.input_names),
@@ -84,6 +134,7 @@ class EngineServer:
         try:
             outputs = await model.batcher.infer(ordered)
         except Exception as ex:
+            self._count(model_name, "error")
             await context.abort(
                 grpc.StatusCode.INTERNAL, "inference failed: {}".format(ex)
             )
@@ -92,6 +143,11 @@ class EngineServer:
             (names[i] if i < len(names) else "output_{}".format(i)): np.asarray(out)
             for i, out in enumerate(outputs)
         }
+        self._count(model_name, "ok")
+        if self.metrics is not None:
+            self.metrics.latency.labels(model=model_name).observe(
+                time.monotonic() - tic
+            )
         return protocol.encode_infer_response(named)
 
     async def status(self, request_bytes: bytes, context) -> bytes:
@@ -106,14 +162,16 @@ class EngineServer:
         )
 
 
-def make_server(repo: EngineModelRepo, port: int = 0) -> "tuple[grpc.aio.Server, int]":
+def make_server(
+    repo: EngineModelRepo, port: int = 0, metrics: Optional[EngineMetrics] = None
+) -> "tuple[grpc.aio.Server, int]":
     server = grpc.aio.server(
         options=[
             ("grpc.max_receive_message_length", 256 * 1024 * 1024),
             ("grpc.max_send_message_length", 256 * 1024 * 1024),
         ]
     )
-    server.add_generic_rpc_handlers((_EngineHandler(EngineServer(repo)),))
+    server.add_generic_rpc_handlers((_EngineHandler(EngineServer(repo, metrics)),))
     bound_port = server.add_insecure_port("[::]:{}".format(port))
     return server, bound_port
 
@@ -135,17 +193,18 @@ async def serve(service_id: Optional[str] = None) -> None:
     metrics_port = int(os.environ.get("TPUSERVE_ENGINE_METRICS_PORT", 8002))
     poll_freq_sec = float(os.environ.get("TPUSERVE_POLL_FREQ", 1.0)) * 60.0
 
-    server, bound = make_server(repo, port)
-    await server.start()
-    print("engine server: gRPC on :{} ({} models)".format(bound, len(repo.list_models())))
-
     try:
         start_http_server(metrics_port)
         requests_g = Gauge("engine_requests_served", "requests served", ["model"])
         batches_g = Gauge("engine_batches_executed", "batches executed", ["model"])
+        metrics = EngineMetrics()
         hbm = StatisticsController("", processor=None)
     except OSError:
-        requests_g = batches_g = hbm = None
+        requests_g = batches_g = hbm = metrics = None
+
+    server, bound = make_server(repo, port, metrics)
+    await server.start()
+    print("engine server: gRPC on :{} ({} models)".format(bound, len(repo.list_models())))
 
     async def reconcile_loop():
         while True:

@@ -124,8 +124,9 @@ class LLMEngineRequest(BaseEngineRequest):
     @staticmethod
     def _report_gen_stats(request, collect_fn) -> None:
         """TTFT + token counts into the sampled-stats pipeline (BASELINE.md
-        per-endpoint metrics). Streaming responses bypass this (the stats
-        packet is emitted before the stream body runs)."""
+        per-endpoint metrics). Streaming handlers call this when the SSE body
+        finishes — the router defers the stats packet to stream completion
+        (StreamingOutput.on_complete), so streaming TTFT is recorded too."""
         if collect_fn is None:
             return
         stats = {"gen_tokens": request.produced, "prompt_tokens": request.prompt_len}
@@ -161,6 +162,12 @@ class LLMEngineRequest(BaseEngineRequest):
             if len(text) > len(sent):
                 yield {"delta": text[len(sent):]}
                 sent = text
+        # flush any held-back tail: if the final decode legitimately ends with
+        # the replacement character (truncated multi-byte at stop, or a real
+        # '�' from the tokenizer), it must not be silently dropped
+        text = self.tokenizer.decode(ids)
+        if len(text) > len(sent):
+            yield {"delta": text[len(sent):]}
 
     def _finish_reason(self, request) -> str:
         """OpenAI semantics: "length" covers BOTH max_tokens truncation and
@@ -176,7 +183,9 @@ class LLMEngineRequest(BaseEngineRequest):
     async def v1_chat_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
         messages = body.get("messages") or []
         prompt = self.tokenizer.apply_chat_template(messages)
-        prompt_ids = self.tokenizer.encode(prompt)
+        # encode_chat: no special-token re-add — HF chat templates already
+        # emit BOS in the template text (double-BOS degrades fidelity)
+        prompt_ids = self.tokenizer.encode_chat(prompt)
         request = self._gen_request_from_body(body, prompt_ids)
         model = body.get("model", self._model_name)
         completion_id = _gen_id("chatcmpl")
@@ -188,36 +197,43 @@ class LLMEngineRequest(BaseEngineRequest):
             self.engine.validate(request)
 
             async def sse():
-                first = {
-                    "id": completion_id, "object": "chat.completion.chunk",
-                    "created": created, "model": model,
-                    "choices": [{"index": 0, "delta": {"role": "assistant"},
-                                 "finish_reason": None}],
-                }
-                yield "data: {}\n\n".format(json.dumps(first))
                 try:
-                    async for piece in self._stream_deltas(request):
-                        chunk = {
-                            "id": completion_id, "object": "chat.completion.chunk",
-                            "created": created, "model": model,
-                            "choices": [{"index": 0, "delta": {"content": piece["delta"]},
-                                         "finish_reason": None}],
-                        }
-                        yield "data: {}\n\n".format(json.dumps(chunk))
-                except Exception as ex:
-                    yield "data: {}\n\n".format(json.dumps(
-                        {"error": {"message": str(ex), "type": type(ex).__name__}}
-                    ))
+                    first = {
+                        "id": completion_id, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "delta": {"role": "assistant"},
+                                     "finish_reason": None}],
+                    }
+                    yield "data: {}\n\n".format(json.dumps(first))
+                    try:
+                        async for piece in self._stream_deltas(request):
+                            chunk = {
+                                "id": completion_id, "object": "chat.completion.chunk",
+                                "created": created, "model": model,
+                                "choices": [{"index": 0, "delta": {"content": piece["delta"]},
+                                             "finish_reason": None}],
+                            }
+                            yield "data: {}\n\n".format(json.dumps(chunk))
+                    except Exception as ex:
+                        yield "data: {}\n\n".format(json.dumps(
+                            {"error": {"message": str(ex), "type": type(ex).__name__}}
+                        ))
+                        yield "data: [DONE]\n\n"
+                        return
+                    done = {
+                        "id": completion_id, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "delta": {},
+                                     "finish_reason": self._finish_reason(request)}],
+                    }
+                    yield "data: {}\n\n".format(json.dumps(done))
                     yield "data: [DONE]\n\n"
-                    return
-                done = {
-                    "id": completion_id, "object": "chat.completion.chunk",
-                    "created": created, "model": model,
-                    "choices": [{"index": 0, "delta": {},
-                                 "finish_reason": self._finish_reason(request)}],
-                }
-                yield "data: {}\n\n".format(json.dumps(done))
-                yield "data: [DONE]\n\n"
+                finally:
+                    # runs on normal completion AND on client disconnect
+                    # (GeneratorExit): free the decode slot early and record
+                    # streaming TTFT/token stats at stream end
+                    request.cancel()
+                    self._report_gen_stats(request, collect_fn)
 
             return StreamingOutput(sse())
 
@@ -282,28 +298,34 @@ class LLMEngineRequest(BaseEngineRequest):
 
             async def sse():
                 try:
-                    async for piece in self._stream_deltas(request):
-                        chunk = {
-                            "id": completion_id, "object": "text_completion",
-                            "created": created, "model": model,
-                            "choices": [{"index": 0, "text": piece["delta"],
-                                         "finish_reason": None}],
-                        }
-                        yield "data: {}\n\n".format(json.dumps(chunk))
-                except Exception as ex:
-                    yield "data: {}\n\n".format(json.dumps(
-                        {"error": {"message": str(ex), "type": type(ex).__name__}}
-                    ))
+                    try:
+                        async for piece in self._stream_deltas(request):
+                            chunk = {
+                                "id": completion_id, "object": "text_completion",
+                                "created": created, "model": model,
+                                "choices": [{"index": 0, "text": piece["delta"],
+                                             "finish_reason": None}],
+                            }
+                            yield "data: {}\n\n".format(json.dumps(chunk))
+                    except Exception as ex:
+                        yield "data: {}\n\n".format(json.dumps(
+                            {"error": {"message": str(ex), "type": type(ex).__name__}}
+                        ))
+                        yield "data: [DONE]\n\n"
+                        return
+                    final = {
+                        "id": completion_id, "object": "text_completion",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "text": "",
+                                     "finish_reason": self._finish_reason(request)}],
+                    }
+                    yield "data: {}\n\n".format(json.dumps(final))
                     yield "data: [DONE]\n\n"
-                    return
-                final = {
-                    "id": completion_id, "object": "text_completion",
-                    "created": created, "model": model,
-                    "choices": [{"index": 0, "text": "",
-                                 "finish_reason": self._finish_reason(request)}],
-                }
-                yield "data: {}\n\n".format(json.dumps(final))
-                yield "data: [DONE]\n\n"
+                finally:
+                    # normal completion AND client disconnect (GeneratorExit):
+                    # free the decode slot early, record streaming stats
+                    request.cancel()
+                    self._report_gen_stats(request, collect_fn)
 
             return StreamingOutput(sse())
 

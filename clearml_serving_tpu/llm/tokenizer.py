@@ -27,6 +27,11 @@ class ByteTokenizer:
         ids = list(text.encode("utf-8"))
         return ([self.bos_token_id] if add_bos else []) + ids
 
+    def encode_chat(self, templated: str) -> List[int]:
+        """Encode apply_chat_template output. The byte-level template carries
+        no special tokens, so BOS is prepended here."""
+        return self.encode(templated, add_bos=True)
+
     def decode(self, ids: Iterable[int]) -> str:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
@@ -49,10 +54,23 @@ class HFTokenizer:
         self.vocab_size = int(self._tok.vocab_size)
         self.bos_token_id = self._tok.bos_token_id
         self.eos_token_id = self._tok.eos_token_id
-        self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
+        # explicit None check: a valid pad_token_id of 0 must not be
+        # silently replaced by eos
+        self.pad_token_id = (
+            self._tok.pad_token_id
+            if self._tok.pad_token_id is not None
+            else self._tok.eos_token_id
+        )
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def encode_chat(self, templated: str) -> List[int]:
+        """Encode apply_chat_template output WITHOUT re-adding special tokens:
+        HF chat templates (Llama family included) already emit BOS in the
+        template text, so encode(add_special_tokens=True) would double it and
+        degrade generation fidelity (matches vLLM's chat encoding)."""
+        return self._tok.encode(templated, add_special_tokens=False)
 
     def decode(self, ids: Iterable[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
@@ -63,7 +81,13 @@ class HFTokenizer:
                 messages, tokenize=False, add_generation_prompt=True
             )
         except Exception:
-            return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+            # no chat template: the fallback text carries no specials, so
+            # prepend the BOS literal to keep encode_chat() (which never adds
+            # special tokens) correct for both paths
+            text = ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+            if self._tok.bos_token:
+                text = self._tok.bos_token + text
+            return text
 
 
 def load_tokenizer(model_path: Optional[str], vocab_size: int):

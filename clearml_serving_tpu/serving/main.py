@@ -152,15 +152,32 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
     async def _respond(request: web.Request, result) -> web.StreamResponse:
         if isinstance(result, tuple):  # streaming
             resp, out = result
-            await resp.prepare(request)
             try:
-                async for chunk in out.generator:
-                    if isinstance(chunk, str):
-                        chunk = chunk.encode("utf-8")
-                    await resp.write(chunk)
+                try:
+                    # prepare inside the guard: a disconnect racing the 200
+                    # headers must still close the generator + emit stats
+                    await resp.prepare(request)
+                    async for chunk in out.generator:
+                        if isinstance(chunk, str):
+                            chunk = chunk.encode("utf-8")
+                        await resp.write(chunk)
+                except ConnectionResetError:
+                    pass
+            finally:
+                # deliver GeneratorExit into the engine's SSE body NOW (frees
+                # the decode slot on disconnect), then emit deferred stats
+                aclose = getattr(out.generator, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:
+                        pass
+                if out.on_complete is not None:
+                    out.on_complete()
+            try:
+                await resp.write_eof()
             except ConnectionResetError:
                 pass
-            await resp.write_eof()
             return resp
         return result
 

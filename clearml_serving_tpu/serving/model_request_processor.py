@@ -47,6 +47,7 @@ from .endpoints import (
 )
 from ..engines import get_engine_cls
 from ..engines.base import BaseEngineRequest
+from .responses import StreamingOutput
 from ..state import ModelRegistry, ServingService, StateStore
 from ..utils.files import sha256_obj
 from ..version import __version__
@@ -531,9 +532,11 @@ class ModelRequestProcessor:
         for name, canary in self._canary_endpoints.items():
             if canary.load_endpoint_prefix:
                 prefix = canary.load_endpoint_prefix.strip("/")
+                # match on name boundaries only: prefix "ep" must match
+                # "ep" and "ep/2" but NOT "ep2/1"
                 matches = [
                     u for u in list(self._endpoints) + list(self._model_monitoring_endpoints)
-                    if u.startswith(prefix)
+                    if u == prefix or u.startswith(prefix + "/")
                 ]
                 # sort by zero-padded numeric version suffix, descending
                 def _version_key(u):
@@ -743,25 +746,36 @@ class ModelRequestProcessor:
             result = await processor.postprocess(out, state, collect_fn)
         else:
             result = processor.postprocess(out, state, collect_fn)
-        toc = time.time()
 
         if collect:
-            stats = {
-                "_url": url,
-                "_latency": round(toc - tic, 6),
-                "_count": int(1.0 / freq) if freq else 1,
-            }
-            # whitelisted request/response fields per the metric spec
-            if metric_spec is not None:
-                for key in metric_spec.metrics:
-                    if key.startswith("_"):
-                        continue
-                    if isinstance(body, dict) and key in body:
-                        stats[key] = body[key]
-                    elif isinstance(result, dict) and key in result:
-                        stats[key] = result[key]
-            stats.update(custom_stats)
-            self._stats_queue.put(stats)
+
+            def _emit_stats() -> None:
+                stats = {
+                    "_url": url,
+                    "_latency": round(time.time() - tic, 6),
+                    "_count": int(1.0 / freq) if freq else 1,
+                }
+                # whitelisted request/response fields per the metric spec
+                if metric_spec is not None:
+                    for key in metric_spec.metrics:
+                        if key.startswith("_"):
+                            continue
+                        if isinstance(body, dict) and key in body:
+                            stats[key] = body[key]
+                        elif isinstance(result, dict) and key in result:
+                            stats[key] = result[key]
+                stats.update(custom_stats)
+                self._stats_queue.put(stats)
+
+            if isinstance(result, StreamingOutput):
+                # streaming: defer the packet to stream completion so
+                # _latency covers the whole stream and the engine's
+                # end-of-stream TTFT/token stats (written through collect_fn
+                # during the body) are included — streaming chat is THE LLM
+                # workload; its TTFT is the BASELINE.md headline metric
+                result.on_complete = _emit_stats
+            else:
+                _emit_stats()
         return result
 
     # -- daemons --------------------------------------------------------------

@@ -18,6 +18,10 @@ class StreamingOutput:
     def __init__(self, generator: AsyncIterator, content_type: str = "text/event-stream"):
         self.generator = generator
         self.content_type = content_type
+        # set by the orchestrator: called once after the stream body finishes
+        # (or the client disconnects) — used to emit the stats packet with the
+        # real stream latency/TTFT instead of time-to-headers
+        self.on_complete = None
 
 
 class JSONOutput:

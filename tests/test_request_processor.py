@@ -113,6 +113,20 @@ def test_canary_routing(mrp, state_root, tmp_path):
     assert mrp._canary_route["q"]["endpoints"] == ["m/1"]
     assert mrp._canary_route["q"]["weights"] == [1.0]
 
+    # prefix matching respects name boundaries: "m" must not match "m2/1"
+    code2 = tmp_path / "double2.py"
+    code2.write_text(DOUBLE_CODE)
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="m2/1"),
+        preprocess_code=str(code2),
+    )
+    mrp.add_canary_endpoint(
+        CanaryEP(endpoint="r", weights=[0.5, 0.5], load_endpoint_prefix="m")
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    assert set(mrp._canary_route["r"]["endpoints"]) == {"m/1", "m/2"}
+
 
 def test_monitoring_auto_deploy(mrp, state_root, tmp_path):
     reg = mrp.registry
