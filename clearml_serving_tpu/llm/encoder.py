@@ -1,0 +1,227 @@
+"""Encoder-model core: embeddings / pooling / classification / scoring.
+
+Backs the OpenAI-compatible encoder routes (v1/embeddings, v1/pooling,
+v1/classify, v1/score, v1/rerank) the reference instantiates task-gated vLLM
+handlers for (reference preprocess_service.py:711-808, route handlers
+:836-1095).  TPU-first design instead of a vLLM port:
+
+- **Bucketed static shapes**: inputs pad to (batch-bucket, seq-bucket); one
+  jitted executable per bucket pair, cached — no recompilation storms, and
+  every shape XLA sees tiles cleanly onto the MXU.
+- **fp32 pooling over bf16 encode**: masked mean (or CLS) pooling accumulates
+  in float32; optional L2 normalization matches OpenAI embedding semantics.
+- **Pair scoring**: cross-encoder when the bundle's classifier head has one
+  label ([CLS] a [SEP] b [SEP] -> sigmoid(logit)), bi-encoder cosine
+  similarity otherwise — same fallback policy vLLM applies to score requests
+  against embedding models.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_SEQ_BUCKETS = [32, 64, 128, 256, 512]
+_DEFAULT_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
+
+
+class EncoderCore:
+    """Bucketed-jit wrapper over an encoder bundle (models/bert.py)."""
+
+    def __init__(
+        self,
+        bundle,
+        params,
+        *,
+        pooling: str = "mean",
+        normalize: bool = True,
+        seq_buckets: Optional[List[int]] = None,
+        batch_buckets: Optional[List[int]] = None,
+        sep_token_id: Optional[int] = None,
+        cls_token_id: Optional[int] = None,
+    ):
+        if not hasattr(bundle, "hidden"):
+            raise ValueError(
+                "encoder tasks need a model bundle with a .hidden() surface "
+                "(e.g. arch 'bert'); arch {!r} is decoder-only".format(
+                    bundle.config.get("arch", "?")
+                )
+            )
+        self.bundle = bundle
+        self.params = params
+        if pooling not in ("mean", "cls"):
+            raise ValueError("pooling must be 'mean' or 'cls'")
+        self.pooling = pooling
+        self.normalize = bool(normalize)
+        self.max_seq_len = int(bundle.config.get("max_seq_len", 512))
+        self.dim = int(bundle.config.get("dim"))
+        self.num_labels = int(bundle.config.get("num_labels", 0))
+        self.sep_token_id = sep_token_id
+        self.cls_token_id = cls_token_id
+        self._seq_buckets = sorted(
+            b for b in (seq_buckets or _DEFAULT_SEQ_BUCKETS) if b <= self.max_seq_len
+        )
+        # the terminal bucket is always max_seq_len, so any admissible length
+        # (<= max_seq_len) has a bucket to land in
+        if not self._seq_buckets or self._seq_buckets[-1] != self.max_seq_len:
+            self._seq_buckets.append(self.max_seq_len)
+        self._batch_buckets = sorted(batch_buckets or _DEFAULT_BATCH_BUCKETS)
+        self._jit_lock = threading.Lock()
+
+        def _embed(params, input_ids, attention_mask):
+            x = bundle.hidden(params, input_ids, attention_mask)  # [B,S,D]
+            x32 = x.astype(jnp.float32)
+            if self.pooling == "cls":
+                pooled = x32[:, 0]
+            else:
+                mask = attention_mask.astype(jnp.float32)[:, :, None]
+                pooled = (x32 * mask).sum(axis=1) / jnp.maximum(
+                    mask.sum(axis=1), 1.0
+                )
+            if self.normalize:
+                pooled = pooled / jnp.maximum(
+                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+                )
+            return pooled  # [B, D] fp32
+
+        def _tokens(params, input_ids, attention_mask):
+            return bundle.hidden(params, input_ids, attention_mask).astype(
+                jnp.float32
+            )
+
+        def _classify(params, input_ids, attention_mask):
+            x = bundle.hidden(params, input_ids, attention_mask)
+            cls = x[:, 0].astype(jnp.float32)
+            w = params["classifier"]["w"].astype(jnp.float32)
+            b = params["classifier"]["b"].astype(jnp.float32)
+            return cls @ w + b  # [B, num_labels]
+
+        self._embed_jit = jax.jit(_embed)
+        self._tokens_jit = jax.jit(_tokens)
+        self._classify_jit = jax.jit(_classify)
+
+    # -- batching helpers ----------------------------------------------------
+
+    def _bucket(self, n: int, buckets: List[int]) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    def _pad_batch(self, id_lists: List[List[int]]) -> Tuple[np.ndarray, np.ndarray]:
+        """Pad token-id lists to (batch-bucket, seq-bucket) static shapes."""
+        longest = max(len(ids) for ids in id_lists)
+        if longest > self.max_seq_len:
+            raise ValueError(
+                "input length {} exceeds model max_seq_len {}".format(
+                    longest, self.max_seq_len
+                )
+            )
+        s = self._bucket(longest, self._seq_buckets)
+        b = self._bucket(len(id_lists), self._batch_buckets)
+        input_ids = np.zeros((b, s), np.int32)
+        mask = np.zeros((b, s), np.int32)
+        for i, ids in enumerate(id_lists):
+            input_ids[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1
+        return input_ids, mask
+
+    def _run_chunks(self, fn, id_lists: List[List[int]]):
+        """Yield (chunk_id_lists, result) per batch-bucket chunk — chunks may
+        land in different seq buckets, so results are NOT concatenated here.
+        Per-request device work; safe from worker threads."""
+        max_b = self._batch_buckets[-1]
+        for start in range(0, len(id_lists), max_b):
+            chunk = id_lists[start : start + max_b]
+            input_ids, mask = self._pad_batch(chunk)
+            with self._jit_lock:  # serialize tracing, not execution
+                result = fn(self.params, jnp.asarray(input_ids), jnp.asarray(mask))
+            yield chunk, np.asarray(result)[: len(chunk)]
+
+    def _run_batched(self, fn, id_lists: List[List[int]]) -> np.ndarray:
+        """Run `fn` over arbitrarily many inputs; valid only for outputs with
+        no seq axis ([B, ...] invariant across seq buckets)."""
+        return np.concatenate(
+            [out for _, out in self._run_chunks(fn, id_lists)], axis=0
+        )
+
+    # -- public surface ------------------------------------------------------
+
+    def embed(self, id_lists: List[List[int]]) -> np.ndarray:
+        """[N] token-id lists -> [N, dim] fp32 (L2-normalized if configured)."""
+        return self._run_batched(self._embed_jit, id_lists)
+
+    def token_states(self, id_lists: List[List[int]]) -> List[np.ndarray]:
+        """Per-input final hidden states (unpadded): list of [len_i, dim]."""
+        out: List[np.ndarray] = []
+        for chunk, states in self._run_chunks(self._tokens_jit, id_lists):
+            out.extend(states[i, : len(ids)] for i, ids in enumerate(chunk))
+        return out
+
+    def classify(self, id_lists: List[List[int]]) -> np.ndarray:
+        """[N] inputs -> [N, num_labels] fp32 logits (CLS head)."""
+        if self.num_labels <= 0:
+            raise ValueError("model bundle has no classifier head")
+        return self._run_batched(self._classify_jit, id_lists)
+
+    @property
+    def is_cross_encoder(self) -> bool:
+        return self.num_labels == 1
+
+    def _join_pair(self, a: List[int], b: List[int]) -> List[int]:
+        """BERT text-pair assembly: [CLS] a [SEP] b [SEP]. `a`/`b` must be
+        encoded WITHOUT special tokens; truncation keeps the final SEP."""
+        cls = [self.cls_token_id] if self.cls_token_id is not None else []
+        sep = [self.sep_token_id] if self.sep_token_id is not None else []
+        ids = cls + list(a) + sep + list(b) + sep
+        if len(ids) > self.max_seq_len:
+            ids = ids[: self.max_seq_len]
+            if sep:
+                ids[-1] = sep[0]
+        return ids
+
+    def score_pairs(
+        self,
+        pairs: List[Tuple[List[int], List[int]]],
+        *,
+        with_specials: Optional[bool] = None,
+    ) -> List[float]:
+        """Relevance score per (text_1, text_2) token-id pair.
+
+        Cross-encoder bundles (num_labels == 1): joint [CLS] a [SEP] b [SEP]
+        encode -> sigmoid(logit); pairs must then be encoded WITHOUT special
+        tokens. Otherwise: bi-encoder cosine similarity of the two pooled
+        embeddings (pairs encoded with specials, as for embed())."""
+        if self.is_cross_encoder:
+            joined = [self._join_pair(a, b) for a, b in pairs]
+            logits = self.classify(joined)[:, 0]
+            return [float(s) for s in 1.0 / (1.0 + np.exp(-logits))]
+        flat: List[List[int]] = []
+        for a, b in pairs:
+            flat.append(list(a))
+            flat.append(list(b))
+        vecs = self.embed(flat)
+        return [
+            self._cosine(vecs[2 * i], vecs[2 * i + 1]) for i in range(len(pairs))
+        ]
+
+    @staticmethod
+    def _cosine(a: np.ndarray, b: np.ndarray) -> float:
+        denom = float(np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12
+        return float(np.dot(a, b) / denom)
+
+    def rerank(self, query_ids: List[int], doc_id_lists: List[List[int]]) -> List[float]:
+        """Score each document against the query. Bi-encoder path embeds the
+        query ONCE and dots it against the document embeddings (score_pairs
+        would redundantly re-encode the query per document); cross-encoder
+        path joint-encodes each (query, doc) pair."""
+        if self.is_cross_encoder:
+            return self.score_pairs([(query_ids, d) for d in doc_id_lists])
+        vecs = self.embed([list(query_ids)] + [list(d) for d in doc_id_lists])
+        q = vecs[0]
+        return [self._cosine(q, v) for v in vecs[1:]]

@@ -49,6 +49,7 @@ class LLMEngineRequest(BaseEngineRequest):
 
     def __init__(self, *args, **kwargs):
         self.engine = None
+        self.encoder = None
         self.tokenizer = None
         self._model_name = "model"
         super().__init__(*args, **kwargs)
@@ -71,7 +72,8 @@ class LLMEngineRequest(BaseEngineRequest):
         elif engine_cfg.get("preset"):
             # weightless demo/bench mode: architecture preset, random params
             bundle = models.build_model(
-                "llama", {"preset": engine_cfg["preset"], **(engine_cfg.get("config") or {})}
+                engine_cfg.get("arch", "llama"),
+                {"preset": engine_cfg["preset"], **(engine_cfg.get("config") or {})},
             )
             params = bundle.init(jax.random.PRNGKey(int(engine_cfg.get("seed", 0))))
         else:
@@ -91,6 +93,41 @@ class LLMEngineRequest(BaseEngineRequest):
         self.tokenizer = load_tokenizer(
             self._model_local_path, int(bundle.config.get("vocab_size", 0))
         )
+
+        # task gating like the reference's model-task handler instantiation
+        # (preprocess_service.py:711-808): encoder bundles (no .decode) serve
+        # the embeddings/pooling/classify/score/rerank routes; decoder bundles
+        # serve chat/completions.
+        task = engine_cfg.get("task")
+        if task is None:
+            task = "generate" if hasattr(bundle, "decode") else "embed"
+        encoder_tasks = {
+            "embed", "embedding", "pooling", "classify", "classification",
+            "score", "rerank",
+        }
+        if task not in encoder_tasks and task != "generate":
+            raise EndpointModelError(
+                "unknown engine task {!r} for endpoint {!r} (expected "
+                "'generate' or one of {})".format(
+                    task, self.endpoint.serving_url, sorted(encoder_tasks)
+                )
+            )
+        if task in encoder_tasks:
+            from .encoder import EncoderCore
+
+            hf = getattr(self.tokenizer, "_tok", None)
+            self.encoder = EncoderCore(
+                bundle,
+                params,
+                pooling=engine_cfg.get("pooling", "mean"),
+                normalize=bool(engine_cfg.get("normalize", True)),
+                seq_buckets=engine_cfg.get("seq_buckets"),
+                batch_buckets=engine_cfg.get("batch_buckets"),
+                sep_token_id=getattr(hf, "sep_token_id", None),
+                cls_token_id=getattr(hf, "cls_token_id", None),
+            )
+            self._model_name = self.endpoint.serving_url
+            return self.encoder
         self.engine = LLMEngineCore(
             bundle,
             params,
@@ -180,7 +217,26 @@ class LLMEngineRequest(BaseEngineRequest):
 
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
+    def _require_engine(self, route: str) -> None:
+        if self.engine is None:
+            raise EndpointModelError(
+                "model {!r} does not support {} (encoder endpoint — task-gated "
+                "like the reference's vLLM handler instantiation)".format(
+                    self._model_name, route
+                )
+            )
+
+    def _require_encoder(self, route: str) -> None:
+        if self.encoder is None:
+            raise EndpointModelError(
+                "model {!r} does not support {} (decoder-only LLM endpoint; "
+                "serve an encoder bundle or set aux_config engine.task)".format(
+                    self._model_name, route
+                )
+            )
+
     async def v1_chat_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        self._require_engine("v1/chat/completions")
         messages = body.get("messages") or []
         prompt = self.tokenizer.apply_chat_template(messages)
         # encode_chat: no special-token re-add — HF chat templates already
@@ -259,7 +315,8 @@ class LLMEngineRequest(BaseEngineRequest):
         }
 
     def _check_token_ids(self, ids: List[int]) -> List[int]:
-        vocab = int(self.engine.bundle.config.get("vocab_size", 0))
+        core = self.engine if self.engine is not None else self.encoder
+        vocab = int(core.bundle.config.get("vocab_size", 0))
         for t in ids:
             if not (0 <= int(t) < vocab):
                 raise ValueError(
@@ -283,6 +340,7 @@ class LLMEngineRequest(BaseEngineRequest):
         return [self.tokenizer.encode(str(prompt))]
 
     async def v1_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        self._require_engine("v1/completions")
         prompt_id_lists = self._encode_prompts(body.get("prompt") or "")
         model = body.get("model", self._model_name)
         completion_id = _gen_id("cmpl")
@@ -366,36 +424,199 @@ class LLMEngineRequest(BaseEngineRequest):
             ],
         }
 
+    @property
+    def _max_model_len(self) -> int:
+        core = self.engine if self.engine is not None else self.encoder
+        return core.max_seq_len if core is not None else 0
+
     async def v1_tokenize(self, body: Dict[str, Any], state: dict, collect_fn=None):
         ids = self.tokenizer.encode(str(body.get("prompt") or body.get("text") or ""))
-        return {"tokens": ids, "count": len(ids), "max_model_len": self.engine.max_seq_len}
+        return {"tokens": ids, "count": len(ids), "max_model_len": self._max_model_len}
 
     async def v1_detokenize(self, body: Dict[str, Any], state: dict, collect_fn=None):
         ids = body.get("tokens") or []
         return {"prompt": self.tokenizer.decode([int(i) for i in ids])}
 
-    # capability-gated routes (model family does not support them yet)
+    # -- encoder routes (OpenAI embeddings API + vLLM-compatible extensions) --
+
+    def _encode_texts(self, value) -> List[List[int]]:
+        """OpenAI embeddings `input` polymorphism, same as completions
+        `prompt`: str | [str] | [int] | [[int]]."""
+        return self._encode_prompts(value)
+
+    @staticmethod
+    def _format_vec(vec, fmt: str):
+        if fmt == "base64":
+            import base64
+
+            import numpy as _np
+
+            return base64.b64encode(
+                _np.asarray(vec, _np.float32).tobytes()
+            ).decode("ascii")
+        return [float(x) for x in vec]
+
+    async def v1_embeddings(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        self._require_encoder("v1/embeddings")
+        id_lists = self._encode_texts(body.get("input") or "")
+        fmt = body.get("encoding_format", "float")
+        if fmt not in ("float", "base64"):
+            raise ValueError("encoding_format must be 'float' or 'base64'")
+        vecs = await asyncio.to_thread(self.encoder.embed, id_lists)
+        n_tokens = sum(len(ids) for ids in id_lists)
+        if collect_fn is not None:
+            collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(id_lists)})
+        return {
+            "object": "list",
+            "model": body.get("model", self._model_name),
+            "data": [
+                {
+                    "object": "embedding",
+                    "index": i,
+                    "embedding": self._format_vec(vec, fmt),
+                }
+                for i, vec in enumerate(vecs)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }
+
+    async def v1_pooling(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        """vLLM pooling API: raw per-token hidden states (or pooled vector)."""
+        self._require_encoder("v1/pooling")
+        id_lists = self._encode_texts(body.get("input") or "")
+        per_token = body.get("return_token_states", False)
+        if per_token:
+            states = await asyncio.to_thread(self.encoder.token_states, id_lists)
+            data = [
+                {"object": "pooling", "index": i, "data": s.tolist()}
+                for i, s in enumerate(states)
+            ]
+        else:
+            vecs = await asyncio.to_thread(self.encoder.embed, id_lists)
+            data = [
+                {"object": "pooling", "index": i, "data": [float(x) for x in v]}
+                for i, v in enumerate(vecs)
+            ]
+        n_tokens = sum(len(ids) for ids in id_lists)
+        return {
+            "object": "list",
+            "model": body.get("model", self._model_name),
+            "data": data,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }
+
+    async def v1_classify(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        self._require_encoder("v1/classify")
+        id_lists = self._encode_texts(body.get("input") or "")
+        logits = await asyncio.to_thread(self.encoder.classify, id_lists)
+        import numpy as _np
+
+        probs = _np.exp(logits - logits.max(axis=-1, keepdims=True))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        labels = self.endpoint_labels()
+        data = []
+        for i in range(len(id_lists)):
+            idx = int(_np.argmax(probs[i]))
+            data.append(
+                {
+                    "index": i,
+                    "label": labels[idx] if idx < len(labels) else str(idx),
+                    "probs": [float(p) for p in probs[i]],
+                    "num_classes": int(probs.shape[-1]),
+                }
+            )
+        n_tokens = sum(len(ids) for ids in id_lists)
+        return {
+            "object": "list",
+            "model": body.get("model", self._model_name),
+            "data": data,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }
+
+    def endpoint_labels(self) -> List[str]:
+        aux = self.endpoint.auxiliary_cfg if isinstance(self.endpoint.auxiliary_cfg, dict) else {}
+        return list((aux.get("engine") or {}).get("labels") or [])
+
+    def _score_pairs_body(self, body: Dict[str, Any]):
+        t1, t2 = body.get("text_1"), body.get("text_2")
+        if t1 is None or t2 is None:
+            raise ValueError("score requests need text_1 and text_2")
+        list1 = t1 if isinstance(t1, list) else [t1]
+        list2 = t2 if isinstance(t2, list) else [t2]
+        if len(list1) == 1 and len(list2) > 1:
+            list1 = list1 * len(list2)
+        if len(list2) == 1 and len(list1) > 1:
+            list2 = list2 * len(list1)
+        if len(list1) != len(list2):
+            raise ValueError("text_1/text_2 lengths do not broadcast")
+        # cross-encoder: segments encoded bare; EncoderCore assembles the
+        # [CLS] a [SEP] b [SEP] pair itself. bi-encoder: full encodes.
+        bare = self.encoder.is_cross_encoder
+        pairs = [
+            (
+                self.tokenizer.encode(str(a), add_bos=not bare),
+                self.tokenizer.encode(str(b), add_bos=not bare),
+            )
+            for a, b in zip(list1, list2)
+        ]
+        return pairs
+
+    async def v1_score(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        """vLLM score API: pairwise relevance of text_1 x text_2."""
+        self._require_encoder("v1/score")
+        pairs = self._score_pairs_body(body)
+        scores = await asyncio.to_thread(self.encoder.score_pairs, pairs)
+        n_tokens = sum(len(a) + len(b) for a, b in pairs)
+        return {
+            "object": "list",
+            "model": body.get("model", self._model_name),
+            "data": [
+                {"object": "score", "index": i, "score": s}
+                for i, s in enumerate(scores)
+            ],
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }
+
+    async def v1_rerank(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        """Jina/Cohere-compatible rerank (vLLM do_rerank semantics): score
+        each document against the query, return top_n descending."""
+        self._require_encoder("v1/rerank")
+        query = body.get("query")
+        documents = body.get("documents") or []
+        if query is None or not documents:
+            raise ValueError("rerank requests need query and documents")
+        doc_texts = [
+            d.get("text") if isinstance(d, dict) else str(d) for d in documents
+        ]
+        bare = self.encoder.is_cross_encoder
+        q_ids = self.tokenizer.encode(str(query), add_bos=not bare)
+        doc_ids = [self.tokenizer.encode(t, add_bos=not bare) for t in doc_texts]
+        scores = await asyncio.to_thread(self.encoder.rerank, q_ids, doc_ids)
+        order = sorted(range(len(scores)), key=lambda i: scores[i], reverse=True)
+        top_n = int(body.get("top_n") or len(order))
+        results = [
+            {
+                "index": i,
+                "document": {"text": doc_texts[i]},
+                "relevance_score": scores[i],
+            }
+            for i in order[:top_n]
+        ]
+        n_tokens = len(q_ids) + sum(len(d) for d in doc_ids)
+        return {
+            "id": _gen_id("rerank"),
+            "model": body.get("model", self._model_name),
+            "results": results,
+            "usage": {"total_tokens": n_tokens},
+        }
+
+    # capability-gated routes (no audio model family in-tree yet)
     async def _unsupported(self, route: str):
         raise EndpointModelError(
-            "model {!r} does not support {} (decoder-only LLM endpoint)".format(
+            "model {!r} does not support {} (no audio model loaded)".format(
                 self._model_name, route
             )
         )
-
-    async def v1_embeddings(self, body, state, collect_fn=None):
-        await self._unsupported("v1/embeddings")
-
-    async def v1_pooling(self, body, state, collect_fn=None):
-        await self._unsupported("v1/pooling")
-
-    async def v1_classify(self, body, state, collect_fn=None):
-        await self._unsupported("v1/classify")
-
-    async def v1_score(self, body, state, collect_fn=None):
-        await self._unsupported("v1/score")
-
-    async def v1_rerank(self, body, state, collect_fn=None):
-        await self._unsupported("v1/rerank")
 
     async def v1_audio_transcriptions(self, body, state, collect_fn=None):
         await self._unsupported("v1/audio/transcriptions")
@@ -414,7 +635,10 @@ class LLMEngineRequest(BaseEngineRequest):
         return body
 
     async def process(self, data: Any, state: dict, collect_fn=None) -> Any:
-        """Plain /serve/{endpoint} POST == non-streaming chat completion."""
+        """Plain /serve/{endpoint} POST: non-streaming chat completion for
+        decoder endpoints, embeddings for encoder endpoints."""
+        if self.engine is None and self.encoder is not None:
+            return await self.v1_embeddings(data or {}, state, collect_fn)
         return await self.v1_chat_completions(data or {}, state, collect_fn)
 
     async def postprocess(self, data: Any, state: dict, collect_fn=None) -> Any:

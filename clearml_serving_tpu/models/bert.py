@@ -93,9 +93,11 @@ def build(config: dict) -> SimpleNamespace:
             )
         return params
 
-    def apply(params, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None):
+    def hidden(params, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None):
         """input_ids [B, S] int32; attention_mask [B, S] (1 = keep) ->
-        per-token label logits [B, S, num_labels]."""
+        final-layer hidden states [B, S, dim] (pre-classifier). The encoder
+        surface for embeddings/pooling/score routes (reference task-gated
+        handlers, preprocess_service.py:711-808)."""
         b, s = input_ids.shape
         if attention_mask is None:
             attention_mask = jnp.ones((b, s), jnp.int32)
@@ -127,7 +129,12 @@ def build(config: dict) -> SimpleNamespace:
                 x + h @ layer["w2"] + layer["b2"],
                 layer["ffn_norm"]["scale"], layer["ffn_norm"]["bias"], eps,
             )
+        return x
+
+    def apply(params, input_ids: jnp.ndarray, attention_mask: Optional[jnp.ndarray] = None):
+        """Per-token label logits [B, S, num_labels] (token classification)."""
+        x = hidden(params, input_ids, attention_mask)
         logits = x @ params["classifier"]["w"] + params["classifier"]["b"]
         return logits.astype(jnp.float32)
 
-    return SimpleNamespace(init=init, apply=apply, config=cfg)
+    return SimpleNamespace(init=init, apply=apply, hidden=hidden, config=cfg)

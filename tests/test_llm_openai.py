@@ -1,5 +1,6 @@
 import asyncio
 import json
+import numpy as np
 
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
@@ -219,3 +220,204 @@ def test_chat_template_no_double_bos(llm_served):
     ids = tok.encode_chat(prompt)
     assert ids[0] == tok.bos_token_id
     assert ids[1] != tok.bos_token_id
+
+
+@pytest.fixture(scope="module")
+def encoder_served(tmp_path_factory):
+    """BERT-tiny encoder endpoint (task=embed) next to the decoder endpoint."""
+    import os
+
+    root = tmp_path_factory.mktemp("enc_state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="enc")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_bert",
+            auxiliary_cfg={
+                "engine": {
+                    "arch": "bert",
+                    "preset": "bert-tiny",
+                    "config": {"dtype": "float32", "num_labels": 3},
+                    "task": "embed",
+                    "labels": ["neg", "neu", "pos"],
+                    "seq_buckets": [16, 32],
+                    "batch_buckets": [1, 2, 4],
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def test_embeddings_route(encoder_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_bert", "input": ["hello world", "hello world", "bye"]},
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(encoder_served, fn)
+    assert out["object"] == "list"
+    assert len(out["data"]) == 3
+    v0, v1, v2 = (np.array(d["embedding"]) for d in out["data"])
+    # identical inputs -> identical embeddings; L2-normalized
+    np.testing.assert_allclose(v0, v1, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(v0), 1.0, rtol=1e-5)
+    assert not np.allclose(v0, v2)
+    assert out["usage"]["prompt_tokens"] > 0
+
+
+def test_embeddings_base64(encoder_served):
+    """OpenAI SDK default format: base64-packed float32."""
+    import base64
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_bert", "input": "hi", "encoding_format": "base64"},
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(encoder_served, fn)
+    raw = base64.b64decode(out["data"][0]["embedding"])
+    vec = np.frombuffer(raw, np.float32)
+    assert vec.shape[0] == 64  # bert-tiny dim
+    np.testing.assert_allclose(np.linalg.norm(vec), 1.0, rtol=1e-5)
+
+
+def test_score_and_rerank_routes(encoder_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/score",
+            json={"model": "tiny_bert", "text_1": "aaaa", "text_2": ["aaaa", "zzzz zz z"]},
+        )
+        assert r.status == 200, await r.text()
+        score_out = await r.json()
+        rr = await client.post(
+            "/serve/openai/v1/rerank",
+            json={
+                "model": "tiny_bert",
+                "query": "aaaa",
+                "documents": ["zzzz zz z", "aaaa", "bbbb"],
+                "top_n": 2,
+            },
+        )
+        assert rr.status == 200, await rr.text()
+        return score_out, await rr.json()
+
+    score_out, rerank_out = _run(encoder_served, fn)
+    scores = [d["score"] for d in score_out["data"]]
+    assert len(scores) == 2
+    # identical pair scores the cosine max
+    assert scores[0] > scores[1]
+    assert scores[0] == pytest.approx(1.0, rel=1e-4)
+    results = rerank_out["results"]
+    assert len(results) == 2
+    # the identical document must rank first
+    assert results[0]["document"]["text"] == "aaaa"
+    assert results[0]["relevance_score"] >= results[1]["relevance_score"]
+
+
+def test_classify_route(encoder_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/classify",
+            json={"model": "tiny_bert", "input": ["hello", "world"]},
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(encoder_served, fn)
+    assert len(out["data"]) == 2
+    for d in out["data"]:
+        assert d["num_classes"] == 3
+        assert d["label"] in ("neg", "neu", "pos")
+        assert sum(d["probs"]) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_generation_route_gated_on_encoder(encoder_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json={"model": "tiny_bert", "messages": [{"role": "user", "content": "x"}]},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(encoder_served, fn)
+    assert status == 422
+    assert "does not support" in text
+
+
+def test_encoder_long_input_and_many_inputs(encoder_served):
+    """Inputs longer than the largest configured seq bucket (but within
+    max_seq_len) and input counts beyond the largest batch bucket must both
+    serve, not crash (review r2 findings 1-2)."""
+    processor = encoder_served._get_processor("tiny_bert")
+    enc = processor.encoder
+    # fixture buckets: seq [16, 32] (+128 terminal), batch [1, 2, 4]
+    long_ids = list(range(1, 60))  # > 32, < 128
+    vecs = enc.embed([long_ids])
+    assert vecs.shape == (1, 64)
+    # 6 inputs straddling two chunks with different seq buckets
+    mixed = [[1, 2, 3]] * 4 + [long_ids, [7] * 20]
+    states = enc.token_states(mixed)
+    assert [s.shape[0] for s in states] == [3, 3, 3, 3, 59, 20]
+    assert enc.embed(mixed).shape == (6, 64)
+
+
+def test_cross_encoder_pair_assembly():
+    """num_labels==1 bundles joint-encode [CLS] a [SEP] b [SEP] (bare
+    segments), keeping the final SEP under truncation (review r2 finding 3)."""
+    import jax as _jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.encoder import EncoderCore
+
+    bundle = models.build_model(
+        "bert",
+        {"preset": "bert-tiny", "dtype": "float32", "num_labels": 1, "max_seq_len": 16},
+    )
+    params = bundle.init(_jax.random.PRNGKey(0))
+    enc = EncoderCore(bundle, params, cls_token_id=101, sep_token_id=102)
+    assert enc.is_cross_encoder
+    joined = enc._join_pair([5, 6], [7, 8])
+    assert joined == [101, 5, 6, 102, 7, 8, 102]
+    truncated = enc._join_pair(list(range(1, 10)), list(range(10, 20)))
+    assert len(truncated) == 16
+    assert truncated[0] == 101 and truncated[-1] == 102
+    scores = enc.score_pairs([([5, 6], [7, 8]), ([5, 6], [9, 9])])
+    assert len(scores) == 2 and all(0.0 <= s <= 1.0 for s in scores)
+
+
+def test_unknown_task_rejected(tmp_path):
+    import os
+
+    os.environ["TPUSERVE_STATE_ROOT"] = str(tmp_path)
+    mrp = ModelRequestProcessor(state_root=str(tmp_path), force_create=True, name="badtask")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_task_ep",
+            auxiliary_cfg={
+                "engine": {"arch": "bert", "preset": "bert-tiny", "task": "nonsense"}
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/embeddings", json={"model": "bad_task_ep", "input": "x"}
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422
+    assert "unknown engine task" in text
