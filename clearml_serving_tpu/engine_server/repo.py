@@ -135,7 +135,7 @@ class EngineModelRepo:
             if record is None:
                 continue
             try:
-                bundle, params = load_bundle(record.get_local_copy())
+                bundle, params = load_bundle(record.get_local_copy(), endpoint=ep)
             except Exception as ex:
                 print("engine-server: failed loading {}: {}".format(url, ex))
                 continue

@@ -1,0 +1,19 @@
+"""Model importers: foreign formats -> servable JAX bundles.
+
+Replaces the reference's reliance on Triton's multi-backend model repository
+(reference engines/triton/triton_helper.py:159-183 materializes savedmodel /
+model.pt / onnx dirs / graphdef / plan files for the C++ server): here each
+foreign graph is converted into a JAX function + params tree that jit/pjit
+compiles for TPU.
+
+- onnx_import: stock ``.onnx`` files -> JAX interpreter bundle (zero-dep
+  protobuf parsing in onnx_proto).
+- torchscript_import: TorchScript ``model.pt`` -> ONNX (in-memory, classic
+  exporter) -> the same JAX bundle.
+"""
+
+# late import in load helpers to keep the package importable mid-build
+try:
+    from .onnx_import import load_onnx_bundle  # noqa: F401
+except ImportError:  # onnx_import not present yet during incremental builds
+    pass

@@ -1,0 +1,90 @@
+"""TorchScript -> JAX importer.
+
+The reference serves ``model.pt`` TorchScript files via Triton's libtorch
+backend (triton_helper.py:165-167 materializes them; examples/pytorch).  The
+TPU-native path converts instead of executing: the scripted module is run
+through torch's classic (TorchScript-based) ONNX exporter in-memory — with
+dynamic batch axes so shape chains stay symbolic — and the resulting graph is
+interpreted into a JAX function (onnx_import), jit-compiling to one XLA
+executable on TPU.
+
+torch's exporter calls into the ``onnx`` python package only to inline
+onnxscript functions, which classic-exported graphs do not use; that hook is
+bypassed so the conversion works without ``onnx`` installed.
+"""
+
+from __future__ import annotations
+
+import io
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def export_torch_to_onnx_bytes(
+    module,
+    example_shapes: Sequence[Sequence[int]],
+    example_dtypes: Optional[Sequence[str]] = None,
+) -> bytes:
+    """torch.nn.Module / ScriptModule -> ONNX ModelProto bytes (classic
+    exporter, dynamic batch dim on every input/output)."""
+    import torch
+
+    try:  # the onnxscript-inline hook needs `onnx`; classic graphs don't
+        from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+        if getattr(onnx_proto_utils._add_onnxscript_fn, "__name__", "") != "_passthrough":
+            _orig = onnx_proto_utils._add_onnxscript_fn
+
+            def _passthrough(model_bytes, custom_opsets):
+                return model_bytes
+
+            onnx_proto_utils._add_onnxscript_fn = _passthrough
+    except Exception:
+        pass
+
+    dtypes = list(example_dtypes or [])
+    args = tuple(
+        torch.zeros(
+            *shape,
+            dtype=getattr(torch, dtypes[i]) if i < len(dtypes) else torch.float32,
+        )
+        for i, shape in enumerate(example_shapes)
+    )
+    input_names = ["input_{}".format(i) for i in range(len(args))]
+    buf = io.BytesIO()
+    module.eval()
+    torch.onnx.export(
+        module,
+        args,
+        buf,
+        input_names=input_names,
+        dynamic_axes={n: {0: "batch"} for n in input_names},
+        dynamo=False,
+    )
+    return buf.getvalue()
+
+
+def load_torchscript_bundle(
+    path,
+    example_shapes: Sequence[Sequence[int]],
+    example_dtypes: Optional[Sequence[str]] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """TorchScript file -> (bundle, params), same surface as load_onnx_bundle.
+
+    ``example_shapes`` supplies one concrete shape per model input (leading
+    dim = any batch size; the export marks it dynamic), normally derived from
+    the endpoint's input_size spec."""
+    import torch
+
+    from .onnx_import import load_onnx_bundle
+
+    module = torch.jit.load(str(path), map_location="cpu")
+    onnx_bytes = export_torch_to_onnx_bytes(module, example_shapes, example_dtypes)
+    with tempfile.TemporaryDirectory() as td:
+        f = Path(td) / "converted.onnx"
+        f.write_bytes(onnx_bytes)
+        bundle, params = load_onnx_bundle(f)
+    bundle.config["arch"] = "torchscript"
+    bundle.config["source"] = str(path)
+    return bundle, params

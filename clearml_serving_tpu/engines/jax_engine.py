@@ -73,14 +73,65 @@ def save_bundle(path, arch: str, config: dict, params) -> None:
     ))
 
 
-def load_bundle(path) -> Tuple[Any, Any]:
-    """Returns (model_bundle namespace, params)."""
+def _endpoint_input_spec(endpoint) -> Tuple[List[List[int]], List[str]]:
+    """Endpoint I/O spec -> per-input example shapes (batch dim 1) + dtypes."""
+    sizes = endpoint.input_size or []
+    types = endpoint.input_type or []
+    if sizes and not isinstance(sizes[0], (list, tuple)):
+        sizes = [sizes]  # single flat shape
+    if isinstance(types, str):
+        types = [types]
+    shapes = [[1] + [int(d) for d in s] for s in sizes]
+    torch_types = []
+    for t in types:
+        torch_types.append(
+            {"float32": "float32", "float64": "float64", "int64": "int64",
+             "int32": "int32", "uint8": "uint8", "bool": "bool"}.get(str(t), "float32")
+        )
+    return shapes, torch_types
+
+
+def load_bundle(path, endpoint=None) -> Tuple[Any, Any]:
+    """Returns (model_bundle namespace, params).
+
+    Dispatches on payload format — the breadth Triton's multi-backend repo
+    gives the reference (triton_helper.py:159-183):
+    - ``*.onnx`` file (or dir containing one) -> ONNX->JAX importer
+    - ``*.pt`` / ``*.torchscript`` TorchScript -> ONNX (in-memory) -> JAX
+      (needs the endpoint's input_size/input_type spec for example shapes)
+    - otherwise: native jax bundle dir (model_config.json + params.msgpack)
+    """
     import jax
     import jax.numpy as jnp
     from flax import serialization
     from .. import models
+    from .importers.onnx_import import find_onnx_file, load_onnx_bundle
 
     path = Path(path)
+    # a native bundle dir wins even if a stray .onnx sits next to it (e.g. a
+    # converter that kept its source beside the output)
+    is_native = path.is_dir() and (path / "model_config.json").exists()
+    onnx_file = None if is_native else find_onnx_file(path)
+    if onnx_file is not None:
+        return load_onnx_bundle(onnx_file)
+    ts_file = None
+    if path.is_file() and path.suffix in (".pt", ".torchscript"):
+        ts_file = path
+    elif path.is_dir():
+        cands = sorted(path.glob("*.pt")) + sorted(path.glob("*.torchscript"))
+        if cands and not (path / "model_config.json").exists():
+            ts_file = cands[0]
+    if ts_file is not None:
+        from .importers.torchscript_import import load_torchscript_bundle
+
+        if endpoint is None or not endpoint.input_size:
+            raise EndpointModelError(
+                "TorchScript model {} needs the endpoint's input_size/"
+                "input_type spec to derive export shapes".format(ts_file)
+            )
+        shapes, dtypes = _endpoint_input_spec(endpoint)
+        return load_torchscript_bundle(ts_file, shapes, dtypes)
+
     if path.is_file():  # single-file bundles not supported; need the dir
         path = path.parent
     meta = read_json(path / "model_config.json")
@@ -135,7 +186,7 @@ class JaxEngineRequest(BaseEngineRequest):
             self._apply_fn = self._model
             self._params = None
         elif self._model_local_path:
-            bundle, params = load_bundle(self._model_local_path)
+            bundle, params = load_bundle(self._model_local_path, endpoint=self.endpoint)
             self._apply_fn = bundle.apply
             self._params = params
             self._model = bundle

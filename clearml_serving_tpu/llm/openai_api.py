@@ -498,6 +498,8 @@ class LLMEngineRequest(BaseEngineRequest):
                 for i, v in enumerate(vecs)
             ]
         n_tokens = sum(len(ids) for ids in id_lists)
+        if collect_fn is not None:
+            collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(id_lists)})
         return {
             "object": "list",
             "model": body.get("model", self._model_name),
@@ -526,6 +528,8 @@ class LLMEngineRequest(BaseEngineRequest):
                 }
             )
         n_tokens = sum(len(ids) for ids in id_lists)
+        if collect_fn is not None:
+            collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(id_lists)})
         return {
             "object": "list",
             "model": body.get("model", self._model_name),
@@ -567,6 +571,8 @@ class LLMEngineRequest(BaseEngineRequest):
         pairs = self._score_pairs_body(body)
         scores = await asyncio.to_thread(self.encoder.score_pairs, pairs)
         n_tokens = sum(len(a) + len(b) for a, b in pairs)
+        if collect_fn is not None:
+            collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(pairs)})
         return {
             "object": "list",
             "model": body.get("model", self._model_name),
@@ -585,9 +591,17 @@ class LLMEngineRequest(BaseEngineRequest):
         documents = body.get("documents") or []
         if query is None or not documents:
             raise ValueError("rerank requests need query and documents")
-        doc_texts = [
-            d.get("text") if isinstance(d, dict) else str(d) for d in documents
-        ]
+        doc_texts = []
+        for i, d in enumerate(documents):
+            if isinstance(d, dict):
+                text = d.get("text", d.get("content"))
+                if not isinstance(text, str):
+                    raise ValueError(
+                        "documents[{}] needs a string 'text' field".format(i)
+                    )
+                doc_texts.append(text)
+            else:
+                doc_texts.append(str(d))
         bare = self.encoder.is_cross_encoder
         q_ids = self.tokenizer.encode(str(query), add_bos=not bare)
         doc_ids = [self.tokenizer.encode(t, add_bos=not bare) for t in doc_texts]
@@ -603,6 +617,8 @@ class LLMEngineRequest(BaseEngineRequest):
             for i in order[:top_n]
         ]
         n_tokens = len(q_ids) + sum(len(d) for d in doc_ids)
+        if collect_fn is not None:
+            collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(doc_ids)})
         return {
             "id": _gen_id("rerank"),
             "model": body.get("model", self._model_name),

@@ -1,0 +1,23 @@
+"""MNIST CNN endpoint pre/post-processing (reference examples/pytorch
+preprocess.py contract: base64/array image in, argmax digit out)."""
+
+from typing import Any
+
+import numpy as np
+
+
+class Preprocess(object):
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        # {"image": [[...28x28...]]} or a flat 784 list
+        image = np.asarray(body.get("image", body), np.float32)
+        if image.ndim == 1:
+            image = image.reshape(28, 28)
+        if image.ndim == 2:
+            image = image[None]  # add channel
+        if image.ndim == 3:
+            image = image[None]  # add batch
+        return {"input_0": image.tolist()}
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        log_probs = np.asarray(data)
+        return {"digit": int(np.argmax(log_probs, axis=-1)[0])}

@@ -1,0 +1,57 @@
+"""Train a small MNIST-style CNN and export it as TorchScript (model.pt).
+
+Mirror of the reference pytorch example (reference
+examples/pytorch/train_pytorch_mnist.py) without the torchvision dependency:
+trains on synthetic digit-like data so the walkthrough runs anywhere, exports
+TorchScript — the same format the reference's Triton/libtorch path consumes
+(triton_helper.py:165-167). The serving side converts it to a JAX/XLA
+executable for TPU (engines/importers/torchscript_import.py); no torch at
+serving time.
+"""
+
+import torch
+import torch.nn as nn
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 8, 3, padding=1)
+        self.conv2 = nn.Conv2d(8, 16, 3, stride=2, padding=1)
+        self.fc1 = nn.Linear(16 * 7 * 7, 64)
+        self.fc2 = nn.Linear(64, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.conv1(x))
+        x = torch.max_pool2d(torch.relu(self.conv2(x)), 2)
+        x = torch.flatten(x, 1)
+        x = torch.relu(self.fc1(x))
+        return torch.log_softmax(self.fc2(x), dim=-1)
+
+
+def main() -> None:
+    torch.manual_seed(0)
+    model = Net()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = nn.NLLLoss()
+    # synthetic "digits": class-dependent blob patterns
+    for step in range(60):
+        labels = torch.randint(0, 10, (64,))
+        images = torch.randn(64, 1, 28, 28) * 0.1
+        for i, lab in enumerate(labels):
+            images[i, 0, lab.item() : lab.item() + 8, 8:20] += 1.0
+        opt.zero_grad()
+        loss = loss_fn(model(images), labels)
+        loss.backward()
+        opt.step()
+        if step % 20 == 0:
+            print("step {} loss {:.4f}".format(step, loss.item()))
+
+    model.eval()
+    scripted = torch.jit.script(model)
+    scripted.save("pytorch-mnist.pt")
+    print("saved TorchScript model to pytorch-mnist.pt")
+
+
+if __name__ == "__main__":
+    main()

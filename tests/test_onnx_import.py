@@ -1,0 +1,266 @@
+"""ONNX / TorchScript import fidelity: converted JAX functions must match
+torch outputs on the same weights (the reference gets this breadth from
+Triton's onnxruntime/libtorch backends; we convert instead)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from clearml_serving_tpu.engines.importers.onnx_import import load_onnx_bundle
+from clearml_serving_tpu.engines.importers.torchscript_import import (
+    export_torch_to_onnx_bytes,
+    load_torchscript_bundle,
+)
+
+
+def _export(module, args, path, dynamic_batch=True):
+    data = export_torch_to_onnx_bytes(
+        module, [list(a.shape) for a in args]
+    )
+    path.write_bytes(data)
+    return path
+
+
+def _check_fidelity(module, args, tmp_path, rtol=1e-4, atol=1e-5):
+    module.eval()
+    f = tmp_path / "m.onnx"
+    f.write_bytes(export_torch_to_onnx_bytes(module, [list(a.shape) for a in args]))
+    bundle, params = load_onnx_bundle(f)
+    with torch.no_grad():
+        expected = module(*args)
+    got = jax.jit(bundle.apply)(params, *[a.numpy() for a in args])
+    np.testing.assert_allclose(
+        np.asarray(got), expected.numpy(), rtol=rtol, atol=atol
+    )
+    return bundle
+
+
+def test_mlp_onnx_fidelity(tmp_path):
+    torch.manual_seed(0)
+    m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 16), nn.Tanh(), nn.Linear(16, 3))
+    x = torch.randn(5, 8)
+    _check_fidelity(m, (x,), tmp_path)
+
+
+def test_cnn_onnx_fidelity(tmp_path):
+    torch.manual_seed(1)
+
+    class CNN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(1, 8, 3, padding=1)
+            self.c2 = nn.Conv2d(8, 16, 3, stride=2)
+            self.fc = nn.Linear(16 * 6 * 6, 10)
+
+        def forward(self, x):
+            x = torch.relu(self.c1(x))
+            x = torch.max_pool2d(torch.relu(self.c2(x)), 2, ceil_mode=False)
+            x = torch.flatten(x, 1)
+            return torch.log_softmax(self.fc(x), dim=-1)
+
+    x = torch.randn(2, 1, 28, 28)
+    _check_fidelity(CNN(), (x,), tmp_path)
+
+
+def test_cnn_onnx_dynamic_batch(tmp_path):
+    """The exported graph must serve batch sizes other than the example's."""
+    torch.manual_seed(2)
+    m = nn.Sequential(nn.Conv2d(1, 4, 3), nn.ReLU(), nn.Flatten(), nn.Linear(4 * 26 * 26, 5))
+    m.eval()
+    f = tmp_path / "m.onnx"
+    f.write_bytes(export_torch_to_onnx_bytes(m, [[1, 1, 28, 28]]))
+    bundle, params = load_onnx_bundle(f)
+    for batch in (1, 3, 7):
+        x = torch.randn(batch, 1, 28, 28)
+        with torch.no_grad():
+            expected = m(x)
+        got = jax.jit(bundle.apply)(params, x.numpy())
+        np.testing.assert_allclose(np.asarray(got), expected.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_hf_bert_onnx_fidelity(tmp_path):
+    """A real transformers BERT encoder (random weights) through the
+    converter: exercises LayerNorm-decomposition, Erf-GELU, Softmax,
+    attention-mask Where chains, Gather embeddings, Slice/Concat shape
+    metaprograms — the BASELINE bert acceptance config's op diet."""
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=64,
+    )
+    torch.manual_seed(3)
+
+    class Wrapped(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.bert = transformers.BertModel(cfg)
+
+        def forward(self, input_ids, attention_mask):
+            return self.bert(
+                input_ids=input_ids, attention_mask=attention_mask
+            ).last_hidden_state
+
+    m = Wrapped()
+    m.eval()
+    ids = torch.randint(0, 128, (2, 12))
+    mask = torch.ones(2, 12, dtype=torch.int64)
+    f = tmp_path / "bert.onnx"
+    data = export_torch_to_onnx_bytes(
+        m, [[2, 12], [2, 12]], example_dtypes=["int64", "int64"]
+    )
+    f.write_bytes(data)
+    bundle, params = load_onnx_bundle(f)
+    with torch.no_grad():
+        expected = m(ids, mask)
+    got = jax.jit(bundle.apply)(params, ids.numpy(), mask.numpy())
+    np.testing.assert_allclose(
+        np.asarray(got), expected.numpy(), rtol=1e-3, atol=1e-4
+    )
+
+
+def test_torchscript_bundle(tmp_path):
+    torch.manual_seed(4)
+    m = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 4))
+    m.eval()
+    scripted = torch.jit.script(m)
+    pt = tmp_path / "model.pt"
+    scripted.save(str(pt))
+    bundle, params = load_torchscript_bundle(pt, [[1, 6]])
+    x = torch.randn(3, 6)
+    with torch.no_grad():
+        expected = m(x)
+    got = jax.jit(bundle.apply)(params, x.numpy())
+    np.testing.assert_allclose(np.asarray(got), expected.numpy(), rtol=1e-4, atol=1e-5)
+    assert bundle.config["arch"] == "torchscript"
+
+
+def test_onnx_served_through_router(tmp_path, state_root):
+    """A stock .onnx file registered as a model serves through the jax
+    engine end-to-end (VERDICT r1 #3 done-criterion)."""
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    torch.manual_seed(5)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    f = tmp_path / "model.onnx"
+    f.write_bytes(export_torch_to_onnx_bytes(m, [[1, 4]]))
+
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="onnx")
+    rec = mrp.registry.register("onnx_mlp", path=f, framework="onnx")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="jax",
+            serving_url="onnx_ep",
+            model_id=rec.id,
+            input_name="x",
+            input_type="float32",
+            input_size=[4],
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    x = np.random.rand(2, 4).astype(np.float32)
+    out = asyncio.run(mrp.process_request("onnx_ep", None, {"x": x.tolist()}))
+    with torch.no_grad():
+        expected = m(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_op_fails_loudly(tmp_path):
+    """Unknown ops must raise by name at conversion, not at runtime."""
+
+    class Weird(nn.Module):
+        def forward(self, x):
+            return torch.det(x)  # Det: not in the supported set
+
+    m = Weird()
+    m.eval()
+    f = tmp_path / "weird.onnx"
+    f.write_bytes(export_torch_to_onnx_bytes(m, [[1, 3, 3]]))
+    with pytest.raises(ValueError, match="unsupported op"):
+        load_onnx_bundle(f)
+
+
+def test_pytorch_example_end_to_end(tmp_path, state_root, monkeypatch):
+    """The examples/pytorch walkthrough: train -> TorchScript -> register ->
+    serve with the example's Preprocess (reference examples/pytorch parity)."""
+    import importlib.util
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "pt_train", "examples/pytorch/train_model.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    torch.manual_seed(0)
+    model = mod.Net()
+    model.eval()
+    pt = tmp_path / "pytorch-mnist.pt"
+    torch.jit.script(model).save(str(pt))
+
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="pt")
+    rec = mrp.registry.register("train pytorch model", path=pt, framework="pytorch")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="jax",
+            serving_url="test_model_pytorch",
+            model_id=rec.id,
+            input_name="input_0",
+            input_type="float32",
+            input_size=[1, 28, 28],
+        ),
+        preprocess_code="examples/pytorch/preprocess.py",
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    image = np.zeros((28, 28), np.float32)
+    image[3:11, 8:20] = 1.0
+    out = asyncio.run(
+        mrp.process_request("test_model_pytorch", None, {"image": image.tolist()})
+    )
+    assert set(out) == {"digit"} and 0 <= out["digit"] <= 9
+    # fidelity vs torch on the same input
+    with torch.no_grad():
+        expected = int(
+            model(torch.from_numpy(image)[None, None]).argmax(dim=-1)[0]
+        )
+    assert out["digit"] == expected
+
+
+def test_maxpool_ceil_mode(tmp_path):
+    """ceil_mode=1 graphs must match torch exactly (review r2 finding)."""
+    torch.manual_seed(6)
+
+    class M(nn.Module):
+        def forward(self, x):
+            return torch.max_pool2d(x, 2, ceil_mode=True)
+
+    m = M()
+    m.eval()
+    x = torch.randn(1, 3, 27, 27)  # odd dims: ceil 14 vs floor 13
+    _check_fidelity(m, (x,), tmp_path)
+
+
+def test_fp16_int32_data_bit_reinterpretation():
+    """FLOAT16 typed storage holds uint16 bit patterns in int32_data; a
+    numeric cast would turn fp16 1.0 (0x3C00=15360) into 15360.0."""
+    from clearml_serving_tpu.engines.importers.onnx_proto import tensor_to_numpy
+
+    t = {"dims": [2], "data_type": 10, "int32_data": [15360, 16384]}  # 1.0, 2.0
+    arr = tensor_to_numpy(t)
+    assert arr.dtype == np.float16
+    np.testing.assert_array_equal(arr.astype(np.float32), [1.0, 2.0])
