@@ -1,0 +1,21 @@
+"""Train a sklearn VotingRegressor ensemble and save it for serving
+(reference examples/ensemble/train_model.py parity, without the ClearML SDK)."""
+
+import joblib
+from sklearn.datasets import make_regression
+from sklearn.ensemble import RandomForestRegressor, VotingRegressor
+from sklearn.linear_model import LinearRegression
+
+
+def main() -> None:
+    X, y = make_regression(n_samples=500, n_features=2, random_state=0, noise=4.0)
+    reg1 = RandomForestRegressor(n_estimators=10, random_state=1)
+    reg2 = LinearRegression()
+    ensemble = VotingRegressor([("rf", reg1), ("lr", reg2)])
+    ensemble.fit(X, y)
+    joblib.dump(ensemble, "ensemble-model.pkl", compress=True)
+    print("saved ensemble-model.pkl")
+
+
+if __name__ == "__main__":
+    main()

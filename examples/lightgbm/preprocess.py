@@ -1,0 +1,18 @@
+"""LightGBM iris endpoint pre/post-processing (reference examples/lightgbm
+preprocess.py contract: x0..x3 in, argmax class out)."""
+
+from typing import Any
+
+import numpy as np
+
+
+class Preprocess(object):
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        return [
+            [body.get("x0", 0), body.get("x1", 0), body.get("x2", 0), body.get("x3", 0)]
+        ]
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        # softmax class probabilities -> predicted class + probs
+        probs = np.asarray(data)
+        return dict(y=probs.tolist(), predicted=int(np.argmax(probs, axis=-1)[0]))

@@ -1,0 +1,20 @@
+"""Train a LightGBM iris classifier and save it for serving (reference
+examples/lightgbm/train_model.py parity, without the ClearML SDK)."""
+
+import lightgbm as lgb
+from sklearn.datasets import load_iris
+from sklearn.model_selection import train_test_split
+
+
+def main() -> None:
+    X, y = load_iris(return_X_y=True)
+    X_train, X_test, y_train, y_test = train_test_split(X, y, test_size=0.1)
+    dtrain = lgb.Dataset(X_train, label=y_train)
+    params = {"objective": "multiclass", "metric": "softmax", "num_class": 3}
+    model = lgb.train(params=params, train_set=dtrain)
+    model.save_model("lgbm_model.txt")
+    print("saved lgbm_model.txt")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""XGBoost iris endpoint pre/post-processing (reference examples/xgboost
+preprocess.py contract: x0..x3 in, y out).
+
+Unlike the reference, the xgboost engine here builds the DMatrix itself
+(engines/cpu_engines.py) — preprocess returns plain nested lists, so user
+code needs no xgboost import."""
+
+from typing import Any
+
+import numpy as np
+
+
+class Preprocess(object):
+    def preprocess(self, body: dict, state: dict, collect_custom_statistics_fn=None) -> Any:
+        return [
+            [body.get("x0", 0), body.get("x1", 0), body.get("x2", 0), body.get("x3", 0)]
+        ]
+
+    def postprocess(self, data: Any, state: dict, collect_custom_statistics_fn=None) -> dict:
+        return dict(y=data.tolist() if isinstance(data, np.ndarray) else data)

@@ -1,0 +1,93 @@
+"""Example-suite walkthroughs as CI integration tests (the reference's
+examples are its de-facto acceptance tests — SURVEY.md §2.13/§4).
+
+Each test follows its readme end-to-end: train -> register -> endpoint ->
+process_request with the suite's own Preprocess code. xgboost/lightgbm skip
+when the library is not in the image (their engines gate the same way)."""
+
+import asyncio
+import importlib.util
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _run_example(state_root, tmp_path, suite, engine, train_artifact, body,
+                 framework=None):
+    """Execute examples/<suite>/train_model.py in tmp_path, register its
+    artifact, serve it with the suite's preprocess.py, POST `body`."""
+    spec = importlib.util.spec_from_file_location(
+        "train_{}".format(suite), EXAMPLES / suite / "train_model.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        mod.main()
+    finally:
+        os.chdir(cwd)
+    artifact = tmp_path / train_artifact
+    assert artifact.exists()
+
+    mrp = ModelRequestProcessor(
+        state_root=str(state_root), force_create=True, name="ex-{}".format(suite)
+    )
+    rec = mrp.registry.register(
+        "train {} model".format(suite), path=artifact, framework=framework or engine
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type=engine,
+            serving_url="test_model_{}".format(suite),
+            model_id=rec.id,
+        ),
+        preprocess_code=str(EXAMPLES / suite / "preprocess.py"),
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return asyncio.run(
+        mrp.process_request("test_model_{}".format(suite), None, body)
+    )
+
+
+def test_ensemble_example(state_root, tmp_path):
+    out = _run_example(
+        state_root, tmp_path, "ensemble", "sklearn", "ensemble-model.pkl",
+        {"x0": 1.2, "x1": -0.5}, framework="sklearn",
+    )
+    assert "y" in out and len(out["y"]) == 1
+    assert np.isfinite(out["y"][0])
+
+
+def test_xgboost_example(state_root, tmp_path):
+    pytest.importorskip("xgboost")
+    out = _run_example(
+        state_root, tmp_path, "xgboost", "xgboost", "xgb_model.json",
+        {"x0": 1, "x1": 2, "x2": 3, "x3": 4},
+    )
+    assert "y" in out
+
+
+def test_lightgbm_example(state_root, tmp_path):
+    pytest.importorskip("lightgbm")
+    out = _run_example(
+        state_root, tmp_path, "lightgbm", "lightgbm", "lgbm_model.txt",
+        {"x0": 1, "x1": 2, "x2": 3, "x3": 4},
+    )
+    assert "y" in out and out["predicted"] in (0, 1, 2)
+
+
+def test_sklearn_example(state_root, tmp_path):
+    out = _run_example(
+        state_root, tmp_path, "sklearn", "sklearn", "sklearn-model.pkl",
+        {"x0": 5.1, "x1": 3.5, "x2": 1.4, "x3": 0.2},
+    )
+    assert "y" in out
