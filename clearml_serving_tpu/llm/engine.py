@@ -127,13 +127,18 @@ class LLMEngineCore:
             from ..parallel.sharding import (
                 llama_cache_sharding,
                 llama_param_sharding,
+                llama_quantized_param_sharding,
                 shard_params,
             )
 
             if not self._quantized:
                 self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
             else:
-                self.params = params  # quantized tree: replicate (TP-shard in a later round)
+                # int8 tree TP-shards like the bf16 weights (scales lose the
+                # input-axis entry) — per-chip HBM ≈ 1/tp of the model
+                self.params = shard_params(
+                    mesh, params, llama_quantized_param_sharding(mesh, params)
+                )
             self._cache_sharding = llama_cache_sharding(mesh)
         else:
             self.params = params

@@ -1,6 +1,7 @@
 from .mesh import make_mesh, mesh_from_aux_cfg
 from .sharding import (
     llama_param_sharding,
+    llama_quantized_param_sharding,
     llama_cache_sharding,
     shard_params,
 )
@@ -10,6 +11,7 @@ __all__ = [
     "make_mesh",
     "mesh_from_aux_cfg",
     "llama_param_sharding",
+    "llama_quantized_param_sharding",
     "llama_cache_sharding",
     "shard_params",
     "global_mesh",

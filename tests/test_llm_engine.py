@@ -206,12 +206,17 @@ def test_decode_continues_during_slow_admission(tiny_engine_parts):
         engine._prefill_device = slow_prefill
 
         a_tokens_during_b_prefill = 0
+        a_warm = asyncio.Event()  # A's decode chunk compiled + flowing
         b_first_token = asyncio.Event()
 
         async def consume_a():
             nonlocal a_tokens_during_b_prefill
             req = GenRequest(prompt_ids=[256, 1], max_new_tokens=10_000)
+            produced = 0
             async for _ in engine.generate(req):
+                produced += 1
+                if produced >= 3:
+                    a_warm.set()
                 if slow_started.is_set() and not b_first_token.is_set():
                     a_tokens_during_b_prefill += 1
                 if b_first_token.is_set():
@@ -223,11 +228,34 @@ def test_decode_continues_during_slow_admission(tiny_engine_parts):
                 b_first_token.set()
 
         task_a = asyncio.create_task(consume_a())
-        await asyncio.sleep(0.15)  # let A start decoding
+        # wait until A's decode executable is compiled and emitting — a fixed
+        # sleep races the first jit compile and flakes
+        await asyncio.wait_for(a_warm.wait(), timeout=120)
         await consume_b()
-        await asyncio.wait_for(task_a, timeout=30)
+        await asyncio.wait_for(task_a, timeout=120)
         return a_tokens_during_b_prefill
 
     overlapped = asyncio.run(run())
     # with serialized admission this is 0 — decode stalls for the full 0.5s
     assert overlapped >= 1, "decode stalled during admission"
+
+
+def test_int8_engine_with_mesh(tiny_engine_parts):
+    """Quantized engine under a tp mesh: params TP-shard (not replicate) and
+    generation still works."""
+    from clearml_serving_tpu.parallel import make_mesh
+
+    bundle, params = tiny_engine_parts
+
+    async def run():
+        # tp bounded by llama-tiny's 2 kv heads (dense cache shards kv heads)
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        engine = _make_engine(bundle, params, quantize="int8", mesh=mesh, max_batch=4)
+        wq = engine.params["layers"][0]["wq"]
+        assert wq["_q8"].addressable_shards[0].data.size == wq["_q8"].size // 2
+        return await _collect(
+            engine, GenRequest(prompt_ids=[256, 1, 2], max_new_tokens=4)
+        )
+
+    out = asyncio.run(run())
+    assert len(out) >= 1
