@@ -21,20 +21,31 @@ from ..serving.endpoints import ModelEndpoint
 
 
 class CompiledModel:
-    """One endpoint's executable: jit-per-bucket + dynamic batcher."""
+    """One endpoint's executable: jit-per-bucket + dynamic batcher.
 
-    def __init__(self, endpoint: ModelEndpoint, bundle, params):
+    On a multi-host slice the host-0 instance broadcasts each batch to the
+    secondary controllers (``dispatcher``) before dispatching locally, so
+    every host enters the same executable (parallel/multihost.py)."""
+
+    def __init__(self, endpoint: ModelEndpoint, bundle, params, *, key: str = "",
+                 dispatcher=None):
         import jax
 
         self.endpoint = endpoint
         self.bundle = bundle
         self.params = params
+        self.key = key or endpoint.serving_url
         aux = endpoint.auxiliary_cfg if isinstance(endpoint.auxiliary_cfg, dict) else {}
         batching = aux.get("batching") or {}
         self.buckets = sorted(int(b) for b in batching.get("buckets", [1, 2, 4, 8, 16, 32, 64]))
         self._jit = jax.jit(lambda params, *xs: bundle.apply(params, *xs))
+        entry = (
+            self.run_batch
+            if dispatcher is None
+            else lambda inputs: dispatcher.run(self.key, self.run_batch, inputs)
+        )
         self.batcher = DynamicBatcher(
-            self.run_batch,
+            entry,
             preferred_batch_size=int(batching.get("preferred_batch_size", 8)),
             max_queue_delay_us=int(batching.get("max_queue_delay_us", 2000)),
             max_batch_size=int(batching.get("max_batch_size", 64)),
@@ -80,12 +91,18 @@ class EngineModelRepo:
 
     ENGINE_TYPES = ("jax_grpc",)
 
-    def __init__(self, processor):
-        # processor: ModelRequestProcessor (control-plane reader + registry)
+    def __init__(self, processor, dispatcher=None):
+        # processor: ModelRequestProcessor (control-plane reader + registry);
+        # dispatcher: parallel/multihost HostZeroDispatcher on host 0 of a
+        # multi-host slice (None on single host and on followers)
         self._processor = processor
+        self._dispatcher = dispatcher
         self._models: Dict[str, CompiledModel] = {}
         self._hashes: Dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def get_by_key(self, key: str) -> Optional[CompiledModel]:
+        return self._models.get(key)
 
     @staticmethod
     def model_key(serving_url: str, version: Optional[str] = None) -> str:
@@ -139,7 +156,9 @@ class EngineModelRepo:
             except Exception as ex:
                 print("engine-server: failed loading {}: {}".format(url, ex))
                 continue
-            model = CompiledModel(ep, bundle, params)
+            model = CompiledModel(
+                ep, bundle, params, key=url, dispatcher=self._dispatcher
+            )
             model.warmup()
             with self._lock:
                 self._models[url] = model  # atomic swap; old entry GC'd

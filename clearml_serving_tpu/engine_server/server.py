@@ -185,8 +185,15 @@ async def serve(service_id: Optional[str] = None) -> None:
     from ..serving.main import maybe_start_profiler
 
     maybe_start_profiler()
+    import jax
+
+    dispatcher = None
+    if jax.process_count() > 1:
+        from ..parallel.multihost import HostZeroDispatcher
+
+        dispatcher = HostZeroDispatcher()
     processor = ModelRequestProcessor(service_id=service_id)
-    repo = EngineModelRepo(processor)
+    repo = EngineModelRepo(processor, dispatcher=dispatcher)
     repo.sync()
 
     port = int(os.environ.get("TPUSERVE_ENGINE_PORT", 8001))
@@ -210,7 +217,18 @@ async def serve(service_id: Optional[str] = None) -> None:
         while True:
             await asyncio.sleep(poll_freq_sec)
             try:
-                await asyncio.to_thread(repo.sync)
+                try:
+                    await asyncio.to_thread(repo.sync)
+                finally:
+                    if dispatcher is not None:
+                        # heartbeat: lets followers leave recv() and re-sync.
+                        # Sent even when this host's sync flaked — follower
+                        # liveness must not depend on host-0 sync success
+                        from ..parallel import multihost
+
+                        await asyncio.to_thread(
+                            dispatcher.channel.send, multihost.OP_NOOP
+                        )
                 if requests_g is not None:
                     for name, info in repo.list_models().items():
                         requests_g.labels(model=name).set(info["requests_served"])
@@ -220,7 +238,67 @@ async def serve(service_id: Optional[str] = None) -> None:
                 print("engine server reconcile error: {}".format(ex))
 
     asyncio.get_running_loop().create_task(reconcile_loop())
-    await server.wait_for_termination()
+    try:
+        await server.wait_for_termination()
+    finally:
+        if dispatcher is not None:
+            dispatcher.stop()
+
+
+def serve_follower(service_id: Optional[str] = None) -> None:
+    """Secondary-controller main: replay host-0's dispatch steps.
+
+    Binds NO service ports. The follower syncs the same model repo from the
+    control plane, then enters the broadcast loop; a NOOP heartbeat from
+    host 0's reconcile loop gives it windows to re-sync (hot swaps land on
+    all hosts within one poll period)."""
+    import jax
+
+    from ..parallel.multihost import follower_loop
+
+    from ..serving.model_request_processor import ModelRequestProcessor
+
+    processor = ModelRequestProcessor(service_id=service_id)
+    repo = EngineModelRepo(processor)
+    repo.sync()
+    print(
+        "engine server follower: process {} of {} ({} models)".format(
+            jax.process_index(), jax.process_count(), len(repo.list_models())
+        )
+    )
+
+    def resolve(key: str):
+        model = repo.get_by_key(key)
+        if model is None:
+            # host 0 may have loaded it after our last sync; a transient
+            # control-plane error here must NOT kill the follower — a dead
+            # participant hangs every subsequent host-0 broadcast
+            try:
+                repo.sync()
+            except Exception as ex:
+                print("follower sync error: {}".format(ex))
+            model = repo.get_by_key(key)
+        return model.run_batch if model is not None else None
+
+    from ..parallel import multihost
+
+    class _SyncingChannel(multihost.BroadcastChannel):
+        def recv(self):
+            op, payload = super().recv()
+            if op == multihost.OP_NOOP:
+                try:
+                    repo.sync()
+                except Exception as ex:
+                    print("follower sync error: {}".format(ex))
+            return op, payload
+
+    follower_loop(
+        resolve,
+        channel=_SyncingChannel(),
+        on_error=lambda key, ex: print(
+            "follower: replay of {!r} failed: {}".format(key, ex)
+        ),
+    )
 
 
 def main() -> None:
@@ -229,17 +307,12 @@ def main() -> None:
     initialize_distributed()  # no-op single-host; TPUSERVE_COORDINATOR multi-host
     service_id = os.environ.get("TPUSERVE_SERVICE_ID") or None
     if not is_primary_host():
-        # Secondary hosts must NOT bind any service port: dispatching
-        # inference on a non-primary controller of a multi-controller SPMD
-        # job enters collectives the other hosts never join and deadlocks the
-        # slice. A true multi-host serving loop (host 0 broadcasting request
-        # batches to peers) is not implemented yet — refuse loudly instead of
-        # half-participating.
-        raise SystemExit(
-            "engine server: process_index != 0; multi-host request dispatch "
-            "is not implemented yet — run the engine server on host 0 only "
-            "(secondary hosts will join via the planned broadcast loop)"
-        )
+        # Secondary hosts bind NO service ports: they replay host-0's
+        # broadcast dispatch steps so every controller of the slice enters
+        # the same executables in the same order (multi-controller SPMD,
+        # SURVEY.md §7 hard part 6).
+        serve_follower(service_id)
+        return
     asyncio.run(serve(service_id))
 
 

@@ -1,0 +1,135 @@
+"""Host-0 broadcast dispatch for multi-controller SPMD serving.
+
+In a multi-host slice every controller must enter the SAME compiled
+computation in the same order, or the collectives deadlock. Requests only
+arrive at host 0 (the router targets its gRPC port alone), so host 0
+**broadcasts each step** — which model to run and the batch bytes — to the
+secondary controllers, which replay it against their own copy of the model
+repo (synced from the same control plane). This replaces the reference
+topology's single tritonserver process with one engine process per host
+(SURVEY.md §7 hard part 6).
+
+Transport: ``jax.experimental.multihost_utils.broadcast_one_to_all`` — itself
+one compiled psum over the global device set, so the control channel rides
+the same ICI/DCN fabric as the data. Two rounds per step: a fixed-shape
+header [op, nbytes], then the payload padded to the broadcast length every
+host now knows.
+
+No NCCL/MPI analog is hand-written; inside the jitted model executable XLA
+inserts all collectives from shardings, and this module only sequences WHICH
+executable runs.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+OP_NOOP = 0
+OP_RUN = 1
+OP_STOP = 2
+
+
+class BroadcastChannel:
+    """Host-0 -> all-hosts step channel over the global device set."""
+
+    def __init__(self):
+        import threading
+
+        import jax
+
+        self._is_source = jax.process_index() == 0
+        self.process_count = jax.process_count()
+        # host-0 sends come from batcher worker threads AND the reconcile
+        # loop; interleaved broadcasts would corrupt the header/payload
+        # pairing, so sends serialize
+        self._send_lock = threading.Lock()
+
+    def send(self, op: int, payload: bytes = b"") -> None:
+        """Host 0 only. Secondary hosts MUST be in recv() concurrently."""
+        from jax.experimental import multihost_utils
+
+        with self._send_lock:
+            header = np.asarray([op, len(payload)], np.int64)
+            multihost_utils.broadcast_one_to_all(header, is_source=self._is_source)
+            if payload:
+                buf = np.frombuffer(payload, np.uint8)
+                multihost_utils.broadcast_one_to_all(buf, is_source=self._is_source)
+
+    def recv(self) -> Tuple[int, bytes]:
+        """Secondary hosts: blocks until host 0 sends the next step."""
+        from jax.experimental import multihost_utils
+
+        header = multihost_utils.broadcast_one_to_all(
+            np.zeros(2, np.int64), is_source=self._is_source
+        )
+        op, nbytes = int(header[0]), int(header[1])
+        payload = b""
+        if nbytes:
+            buf = multihost_utils.broadcast_one_to_all(
+                np.zeros(nbytes, np.uint8), is_source=self._is_source
+            )
+            payload = np.asarray(buf, np.uint8).tobytes()
+        return op, payload
+
+
+class HostZeroDispatcher:
+    """Wraps host-0's per-request execution so every step is mirrored to the
+    followers BEFORE the local dispatch enters the executable."""
+
+    def __init__(self, channel: Optional[BroadcastChannel] = None):
+        import threading
+
+        self.channel = channel or BroadcastChannel()
+        self._multi = self.channel.process_count > 1
+        # broadcast order MUST equal local execution order: followers replay
+        # in broadcast order, and two executables entered in different orders
+        # on different hosts deadlock the slice if they contain cross-host
+        # collectives — so send+dispatch are one critical section
+        self._order_lock = threading.Lock()
+
+    def run(self, key: str, fn: Callable, inputs) -> Any:
+        """Broadcast (key, inputs) then execute fn(inputs) locally, atomically
+        with respect to other dispatches."""
+        if not self._multi:
+            return fn(inputs)
+        with self._order_lock:
+            self.channel.send(OP_RUN, pickle.dumps((key, inputs)))
+            return fn(inputs)
+
+    def stop(self) -> None:
+        if self._multi:
+            self.channel.send(OP_STOP)
+
+
+def follower_loop(
+    resolve: Callable[[str], Optional[Callable]],
+    channel: Optional[BroadcastChannel] = None,
+    on_error: Optional[Callable[[str, BaseException], None]] = None,
+) -> None:
+    """Secondary-controller main loop: replay host-0's steps until OP_STOP.
+
+    ``resolve(key)`` returns the callable for a broadcast step (e.g. the
+    repo model's run_batch) or None if this host hasn't synced it yet — in
+    which case the step is skipped locally, which is only safe for models
+    whose executables contain no cross-host collectives; mismatch with
+    host 0 otherwise deadlocks, so followers sync the repo BEFORE joining.
+    """
+    chan = channel or BroadcastChannel()
+    while True:
+        op, payload = chan.recv()
+        if op == OP_STOP:
+            return
+        if op != OP_RUN:
+            continue
+        key, inputs = pickle.loads(payload)
+        fn = resolve(key)
+        if fn is None:
+            continue
+        try:
+            fn(inputs)
+        except BaseException as ex:  # a follower must never desync the loop
+            if on_error is not None:
+                on_error(key, ex)
