@@ -159,7 +159,14 @@ class EngineModelRepo:
             model = CompiledModel(
                 ep, bundle, params, key=url, dispatcher=self._dispatcher
             )
-            model.warmup()
+            import jax
+
+            if jax.process_count() == 1:
+                # multi-host: warmup would enter the executable on THIS host
+                # alone, outside the broadcast order — an executable with
+                # cross-host collectives would deadlock the slice. First
+                # dispatched batch compiles on all hosts in step instead.
+                model.warmup()
             with self._lock:
                 self._models[url] = model  # atomic swap; old entry GC'd
                 self._hashes[url] = content_hash

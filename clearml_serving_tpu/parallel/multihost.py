@@ -47,6 +47,17 @@ class BroadcastChannel:
         # pairing, so sends serialize
         self._send_lock = threading.Lock()
 
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        """Pad payload broadcasts to power-of-two sizes: broadcast_one_to_all
+        jit-compiles per shape, so raw pickle lengths would compile a fresh
+        collective for nearly every request; bucketing bounds the cache to
+        ~log2(max_payload) executables."""
+        size = 64
+        while size < nbytes:
+            size *= 2
+        return size
+
     def send(self, op: int, payload: bytes = b"") -> None:
         """Host 0 only. Secondary hosts MUST be in recv() concurrently."""
         from jax.experimental import multihost_utils
@@ -55,7 +66,9 @@ class BroadcastChannel:
             header = np.asarray([op, len(payload)], np.int64)
             multihost_utils.broadcast_one_to_all(header, is_source=self._is_source)
             if payload:
-                buf = np.frombuffer(payload, np.uint8)
+                bucket = self._bucket(len(payload))
+                buf = np.zeros(bucket, np.uint8)
+                buf[: len(payload)] = np.frombuffer(payload, np.uint8)
                 multihost_utils.broadcast_one_to_all(buf, is_source=self._is_source)
 
     def recv(self) -> Tuple[int, bytes]:
@@ -69,9 +82,9 @@ class BroadcastChannel:
         payload = b""
         if nbytes:
             buf = multihost_utils.broadcast_one_to_all(
-                np.zeros(nbytes, np.uint8), is_source=self._is_source
+                np.zeros(self._bucket(nbytes), np.uint8), is_source=self._is_source
             )
-            payload = np.asarray(buf, np.uint8).tobytes()
+            payload = np.asarray(buf, np.uint8)[:nbytes].tobytes()
         return op, payload
 
 
@@ -101,7 +114,10 @@ class HostZeroDispatcher:
 
     def stop(self) -> None:
         if self._multi:
-            self.channel.send(OP_STOP)
+            # under the order lock: a queued dispatch must not broadcast
+            # AFTER followers exit, or its collective hangs host 0 forever
+            with self._order_lock:
+                self.channel.send(OP_STOP)
 
 
 def follower_loop(

@@ -173,3 +173,28 @@ def test_quantized_llama_forward_close():
     denom = float(jnp.std(ref))
     drift = float(jnp.max(jnp.abs(out - ref))) / denom
     assert drift < 0.25, drift
+
+
+def test_paged_attention_block_sizes_and_bf16():
+    """The r2 multi-page kernel must be exact for any pages_per_block split
+    (incl. non-dividing tails) and for bf16 pools."""
+    q, k_pool, v_pool, page_table, lengths = _random_paged_setup(jax.random.PRNGKey(3))
+    ref = paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
+    for pb in (1, 2, 3, 4, 8):
+        out = paged_attention(
+            q, k_pool, v_pool, page_table, lengths,
+            pages_per_block=pb, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+            err_msg="pages_per_block={}".format(pb),
+        )
+    qb = q.astype(jnp.bfloat16)
+    kb = k_pool.astype(jnp.bfloat16)
+    vb = v_pool.astype(jnp.bfloat16)
+    refb = paged_attention_xla(qb, kb, vb, page_table, lengths)
+    outb = paged_attention(qb, kb, vb, page_table, lengths, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(outb, np.float32), np.asarray(refb, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
