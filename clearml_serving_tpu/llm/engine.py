@@ -95,6 +95,8 @@ class LLMEngineCore:
         cache_mode: str = "dense",
         page_size: int = 16,
         num_pages: Optional[int] = None,
+        long_prefill_threshold: Optional[int] = None,
+        long_bucket_step: Optional[int] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -108,6 +110,25 @@ class LLMEngineCore:
             b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
         ) or [max_seq_len]
         self._mesh = mesh
+        # long-context sequence parallelism: prompts past the threshold
+        # prefill through ring attention over the mesh's sp axis (the prompt
+        # spreads across chips; SURVEY.md §5.7) — needs sp > 1 and a bundle
+        # with a prefill_ring surface
+        self._sp = int(dict(mesh.shape).get("sp", 1)) if mesh is not None else 1
+        if self._sp > 1 and not hasattr(bundle, "prefill_ring"):
+            self._sp = 1
+        self._long_threshold = (
+            int(long_prefill_threshold)
+            if long_prefill_threshold is not None
+            else self._buckets[-1]
+        )
+        # long-prefill shapes pad to multiples of this (must divide sp)
+        step = int(long_bucket_step) if long_bucket_step else self._sp * 512
+        self._long_step = -(-step // self._sp) * self._sp
+        # largest sp-divisible ring bucket that still fits the cache: prompts
+        # between this and max_seq_len fall back to plain prefill (rounding
+        # the bucket UP past max_seq_len would crash the cache insert)
+        self._long_cap = (self.max_seq_len // self._sp) * self._sp if self._sp > 1 else 0
 
         # int8 weight quantization: params live in HBM as int8 + scales; the
         # model's weight accessor (models/llama.py `_w`) dequantizes each
@@ -204,6 +225,17 @@ class LLMEngineCore:
             return bundle.prefill(params, tokens, seq_lens, cache_template)
 
         self._prefill_jit = jax.jit(_prefill)
+
+        if self._sp > 1:
+
+            def _prefill_ring(params, tokens, seq_lens, cache_template):
+                return bundle.prefill_ring(
+                    params, tokens, seq_lens, cache_template, self._mesh
+                )
+
+            self._prefill_ring_jit = jax.jit(_prefill_ring)
+        else:
+            self._prefill_ring_jit = None
 
         def _insert(cache, k_new, v_new, length, slot):
             k = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
@@ -353,7 +385,19 @@ class LLMEngineCore:
         long prompt prefills. The cheap commit happens on the loop thread at
         the next chunk boundary (_commit_admission)."""
         ids = request.prompt_ids
-        bucket = self._bucket_for(len(ids))
+        use_ring = (
+            self._prefill_ring_jit is not None
+            and self._long_threshold < len(ids) <= self._long_cap
+        )
+        if use_ring:
+            # sp-sharded long prefill: pad to a multiple of the sp axis,
+            # never past the sp-divisible cap
+            bucket = min(
+                -(-len(ids) // self._long_step) * self._long_step,
+                self._long_cap,
+            )
+        else:
+            bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, : len(ids)] = ids
         seq_lens = jnp.asarray([len(ids)], jnp.int32)
@@ -361,12 +405,14 @@ class LLMEngineCore:
         # bucket (prefill reads only its shape; re-allocating [L,1,bucket,H,D]
         # per admission would put hundreds of MB of HBM traffic on the
         # admission path for 8B-class models)
+        template_len = max(bucket, 1)
         with self._template_lock:
-            template = self._prefill_templates.get(bucket)
+            template = self._prefill_templates.get(template_len)
             if template is None:
-                template = self.bundle.init_cache(1, bucket)
-                self._prefill_templates[bucket] = template
-        last_logits, mini_cache = self._prefill_jit(
+                template = self.bundle.init_cache(1, template_len)
+                self._prefill_templates[template_len] = template
+        prefill_fn = self._prefill_ring_jit if use_ring else self._prefill_jit
+        last_logits, mini_cache = prefill_fn(
             self.params, jnp.asarray(tokens), seq_lens, template
         )
         first = self._sample_jit(

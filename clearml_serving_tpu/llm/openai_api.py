@@ -141,6 +141,8 @@ class LLMEngineRequest(BaseEngineRequest):
             cache_mode=engine_cfg.get("cache", "dense"),
             page_size=int(engine_cfg.get("page_size", 16)),
             num_pages=int(engine_cfg["num_pages"]) if engine_cfg.get("num_pages") else None,
+            long_prefill_threshold=engine_cfg.get("long_prefill_threshold"),
+            long_bucket_step=engine_cfg.get("long_bucket_step"),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine

@@ -261,22 +261,21 @@ def build(config: dict) -> SimpleNamespace:
             "length": jnp.zeros((batch,), jnp.int32),
         }
 
-    def prefill(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache):
-        """Right-padded tokens [B, S]; seq_lens [B]. Writes the cache and
-        returns (last-token logits [B, vocab], cache)."""
+    def _prefill_impl(params, tokens, seq_lens, cache, attend_fn):
+        """Shared prefill body: embed -> layers (attend_fn pluggable) ->
+        last-token logits + freshly written cache. Only the LAST position's
+        hidden state is projected to vocab — materializing [B, S, vocab] to
+        keep one row would make throwaway logits the memory ceiling exactly
+        on the long-S ring path."""
         b, s = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
-        valid = positions < seq_lens[:, None]                      # [B, S]
-        causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
-        mask_b = causal & valid[:, None, :]                        # [B, S, T]
-        mask = jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
         x = params["embed"][tokens]
 
         def layer_body(x, layer):
             h = _rms_norm(x, layer["attn_norm"], eps)
             q, k, v = _qkv(layer, h, cos, sin)
-            x = x + _attend(q, k, v, mask) @ _w(layer, "wo")
+            x = x + attend_fn(q, k, v) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
             return x + _ffn(layer, h), (k, v)
 
@@ -290,10 +289,10 @@ def build(config: dict) -> SimpleNamespace:
                 new_v.append(v)
             k_stack = jnp.stack(new_k)                             # [L,B,S,Hkv,D]
             v_stack = jnp.stack(new_v)
-        logits = _logits(params, x)                                # [B, S, vocab]
-        last = jnp.take_along_axis(
-            logits, (seq_lens - 1)[:, None, None].clip(0), axis=1
-        )[:, 0]
+        last_x = jnp.take_along_axis(
+            x, (seq_lens - 1)[:, None, None].clip(0), axis=1
+        )                                                          # [B, 1, D]
+        last = _logits(params, last_x)[:, 0]                       # [B, vocab]
         max_len = cache["k"].shape[2]
         pad = max_len - s
         k_full = jnp.pad(k_stack, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
@@ -304,6 +303,45 @@ def build(config: dict) -> SimpleNamespace:
             "length": seq_lens.astype(jnp.int32),
         }
         return last, cache
+
+    def prefill(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache):
+        """Right-padded tokens [B, S]; seq_lens [B]. Writes the cache and
+        returns (last-token logits [B, vocab], cache)."""
+        b, s = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        valid = positions < seq_lens[:, None]                      # [B, S]
+        causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
+        mask_b = causal & valid[:, None, :]                        # [B, S, T]
+        mask = jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
+
+        def attend(q, k, v):
+            return _attend(q, k, v, mask)
+
+        return _prefill_impl(params, tokens, seq_lens, cache, attend)
+
+    def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache, mesh):
+        """Sequence-parallel long-prompt prefill: exact ring attention over
+        the mesh's ``sp`` axis (parallel/ring_attention.py shard_map +
+        ppermute), so a single prompt's attention spreads across chips and
+        context length is bounded by the SLICE's HBM, not one chip's.
+
+        Same contract as :func:`prefill` (right-padded [B, S] tokens, S must
+        divide the sp axis). Causal masking inside the ring keeps valid
+        tokens from attending right-padding; padded positions' K/V land in
+        the cache but sit beyond ``length`` and are masked by decode."""
+        from ..parallel.ring_attention import ring_attention
+
+        b, s = tokens.shape
+
+        def attend_sp(q, k, v):
+            # GQA: repeat KV heads to query heads for the ring (activation
+            # cost only; weights untouched)
+            kf = jnp.repeat(k, group, axis=2)
+            vf = jnp.repeat(v, group, axis=2)
+            out = ring_attention(q, kf, vf, mesh, axis_name="sp", causal=True)
+            return out.reshape(b, s, n_heads * head_dim).astype(q.dtype)
+
+        return _prefill_impl(params, tokens, seq_lens, cache, attend_sp)
 
     def decode(params, tokens: jnp.ndarray, cache):
         """One decode step. tokens: [B] int32. Returns (logits [B, vocab], cache)."""
@@ -435,6 +473,7 @@ def build(config: dict) -> SimpleNamespace:
         apply=apply,
         init_cache=init_cache,
         prefill=prefill,
+        prefill_ring=prefill_ring,
         decode=decode,
         decode_paged=decode_paged,
         prepare_params=prepare_params,
