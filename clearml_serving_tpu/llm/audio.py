@@ -1,0 +1,144 @@
+"""Speech-to-text serving core (v1/audio/transcriptions + translations).
+
+Wraps a whisper bundle (models/whisper.py) the way EncoderCore wraps BERT:
+host-side mel frontend (ops/audio.py), one jitted encoder executable per
+fixed 30s chunk shape, and greedy decode as fused multi-step ``lax.scan``
+chunks (the llm engine's dispatch-amortization trick — decode_steps tokens
+per host round-trip). Long audio transcribes chunk-by-chunk, concatenating
+text (OpenAI Whisper's sequential 30s windows, minus timestamp conditioning).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class AudioCore:
+    def __init__(
+        self,
+        bundle,
+        params,
+        *,
+        decode_steps: int = 16,
+        max_new_tokens: Optional[int] = None,
+    ):
+        from ..ops.audio import mel_filter_bank
+
+        if not hasattr(bundle, "encode") or not hasattr(bundle, "init_cache"):
+            raise ValueError(
+                "audio tasks need a speech encoder-decoder bundle (arch 'whisper')"
+            )
+        self.bundle = bundle
+        cfg = bundle.config
+        self.params = params
+        self.sampling_rate = int(cfg.get("sampling_rate", 16000))
+        self.hop_length = int(cfg.get("hop_length", 160))
+        self.n_fft = int(cfg.get("n_fft", 400))
+        self.chunk_length = int(cfg.get("chunk_length", 30))
+        self.n_samples = self.sampling_rate * self.chunk_length
+        self.n_mels = int(cfg["n_mels"])
+        self.max_target = int(cfg["max_target_positions"])
+        self.max_new_tokens = int(max_new_tokens or self.max_target - 8)
+        self.decode_steps = max(1, int(decode_steps))
+        self.eos_token_id = int(cfg.get("eos_token_id", 50257))
+        self._prompts = {
+            "transcribe": list(cfg.get("transcribe_prompt_ids") or []),
+            "translate": list(cfg.get("translate_prompt_ids") or []),
+        }
+        # converted bundles carry the checkpoint's own filters in the tree
+        filters = None
+        if isinstance(params, dict) and "mel_filters" in params:
+            filters = np.asarray(params["mel_filters"], np.float32)
+            self.params = {k: v for k, v in params.items() if k != "mel_filters"}
+        if filters is None:
+            filters = mel_filter_bank(self.n_mels, self.n_fft, self.sampling_rate)
+        self.mel_filters = filters
+        # mel frames per chunk, bounded by the encoder's position table
+        self._frames = min(
+            self.n_samples // self.hop_length, 2 * int(cfg["max_source_positions"])
+        )
+        self._lock = threading.Lock()
+
+        self._encode_jit = jax.jit(bundle.encode)
+
+        def _decode_chunk(params, token, cache):
+            def body(carry, _):
+                token, cache = carry
+                logits, cache = bundle.decode(params, token, cache)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, cache), nxt
+
+            (_, cache), toks = jax.lax.scan(
+                body, (token, cache), None, length=self.decode_steps
+            )
+            return toks[:, 0], cache  # [steps] for batch 1
+
+        self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
+
+        def _prime(params, token, cache):
+            # teacher-forced prompt token: extend the cache, ignore logits
+            logits, cache = bundle.decode(params, token, cache)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._prime_jit = jax.jit(_prime, donate_argnums=(2,))
+
+    def prompt_ids(self, task: str) -> List[int]:
+        ids = self._prompts.get(task) or self._prompts.get("transcribe") or []
+        if not ids:
+            raise ValueError(
+                "bundle carries no decoder prompt ids for task {!r} (convert "
+                "with engines/importers/convert_hf_whisper.py)".format(task)
+            )
+        return ids
+
+    def _transcribe_chunk(self, pcm: np.ndarray, prompt: List[int]) -> List[int]:
+        from ..ops.audio import log_mel_spectrogram
+
+        mel = log_mel_spectrogram(
+            pcm,
+            self.mel_filters,
+            n_fft=self.n_fft,
+            hop_length=self.hop_length,
+            n_samples=self.n_samples,
+        )[None, :, : self._frames]
+        with self._lock:  # serialize per-core device decode state
+            enc = self._encode_jit(self.params, jnp.asarray(mel))
+            cache = self.bundle.init_cache(self.params, enc, self.max_target)
+            next_tok = jnp.asarray([prompt[0]], jnp.int32)
+            for tok in prompt[1:]:
+                _, cache = self._prime_jit(self.params, next_tok, cache)
+                next_tok = jnp.asarray([tok], jnp.int32)
+            first, cache = self._prime_jit(self.params, next_tok, cache)
+            out: List[int] = []
+            token = first
+            budget = min(self.max_new_tokens, self.max_target - len(prompt) - 1)
+            while len(out) < budget:
+                steps = np.asarray(token)
+                if int(steps[0]) == self.eos_token_id:
+                    break
+                out.append(int(steps[0]))
+                chunk, cache = self._decode_chunk_jit(self.params, token, cache)
+                chunk_np = np.asarray(chunk)
+                for t in chunk_np[:-1]:
+                    if int(t) == self.eos_token_id or len(out) >= budget:
+                        return out
+                    out.append(int(t))
+                token = jnp.asarray([chunk_np[-1]], jnp.int32)
+        return out
+
+    def transcribe_ids(self, pcm: np.ndarray, task: str = "transcribe") -> List[int]:
+        """Full utterance -> generated token ids (30s windows, concatenated)."""
+        prompt = self.prompt_ids(task)
+        pcm = np.asarray(pcm, np.float32).reshape(-1)
+        if len(pcm) == 0:
+            return []
+        ids: List[int] = []
+        for start in range(0, len(pcm), self.n_samples):
+            ids.extend(self._transcribe_chunk(pcm[start : start + self.n_samples], prompt))
+        return ids

@@ -50,6 +50,7 @@ class LLMEngineRequest(BaseEngineRequest):
     def __init__(self, *args, **kwargs):
         self.engine = None
         self.encoder = None
+        self.audio = None
         self.tokenizer = None
         self._model_name = "model"
         super().__init__(*args, **kwargs)
@@ -100,18 +101,37 @@ class LLMEngineRequest(BaseEngineRequest):
         # serve chat/completions.
         task = engine_cfg.get("task")
         if task is None:
-            task = "generate" if hasattr(bundle, "decode") else "embed"
+            if hasattr(bundle, "encode") and hasattr(bundle, "init_cache") and not hasattr(bundle, "prefill"):
+                task = "transcribe"  # speech encoder-decoder (whisper family)
+            elif hasattr(bundle, "decode"):
+                task = "generate"
+            else:
+                task = "embed"
         encoder_tasks = {
             "embed", "embedding", "pooling", "classify", "classification",
             "score", "rerank",
         }
-        if task not in encoder_tasks and task != "generate":
+        audio_tasks = {"transcribe", "translate", "audio"}
+        if task not in encoder_tasks and task not in audio_tasks and task != "generate":
             raise EndpointModelError(
                 "unknown engine task {!r} for endpoint {!r} (expected "
                 "'generate' or one of {})".format(
-                    task, self.endpoint.serving_url, sorted(encoder_tasks)
+                    task,
+                    self.endpoint.serving_url,
+                    sorted(encoder_tasks | audio_tasks),
                 )
             )
+        if task in audio_tasks:
+            from .audio import AudioCore
+
+            self.audio = AudioCore(
+                bundle,
+                params,
+                decode_steps=int(engine_cfg.get("decode_steps", 16)),
+                max_new_tokens=engine_cfg.get("max_tokens"),
+            )
+            self._model_name = self.endpoint.serving_url
+            return self.audio
         if task in encoder_tasks:
             from .encoder import EncoderCore
 
@@ -628,19 +648,67 @@ class LLMEngineRequest(BaseEngineRequest):
             "usage": {"total_tokens": n_tokens},
         }
 
-    # capability-gated routes (no audio model family in-tree yet)
-    async def _unsupported(self, route: str):
-        raise EndpointModelError(
-            "model {!r} does not support {} (no audio model loaded)".format(
-                self._model_name, route
+    # -- audio routes (OpenAI transcription API; whisper-family bundles) ------
+
+    def _require_audio(self, route: str) -> None:
+        if self.audio is None:
+            raise EndpointModelError(
+                "model {!r} does not support {} (serve a speech bundle — "
+                "arch 'whisper' — on this endpoint)".format(self._model_name, route)
             )
-        )
+
+    def _audio_pcm(self, body: Dict[str, Any]):
+        from ..ops.audio import decode_wav
+
+        data = body.get("file")
+        if isinstance(data, str):
+            import base64
+
+            try:
+                data = base64.b64decode(data)
+            except Exception:
+                raise ValueError("'file' must be WAV bytes or base64-encoded WAV")
+        if not isinstance(data, (bytes, bytearray)):
+            raise ValueError(
+                "audio requests need a 'file' field (multipart upload or "
+                "base64 WAV in JSON)"
+            )
+        return decode_wav(bytes(data), target_rate=self.audio.sampling_rate)
+
+    async def _audio_route(self, body, collect_fn, task: str, route: str):
+        self._require_audio(route)
+        pcm = self._audio_pcm(body)
+        ids = await asyncio.to_thread(self.audio.transcribe_ids, pcm, task)
+        text = self.tokenizer.decode(ids)
+        if collect_fn is not None:
+            collect_fn(
+                {
+                    "gen_tokens": len(ids),
+                    "audio_seconds": round(len(pcm) / self.audio.sampling_rate, 3),
+                }
+            )
+        if body.get("response_format") == "text":
+            from ..serving.responses import TextOutput
+
+            return TextOutput(text)
+        out = {"text": text}
+        if body.get("response_format") == "verbose_json":
+            out.update(
+                task=task,
+                duration=round(len(pcm) / self.audio.sampling_rate, 3),
+                language=body.get("language"),
+            )
+        return out
 
     async def v1_audio_transcriptions(self, body, state, collect_fn=None):
-        await self._unsupported("v1/audio/transcriptions")
+        return await self._audio_route(
+            body or {}, collect_fn, "transcribe", "v1/audio/transcriptions"
+        )
 
     async def v1_audio_translations(self, body, state, collect_fn=None):
-        await self._unsupported("v1/audio/translations")
+        return await self._audio_route(
+            body or {}, collect_fn, "translate", "v1/audio/translations"
+        )
 
     # -- phases -----------------------------------------------------------------
 

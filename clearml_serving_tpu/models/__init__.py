@@ -39,3 +39,4 @@ from . import mlp  # noqa: E402,F401
 from . import cnn  # noqa: E402,F401
 from . import bert  # noqa: E402,F401
 from . import llama  # noqa: E402,F401
+from . import whisper  # noqa: E402,F401

@@ -39,7 +39,7 @@ from .model_request_processor import (
     ModelRequestProcessor,
     ServingInitializationError,
 )
-from .responses import JSONOutput, StreamingOutput
+from .responses import JSONOutput, StreamingOutput, TextOutput
 from ..engines.base import EndpointModelError
 
 
@@ -57,6 +57,21 @@ def _is_hbm_oom(ex: BaseException) -> bool:
 
 
 async def _read_body(request: web.Request) -> Any:
+    content_type = request.headers.get("Content-Type", "")
+    if content_type.startswith("multipart/form-data"):
+        # OpenAI audio API shape: file upload + form fields (model, language,
+        # response_format, ...) — fields land in a dict, the upload's bytes
+        # under its field name (usually "file")
+        fields: dict = {}
+        async for part in await request.multipart():
+            if part.name is None:
+                continue
+            data = await part.read(decode=True)
+            if part.filename is not None:
+                fields[part.name] = data
+            else:
+                fields[part.name] = data.decode("utf-8", "replace")
+        return fields
     raw = await request.read()
     # aiohttp transparently decompresses Content-Encoding: gzip; only
     # decompress here if the payload still carries the gzip magic (e.g. a
@@ -135,6 +150,8 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
             return resp, out  # handled by caller (needs the request to prepare)
         if isinstance(out, JSONOutput):
             return web.json_response(out.payload, status=out.status)
+        if isinstance(out, TextOutput):
+            return web.Response(text=out.text, content_type=out.content_type)
         if isinstance(out, (bytes, bytearray)):
             return web.Response(body=bytes(out), content_type="application/octet-stream")
         try:
@@ -183,7 +200,14 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
 
     async def serve_model(request: web.Request) -> web.StreamResponse:
         tail = request.match_info["tail"].strip("/")
-        body = await _read_body(request)
+        try:
+            body = await _read_body(request)
+        except Exception as ex:
+            # malformed multipart/body must follow the 422 JSON error
+            # contract, not aiohttp's default 500 page
+            return web.json_response(
+                {"detail": "unreadable request body: {}".format(ex)}, status=422
+            )
         if tail.startswith("openai/"):
             # OpenAI-compatible: serve type is the path, endpoint is body.model
             serve_type = tail[len("openai/"):]

@@ -30,3 +30,13 @@ class JSONOutput:
     def __init__(self, payload: Any, status: int = 200):
         self.payload = payload
         self.status = status
+
+
+class TextOutput:
+    """Engine phases may return this for a raw text body (e.g. the OpenAI
+    transcription API's response_format=text, which expects text/plain — a
+    JSON-encoded string would arrive wrapped in literal quotes)."""
+
+    def __init__(self, text: str, content_type: str = "text/plain"):
+        self.text = text
+        self.content_type = content_type

@@ -1,0 +1,329 @@
+"""Whisper model/converter/mel fidelity vs transformers (audio routes)."""
+
+import jax
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.engines.importers.convert_hf_whisper import (
+    config_from_hf,
+    convert_state_dict,
+)
+from clearml_serving_tpu.ops.audio import (
+    decode_wav,
+    log_mel_spectrogram,
+    mel_filter_bank,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_whisper():
+    cfg = transformers.WhisperConfig(
+        vocab_size=51200, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=2, decoder_attention_heads=2,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, num_mel_bins=16,
+        max_source_positions=64, max_target_positions=32,
+    )
+    torch.manual_seed(0)
+    hf = transformers.WhisperForConditionalGeneration(cfg)
+    hf.eval()
+    our_cfg = config_from_hf(cfg)
+    our_cfg["dtype"] = "float32"
+    bundle = models.build_model("whisper", our_cfg)
+    params = convert_state_dict(hf.state_dict(), our_cfg)
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    return hf, bundle, params
+
+
+def test_encoder_matches_hf(tiny_hf_whisper):
+    hf, bundle, params = tiny_hf_whisper
+    mel = np.random.RandomState(0).rand(1, 16, 128).astype(np.float32)
+    ours = bundle.encode(params, mel)
+    with torch.no_grad():
+        theirs = hf.model.encoder(torch.from_numpy(mel)).last_hidden_state
+    np.testing.assert_allclose(
+        np.asarray(ours), theirs.numpy(), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_decoder_forward_matches_hf(tiny_hf_whisper):
+    hf, bundle, params = tiny_hf_whisper
+    mel = np.random.RandomState(1).rand(1, 16, 128).astype(np.float32)
+    tokens = np.array([[50258, 50359, 50363, 11, 23, 42]], np.int64)
+    enc = bundle.encode(params, mel)
+    ours = bundle.decoder_forward(params, tokens.astype(np.int32), enc)
+    with torch.no_grad():
+        theirs = hf(
+            input_features=torch.from_numpy(mel),
+            decoder_input_ids=torch.from_numpy(tokens),
+        ).logits
+    np.testing.assert_allclose(
+        np.asarray(ours), theirs.numpy(), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_cached_decode_matches_forward(tiny_hf_whisper):
+    """The serving decode path (self-KV cache + precomputed cross KV) must
+    match the teacher-forced forward exactly."""
+    hf, bundle, params = tiny_hf_whisper
+    mel = np.random.RandomState(2).rand(1, 16, 128).astype(np.float32)
+    tokens = np.array([[50258, 50359, 50363, 7, 9]], np.int32)
+    enc = bundle.encode(params, mel)
+    full = bundle.decoder_forward(params, tokens, enc)      # [1, S, V]
+
+    cache = bundle.init_cache(params, enc, max_len=16)
+    step_logits = []
+    for i in range(tokens.shape[1]):
+        logits, cache = bundle.decode(params, tokens[:, i], cache)
+        step_logits.append(np.asarray(logits))
+    np.testing.assert_allclose(
+        np.stack(step_logits, axis=1), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_log_mel_matches_feature_extractor():
+    fe = transformers.WhisperFeatureExtractor(
+        feature_size=16, sampling_rate=16000, hop_length=160, chunk_length=2, n_fft=400
+    )
+    rng = np.random.RandomState(3)
+    pcm = (rng.rand(20000).astype(np.float32) - 0.5) * 0.2
+    theirs = fe(pcm, sampling_rate=16000, return_tensors="np").input_features[0]
+    ours = log_mel_spectrogram(
+        pcm, np.asarray(fe.mel_filters), n_fft=400, hop_length=160,
+        n_samples=fe.n_samples,
+    )
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+def test_mel_filter_bank_fallback_close_to_hf():
+    ours = mel_filter_bank(16, 400, 16000)
+    from transformers.audio_utils import mel_filter_bank as hf_bank
+
+    theirs = np.asarray(
+        hf_bank(
+            num_frequency_bins=201, num_mel_filters=16, min_frequency=0.0,
+            max_frequency=8000.0, sampling_rate=16000, norm="slaney",
+            mel_scale="slaney",
+        )
+    )
+    assert ours.shape == theirs.shape
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_wav_roundtrip():
+    import io
+    import wave
+
+    rate = 8000
+    t = np.linspace(0, 1, rate, endpoint=False)
+    sig = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(2)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        stereo = np.stack([sig, sig], axis=1)
+        w.writeframes((stereo * 32767).astype(np.int16).tobytes())
+    pcm = decode_wav(buf.getvalue(), target_rate=16000)
+    assert pcm.shape[0] == 16000  # resampled 1s
+    assert np.max(np.abs(pcm)) == pytest.approx(0.5, rel=0.05)
+
+
+@pytest.fixture(scope="module")
+def audio_served(tmp_path_factory):
+    """Whisper-test endpoint (random weights) served through the router."""
+    import os
+
+    from clearml_serving_tpu.engines.jax_engine import save_bundle
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    root = tmp_path_factory.mktemp("audio_state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    bundle = models.build_model("whisper", {"preset": "whisper-test"})
+    params = bundle.init(jax.random.PRNGKey(0))
+    cfg = dict(bundle.config)
+    cfg.update(
+        transcribe_prompt_ids=[300, 301, 302],
+        translate_prompt_ids=[300, 303, 302],
+        eos_token_id=399,
+        sampling_rate=16000,
+        chunk_length=2,  # 2s windows keep the test tiny
+    )
+    bdir = tmp_path_factory.mktemp("audio_bundle") / "whisper"
+    save_bundle(bdir, "whisper", cfg, params)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="audio")
+    rec = mrp.registry.register("whisper-test", path=bdir, framework="jax")
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="llm", serving_url="tiny_whisper", model_id=rec.id)
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def _tone_wav(seconds=1.0, rate=16000) -> bytes:
+    import io
+    import wave
+
+    t = np.linspace(0, seconds, int(rate * seconds), endpoint=False)
+    sig = (0.3 * np.sin(2 * np.pi * 300 * t)).astype(np.float32)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes((sig * 32767).astype(np.int16).tobytes())
+    return buf.getvalue()
+
+
+def test_audio_transcription_route_multipart(audio_served):
+    import asyncio
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from clearml_serving_tpu.serving.main import build_app
+
+    async def fn():
+        client = TestClient(TestServer(build_app(audio_served)))
+        await client.start_server()
+        try:
+            form = aiohttp.FormData()
+            form.add_field("file", _tone_wav(), filename="a.wav",
+                           content_type="audio/wav")
+            form.add_field("model", "tiny_whisper")
+            r = await client.post("/serve/openai/v1/audio/transcriptions", data=form)
+            assert r.status == 200, await r.text()
+            out = await r.json()
+            # translation task uses its own prompt ids
+            form2 = aiohttp.FormData()
+            form2.add_field("file", _tone_wav(0.5), filename="b.wav",
+                            content_type="audio/wav")
+            form2.add_field("model", "tiny_whisper")
+            form2.add_field("response_format", "verbose_json")
+            r2 = await client.post("/serve/openai/v1/audio/translations", data=form2)
+            assert r2.status == 200, await r2.text()
+            return out, await r2.json()
+        finally:
+            await client.close()
+
+    out, out2 = asyncio.run(fn())
+    assert "text" in out and isinstance(out["text"], str)
+    assert out2["task"] == "translate"
+    assert out2["duration"] == pytest.approx(0.5, abs=0.01)
+
+
+def test_audio_transcription_json_base64(audio_served):
+    import asyncio
+    import base64
+
+    async def fn():
+        return await audio_served.process_request(
+            "tiny_whisper",
+            None,
+            {"file": base64.b64encode(_tone_wav(0.3)).decode()},
+            serve_type="v1/audio/transcriptions",
+        )
+
+    out = asyncio.run(fn())
+    assert "text" in out
+
+
+def test_audio_route_gated_on_decoder_endpoint(tmp_path):
+    """v1/audio/* on a text-LLM endpoint must 422 cleanly."""
+    import asyncio
+    import os
+
+    from clearml_serving_tpu.engines.base import EndpointModelError
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    os.environ["TPUSERVE_STATE_ROOT"] = str(tmp_path)
+    mrp = ModelRequestProcessor(state_root=str(tmp_path), force_create=True, name="gate")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="text_llm",
+            auxiliary_cfg={"engine": {"preset": "llama-tiny",
+                                      "config": {"dtype": "float32"}}},
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    with pytest.raises(EndpointModelError, match="does not support"):
+        asyncio.run(
+            mrp.process_request(
+                "text_llm", None, {"file": "x"},
+                serve_type="v1/audio/transcriptions",
+            )
+        )
+
+
+def test_decode_float32_wav():
+    """IEEE-float WAVs (soundfile's default) must decode via the RIFF
+    fallback — stdlib wave rejects format 3 (review r2 finding)."""
+    import struct
+
+    rate = 16000
+    sig = (0.25 * np.sin(2 * np.pi * 220 * np.linspace(0, 0.5, rate // 2))).astype(
+        np.float32
+    )
+    payload = sig.tobytes()
+    fmt = struct.pack("<HHIIHH", 3, 1, rate, rate * 4, 4, 32)
+    data = (
+        b"RIFF" + struct.pack("<I", 4 + 8 + len(fmt) + 8 + len(payload)) + b"WAVE"
+        + b"fmt " + struct.pack("<I", len(fmt)) + fmt
+        + b"data" + struct.pack("<I", len(payload)) + payload
+    )
+    pcm = decode_wav(data, target_rate=16000)
+    assert pcm.shape[0] == rate // 2
+    np.testing.assert_allclose(pcm, sig, rtol=1e-6)
+
+
+def test_audio_text_response_and_bad_multipart(audio_served):
+    import asyncio
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from clearml_serving_tpu.serving.main import build_app
+
+    async def fn():
+        client = TestClient(TestServer(build_app(audio_served)))
+        await client.start_server()
+        try:
+            form = aiohttp.FormData()
+            form.add_field("file", _tone_wav(0.3), filename="a.wav",
+                           content_type="audio/wav")
+            form.add_field("model", "tiny_whisper")
+            form.add_field("response_format", "text")
+            r = await client.post("/serve/openai/v1/audio/transcriptions", data=form)
+            assert r.status == 200
+            # OpenAI parity: raw text/plain body, not a JSON-quoted string
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = await r.text()
+            assert not text.startswith('"')
+
+            # malformed multipart must 422 with the JSON error contract
+            r2 = await client.post(
+                "/serve/openai/v1/audio/transcriptions",
+                data=b"garbage",
+                headers={"Content-Type": "multipart/form-data"},  # no boundary
+            )
+            assert r2.status == 422, await r2.text()
+            body = await r2.json()
+            assert "detail" in body
+            return True
+        finally:
+            await client.close()
+
+    assert asyncio.run(fn())
