@@ -97,6 +97,7 @@ class LLMEngineCore:
         num_pages: Optional[int] = None,
         long_prefill_threshold: Optional[int] = None,
         long_bucket_step: Optional[int] = None,
+        chunked_prefill_size: Optional[int] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -236,6 +237,25 @@ class LLMEngineCore:
             self._prefill_ring_jit = jax.jit(_prefill_ring)
         else:
             self._prefill_ring_jit = None
+
+        # chunked prefill: bound each admission dispatch to C tokens so
+        # decode chunks interleave on the device stream between prompt
+        # segments instead of queueing behind one full-prompt prefill
+        self._chunked = int(chunked_prefill_size or 0)
+        if self._chunked > 0 and hasattr(bundle, "prefill_chunk"):
+            # the first chunk reads the shared never-mutated template, so it
+            # must NOT donate; later chunks own their cache and do. Non-final
+            # chunks skip the lm_head projection (static with_logits arg).
+            self._prefill_chunk_first_jit = jax.jit(
+                bundle.prefill_chunk, static_argnames=("with_logits",)
+            )
+            self._prefill_chunk_jit = jax.jit(
+                bundle.prefill_chunk,
+                donate_argnums=(4,),
+                static_argnames=("with_logits",),
+            )
+        else:
+            self._chunked = 0
 
         def _insert(cache, k_new, v_new, length, slot):
             k = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
@@ -411,10 +431,55 @@ class LLMEngineCore:
             if template is None:
                 template = self.bundle.init_cache(1, template_len)
                 self._prefill_templates[template_len] = template
-        prefill_fn = self._prefill_ring_jit if use_ring else self._prefill_jit
-        last_logits, mini_cache = prefill_fn(
-            self.params, jnp.asarray(tokens), seq_lens, template
+        c = self._chunked
+        # the chunked mini cache must be a multiple of C: a final chunk
+        # overflowing the bucket would be CLAMPED backward by
+        # dynamic_update_slice, silently overwriting earlier prompt K/V
+        chunk_bucket = -(-bucket // c) * c if c else 0
+        use_chunked = (
+            not use_ring
+            and c > 0
+            and len(ids) > c
+            and chunk_bucket <= self.max_seq_len
         )
+        if use_chunked and chunk_bucket != bucket:
+            bucket = chunk_bucket
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, : len(ids)] = ids
+        if use_chunked:
+            # incremental prefill: C-token segments attend over the cache so
+            # far; the template is read (not donated) on the first segment
+            with self._template_lock:
+                template = self._prefill_templates.get(bucket)
+                if template is None:
+                    template = self.bundle.init_cache(1, bucket)
+                    self._prefill_templates[bucket] = template
+            cache = template
+            last_logits = None
+            n_segs = -(-len(ids) // c)
+            for seg_i, s in enumerate(range(0, len(ids), c)):
+                seg = ids[s : s + c]
+                seg_tokens = np.zeros((1, c), np.int32)
+                seg_tokens[0, : len(seg)] = seg
+                fn = (
+                    self._prefill_chunk_first_jit
+                    if seg_i == 0
+                    else self._prefill_chunk_jit
+                )
+                last_logits, cache = fn(
+                    self.params,
+                    jnp.asarray(seg_tokens),
+                    jnp.asarray([s], jnp.int32),
+                    jnp.asarray([len(seg) - 1], jnp.int32),
+                    cache,
+                    with_logits=(seg_i == n_segs - 1),
+                )
+            mini_cache = cache
+        else:
+            prefill_fn = self._prefill_ring_jit if use_ring else self._prefill_jit
+            last_logits, mini_cache = prefill_fn(
+                self.params, jnp.asarray(tokens), seq_lens, template
+            )
         first = self._sample_jit(
             last_logits.astype(jnp.float32),
             SamplingParams(

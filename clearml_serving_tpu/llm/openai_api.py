@@ -163,6 +163,7 @@ class LLMEngineRequest(BaseEngineRequest):
             num_pages=int(engine_cfg["num_pages"]) if engine_cfg.get("num_pages") else None,
             long_prefill_threshold=engine_cfg.get("long_prefill_threshold"),
             long_bucket_step=engine_cfg.get("long_bucket_step"),
+            chunked_prefill_size=engine_cfg.get("chunked_prefill"),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine

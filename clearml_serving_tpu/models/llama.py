@@ -319,6 +319,82 @@ def build(config: dict) -> SimpleNamespace:
 
         return _prefill_impl(params, tokens, seq_lens, cache, attend)
 
+    def prefill_chunk(params, tokens: jnp.ndarray, start: jnp.ndarray,
+                      last_rel: jnp.ndarray, cache, *, with_logits: bool = True):
+        """Incremental (chunked) prefill: process ``tokens`` [B, C] at
+        absolute positions ``start``..``start+C``, attending over everything
+        already in ``cache`` plus the chunk itself (causal). Returns logits
+        at relative index ``last_rel`` (the prompt's final real token in the
+        — possibly right-padded — last chunk; [B, vocab]) and the extended
+        cache. Pad positions write masked-out K/V exactly like plain
+        prefill's bucket padding.
+
+        Bounding each prefill dispatch to C tokens lets decode chunks
+        interleave on the device stream between prompt segments — a full-
+        prompt prefill would occupy the queue for the whole prompt (the
+        chunked-prefill TTFT/TPOT smoothing from the serving literature).
+        """
+        b, c = tokens.shape
+        max_len = cache["k"].shape[2]
+        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+        x = params["embed"][tokens]
+        t_idx = jnp.arange(max_len, dtype=jnp.int32)
+        # key t visible to chunk query i iff t <= start + i (causal over the
+        # whole sequence; cache beyond the chunk end is stale -> masked)
+        q_abs = positions                                                   # [B, C]
+        mask = jnp.where(
+            t_idx[None, None, :] <= q_abs[:, :, None], 0.0, -jnp.inf
+        ).astype(jnp.float32)[:, None]                                      # [B,1,C,T]
+
+        def layer_body(carry, layer_and_kv):
+            x = carry
+            layer, k_cache, v_cache = layer_and_kv
+            h = _rms_norm(x, layer["attn_norm"], eps)
+            q, k, v = _qkv(layer, h, cos, sin)
+            k_cache = jax.vmap(
+                lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
+            )(k_cache, k.astype(k_cache.dtype), start)
+            v_cache = jax.vmap(
+                lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
+            )(v_cache, v.astype(v_cache.dtype), start)
+            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
+            h = _rms_norm(x, layer["ffn_norm"], eps)
+            return x + _ffn(layer, h), (k_cache, v_cache)
+
+        if scan_layers:
+            x, (k_new, v_new) = jax.lax.scan(
+                lambda x, xs: layer_body(x, xs),
+                x,
+                (params["layers"], cache["k"], cache["v"]),
+            )
+        else:
+            k_list, v_list = [], []
+            for i, layer in enumerate(params["layers"]):
+                x, (k_l, v_l) = layer_body(x, (layer, cache["k"][i], cache["v"][i]))
+                k_list.append(k_l)
+                v_list.append(v_l)
+            k_new = jnp.stack(k_list)
+            v_new = jnp.stack(v_list)
+        if with_logits:
+            last_x = jnp.take_along_axis(
+                x, last_rel[:, None, None].clip(0, c - 1), axis=1
+            )                                                              # [B,1,D]
+            last = _logits(params, last_x)[:, 0]                           # [B, vocab]
+        else:
+            # non-final chunks: skip final-norm + lm_head — for an 8B model
+            # that matmul reads the whole vocab projection from HBM just to
+            # be discarded
+            last = jnp.zeros((b, 1), jnp.float32)
+        cache = {
+            "k": k_new,
+            "v": v_new,
+            "length": jnp.maximum(
+                cache["length"], start + last_rel + 1
+            ).astype(jnp.int32),
+        }
+        return last, cache
+
     def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache, mesh):
         """Sequence-parallel long-prompt prefill: exact ring attention over
         the mesh's ``sp`` axis (parallel/ring_attention.py shard_map +
@@ -473,6 +549,7 @@ def build(config: dict) -> SimpleNamespace:
         apply=apply,
         init_cache=init_cache,
         prefill=prefill,
+        prefill_chunk=prefill_chunk,
         prefill_ring=prefill_ring,
         decode=decode,
         decode_paged=decode_paged,

@@ -259,3 +259,36 @@ def test_int8_engine_with_mesh(tiny_engine_parts):
 
     out = asyncio.run(run())
     assert len(out) >= 1
+
+
+def test_chunked_prefill_matches_plain(tiny_engine_parts):
+    """Chunked prefill (C-token segments over the cache) must generate the
+    same greedy tokens as one-shot prefill, including ragged final chunks."""
+    bundle, params = tiny_engine_parts
+    prompts = [
+        [256, 5, 9, 13, 2, 7, 40, 41, 42],          # 9 tokens, C=4 -> 4+4+1
+        [256] + list(range(1, 17)),                  # 17 tokens -> 4x4+1
+        [256, 3],                                    # shorter than C: plain path
+    ]
+
+    async def run(engine):
+        outs = []
+        for p in prompts:
+            outs.append(
+                await _collect(engine, GenRequest(prompt_ids=p, max_new_tokens=5))
+            )
+        return outs
+
+    plain = asyncio.run(run(_make_engine(bundle, params)))
+    chunked_engine = _make_engine(bundle, params, chunked_prefill_size=4)
+    assert chunked_engine._chunked == 4
+    chunked = asyncio.run(run(chunked_engine))
+    assert chunked == plain
+
+    # C that does NOT divide the buckets (16/32): a clamped final-chunk
+    # write would silently corrupt earlier prompt K/V (review r2 finding)
+    odd_engine = _make_engine(bundle, params, chunked_prefill_size=6)
+    odd = asyncio.run(run(odd_engine))
+    assert odd == plain
+    # the chunked mini cache rounded up to a multiple of C
+    assert any(b % 6 == 0 for b in odd_engine._prefill_templates)
