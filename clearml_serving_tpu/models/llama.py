@@ -134,24 +134,42 @@ def build(config: dict) -> SimpleNamespace:
     # compile; the scanned one compiles like a 1-layer model.
     scan_layers = bool(cfg.get("scan_layers", False))
 
+    # sparse MoE FFN (Mixtral-style): n_experts stacked expert FFNs behind a
+    # top-k router; expert weights shard over the mesh's ``ep`` axis
+    n_experts = int(cfg.get("n_experts", 0) or 0)
+    moe = n_experts > 1
+    moe_top_k = int(cfg.get("moe_top_k", 2))
+    moe_capacity = float(cfg.get("moe_capacity_factor", 1.25))
+
     def _init_layer(key):
         def dense(k, shape, fan_in):
             return (
                 jax.random.normal(k, shape, dtype=jnp.float32) * fan_in ** -0.5
             ).astype(dtype)
 
-        k = jax.random.split(key, 7)
-        return {
+        k = jax.random.split(key, 8)
+        out = {
             "attn_norm": jnp.ones((dim,), dtype),
             "wq": dense(k[0], (dim, n_heads * head_dim), dim),
             "wk": dense(k[1], (dim, n_kv * head_dim), dim),
             "wv": dense(k[2], (dim, n_kv * head_dim), dim),
             "wo": dense(k[3], (n_heads * head_dim, dim), n_heads * head_dim),
             "ffn_norm": jnp.ones((dim,), dtype),
-            "w_gate": dense(k[4], (dim, ffn_dim), dim),
-            "w_up": dense(k[5], (dim, ffn_dim), dim),
-            "w_down": dense(k[6], (ffn_dim, dim), ffn_dim),
         }
+        if moe:
+            out.update(
+                w_router=dense(k[7], (dim, n_experts), dim),
+                w_gate_e=dense(k[4], (n_experts, dim, ffn_dim), dim),
+                w_up_e=dense(k[5], (n_experts, dim, ffn_dim), dim),
+                w_down_e=dense(k[6], (n_experts, ffn_dim, dim), ffn_dim),
+            )
+        else:
+            out.update(
+                w_gate=dense(k[4], (dim, ffn_dim), dim),
+                w_up=dense(k[5], (dim, ffn_dim), dim),
+                w_down=dense(k[6], (ffn_dim, dim), ffn_dim),
+            )
+        return out
 
     def init(rng) -> Dict[str, Any]:
         def dense(key, shape, fan_in):
@@ -210,10 +228,94 @@ def build(config: dict) -> SimpleNamespace:
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
         return out.reshape(b, s, n_heads * head_dim)
 
-    def _ffn(layer, x):
+    def _ffn_dense(layer, x):
         return (
             jax.nn.silu(x @ _w(layer, "w_gate")) * (x @ _w(layer, "w_up"))
         ) @ _w(layer, "w_down")
+
+    def _moe_routing(layer, tokens):
+        router_logits = (
+            tokens.astype(jnp.float32) @ _w(layer, "w_router").astype(jnp.float32)
+        )                                                         # [T, E]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, moe_top_k)            # [T, k]
+        # mixtral renormalizes the chosen experts' probabilities
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        return top_p, top_e
+
+    def _ffn_moe(layer, x, valid=None):
+        """Mixtral-style sparse MoE FFN, GShard dispatch (TPU-first: the
+        token->expert routing is expressed as one-hot einsums over a fixed
+        capacity, so everything is static-shape batched matmuls — expert
+        weights stack [E, ...] and shard over the mesh's ``ep`` axis, with
+        XLA inserting the all-to-alls).
+
+        ``valid`` [B, S] (bool) excludes right-padding from routing —
+        without it one sequence's pad tokens would consume expert capacity
+        and evict another sequence's REAL tokens. Exact w.r.t. top-k routing
+        EXCEPT under overflow of valid tokens (capacity_factor * tokens * k
+        / E per expert, standard GShard drop).
+        """
+        b, s, d_ = x.shape
+        tokens = x.reshape(b * s, d_)
+        n_tok = b * s
+        top_p, top_e = _moe_routing(layer, tokens)
+
+        capacity = max(1, int(moe_capacity * n_tok * moe_top_k / n_experts))
+        # position of each (token, slot) within its expert's capacity buffer
+        onehot = jax.nn.one_hot(top_e, n_experts, dtype=jnp.int32)  # [T,k,E]
+        if valid is not None:
+            onehot = onehot * valid.reshape(n_tok, 1, 1).astype(jnp.int32)
+        # rank tokens per expert by arrival order across (slot-major) choices
+        flat = onehot.reshape(n_tok * moe_top_k, n_experts)
+        pos_in_expert = (jnp.cumsum(flat, axis=0) - 1).reshape(
+            n_tok, moe_top_k, n_experts
+        )
+        within = (pos_in_expert < capacity) & (onehot > 0)
+        # dispatch tensor [T, E, C]: one-hot of each kept (token, expert, pos)
+        pos_oh = jax.nn.one_hot(
+            jnp.where(within, pos_in_expert, capacity), capacity, dtype=x.dtype
+        )                                                         # [T,k,E,C]
+        dispatch = jnp.einsum("tke,tkec->tec", onehot.astype(x.dtype), pos_oh)
+        combine = jnp.einsum(
+            "tke,tkec->tec",
+            (top_p.astype(jnp.float32)[:, :, None] * onehot).astype(x.dtype),
+            pos_oh,
+        )
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)   # [E,C,D]
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, _w(layer, "w_gate_e"))
+        ) * jnp.einsum("ecd,edf->ecf", expert_in, _w(layer, "w_up_e"))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, _w(layer, "w_down_e"))
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)      # [T, D]
+        return out.reshape(b, s, d_).astype(x.dtype)
+
+    def _ffn_moe_dropless(layer, x):
+        """Dropless MoE for decode: every token computes ALL experts and
+        combines the top-k — no capacity, no cross-token interaction, so an
+        inactive slot can never evict an active one and quality never
+        depends on batch occupancy (inference references like vLLM apply no
+        capacity either). E× FFN FLOPs on a [B, 1, D] decode step is cheap;
+        the GShard dispatch path stays for prefill's long sequences."""
+        b, s, d_ = x.shape
+        tokens = x.reshape(b * s, d_)
+        top_p, top_e = _moe_routing(layer, tokens)
+        weights = jnp.zeros((b * s, n_experts), jnp.float32).at[
+            jnp.arange(b * s)[:, None], top_e
+        ].add(top_p)
+        h = jax.nn.silu(
+            jnp.einsum("td,edf->etf", tokens, _w(layer, "w_gate_e"))
+        ) * jnp.einsum("td,edf->etf", tokens, _w(layer, "w_up_e"))
+        expert_out = jnp.einsum("etf,efd->etd", h, _w(layer, "w_down_e"))
+        out = jnp.einsum("te,etd->td", weights.astype(x.dtype), expert_out)
+        return out.reshape(b, s, d_).astype(x.dtype)
+
+    def _ffn(layer, x, valid=None):
+        if moe:
+            if x.shape[1] == 1:  # decode: one token per sequence
+                return _ffn_moe_dropless(layer, x)
+            return _ffn_moe(layer, x, valid)
+        return _ffn_dense(layer, x)
 
     def _logits(params, x):
         x = _rms_norm(x, params["final_norm"], eps)
@@ -270,6 +372,7 @@ def build(config: dict) -> SimpleNamespace:
         b, s = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+        ffn_valid = positions < seq_lens[:, None]  # pads never route (MoE)
         x = params["embed"][tokens]
 
         def layer_body(x, layer):
@@ -277,7 +380,7 @@ def build(config: dict) -> SimpleNamespace:
             q, k, v = _qkv(layer, h, cos, sin)
             x = x + attend_fn(q, k, v) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h), (k, v)
+            return x + _ffn(layer, h, ffn_valid), (k, v)
 
         if scan_layers:
             x, (k_stack, v_stack) = jax.lax.scan(layer_body, x, params["layers"])
@@ -346,6 +449,9 @@ def build(config: dict) -> SimpleNamespace:
         mask = jnp.where(
             t_idx[None, None, :] <= q_abs[:, :, None], 0.0, -jnp.inf
         ).astype(jnp.float32)[:, None]                                      # [B,1,C,T]
+        ffn_valid = (
+            jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
+        )  # pad tail of the final chunk never routes (MoE)
 
         def layer_body(carry, layer_and_kv):
             x = carry
@@ -360,7 +466,7 @@ def build(config: dict) -> SimpleNamespace:
             )(v_cache, v.astype(v_cache.dtype), start)
             x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h), (k_cache, v_cache)
+            return x + _ffn(layer, h, ffn_valid), (k_cache, v_cache)
 
         if scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
@@ -550,6 +656,7 @@ def build(config: dict) -> SimpleNamespace:
         init_cache=init_cache,
         prefill=prefill,
         prefill_chunk=prefill_chunk,
+        ffn=_ffn,
         prefill_ring=prefill_ring,
         decode=decode,
         decode_paged=decode_paged,

@@ -39,7 +39,12 @@ def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
     dequant next to its consumer matmul and frees the bf16 buffer after use,
     so weights at rest stay int8. Calling dequant eagerly (outside jit)
     materializes a full bf16 copy and defeats the purpose."""
-    quant_keys = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+    quant_keys = {
+        "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+        # MoE expert stacks [E, in, out] quantize the same way (axis=-2 is
+        # still the reduction dim); the small router stays full precision
+        "w_gate_e", "w_up_e", "w_down_e",
+    }
 
     def _q(tree):
         if isinstance(tree, dict):

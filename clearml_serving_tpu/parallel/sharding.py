@@ -43,7 +43,17 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         "w_gate": col(None, "tp"),
         "w_up": col(None, "tp"),
         "w_down": col("tp", None),
+        # MoE variant: experts shard over ep, each expert's ffn over tp
+        # (XLA inserts the dispatch/combine all-to-alls across ep)
+        "w_router": col(),
+        "w_gate_e": col("ep", None, "tp"),
+        "w_up_e": col("ep", None, "tp"),
+        "w_down_e": col("ep", "tp", None),
     }
+    # spec structure must mirror the actual param keys (dense layers carry
+    # w_gate/..., MoE layers carry w_router/w_*_e)
+    sample = params["layers"] if stacked else params["layers"][0]
+    layer_spec = {k: v for k, v in layer_spec.items() if k in sample}
     out: Dict[str, Any] = {
         "embed": ns("tp", None),        # vocab-sharded lookup; gathered by XLA
         "final_norm": ns(),

@@ -219,3 +219,28 @@ def test_ring_cap_non_divisible_max_seq_len():
     # 60-token prompt: ring path, bucket 64 <= 124
     assert len(asyncio.run(run(60))) >= 1
     assert 64 in engine._prefill_templates
+
+
+def test_moe_ep_sharded_forward_matches_single():
+    """MoE expert weights shard over the ep axis (tp for the per-expert ffn);
+    the sharded forward must equal the unsharded one — EP first-class over
+    the mesh (SURVEY §2.9 parallelism checklist)."""
+    mesh = make_mesh({"dp": 1, "tp": 2, "ep": 4})
+    bundle = models.build_model(
+        "llama",
+        {"preset": "llama-tiny", "dtype": "float32",
+         "n_experts": 4, "moe_top_k": 2, "moe_capacity_factor": 4.0},
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 512)
+    expected = bundle.apply(params, tokens)
+
+    shardings = llama_param_sharding(mesh, params)
+    sharded = shard_params(mesh, params, shardings)
+    wge = sharded["layers"][0]["w_gate_e"]
+    assert wge.sharding.spec == ("ep", None, "tp")
+    assert wge.addressable_shards[0].data.shape[0] == 1  # 4 experts / ep=4
+    out = jax.jit(bundle.apply)(sharded, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-3, atol=2e-3
+    )
