@@ -9,6 +9,10 @@ Here parallelism is first-class: every tensor engine accepts a per-endpoint
 Axis vocabulary (used consistently across sharding rules and kernels):
   dp — data/batch parallel     tp — tensor parallel (heads / ffn)
   sp — sequence/context parallel (ring attention)   ep — expert parallel (MoE)
+  pp — layer-stage parallel: the stacked (scan_layers) layer dim shards over
+       pp, so each chip holds L/pp layers' weights and XLA gathers one
+       layer per scan step — the serving-side memory-scaling form of
+       pipeline parallelism (no microbatch schedule; latency trades for HBM)
 """
 
 from __future__ import annotations
@@ -17,7 +21,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-AXES = ("dp", "tp", "sp", "ep")
+AXES = ("dp", "tp", "sp", "ep", "pp")
 
 
 def make_mesh(

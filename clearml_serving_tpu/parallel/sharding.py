@@ -28,10 +28,15 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         return NamedSharding(mesh, P(*spec))
 
     stacked = isinstance(params["layers"], dict)  # scan_layers: [L, ...] arrays
+    # pp: shard the stacked layer dim — each chip stores L/pp layers and XLA
+    # gathers one layer's weights per scan step (memory-scaling PP)
+    pp = int(dict(mesh.shape).get("pp", 1)) if stacked else 1
+    layer_axis = "pp" if pp > 1 else None
 
     def col(*spec):
-        # stacked layer params carry a leading layer dim that stays unsharded
-        return ns(None, *spec) if stacked else ns(*spec)
+        # stacked layer params carry a leading layer dim (pp-sharded if the
+        # mesh has a pp axis)
+        return ns(layer_axis, *spec) if stacked else ns(*spec)
 
     layer_spec = {
         "attn_norm": col(),
