@@ -183,3 +183,29 @@ def test_moe_generation_through_engine():
 
     out = asyncio.run(run())
     assert len(out) >= 1
+
+
+def test_moe_int8_quantization_covers_expert_stacks():
+    """quantize_llama_params must quantize the expert stacks (the bulk of a
+    MoE model), and the quantized model must still generate."""
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.ops.quant import quantize_llama_params
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32", "n_experts": 4}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    qparams = quantize_llama_params(params)
+    layer = qparams["layers"][0]
+    for key in ("w_gate_e", "w_up_e", "w_down_e"):
+        assert "_q8" in layer[key], key
+        assert layer[key]["_q8"].dtype == np.int8 or str(layer[key]["_q8"].dtype) == "int8"
+    assert "_q8" not in layer["w_router"] if isinstance(layer["w_router"], dict) else True
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 512)
+    out_q = np.asarray(bundle.apply(qparams, tokens))
+    out_f = np.asarray(bundle.apply(params, tokens))
+    assert np.all(np.isfinite(out_q))
+    # int8 is approximate but must track the full-precision logits closely
+    assert np.mean(np.abs(out_q - out_f)) < 0.5
