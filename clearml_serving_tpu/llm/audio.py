@@ -10,6 +10,7 @@ text (OpenAI Whisper's sequential 30s windows, minus timestamp conditioning).
 
 from __future__ import annotations
 
+import asyncio
 import threading
 from typing import Any, List, Optional
 
@@ -27,6 +28,8 @@ class AudioCore:
         *,
         decode_steps: int = 16,
         max_new_tokens: Optional[int] = None,
+        max_batch: int = 4,
+        max_batch_delay_ms: float = 10.0,
     ):
         from ..ops.audio import mel_filter_bank
 
@@ -64,10 +67,19 @@ class AudioCore:
             self.n_samples // self.hop_length, 2 * int(cfg["max_source_positions"])
         )
         self._lock = threading.Lock()
+        # cross-request micro-batching: concurrent utterances with the same
+        # task batch into one encode + one greedy decode loop (batch-bucketed
+        # executables), instead of serializing on the device lock
+        self.max_batch = max(1, int(max_batch))
+        self._batch_delay = max(0.0, float(max_batch_delay_ms)) / 1000.0
+        self._pending: Optional[asyncio.Queue] = None
+        self._loop = None
+        self._batch_task = None
+        self._carry = None  # deferred different-task item (runs first next round)
 
         self._encode_jit = jax.jit(bundle.encode)
 
-        def _decode_chunk(params, token, cache):
+        def _decode_chunk_batch(params, token, cache):
             def body(carry, _):
                 token, cache = carry
                 logits, cache = bundle.decode(params, token, cache)
@@ -77,9 +89,11 @@ class AudioCore:
             (_, cache), toks = jax.lax.scan(
                 body, (token, cache), None, length=self.decode_steps
             )
-            return toks[:, 0], cache  # [steps] for batch 1
+            return toks, cache  # [steps, B]
 
-        self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
+        self._decode_chunk_batch_jit = jax.jit(
+            _decode_chunk_batch, donate_argnums=(2,)
+        )
 
         def _prime(params, token, cache):
             # teacher-forced prompt token: extend the cache, ignore logits
@@ -98,39 +112,7 @@ class AudioCore:
         return ids
 
     def _transcribe_chunk(self, pcm: np.ndarray, prompt: List[int]) -> List[int]:
-        from ..ops.audio import log_mel_spectrogram
-
-        mel = log_mel_spectrogram(
-            pcm,
-            self.mel_filters,
-            n_fft=self.n_fft,
-            hop_length=self.hop_length,
-            n_samples=self.n_samples,
-        )[None, :, : self._frames]
-        with self._lock:  # serialize per-core device decode state
-            enc = self._encode_jit(self.params, jnp.asarray(mel))
-            cache = self.bundle.init_cache(self.params, enc, self.max_target)
-            next_tok = jnp.asarray([prompt[0]], jnp.int32)
-            for tok in prompt[1:]:
-                _, cache = self._prime_jit(self.params, next_tok, cache)
-                next_tok = jnp.asarray([tok], jnp.int32)
-            first, cache = self._prime_jit(self.params, next_tok, cache)
-            out: List[int] = []
-            token = first
-            budget = min(self.max_new_tokens, self.max_target - len(prompt) - 1)
-            while len(out) < budget:
-                steps = np.asarray(token)
-                if int(steps[0]) == self.eos_token_id:
-                    break
-                out.append(int(steps[0]))
-                chunk, cache = self._decode_chunk_jit(self.params, token, cache)
-                chunk_np = np.asarray(chunk)
-                for t in chunk_np[:-1]:
-                    if int(t) == self.eos_token_id or len(out) >= budget:
-                        return out
-                    out.append(int(t))
-                token = jnp.asarray([chunk_np[-1]], jnp.int32)
-        return out
+        return self._transcribe_batch([pcm], prompt)[0]
 
     def transcribe_ids(self, pcm: np.ndarray, task: str = "transcribe") -> List[int]:
         """Full utterance -> generated token ids (30s windows, concatenated)."""
@@ -142,3 +124,141 @@ class AudioCore:
         for start in range(0, len(pcm), self.n_samples):
             ids.extend(self._transcribe_chunk(pcm[start : start + self.n_samples], prompt))
         return ids
+
+    # -- cross-request batching ------------------------------------------------
+
+    def _batch_bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.max_batch)
+
+    def _transcribe_batch(
+        self, pcms: List[np.ndarray], prompt: List[int]
+    ) -> List[List[int]]:
+        """N ≤ max_batch single-window utterances, one shared prompt -> per-
+        utterance token ids. One encode + one greedy loop over the batch;
+        finished sequences keep stepping (masked host-side) until all hit
+        eos or the budget."""
+        from ..ops.audio import log_mel_spectrogram
+
+        n = len(pcms)
+        bucket = self._batch_bucket(n)
+        mels = np.zeros((bucket, self.n_mels, self._frames), np.float32)
+        for i, pcm in enumerate(pcms):
+            mels[i] = log_mel_spectrogram(
+                pcm, self.mel_filters, n_fft=self.n_fft,
+                hop_length=self.hop_length, n_samples=self.n_samples,
+            )[:, : self._frames]
+        with self._lock:
+            enc = self._encode_jit(self.params, jnp.asarray(mels))
+            cache = self.bundle.init_cache(self.params, enc, self.max_target)
+            next_tok = jnp.full((bucket,), prompt[0], jnp.int32)
+            for tok in prompt[1:]:
+                _, cache = self._prime_jit(self.params, next_tok, cache)
+                next_tok = jnp.full((bucket,), tok, jnp.int32)
+            first, cache = self._prime_jit(self.params, next_tok, cache)
+            outs: List[List[int]] = [[] for _ in range(bucket)]
+            done = [False] * bucket
+            budget = min(self.max_new_tokens, self.max_target - len(prompt) - 1)
+            token = first
+            while not all(done[:n]):
+                step = np.asarray(token)
+                for i in range(n):
+                    if not done[i]:
+                        if int(step[i]) == self.eos_token_id or len(outs[i]) >= budget:
+                            done[i] = True
+                        else:
+                            outs[i].append(int(step[i]))
+                if all(done[:n]):
+                    break
+                chunk, cache = self._decode_chunk_batch_jit(
+                    self.params, token, cache
+                )                                               # [steps, B]
+                chunk_np = np.asarray(chunk)
+                for s_i in range(chunk_np.shape[0] - 1):
+                    for i in range(n):
+                        if done[i]:
+                            continue
+                        t = int(chunk_np[s_i, i])
+                        if t == self.eos_token_id or len(outs[i]) >= budget:
+                            done[i] = True
+                        else:
+                            outs[i].append(t)
+                token = jnp.asarray(chunk_np[-1], jnp.int32)
+        return outs[:n]
+
+    async def transcribe_ids_async(
+        self, pcm: np.ndarray, task: str = "transcribe"
+    ) -> List[int]:
+        """Batching front door: concurrent same-task utterances share one
+        encode/decode pass. Long audio submits each 30s window in order."""
+        self.prompt_ids(task)  # surface config errors even for empty audio
+        loop = asyncio.get_running_loop()
+        if self._pending is None or getattr(self, "_loop", None) is not loop:
+            # an asyncio.Queue is bound to its creating loop: rebind when the
+            # serving loop changes (tests, process-model restarts) or a put
+            # into the dead loop's queue would hang forever
+            self._pending = asyncio.Queue()
+            self._loop = loop
+            self._batch_task = None
+        pcm = np.asarray(pcm, np.float32).reshape(-1)
+        if len(pcm) == 0:
+            return []
+        ids: List[int] = []
+        for start in range(0, len(pcm), self.n_samples):
+            fut = loop.create_future()
+            await self._pending.put((pcm[start : start + self.n_samples], task, fut))
+            self._ensure_batch_loop()
+            ids.extend(await fut)
+        return ids
+
+    def _ensure_batch_loop(self) -> None:
+        if self._batch_task is None or self._batch_task.done():
+            self._batch_task = asyncio.get_running_loop().create_task(
+                self._batch_loop()
+            )
+
+    async def _batch_loop(self) -> None:
+        while True:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = await asyncio.wait_for(self._pending.get(), timeout=5.0)
+                except asyncio.TimeoutError:
+                    if self._pending.empty():
+                        return  # idle; a new submit restarts the loop
+                    continue
+            batch = [first]
+            deadline = (
+                asyncio.get_running_loop().time() + self._batch_delay
+            )
+            while len(batch) < self.max_batch:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._pending.get(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+                if item[1] != batch[0][1]:
+                    # different task (prompt ids differ): carry it to the
+                    # FRONT of the next round — re-queueing at the tail
+                    # could starve it under sustained same-task load
+                    self._carry = item
+                    break
+                batch.append(item)
+            pcms = [b[0] for b in batch]
+            futures = [b[2] for b in batch]
+            task = batch[0][1]
+            try:
+                prompt = self.prompt_ids(task)
+                outs = await asyncio.to_thread(self._transcribe_batch, pcms, prompt)
+                for fut, out in zip(futures, outs):
+                    if not fut.done():
+                        fut.set_result(out)
+            except Exception as ex:
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(ex)

@@ -679,7 +679,9 @@ class LLMEngineRequest(BaseEngineRequest):
     async def _audio_route(self, body, collect_fn, task: str, route: str):
         self._require_audio(route)
         pcm = self._audio_pcm(body)
-        ids = await asyncio.to_thread(self.audio.transcribe_ids, pcm, task)
+        # batching front door: concurrent same-task requests share one
+        # encode/decode pass (AudioCore micro-batcher)
+        ids = await self.audio.transcribe_ids_async(pcm, task)
         text = self.tokenizer.decode(ids)
         if collect_fn is not None:
             collect_fn(

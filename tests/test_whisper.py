@@ -327,3 +327,26 @@ def test_audio_text_response_and_bad_multipart(audio_served):
             await client.close()
 
     assert asyncio.run(fn())
+
+
+def test_audio_batched_matches_sequential(audio_served):
+    """Micro-batched transcription must produce the same tokens as the
+    per-utterance path, for concurrent requests of different audio."""
+    import asyncio
+
+    processor = audio_served._get_processor("tiny_whisper")
+    core = processor.audio
+
+    rng = np.random.RandomState(7)
+    pcms = [
+        (rng.rand(16000) - 0.5).astype(np.float32) * 0.4 for _ in range(3)
+    ]
+    sequential = [core.transcribe_ids(p, "transcribe") for p in pcms]
+
+    async def run():
+        return await asyncio.gather(
+            *[core.transcribe_ids_async(p, "transcribe") for p in pcms]
+        )
+
+    batched = asyncio.run(run())
+    assert batched == sequential
