@@ -1,0 +1,119 @@
+"""Microbench: Pallas paged-attention kernel vs XLA gather vs dense cache.
+
+Answers the standing question from ops/paged_attention.py's header: does the
+r2 multi-page double-buffered-DMA kernel beat the plain-XLA page gather (the
+r1 kernel lost, 4.3 vs 3.1 ms)?  Shapes match the r1 measurement so numbers
+are comparable: b=16 hkv=8 g=4 d=64, 16-token pages, 64 pages/seq, bf16
+pools, sequences half-full (512 tokens live of 1024 capacity).
+
+Contenders:
+- pallas[pb=N]   ops.paged_attention (r2 kernel), pages_per_block sweep
+- xla_gather     ops.paged_attention_xla (the fallback the kernel must beat)
+- dense          attention over a dense [B, Hkv, S, D] cache at the same
+                 occupancy — the no-paging baseline (wastes HBM capacity,
+                 not traffic, at this occupancy)
+
+Timing: the axon tunnel no-ops block_until_ready, so every timed section
+ends in a host readback that data-depends on the result (np.asarray).
+Prints one JSON line per contender plus a "winner" summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, HKV, G, D = 16, 8, 4, 64
+PAGE = 16
+PAGES_PER_SEQ = 64
+LIVE_TOKENS = PAGE * PAGES_PER_SEQ // 2  # half-full steady state
+ROUNDS = 50
+
+
+def _time(fn, *args, rounds=ROUNDS):
+    out = fn(*args)
+    np.asarray(out)  # warmup + compile, readback-synced
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        out = fn(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / rounds * 1e3  # ms
+
+
+def main() -> None:
+    from clearml_serving_tpu.ops import paged_attention as pa
+
+    platform = jax.devices()[0].platform
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 5)
+    n_pages = B * PAGES_PER_SEQ + 1
+    q = jax.random.normal(ks[0], (B, HKV, G, D), jnp.bfloat16)
+    k_pool = jax.random.normal(ks[1], (HKV, n_pages, PAGE, D), jnp.bfloat16)
+    v_pool = jax.random.normal(ks[2], (HKV, n_pages, PAGE, D), jnp.bfloat16)
+    page_table = jnp.arange(1, B * PAGES_PER_SEQ + 1, dtype=jnp.int32).reshape(
+        B, PAGES_PER_SEQ
+    )
+    lengths = jnp.full((B,), LIVE_TOKENS, jnp.int32)
+
+    results = {}
+
+    xla = jax.jit(pa.paged_attention_xla)
+    results["xla_gather"] = _time(xla, q, k_pool, v_pool, page_table, lengths)
+
+    if platform == "tpu":
+        for pb in (4, 8, 16, 32):
+            fn = jax.jit(
+                lambda q, k, v, pt, ln, pb=pb: pa.paged_attention(
+                    q, k, v, pt, ln, pages_per_block=pb
+                )
+            )
+            try:
+                results["pallas_pb{}".format(pb)] = _time(
+                    fn, q, k_pool, v_pool, page_table, lengths
+                )
+            except Exception as ex:  # record, keep sweeping
+                print(json.dumps({"contender": "pallas_pb{}".format(pb),
+                                  "error": str(ex)[:200]}))
+
+    # dense baseline: same live tokens in a dense cache (max capacity seq)
+    seq_cap = PAGE * PAGES_PER_SEQ
+    k_dense = jax.random.normal(ks[3], (B, HKV, seq_cap, D), jnp.bfloat16)
+    v_dense = jax.random.normal(ks[4], (B, HKV, seq_cap, D), jnp.bfloat16)
+
+    def dense_attn(q, k, v, lengths):
+        # q [B,Hkv,G,D]; masked flash-free softmax over full capacity
+        s = jnp.einsum("bhgd,bhsd->bhgs", q, k, preferred_element_type=jnp.float32)
+        s = s / np.sqrt(D)
+        mask = jnp.arange(seq_cap)[None, None, None, :] < lengths[:, None, None, None]
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhgs,bhsd->bhgd", p.astype(k.dtype), v, preferred_element_type=jnp.float32
+        ).astype(q.dtype)
+
+    results["dense_fullcap"] = _time(jax.jit(dense_attn), q, k_dense, v_dense, lengths)
+
+    for name, ms in results.items():
+        print(json.dumps({"contender": name, "ms": round(ms, 3),
+                          "platform": platform}))
+    best_pallas = min(
+        (v for k, v in results.items() if k.startswith("pallas")), default=None
+    )
+    summary = {
+        "metric": "paged_attention_decode_b16",
+        "platform": platform,
+        "xla_gather_ms": round(results["xla_gather"], 3),
+        "dense_ms": round(results["dense_fullcap"], 3),
+    }
+    if best_pallas is not None:
+        summary["best_pallas_ms"] = round(best_pallas, 3)
+        summary["pallas_vs_gather"] = round(results["xla_gather"] / best_pallas, 3)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -116,15 +116,19 @@ class EngineServer:
                     model_name, request.get("version"), sorted(self.repo.list_models())
                 ),
             )
+        # metric label = the repo's canonical key, not the client-supplied
+        # name: a model reachable under several names (with/without version
+        # suffix) must not split or mis-attribute its series
+        label = model.key
         if self.metrics is not None:
-            self.metrics.wire_batcher(model_name, model.batcher)
+            self.metrics.wire_batcher(label, model.batcher)
         inputs_by_name = request["inputs"]
         # order inputs per the endpoint spec; single-input models accept any name
         if model.input_names:
             try:
                 ordered = [inputs_by_name[name] for name in model.input_names]
             except KeyError as ex:
-                self._count(model_name, "bad_request")
+                self._count(label, "bad_request")
                 await context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     "missing input {} (expected {})".format(ex, model.input_names),
@@ -134,7 +138,7 @@ class EngineServer:
         try:
             outputs = await model.batcher.infer(ordered)
         except Exception as ex:
-            self._count(model_name, "error")
+            self._count(label, "error")
             await context.abort(
                 grpc.StatusCode.INTERNAL, "inference failed: {}".format(ex)
             )
@@ -143,9 +147,9 @@ class EngineServer:
             (names[i] if i < len(names) else "output_{}".format(i)): np.asarray(out)
             for i, out in enumerate(outputs)
         }
-        self._count(model_name, "ok")
+        self._count(label, "ok")
         if self.metrics is not None:
-            self.metrics.latency.labels(model=model_name).observe(
+            self.metrics.latency.labels(model=label).observe(
                 time.monotonic() - tic
             )
         return protocol.encode_infer_response(named)
@@ -223,12 +227,10 @@ async def serve(service_id: Optional[str] = None) -> None:
                     if dispatcher is not None:
                         # heartbeat: lets followers leave recv() and re-sync.
                         # Sent even when this host's sync flaked — follower
-                        # liveness must not depend on host-0 sync success
-                        from ..parallel import multihost
-
-                        await asyncio.to_thread(
-                            dispatcher.channel.send, multihost.OP_NOOP
-                        )
+                        # liveness must not depend on host-0 sync success.
+                        # Via the dispatcher so it serializes with in-flight
+                        # RUN broadcasts (ordering contract in multihost.py)
+                        await asyncio.to_thread(dispatcher.noop)
                 if requests_g is not None:
                     for name, info in repo.list_models().items():
                         requests_g.labels(model=name).set(info["requests_served"])
@@ -270,14 +272,23 @@ def serve_follower(service_id: Optional[str] = None) -> None:
     def resolve(key: str):
         model = repo.get_by_key(key)
         if model is None:
-            # host 0 may have loaded it after our last sync; a transient
-            # control-plane error here must NOT kill the follower — a dead
-            # participant hangs every subsequent host-0 broadcast
-            try:
-                repo.sync()
-            except Exception as ex:
-                print("follower sync error: {}".format(ex))
-            model = repo.get_by_key(key)
+            # host 0 may have loaded it after our last sync. Retry the sync
+            # a few times so one dropped control-plane packet isn't
+            # slice-fatal; only after retries is this a real desync, and
+            # follower_loop then fails LOUDLY (crash + supervisor restart)
+            # rather than silently skipping a broadcast step the rest of
+            # the slice is already inside (silent skip = undiagnosable
+            # collective deadlock).
+            for attempt in range(3):
+                try:
+                    repo.sync()
+                except Exception as ex:
+                    print("follower sync error (try {}): {}".format(attempt + 1, ex))
+                    time.sleep(0.5 * (attempt + 1))
+                    continue
+                model = repo.get_by_key(key)
+                if model is not None:
+                    break
         return model.run_batch if model is not None else None
 
     from ..parallel import multihost

@@ -12,8 +12,10 @@ compiles for TPU.
   exporter) -> the same JAX bundle.
 """
 
-# late import in load helpers to keep the package importable mid-build
-try:
+# Tolerate only the file-absent case (incremental builds); an ImportError
+# raised INSIDE onnx_import (broken transitive dep) must propagate, not
+# silently strip the symbol from the package.
+import importlib.util as _ilu
+
+if _ilu.find_spec(__name__ + ".onnx_import") is not None:
     from .onnx_import import load_onnx_bundle  # noqa: F401
-except ImportError:  # onnx_import not present yet during incremental builds
-    pass

@@ -218,6 +218,7 @@ class LLMEngineCore:
         # their reserved slot at the next chunk boundary (loop thread only)
         self._ready: "asyncio.Queue" = asyncio.Queue()
         self._admitting: set = set()
+        self._admission_tasks: set = set()  # strong refs; see _run_loop_inner
         self._wake: Optional[asyncio.Event] = None
 
         # -- compiled functions --------------------------------------------
@@ -675,9 +676,14 @@ class LLMEngineCore:
                     continue
                 slot = free.pop(0)
                 self._admitting.add(slot)
-                asyncio.get_running_loop().create_task(
+                # hold a strong ref: the loop keeps only weak refs to tasks,
+                # so an unreferenced admission could be GC'd mid-flight,
+                # leaving the slot stuck in _admitting forever
+                task = asyncio.get_running_loop().create_task(
                     self._admission_task(request, slot)
                 )
+                self._admission_tasks.add(task)
+                task.add_done_callback(self._admission_tasks.discard)
             # commit finished prefills (loop thread; between decode chunks)
             while not self._ready.empty():
                 request, slot, first_id, mini_cache = self._ready.get_nowait()

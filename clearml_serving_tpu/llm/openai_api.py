@@ -447,6 +447,13 @@ class LLMEngineRequest(BaseEngineRequest):
             ],
         }
 
+    async def version(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        """The 13th OpenAI route type (reference preprocess_service.py:890
+        ``show_version`` → GET /serve/openai/version)."""
+        from ..version import __version__
+
+        return {"version": __version__}
+
     @property
     def _max_model_len(self) -> int:
         core = self.engine if self.engine is not None else self.encoder

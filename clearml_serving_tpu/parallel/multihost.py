@@ -112,6 +112,19 @@ class HostZeroDispatcher:
             self.channel.send(OP_RUN, pickle.dumps((key, inputs)))
             return fn(inputs)
 
+    def noop(self) -> None:
+        """Heartbeat broadcast, ordered with respect to run()/stop().
+
+        run() releases the channel's send lock before entering the executable
+        (still inside _order_lock), so a raw ``channel.send(OP_NOOP)`` from
+        another thread could slot its psum between a RUN broadcast and the
+        executable's own collectives — host 0 and the followers would then
+        enqueue device work in different orders and deadlock the slice.
+        """
+        if self._multi:
+            with self._order_lock:
+                self.channel.send(OP_NOOP)
+
     def stop(self) -> None:
         if self._multi:
             # under the order lock: a queued dispatch must not broadcast
@@ -128,10 +141,13 @@ def follower_loop(
     """Secondary-controller main loop: replay host-0's steps until OP_STOP.
 
     ``resolve(key)`` returns the callable for a broadcast step (e.g. the
-    repo model's run_batch) or None if this host hasn't synced it yet — in
-    which case the step is skipped locally, which is only safe for models
-    whose executables contain no cross-host collectives; mismatch with
-    host 0 otherwise deadlocks, so followers sync the repo BEFORE joining.
+    repo model's run_batch) or None if this host could not materialize the
+    model even after a re-sync. None is a FATAL desync: host 0 is already
+    entering the executable, and if it contains cross-host collectives a
+    silently-skipping follower hangs the whole slice with no diagnostic.
+    We fail loudly instead — raise, crash this controller, and let the
+    supervisor restart it into a fresh sync (same crash-and-restart policy
+    as HBM OOM; the hang becomes a visible, attributable failure).
     """
     chan = channel or BroadcastChannel()
     while True:
@@ -143,7 +159,12 @@ def follower_loop(
         key, inputs = pickle.loads(payload)
         fn = resolve(key)
         if fn is None:
-            continue
+            raise RuntimeError(
+                "follower desync: host 0 dispatched model {!r} but this host "
+                "cannot resolve it after re-sync; refusing to silently skip a "
+                "broadcast step (slice would deadlock on any cross-host "
+                "collective). Restart this controller to re-join.".format(key)
+            )
         try:
             fn(inputs)
         except BaseException as ex:  # a follower must never desync the loop

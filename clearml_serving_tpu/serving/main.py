@@ -211,6 +211,12 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
         if tail.startswith("openai/"):
             # OpenAI-compatible: serve type is the path, endpoint is body.model
             serve_type = tail[len("openai/"):]
+            if serve_type == "version":
+                # model-independent (reference show_version): answer without
+                # requiring a body/model so plain GET works
+                from ..version import __version__
+
+                return web.json_response({"version": __version__})
             if not isinstance(body, dict) or not body.get("model"):
                 return web.json_response(
                     {"detail": "OpenAI route requires a JSON body with a 'model' field"},

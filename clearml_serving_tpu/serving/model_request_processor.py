@@ -54,7 +54,9 @@ from ..version import __version__
 
 
 # serve-type dispatch allowlist: v1_chat_completions, v2_embeddings, ...
-_SERVE_TYPE_RE = re.compile(r"^v\d+_[a-z][a-z0-9_]*$")
+# versioned API handler names, plus the bare "version" route (the reference's
+# show_version, preprocess_service.py:890 / :1218)
+_SERVE_TYPE_RE = re.compile(r"^(v\d+_[a-z][a-z0-9_]*|version)$")
 
 
 class EndpointNotFoundException(Exception):

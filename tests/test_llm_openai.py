@@ -115,13 +115,24 @@ def test_completions_and_tokenize(llm_served):
             "/serve/openai/v1/models", json={"model": "tiny_llm"}
         )
         mods = await r.json()
-        return comp, tok, detok, mods
 
-    comp, tok, detok, mods = _run(llm_served, fn)
+        # model-independent route: plain GET with no body must work
+        # (reference show_version), as must the body-carrying POST form
+        r = await client.get("/serve/openai/version")
+        ver = await r.json()
+        r = await client.post("/serve/openai/version", json={"model": "tiny_llm"})
+        ver_post = await r.json()
+        assert ver_post == ver
+        return comp, tok, detok, mods, ver
+
+    comp, tok, detok, mods, ver = _run(llm_served, fn)
     assert comp["object"] == "text_completion"
     assert tok["count"] == 4  # bos + 3 bytes
     assert detok["prompt"] == "abc"
     assert mods["data"][0]["id"] == "tiny_llm"
+    from clearml_serving_tpu.version import __version__
+
+    assert ver == {"version": __version__}
 
 
 def test_unsupported_capability(llm_served):
