@@ -7,7 +7,8 @@ Capability parity with the reference's StatisticsController
   (endpoint, variable), named ``{endpoint}:{variable}`` sanitized;
 - reserved variables: ``_latency`` → histogram with the reference's 5ms…5s
   buckets, ``_count`` → counter (weighted by the sampling-unbias factor);
-- metric-spec types: scalar → bucketed Histogram, enum → labeled Counter,
+- metric-spec types: scalar → bucketed Histogram, enum → EnumHistogram over
+  the declared buckets (labeled-Counter fallback when no buckets declared),
   value → Gauge, counter → Counter;
 - endpoints it doesn't know get auto-added with reserved-only logging and a
   throttled config re-sync;
@@ -39,6 +40,55 @@ _name_re = re.compile(r"[^a-zA-Z0-9_]")
 
 def _sanitize(name: str) -> str:
     return _name_re.sub("_", name)
+
+
+class EnumHistogram:
+    """Reference-parity enum histogram (reference statistics/metrics.py:64-185).
+
+    Exports a histogram-typed family with one NON-cumulative
+    ``{name}_bucket{enum="<value>"}`` series per **declared** enum value (in
+    declared order — the bucket set and ordering come from the metric spec,
+    not from whichever values happen to arrive first) plus ``{name}_sum`` =
+    total observations. Values outside the declared set are dropped, matching
+    the reference's fixed-bucket contract. Enum specs below the two-bucket
+    minimum fall back to a value-labeled Counter (dynamic value set) — see
+    StatisticsController._collector.
+    """
+
+    def __init__(self, name: str, documentation: str, buckets, registry=REGISTRY):
+        buckets = [str(b) for b in buckets]
+        if len(buckets) < 2:
+            raise ValueError("enum histogram needs at least two declared buckets")
+        self._name = name
+        self._documentation = documentation
+        self._buckets = {b: 0.0 for b in buckets}  # insertion = declared order
+        self._sum = 0.0
+        self._lock = threading.Lock()
+        if registry is not None:
+            registry.register(self)
+
+    def observe(self, value) -> None:
+        v = str(value)
+        with self._lock:
+            if v not in self._buckets:
+                return
+            self._buckets[v] += 1.0
+            self._sum += 1.0
+
+    def collect(self):
+        from prometheus_client.core import Metric
+
+        metric = Metric(self._name, self._documentation, "histogram")
+        with self._lock:
+            for bucket, acc in self._buckets.items():
+                metric.add_sample(
+                    self._name + "_bucket", {"enum": bucket}, acc
+                )
+            metric.add_sample(self._name + "_sum", {}, self._sum)
+        return [metric]
+
+    def describe(self):
+        return self.collect()
 
 
 class StatisticsController:
@@ -129,10 +179,20 @@ class StatisticsController:
                     buckets=buckets, registry=self._registry,
                 ))
             elif mtype == "enum":
-                collector = ("enum", Counter(
-                    full_name, "enum {} for {}".format(variable, url),
-                    labelnames=("value",), registry=self._registry,
-                ))
+                declared = [str(b) for b in (spec.get("buckets") or [])]
+                if len(declared) >= 2:
+                    # declared bucket set -> reference-parity EnumHistogram
+                    # (fixed buckets, declared ordering)
+                    collector = ("enum_hist", EnumHistogram(
+                        full_name, "enum {} for {}".format(variable, url),
+                        declared, registry=self._registry,
+                    ))
+                else:
+                    # spec-less enum: dynamic value set via labeled Counter
+                    collector = ("enum", Counter(
+                        full_name, "enum {} for {}".format(variable, url),
+                        labelnames=("value",), registry=self._registry,
+                    ))
             elif mtype == "counter":
                 collector = ("counter", Counter(
                     full_name, "counter {} for {}".format(variable, url),
@@ -156,6 +216,8 @@ class StatisticsController:
             try:
                 if kind == "histogram":
                     collector.observe(float(v))
+                elif kind == "enum_hist":
+                    collector.observe(v)
                 elif kind == "enum":
                     collector.labels(value=str(v)).inc()
                 elif kind == "counter":

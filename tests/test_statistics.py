@@ -68,9 +68,50 @@ def test_statistics_controller(tmp_path, state_root):
     assert _get_sample(registry, "ep1__latency", "_count") == 2.0
     assert _get_sample(registry, "ep1__count", "_total") == 20.0
     assert _get_sample(registry, "ep1_x0", "_count") == 3.0  # list observed per-value
-    assert _get_sample(registry, "ep1_label", "_total", {"value": "cat"}) == 1.0
+    # declared-bucket enum -> reference-parity EnumHistogram export shape
+    assert _get_sample(registry, "ep1_label", "_bucket", {"enum": "cat"}) == 1.0
+    assert _get_sample(registry, "ep1_label", "_bucket", {"enum": "dog"}) == 1.0
+    assert _get_sample(registry, "ep1_label", "_sum") == 2.0
     assert _get_sample(registry, "ep1_conf") == 0.4  # gauge keeps last
     assert _get_sample(registry, "ep1_hits", "_total") == 5.0
+
+
+def test_enum_histogram_semantics(tmp_path, state_root):
+    """Declared buckets fix the exported set and ordering (reference
+    EnumHistogram); undeclared values are dropped; spec-less enums fall
+    back to the labeled Counter."""
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="se")
+    mrp.add_metric_logging(
+        EndpointMetricLogging(
+            endpoint="ep2",
+            metrics={
+                "cls": MetricType(type="enum", buckets=["a", "b", "c"]),
+                # single declared bucket: below EnumHistogram's 2-bucket
+                # minimum (matches reference), falls back to labeled Counter
+                "free": MetricType(type="enum", buckets=["only"]),
+            },
+        )
+    )
+    mrp.serialize()
+    registry = CollectorRegistry()
+    ctl = StatisticsController(
+        "file://{}".format(tmp_path / "b"), processor=mrp, registry=registry
+    )
+    ctl.sync_specs()
+    ctl.process_batch(
+        [
+            {"_url": "ep2", "cls": "b", "free": "anything"},
+            {"_url": "ep2", "cls": ["b", "zzz"], "free": "other"},
+        ]
+    )
+    assert _get_sample(registry, "ep2_cls", "_bucket", {"enum": "a"}) == 0.0
+    assert _get_sample(registry, "ep2_cls", "_bucket", {"enum": "b"}) == 2.0
+    assert _get_sample(registry, "ep2_cls", "_sum") == 2.0  # "zzz" dropped
+    # undeclared value has no series at all (fixed bucket set)
+    assert _get_sample(registry, "ep2_cls", "_bucket", {"enum": "zzz"}) is None
+    # sub-minimum bucket list keeps the dynamic labeled-Counter shape
+    assert _get_sample(registry, "ep2_free", "_total", {"value": "anything"}) == 1.0
+    assert _get_sample(registry, "ep2_free", "_total", {"value": "other"}) == 1.0
 
 
 def test_unknown_endpoint_reserved_only(tmp_path, state_root):
