@@ -76,6 +76,68 @@ class GenRequest:
 _FINISHED = object()
 
 
+class _PrefillGate:
+    """Decode-first chunked-prefill scheduling policy.
+
+    Admission prefills run in worker threads concurrently with decode
+    chunks, but every dispatch lands in the SAME device queue — an unpaced
+    long-prompt segment train would enqueue ahead of the next decode chunk
+    and blow up the decoding requests' inter-token latency. The gate bounds
+    the interleave: while decode is active, at most ``segments_per_chunk``
+    prefill dispatches may enter the queue per decode chunk (the decode loop
+    ``deposit()``s that many permits after each chunk; admission threads
+    ``acquire()`` one per prefill dispatch).
+
+    ``stall_timeout`` is the prefill-starvation bound in the other
+    direction: if decode stops depositing (loop stalled on commits or
+    emission), a waiting prefill proceeds anyway after this many seconds —
+    admission can be slowed by decode, never parked indefinitely. The
+    default must comfortably EXCEED one decode-chunk duration (~90 ms
+    dispatch overhead alone on a tunneled TPU, plus device time), or
+    permit-exhausted segments would time out past the gate mid-chunk and
+    silently void the segments_per_chunk bound; it only ever bites when the
+    loop is wedged, so seconds-scale is correct.
+    """
+
+    def __init__(self, segments_per_chunk: int = 2, stall_timeout: float = 2.0):
+        self._spc = max(1, int(segments_per_chunk))
+        self._stall_timeout = float(stall_timeout)
+        self._cond = threading.Condition()
+        self._permits = self._spc
+        self._active = False
+
+    def set_active(self, active: bool) -> None:
+        """Loop thread: decode has (in)active slots; inactive opens the gate."""
+        with self._cond:
+            self._active = bool(active)
+            if not self._active:
+                self._permits = self._spc
+                self._cond.notify_all()
+
+    def deposit(self) -> None:
+        """Loop thread: a decode chunk completed — refresh the permit budget.
+
+        Permits are SET, not accumulated: idle decode periods must not bank
+        an unbounded burst allowance for a later admission."""
+        with self._cond:
+            self._permits = self._spc
+            self._cond.notify_all()
+
+    def acquire(self) -> None:
+        """Admission thread: blocks (boundedly) before one prefill dispatch."""
+        with self._cond:
+            if not self._active:
+                return
+            if self._permits <= 0:
+                self._cond.wait_for(
+                    lambda: self._permits > 0 or not self._active,
+                    timeout=self._stall_timeout,
+                )
+            if self._permits > 0:
+                self._permits -= 1
+            # timed out with no permit: proceed — starvation bound
+
+
 class LLMEngineCore:
     """Slot-based continuous batching over a dense per-slot KV cache."""
 
@@ -98,6 +160,8 @@ class LLMEngineCore:
         long_prefill_threshold: Optional[int] = None,
         long_bucket_step: Optional[int] = None,
         chunked_prefill_size: Optional[int] = None,
+        prefill_segments_per_decode: Optional[int] = 2,
+        prefill_stall_timeout: Optional[float] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -219,6 +283,19 @@ class LLMEngineCore:
         self._ready: "asyncio.Queue" = asyncio.Queue()
         self._admitting: set = set()
         self._admission_tasks: set = set()  # strong refs; see _run_loop_inner
+        # decode-first prefill pacing (None/0 disables the policy)
+        self._prefill_gate = (
+            _PrefillGate(
+                int(prefill_segments_per_decode),
+                **(
+                    {"stall_timeout": float(prefill_stall_timeout)}
+                    if prefill_stall_timeout
+                    else {}
+                ),
+            )
+            if prefill_segments_per_decode
+            else None
+        )
         self._wake: Optional[asyncio.Event] = None
 
         # -- compiled functions --------------------------------------------
@@ -467,6 +544,10 @@ class LLMEngineCore:
                     if seg_i == 0
                     else self._prefill_chunk_jit
                 )
+                if self._prefill_gate is not None:
+                    # pace the segment train against decode chunks so the
+                    # device queue interleaves instead of bursting
+                    self._prefill_gate.acquire()
                 last_logits, cache = fn(
                     self.params,
                     jnp.asarray(seg_tokens),
@@ -478,6 +559,8 @@ class LLMEngineCore:
             mini_cache = cache
         else:
             prefill_fn = self._prefill_ring_jit if use_ring else self._prefill_jit
+            if self._prefill_gate is not None:
+                self._prefill_gate.acquire()
             last_logits, mini_cache = prefill_fn(
                 self.params, jnp.asarray(tokens), seq_lens, template
             )
@@ -641,6 +724,9 @@ class LLMEngineCore:
             self._drain_ready(ex)
             raise
         finally:
+            if self._prefill_gate is not None:
+                # no decode loop -> nothing to pace against; unblock waiters
+                self._prefill_gate.set_active(False)
             if self._stopped:
                 # catch requests admitted while stop() was racing the loop
                 # (popped from _pending before stop drained it)
@@ -693,6 +779,9 @@ class LLMEngineCore:
                     continue
                 self._commit_admission(request, slot, first_id, mini_cache)
             active_mask = np.array([r is not None for r in self._slot_req])
+            if self._prefill_gate is not None:
+                # open the gate while decode idles; pace prefills while active
+                self._prefill_gate.set_active(bool(active_mask.any()))
             if not active_mask.any():
                 if (
                     self._pending.empty()
@@ -734,6 +823,9 @@ class LLMEngineCore:
                     self._next_rng(),
                 )
                 chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
+            if self._prefill_gate is not None:
+                # decode chunk done: grant the next prefill-dispatch budget
+                self._prefill_gate.deposit()
             for slot in np.nonzero(active_mask)[0]:
                 self._next_token[slot] = int(chunk_np[slot, -1])
                 for token_id in chunk_np[slot]:

@@ -164,6 +164,10 @@ class LLMEngineRequest(BaseEngineRequest):
             long_prefill_threshold=engine_cfg.get("long_prefill_threshold"),
             long_bucket_step=engine_cfg.get("long_bucket_step"),
             chunked_prefill_size=engine_cfg.get("chunked_prefill"),
+            prefill_segments_per_decode=engine_cfg.get(
+                "prefill_segments_per_decode", 2
+            ),
+            prefill_stall_timeout=engine_cfg.get("prefill_stall_timeout"),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine
