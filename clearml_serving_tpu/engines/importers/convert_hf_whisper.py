@@ -115,6 +115,12 @@ def prompt_ids_from_tokenizer(tok, language: Optional[str] = None) -> dict:
     notimestamps = tid("<|notimestamps|>")
     lang = tid("<|{}|>".format(language)) if language else None
     out = {"eos_token_id": int(tok.eos_token_id)}
+    if notimestamps is not None:
+        # timestamp vocabulary starts right after <|notimestamps|>; each id
+        # encodes (id - begin) * 0.02 s — enables verbose_json segments
+        out["notimestamps_token_id"] = notimestamps
+        out["timestamp_begin"] = notimestamps + 1
+        out["time_precision"] = 0.02
     for task in ("transcribe", "translate"):
         task_id = tid("<|{}|>".format(task))
         ids = [x for x in (sot, lang, task_id, notimestamps) if x is not None]

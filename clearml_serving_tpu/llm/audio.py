@@ -5,14 +5,18 @@ host-side mel frontend (ops/audio.py), one jitted encoder executable per
 fixed 30s chunk shape, and greedy decode as fused multi-step ``lax.scan``
 chunks (the llm engine's dispatch-amortization trick — decode_steps tokens
 per host round-trip). Long audio transcribes chunk-by-chunk, concatenating
-text (OpenAI Whisper's sequential 30s windows, minus timestamp conditioning).
+text (OpenAI Whisper's sequential 30s windows). verbose_json responses use
+timestamp-conditioned decoding — the well-formedness rules run in-graph
+inside the scan — and a host-side parser turns the marker tokens into
+segments (reference preprocess_service.py:1031-1075 delegates this to vLLM).
 """
 
 from __future__ import annotations
 
 import asyncio
 import threading
-from typing import Any, List, Optional
+from functools import partial
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -102,13 +106,110 @@ class AudioCore:
 
         self._prime_jit = jax.jit(_prime, donate_argnums=(2,))
 
-    def prompt_ids(self, task: str) -> List[int]:
+        # -- timestamp-conditioned decoding (verbose_json segments) ----------
+        # Whisper emits time markers as vocabulary ids >= timestamp_begin
+        # ((id - begin) * time_precision seconds) when the prompt OMITS
+        # <|notimestamps|>. The well-formedness rules (OpenAI's decoding
+        # constraints, mirrored by HF's WhisperTimeStampLogitsProcessor) run
+        # IN-GRAPH inside the fused decode scan so segment structure is
+        # guaranteed without per-token host round-trips.
+        self.timestamp_begin = (
+            int(cfg["timestamp_begin"]) if cfg.get("timestamp_begin") else None
+        )
+        self.notimestamps_id = (
+            int(cfg["notimestamps_token_id"])
+            if cfg.get("notimestamps_token_id") is not None
+            else None
+        )
+        self.time_precision = float(cfg.get("time_precision", 0.02))
+        self._decode_chunk_ts_jit = None
+        if self.timestamp_begin is not None:
+            ts_begin = self.timestamp_begin
+            eos = self.eos_token_id
+            vocab = int(cfg["vocab_size"])
+            max_initial = int(cfg.get("max_initial_timestamp_index", 50))
+            ids = jnp.arange(vocab)
+            is_ts = ids >= ts_begin
+            text_not_eos = (~is_ts) & (ids != eos)
+            neg = jnp.float32(-1e30)
+
+            def _ts_body(params, carry, step):
+                # pen_is_ts is the pairing state of the SAMPLED sequence:
+                # initialized True because with fewer than two sampled
+                # tokens the "penultimate" defaults to timestamp (HF's
+                # len<2 case) — so the forced initial marker is a COMPLETED
+                # pair and text must follow, never a second marker
+                token, pen_is_ts, max_ts, cache = carry
+                logits, cache = bundle.decode(params, token, cache)
+                lg = logits.astype(jnp.float32)
+                last_was = (token >= ts_begin)[:, None]
+                pen_was = pen_is_ts[:, None]
+                # a completed <|t|><|t|> pair -> next must be text
+                lg = jnp.where(last_was & pen_was & is_ts[None, :], neg, lg)
+                # a single open timestamp -> next must be its pair or EOS
+                lg = jnp.where(
+                    last_was & (~pen_was) & text_not_eos[None, :], neg, lg
+                )
+                # monotonic: the pair's second element may repeat the value,
+                # otherwise timestamps strictly increase
+                bound = jnp.where(
+                    (token >= ts_begin) & ~pen_is_ts, max_ts, max_ts + 1
+                )
+                lg = jnp.where(
+                    is_ts[None, :] & (ids[None, :] < bound[:, None]), neg, lg
+                )
+                # first sampled token is a timestamp near the window start
+                first = step == 0
+                lg = jnp.where(first & (~is_ts)[None, :], neg, lg)
+                lg = jnp.where(
+                    first & (ids > ts_begin + max_initial)[None, :], neg, lg
+                )
+                # if total timestamp mass beats every text token, force a
+                # timestamp (computed AFTER the structural masks, so a
+                # forbidden timestamp can never be forced back in)
+                lp = jax.nn.log_softmax(lg, axis=-1)
+                ts_lse = jax.nn.logsumexp(
+                    jnp.where(is_ts[None, :], lp, neg), axis=-1
+                )
+                max_text = jnp.max(jnp.where(is_ts[None, :], neg, lp), axis=-1)
+                force = (ts_lse > max_text)[:, None]
+                lg = jnp.where(force & (~is_ts)[None, :], neg, lg)
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                new_max = jnp.where(nxt >= ts_begin, nxt, max_ts)
+                # leaving step 0 the sampled length is 1, so the penultimate
+                # stays "timestamp" (len<2 default); afterwards it tracks
+                # the previously sampled token
+                new_pen = jnp.where(step == 0, True, token >= ts_begin)
+                return (nxt, new_pen, new_max, cache), nxt
+
+            def _decode_chunk_ts(params, token, pen_is_ts, max_ts, cache, start_step):
+                (token, pen_is_ts, max_ts, cache), toks = jax.lax.scan(
+                    partial(_ts_body, params),
+                    (token, pen_is_ts, max_ts, cache),
+                    start_step + jnp.arange(self.decode_steps),
+                )
+                return toks, token, pen_is_ts, max_ts, cache
+
+            self._decode_chunk_ts_jit = jax.jit(
+                _decode_chunk_ts, donate_argnums=(4,)
+            )
+
+    def prompt_ids(self, task: str, timestamps: bool = False) -> List[int]:
         ids = self._prompts.get(task) or self._prompts.get("transcribe") or []
         if not ids:
             raise ValueError(
                 "bundle carries no decoder prompt ids for task {!r} (convert "
                 "with engines/importers/convert_hf_whisper.py)".format(task)
             )
+        if timestamps:
+            if self._decode_chunk_ts_jit is None:
+                raise ValueError(
+                    "bundle carries no timestamp vocabulary (re-convert with "
+                    "a tokenizer that has <|notimestamps|> to enable "
+                    "verbose_json segments)"
+                )
+            # timestamps flow when the prompt OMITS <|notimestamps|>
+            ids = [i for i in ids if i != self.notimestamps_id]
         return ids
 
     def _transcribe_chunk(self, pcm: np.ndarray, prompt: List[int]) -> List[int]:
@@ -125,6 +226,114 @@ class AudioCore:
             ids.extend(self._transcribe_chunk(pcm[start : start + self.n_samples], prompt))
         return ids
 
+    def parse_segments(
+        self, window_ids: List[List[int]], duration: float
+    ) -> List[dict]:
+        """Timestamp-token streams (one per fixed window) -> verbose_json
+        segments. A segment is text bracketed by <|t0|> ... <|t1|>; the
+        closing/opening pair between segments shares the value. Windows
+        advance by the fixed chunk length (the serving path transcribes
+        fixed 30s windows rather than seek-to-last-timestamp)."""
+        ts_begin = self.timestamp_begin
+        precision = self.time_precision
+        window_s = float(self.chunk_length)
+        segments: List[dict] = []
+        for w, ids in enumerate(window_ids):
+            offset = w * window_s
+            window_end = min(duration, offset + window_s)
+            cur_start: Optional[float] = None
+            cur_tokens: List[int] = []
+            for t in ids:
+                if ts_begin is not None and t >= ts_begin:
+                    # markers emitted in the window's zero-padded region must
+                    # not place segments past the end of the actual audio
+                    mark = min((t - ts_begin) * precision + offset, window_end)
+                    if cur_tokens:
+                        segments.append(
+                            {"start": cur_start, "end": mark, "tokens": cur_tokens}
+                        )
+                        cur_tokens = []
+                    cur_start = mark
+                else:
+                    if cur_start is None:
+                        cur_start = offset  # malformed head: anchor to window
+                    cur_tokens.append(t)
+            if cur_tokens:  # unterminated tail: close at the window edge
+                segments.append(
+                    {"start": cur_start, "end": window_end, "tokens": cur_tokens}
+                )
+        out = []
+        for i, seg in enumerate(segments):
+            out.append(
+                {
+                    "id": i,
+                    "seek": int(seg["start"] // window_s * window_s * 100),
+                    "start": round(float(seg["start"]), 2),
+                    "end": round(float(seg["end"]), 2),
+                    "tokens": seg["tokens"],
+                }
+            )
+        return out
+
+    def _encode_and_prime(self, pcms: List[np.ndarray], prompt: List[int]):
+        """Shared admission preamble (caller must hold self._lock): mel
+        batch -> encoder -> cache primed with all but the LAST prompt token.
+        Returns (bucket, last_prompt_token [B], cache)."""
+        from ..ops.audio import log_mel_spectrogram
+
+        bucket = self._batch_bucket(len(pcms))
+        mels = np.zeros((bucket, self.n_mels, self._frames), np.float32)
+        for i, pcm in enumerate(pcms):
+            mels[i] = log_mel_spectrogram(
+                pcm, self.mel_filters, n_fft=self.n_fft,
+                hop_length=self.hop_length, n_samples=self.n_samples,
+            )[:, : self._frames]
+        enc = self._encode_jit(self.params, jnp.asarray(mels))
+        cache = self.bundle.init_cache(self.params, enc, self.max_target)
+        next_tok = jnp.full((bucket,), prompt[0], jnp.int32)
+        for tok in prompt[1:]:
+            _, cache = self._prime_jit(self.params, next_tok, cache)
+            next_tok = jnp.full((bucket,), tok, jnp.int32)
+        return bucket, next_tok, cache
+
+    def _transcribe_batch_ts(
+        self, pcms: List[np.ndarray], prompt: List[int]
+    ) -> List[List[int]]:
+        """Timestamp-conditioned variant of _transcribe_batch: the final
+        prompt token feeds the rules-constrained scan directly (its very
+        first sample must already obey the initial-timestamp rule), and the
+        outputs KEEP timestamp tokens for the segment parser."""
+        n = len(pcms)
+        with self._lock:
+            bucket, token, cache = self._encode_and_prime(pcms, prompt)
+            # sampled-sequence pairing state; True = len<2 default (see
+            # _ts_body)
+            pen_is_ts = jnp.ones((bucket,), bool)
+            max_ts = jnp.full((bucket,), self.timestamp_begin - 1, jnp.int32)
+            outs: List[List[int]] = [[] for _ in range(bucket)]
+            done = [False] * bucket
+            budget = min(self.max_new_tokens, self.max_target - len(prompt) - 1)
+            step = 0
+            while not all(done[:n]) and step < budget:
+                toks, token, pen_is_ts, max_ts, cache = self._decode_chunk_ts_jit(
+                    self.params, token, pen_is_ts, max_ts, cache,
+                    jnp.asarray(step, jnp.int32),
+                )
+                chunk_np = np.asarray(toks)  # [steps, B]
+                for s_i in range(chunk_np.shape[0]):
+                    if step + s_i >= budget:
+                        break
+                    for i in range(n):
+                        if done[i]:
+                            continue
+                        t = int(chunk_np[s_i, i])
+                        if t == self.eos_token_id:
+                            done[i] = True
+                        else:
+                            outs[i].append(t)
+                step += chunk_np.shape[0]
+        return outs[:n]
+
     # -- cross-request batching ------------------------------------------------
 
     def _batch_bucket(self, n: int) -> int:
@@ -140,23 +349,9 @@ class AudioCore:
         utterance token ids. One encode + one greedy loop over the batch;
         finished sequences keep stepping (masked host-side) until all hit
         eos or the budget."""
-        from ..ops.audio import log_mel_spectrogram
-
         n = len(pcms)
-        bucket = self._batch_bucket(n)
-        mels = np.zeros((bucket, self.n_mels, self._frames), np.float32)
-        for i, pcm in enumerate(pcms):
-            mels[i] = log_mel_spectrogram(
-                pcm, self.mel_filters, n_fft=self.n_fft,
-                hop_length=self.hop_length, n_samples=self.n_samples,
-            )[:, : self._frames]
         with self._lock:
-            enc = self._encode_jit(self.params, jnp.asarray(mels))
-            cache = self.bundle.init_cache(self.params, enc, self.max_target)
-            next_tok = jnp.full((bucket,), prompt[0], jnp.int32)
-            for tok in prompt[1:]:
-                _, cache = self._prime_jit(self.params, next_tok, cache)
-                next_tok = jnp.full((bucket,), tok, jnp.int32)
+            bucket, next_tok, cache = self._encode_and_prime(pcms, prompt)
             first, cache = self._prime_jit(self.params, next_tok, cache)
             outs: List[List[int]] = [[] for _ in range(bucket)]
             done = [False] * bucket
@@ -188,12 +383,14 @@ class AudioCore:
                 token = jnp.asarray(chunk_np[-1], jnp.int32)
         return outs[:n]
 
-    async def transcribe_ids_async(
-        self, pcm: np.ndarray, task: str = "transcribe"
-    ) -> List[int]:
-        """Batching front door: concurrent same-task utterances share one
-        encode/decode pass. Long audio submits each 30s window in order."""
-        self.prompt_ids(task)  # surface config errors even for empty audio
+    async def transcribe_windows_async(
+        self, pcm: np.ndarray, task: str = "transcribe", timestamps: bool = False
+    ) -> List[List[int]]:
+        """Batching front door: concurrent utterances with the same
+        (task, timestamps) key share one encode/decode pass. Long audio
+        submits each 30s window in order; returns PER-WINDOW token lists
+        (the segment parser needs window boundaries for time offsets)."""
+        self.prompt_ids(task, timestamps)  # surface config errors early
         loop = asyncio.get_running_loop()
         if self._pending is None or getattr(self, "_loop", None) is not loop:
             # an asyncio.Queue is bound to its creating loop: rebind when the
@@ -205,13 +402,21 @@ class AudioCore:
         pcm = np.asarray(pcm, np.float32).reshape(-1)
         if len(pcm) == 0:
             return []
-        ids: List[int] = []
+        key: Tuple[str, bool] = (task, bool(timestamps))
+        windows: List[List[int]] = []
         for start in range(0, len(pcm), self.n_samples):
             fut = loop.create_future()
-            await self._pending.put((pcm[start : start + self.n_samples], task, fut))
+            await self._pending.put((pcm[start : start + self.n_samples], key, fut))
             self._ensure_batch_loop()
-            ids.extend(await fut)
-        return ids
+            windows.append(await fut)
+        return windows
+
+    async def transcribe_ids_async(
+        self, pcm: np.ndarray, task: str = "transcribe"
+    ) -> List[int]:
+        """Flattened-token front door (plain text responses)."""
+        windows = await self.transcribe_windows_async(pcm, task)
+        return [t for w in windows for t in w]
 
     def _ensure_batch_loop(self) -> None:
         if self._batch_task is None or self._batch_task.done():
@@ -251,10 +456,11 @@ class AudioCore:
                 batch.append(item)
             pcms = [b[0] for b in batch]
             futures = [b[2] for b in batch]
-            task = batch[0][1]
+            task, with_ts = batch[0][1]
             try:
-                prompt = self.prompt_ids(task)
-                outs = await asyncio.to_thread(self._transcribe_batch, pcms, prompt)
+                prompt = self.prompt_ids(task, with_ts)
+                fn = self._transcribe_batch_ts if with_ts else self._transcribe_batch
+                outs = await asyncio.to_thread(fn, pcms, prompt)
                 for fut, out in zip(futures, outs):
                     if not fut.done():
                         fut.set_result(out)

@@ -690,15 +690,29 @@ class LLMEngineRequest(BaseEngineRequest):
     async def _audio_route(self, body, collect_fn, task: str, route: str):
         self._require_audio(route)
         pcm = self._audio_pcm(body)
-        # batching front door: concurrent same-task requests share one
-        # encode/decode pass (AudioCore micro-batcher)
-        ids = await self.audio.transcribe_ids_async(pcm, task)
-        text = self.tokenizer.decode(ids)
+        duration = round(len(pcm) / self.audio.sampling_rate, 3)
+        verbose = body.get("response_format") == "verbose_json"
+        # verbose_json decodes WITH timestamp conditioning (segments need
+        # the marker tokens); the plain paths keep the faster
+        # <|notimestamps|> prompt. Bundles converted before the timestamp
+        # vocabulary was recorded fall back to text-only verbose output.
+        with_ts = verbose and self.audio.timestamp_begin is not None
+        # batching front door: concurrent same-(task, timestamps) requests
+        # share one encode/decode pass (AudioCore micro-batcher)
+        windows = await self.audio.transcribe_windows_async(
+            pcm, task, timestamps=with_ts
+        )
+        ids = [t for w in windows for t in w]
+        ts_begin = self.audio.timestamp_begin
+        text_ids = (
+            [t for t in ids if t < ts_begin] if ts_begin is not None else ids
+        )
+        text = self.tokenizer.decode(text_ids)
         if collect_fn is not None:
             collect_fn(
                 {
                     "gen_tokens": len(ids),
-                    "audio_seconds": round(len(pcm) / self.audio.sampling_rate, 3),
+                    "audio_seconds": duration,
                 }
             )
         if body.get("response_format") == "text":
@@ -706,12 +720,17 @@ class LLMEngineRequest(BaseEngineRequest):
 
             return TextOutput(text)
         out = {"text": text}
-        if body.get("response_format") == "verbose_json":
+        if verbose:
             out.update(
                 task=task,
-                duration=round(len(pcm) / self.audio.sampling_rate, 3),
+                duration=duration,
                 language=body.get("language"),
             )
+            if with_ts:
+                segments = self.audio.parse_segments(windows, duration)
+                for seg in segments:
+                    seg["text"] = self.tokenizer.decode(seg["tokens"])
+                out["segments"] = segments
         return out
 
     async def v1_audio_transcriptions(self, body, state, collect_fn=None):

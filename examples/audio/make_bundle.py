@@ -1,0 +1,38 @@
+"""Build a CI-sized random-weight Whisper bundle for the audio walkthrough.
+
+Real deployments convert an HF checkpoint instead (readme step 1:
+``python -m clearml_serving_tpu.engines.importers.convert_hf_whisper``);
+this stands in for that step the way the other suites' train_model.py
+scripts stand in for real training, so the register -> deploy -> transcribe
+flow runs end-to-end in CI without model downloads.
+"""
+
+import jax
+
+
+def main(out_dir: str = "whisper-bundle") -> None:
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.engines.jax_engine import save_bundle
+
+    cfg = dict(
+        preset="whisper-test",
+        # decoder prompt ids a converted checkpoint would carry (the values
+        # are arbitrary for random weights; the STRUCTURE mirrors
+        # <|startoftranscript|> <|task|> <|...|> <|notimestamps|>)
+        transcribe_prompt_ids=[300, 301, 302, 349],
+        translate_prompt_ids=[300, 303, 302, 349],
+        eos_token_id=340,
+        notimestamps_token_id=349,
+        timestamp_begin=350,
+        time_precision=0.02,
+        sampling_rate=16000,
+        chunk_length=1,
+    )
+    bundle = models.build_model("whisper", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    save_bundle(out_dir, "whisper", dict(bundle.config), params)
+    print("saved whisper bundle to {}".format(out_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,3 +91,52 @@ def test_sklearn_example(state_root, tmp_path):
         {"x0": 5.1, "x1": 3.5, "x2": 1.4, "x3": 0.2},
     )
     assert "y" in out
+
+
+def test_audio_example(state_root, tmp_path):
+    """examples/audio walkthrough: build bundle -> register -> transcribe
+    (multipart route shape is covered by tests/test_whisper.py; this runs
+    the example's own bundle through the full register->serve flow)."""
+    import base64
+    import io
+    import wave
+
+    spec = importlib.util.spec_from_file_location(
+        "make_bundle_audio", EXAMPLES / "audio" / "make_bundle.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bdir = tmp_path / "whisper-bundle"
+    mod.main(str(bdir))
+    assert bdir.exists()
+
+    mrp = ModelRequestProcessor(
+        state_root=str(state_root), force_create=True, name="ex-audio"
+    )
+    rec = mrp.registry.register("whisper example", path=bdir, framework="jax")
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="llm", serving_url="speech", model_id=rec.id)
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    t = np.linspace(0, 0.5, 8000, endpoint=False)
+    sig = (0.3 * np.sin(2 * np.pi * 220 * t)).astype(np.float32)
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(16000)
+        w.writeframes((sig * 32767).astype(np.int16).tobytes())
+
+    out = asyncio.run(
+        mrp.process_request(
+            "speech",
+            None,
+            {"file": base64.b64encode(buf.getvalue()).decode(),
+             "response_format": "verbose_json"},
+            serve_type="v1/audio/transcriptions",
+        )
+    )
+    assert isinstance(out["text"], str)
+    assert out["segments"], "timestamp-capable bundle must yield segments"

@@ -350,3 +350,129 @@ def test_audio_batched_matches_sequential(audio_served):
 
     batched = asyncio.run(run())
     assert batched == sequential
+
+
+# -- timestamp-conditioned decoding (verbose_json segments) -----------------
+
+_TS_CFG = dict(
+    preset="whisper-test",
+    transcribe_prompt_ids=[300, 301, 302, 349],   # ends with <|notimestamps|>
+    translate_prompt_ids=[300, 303, 302, 349],
+    eos_token_id=340,
+    notimestamps_token_id=349,
+    timestamp_begin=350,                           # ids 350..399 = 0..0.98s
+    time_precision=0.02,
+    sampling_rate=16000,
+    chunk_length=1,                                # 1s windows for CI speed
+)
+
+
+@pytest.fixture(scope="module")
+def ts_audio_core():
+    from clearml_serving_tpu.llm.audio import AudioCore
+
+    bundle = models.build_model("whisper", dict(_TS_CFG))
+    params = bundle.init(jax.random.PRNGKey(3))
+    return AudioCore(bundle, params, decode_steps=4, max_new_tokens=12)
+
+
+def test_timestamp_rules_wellformed(ts_audio_core):
+    """In-graph decoding rules guarantee well-formed marker structure even
+    with random weights: first token is a timestamp, timestamps never
+    decrease, and a completed pair is never followed by a third marker."""
+    core = ts_audio_core
+    rng = np.random.RandomState(0)
+    pcm = (0.1 * rng.randn(16000)).astype(np.float32)
+    prompt = core.prompt_ids("transcribe", timestamps=True)
+    assert 349 not in prompt  # <|notimestamps|> stripped
+    outs = core._transcribe_batch_ts([pcm, pcm], prompt)
+    assert len(outs) == 2
+    for ids in outs:
+        assert ids, "timestamp decode emitted nothing"
+        assert ids[0] >= 350, "first sampled token must be a timestamp"
+        # the initial marker completes a pair by the len<2 convention (HF
+        # WhisperTimeStampLogitsProcessor): TEXT must follow, not a marker
+        if len(ids) > 1:
+            assert ids[1] < 350, "second token must be text: {}".format(ids)
+        last_ts = ids[0]
+        run = 1  # ids[0] is a marker
+        for t in ids[1:]:
+            if t >= 350:
+                run += 1
+                assert run <= 2, "three timestamps in a row: {}".format(ids)
+                if last_ts is not None:
+                    assert t >= last_ts, "timestamps decreased: {}".format(ids)
+                last_ts = t
+            else:
+                run = 0
+
+
+def test_parse_segments(ts_audio_core):
+    core = ts_audio_core
+    # window 0: <|0.1|> text <|0.3|><|0.3|> text <|0.5|>; window 1: tail
+    w0 = [355, 341, 342, 365, 365, 343, 375]
+    w1 = [352, 344, 345]  # unterminated: closes at min(duration, window end)
+    segs = core.parse_segments([w0, w1], duration=1.7)
+    assert [s["id"] for s in segs] == [0, 1, 2]
+    assert segs[0]["start"] == pytest.approx(0.1) and segs[0]["end"] == pytest.approx(0.3)
+    assert segs[0]["tokens"] == [341, 342]
+    assert segs[1]["start"] == pytest.approx(0.3) and segs[1]["end"] == pytest.approx(0.5)
+    assert segs[1]["tokens"] == [343]
+    # window 1 offsets by the 1s window length; tail closes at duration=1.7
+    assert segs[2]["start"] == pytest.approx(1.04)
+    assert segs[2]["end"] == pytest.approx(1.7)
+    assert segs[2]["tokens"] == [344, 345]
+
+
+@pytest.fixture(scope="module")
+def ts_audio_served(tmp_path_factory):
+    """Timestamp-capable whisper endpoint served through the router."""
+    import os
+
+    from clearml_serving_tpu.engines.jax_engine import save_bundle
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    root = tmp_path_factory.mktemp("ts_audio_state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    bundle = models.build_model("whisper", dict(_TS_CFG))
+    params = bundle.init(jax.random.PRNGKey(3))
+    bdir = tmp_path_factory.mktemp("ts_audio_bundle") / "whisper"
+    save_bundle(bdir, "whisper", dict(bundle.config), params)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="tsaudio")
+    rec = mrp.registry.register("whisper-ts", path=bdir, framework="jax")
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="llm", serving_url="ts_whisper", model_id=rec.id)
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def test_verbose_json_segments_route(ts_audio_served):
+    import asyncio
+    import base64
+
+    async def fn():
+        return await ts_audio_served.process_request(
+            "ts_whisper",
+            None,
+            {
+                "file": base64.b64encode(_tone_wav(0.6)).decode(),
+                "response_format": "verbose_json",
+            },
+            serve_type="v1/audio/transcriptions",
+        )
+
+    out = asyncio.run(fn())
+    assert out["duration"] == pytest.approx(0.6, abs=0.01)
+    assert "segments" in out and len(out["segments"]) >= 1
+    for seg in out["segments"]:
+        assert set(seg) >= {"id", "seek", "start", "end", "tokens", "text"}
+        # boundaries clamp to the real audio duration, not the padded window
+        assert 0.0 <= seg["start"] <= seg["end"] <= out["duration"] + 1e-6
+        assert all(t < 350 for t in seg["tokens"]) or seg["tokens"] == []
+    # the top-level text contains no marker tokens (they decode per segment)
+    assert isinstance(out["text"], str)
