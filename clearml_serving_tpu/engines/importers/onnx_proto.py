@@ -133,6 +133,14 @@ def _parse_message(buf: bytes, schema: Dict[int, Field]) -> Dict[str, Any]:
         name, kind, repeated = spec
         values: List[Any] = []
         if isinstance(kind, tuple):  # nested message
+            if wire_type != 2:
+                # same field number, wrong wire type: this buffer is a
+                # DIFFERENT message type than the schema (e.g. probing a
+                # SavedModel with the GraphDef schema, whose field 1 is the
+                # varint schema_version) — skip instead of misreading the
+                # varint as a length and walking off the buffer
+                pos = _skip_field(buf, pos, wire_type)
+                continue
             n, pos = _read_varint(buf, pos)
             values.append(_parse_message(buf[pos : pos + n], kind[1]))
             pos += n

@@ -97,6 +97,8 @@ def load_bundle(path, endpoint=None) -> Tuple[Any, Any]:
     Dispatches on payload format — the breadth Triton's multi-backend repo
     gives the reference (triton_helper.py:159-183):
     - ``*.onnx`` file (or dir containing one) -> ONNX->JAX importer
+    - ``*.graphdef`` / ``*.pb`` frozen TF graph (or TF1 SavedModel wrapper)
+      -> native GraphDef->JAX importer
     - ``*.pt`` / ``*.torchscript`` TorchScript -> ONNX (in-memory) -> JAX
       (needs the endpoint's input_size/input_type spec for example shapes)
     - otherwise: native jax bundle dir (model_config.json + params.msgpack)
@@ -114,6 +116,15 @@ def load_bundle(path, endpoint=None) -> Tuple[Any, Any]:
     onnx_file = None if is_native else find_onnx_file(path)
     if onnx_file is not None:
         return load_onnx_bundle(onnx_file)
+    if not is_native:
+        from .importers.graphdef_import import (
+            find_graphdef_file,
+            load_graphdef_bundle,
+        )
+
+        gd_file = find_graphdef_file(path)
+        if gd_file is not None:
+            return load_graphdef_bundle(gd_file)
     ts_file = None
     if path.is_file() and path.suffix in (".pt", ".torchscript"):
         ts_file = path

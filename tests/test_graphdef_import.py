@@ -1,0 +1,320 @@
+"""Native GraphDef importer: frozen TF graphs -> jitted JAX executables.
+
+No tensorflow in the image, so fixtures are built with a minimal protobuf
+ENCODER (wire format is public spec) — the same bytes TF would serialize
+for a frozen inference graph — and numerics verify against numpy.
+"""
+
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.engines.importers.graphdef_import import (
+    load_graphdef_bundle,
+    parse_graphdef,
+)
+
+# -- minimal protobuf writer ---------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _len_field(num: int, payload: bytes) -> bytes:
+    return _varint(num << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(num: int, value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64
+    return _varint(num << 3) + _varint(value)
+
+
+def _f32_field(num: int, value: float) -> bytes:
+    return _varint(num << 3 | 5) + struct.pack("<f", value)
+
+
+def _shape(dims) -> bytes:
+    return b"".join(_len_field(2, _varint_field(1, d)) for d in dims)
+
+
+def _tensor(arr: np.ndarray) -> bytes:
+    dtype = {"float32": 1, "int32": 3, "int64": 9}[arr.dtype.name]
+    return (
+        _varint_field(1, dtype)
+        + _len_field(2, _shape(arr.shape))
+        + _len_field(4, arr.tobytes())
+    )
+
+
+def _attr(key: str, value: bytes) -> bytes:
+    return _len_field(5, _len_field(1, key.encode()) + _len_field(2, value))
+
+
+def attr_tensor(key, arr):
+    return _attr(key, _len_field(8, _tensor(np.ascontiguousarray(arr))))
+
+
+def attr_type(key, enum):
+    return _attr(key, _varint_field(6, enum))
+
+
+def attr_shape(key, dims):
+    return _attr(key, _len_field(7, _shape(dims)))
+
+
+def attr_s(key, s):
+    return _attr(key, _len_field(2, s.encode()))
+
+
+def attr_i(key, v):
+    return _attr(key, _varint_field(3, v))
+
+
+def attr_f(key, v):
+    return _attr(key, _f32_field(4, v))
+
+
+def attr_ilist(key, vals):
+    lst = b"".join(_varint_field(3, v) for v in vals)
+    return _attr(key, _len_field(1, lst))
+
+
+def node(name, op, inputs=(), *attrs):
+    body = _len_field(1, name.encode()) + _len_field(2, op.encode())
+    for ref in inputs:
+        body += _len_field(3, ref.encode())
+    return body + b"".join(attrs)
+
+
+def graphdef(*nodes) -> bytes:
+    return b"".join(_len_field(1, n) for n in nodes)
+
+
+def const(name, arr):
+    return node(name, "Const", (), attr_tensor("value", arr))
+
+
+# -- fixtures -----------------------------------------------------------------
+
+
+def _mlp_graph(rng):
+    w1 = rng.randn(4, 32).astype(np.float32)
+    b1 = rng.randn(32).astype(np.float32)
+    w2 = rng.randn(32, 3).astype(np.float32)
+    b2 = rng.randn(3).astype(np.float32)
+    gd = graphdef(
+        node("x", "Placeholder", (), attr_type("dtype", 1), attr_shape("shape", [-1, 4])),
+        const("w1", w1),
+        const("b1", b1),
+        const("w2", w2),
+        const("b2", b2),
+        node("mm1", "MatMul", ("x", "w1")),
+        node("h1", "BiasAdd", ("mm1", "b1")),
+        node("relu", "Relu", ("h1",)),
+        node("mm2", "MatMul", ("relu", "w2")),
+        node("logits", "BiasAdd", ("mm2", "b2")),
+        node("probs", "Softmax", ("logits",)),
+    )
+    weights = (w1, b1, w2, b2)
+    return gd, weights
+
+
+def _mlp_ref(x, w1, b1, w2, b2):
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def test_mlp_graph_matches_numpy(tmp_path):
+    rng = np.random.RandomState(0)
+    gd, (w1, b1, w2, b2) = _mlp_graph(rng)
+    f = tmp_path / "model.graphdef"
+    f.write_bytes(gd)
+    bundle, params = load_graphdef_bundle(f)
+    assert bundle.input_names == ["x"]
+    assert bundle.output_names == ["probs"]
+    assert bundle.config["input_shapes"]["x"] == [-1, 4]
+    x = rng.randn(5, 4).astype(np.float32)
+    out = jax.jit(bundle.apply)(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out), _mlp_ref(x, w1, b1, w2, b2), rtol=1e-5, atol=1e-5
+    )
+    # the big weights became device params; small consts stayed host-side
+    assert set(params) == {"w1", "w2"}
+
+
+def test_cnn_graph_matches_reference(tmp_path):
+    """Conv2D(SAME) -> BiasAdd -> Relu -> MaxPool -> Mean -> MatMul."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)   # HWIO
+    b = rng.randn(4).astype(np.float32)
+    wd = rng.randn(4, 3).astype(np.float32)
+    gd = graphdef(
+        node("img", "Placeholder", (), attr_type("dtype", 1),
+             attr_shape("shape", [-1, 8, 8, 2])),
+        const("w", w),
+        const("b", b),
+        const("wd", wd),
+        const("axes", np.asarray([1, 2], np.int32)),
+        node("conv", "Conv2D", ("img", "w"), attr_s("padding", "SAME"),
+             attr_ilist("strides", [1, 1, 1, 1]), attr_s("data_format", "NHWC")),
+        node("biased", "BiasAdd", ("conv", "b")),
+        node("act", "Relu", ("biased",)),
+        node("pool", "MaxPool", ("act",), attr_s("padding", "VALID"),
+             attr_ilist("ksize", [1, 2, 2, 1]), attr_ilist("strides", [1, 2, 2, 1])),
+        node("gap", "Mean", ("pool", "axes"), attr_i("keep_dims", 0)),
+        node("out", "MatMul", ("gap", "wd")),
+    )
+    f = tmp_path / "model.pb"
+    f.write_bytes(gd)
+    bundle, params = load_graphdef_bundle(f)
+    x = rng.randn(2, 8, 8, 2).astype(np.float32)
+    out = np.asarray(jax.jit(bundle.apply)(params, x))
+
+    # numpy reference
+    from jax import lax
+    import jax.numpy as jnp
+
+    xp = jnp.pad(jnp.asarray(x), [(0, 0), (1, 1), (1, 1), (0, 0)])
+    ref_conv = np.zeros((2, 8, 8, 4), np.float32)
+    for i in range(8):
+        for j in range(8):
+            patch = np.asarray(xp)[:, i : i + 3, j : j + 3, :]
+            ref_conv[:, i, j, :] = np.einsum("bhwc,hwco->bo", patch, w)
+    act = np.maximum(ref_conv + b, 0)
+    pool = act.reshape(2, 4, 2, 4, 2, 4).max(axis=(2, 4))
+    gap = pool.mean(axis=(1, 2))
+    ref = gap @ wd
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_savedmodel_wrapper_and_multi_output(tmp_path):
+    """TF1 SavedModel wrapper parses, FusedBatchNorm's :0 output resolves."""
+    rng = np.random.RandomState(2)
+    scale = rng.rand(4).astype(np.float32) + 0.5
+    offset = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+    gd = graphdef(
+        node("x", "Placeholder", (), attr_type("dtype", 1),
+             attr_shape("shape", [-1, 2, 2, 4])),
+        const("scale", scale),
+        const("offset", offset),
+        const("mean", mean),
+        const("var", var),
+        node("bn", "FusedBatchNormV3", ("x", "scale", "offset", "mean", "var"),
+             attr_f("epsilon", 1e-3)),
+        node("y", "Relu", ("bn:0",)),
+    )
+    # wrap: SavedModel{ meta_graphs{ graph_def{...} } }
+    saved = _len_field(2, _len_field(2, gd))
+    f = tmp_path / "saved_model.pb"
+    f.write_bytes(saved)
+    nodes = parse_graphdef(f.read_bytes())
+    assert [n["name"] for n in nodes][0] == "x"
+    bundle, params = load_graphdef_bundle(f)
+    x = rng.randn(3, 2, 2, 4).astype(np.float32)
+    out = np.asarray(jax.jit(bundle.apply)(params, x))
+    ref = np.maximum((x - mean) / np.sqrt(var + 1e-3) * scale + offset, 0)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_unsupported_op_reports_recipe(tmp_path):
+    gd = graphdef(
+        node("x", "Placeholder", (), attr_type("dtype", 1)),
+        node("w", "WeirdCustomOp", ("x",)),
+    )
+    f = tmp_path / "model.graphdef"
+    f.write_bytes(gd)
+    bundle, params = load_graphdef_bundle(f)
+    with pytest.raises(ValueError, match="tf2onnx"):
+        bundle.apply(params, np.zeros((1, 2), np.float32))
+
+
+def test_served_through_jax_engine(tmp_path, state_root):
+    """A .graphdef model registers and serves like any other import format."""
+    import asyncio
+
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    rng = np.random.RandomState(3)
+    gd, weights = _mlp_graph(rng)
+    f = tmp_path / "model.graphdef"
+    f.write_bytes(gd)
+
+    mrp = ModelRequestProcessor(state_root=str(state_root), force_create=True, name="gd")
+    rec = mrp.registry.register("tf mlp", path=f, framework="tensorflow")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="jax", serving_url="tf_mlp", model_id=rec.id,
+            input_size=[[4]], input_type=["float32"], input_name=["x"],
+            output_size=[[3]], output_type=["float32"], output_name=["probs"],
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    x = rng.randn(2, 4).astype(np.float32)
+    out = asyncio.run(mrp.process_request("tf_mlp", None, {"x": x.tolist()}))
+    got = np.asarray(out["probs"] if isinstance(out, dict) else out)
+    np.testing.assert_allclose(got, _mlp_ref(x, *weights), rtol=1e-4, atol=1e-4)
+
+
+def test_real_savedmodel_leads_with_schema_version(tmp_path):
+    """Real TF exporters always serialize saved_model_schema_version=1 first;
+    the importer must not misparse that varint as a GraphDef node."""
+    rng = np.random.RandomState(4)
+    gd, weights = _mlp_graph(rng)
+    saved = _varint_field(1, 1) + _len_field(2, _len_field(2, gd))
+    f = tmp_path / "saved_model.pb"
+    f.write_bytes(saved)
+    bundle, params = load_graphdef_bundle(f)
+    x = rng.randn(2, 4).astype(np.float32)
+    out = np.asarray(jax.jit(bundle.apply)(params, x))
+    np.testing.assert_allclose(out, _mlp_ref(x, *weights), rtol=1e-5, atol=1e-5)
+
+
+def test_dead_nodes_do_not_break_import(tmp_path):
+    """Frozen graphs keep Saver/init leftovers: dead unsupported ops and
+    non-numeric consts outside the output's ancestry must not fail the
+    load, nor leak into the auto-detected outputs."""
+    rng = np.random.RandomState(5)
+    gd, weights = _mlp_graph(rng)
+    extras = graphdef(
+        # dead unsupported op chain (never feeds "probs")
+        node("save/Const", "Const", (), attr_tensor("value", np.asarray([7], np.int32))),
+        node("save/SaveV2", "SaveV2", ("save/Const",)),
+        # dead string const: unsupported dtype enum 7 must not parse eagerly
+        node("labels", "Const", (),
+             _attr("value", _len_field(8, _varint_field(1, 7) + _len_field(8, b"cat")))),
+    )
+    f = tmp_path / "model.graphdef"
+    f.write_bytes(gd + extras)
+    bundle, params = load_graphdef_bundle(f)
+    assert bundle.output_names == ["probs"]  # leftovers not outputs
+    x = rng.randn(2, 4).astype(np.float32)
+    out = np.asarray(jax.jit(bundle.apply)(params, x))
+    np.testing.assert_allclose(out, _mlp_ref(x, *weights), rtol=1e-5, atol=1e-5)
+
+
+def test_input_arity_validated(tmp_path):
+    rng = np.random.RandomState(6)
+    gd, _ = _mlp_graph(rng)
+    f = tmp_path / "model.graphdef"
+    f.write_bytes(gd)
+    bundle, params = load_graphdef_bundle(f)
+    with pytest.raises(ValueError, match="expects 1 inputs"):
+        bundle.apply(params)
