@@ -424,7 +424,11 @@ class _GraphInterpreter:
             x, scale, offset, mean, var = args[:5]
             eps = attr_f("epsilon", 1e-4)
             inv = lax.rsqrt(var + eps) * scale
-            return (x * inv + (offset - mean * inv),)  # tuple: output :0 is y
+            bias = offset - mean * inv
+            if attr_s("data_format", b"NHWC") == "NCHW" and x.ndim == 4:
+                inv = inv.reshape(1, -1, 1, 1)
+                bias = bias.reshape(1, -1, 1, 1)
+            return (x * inv + bias,)  # tuple: output :0 is y
         if op == "Reshape":
             shape = [int(v) for v in self._static(args[1]).reshape(-1)]
             return jnp.reshape(args[0], shape)

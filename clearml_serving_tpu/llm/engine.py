@@ -162,6 +162,9 @@ class LLMEngineCore:
         chunked_prefill_size: Optional[int] = None,
         prefill_segments_per_decode: Optional[int] = 2,
         prefill_stall_timeout: Optional[float] = None,
+        speculation: Optional[str] = None,
+        spec_k: int = 4,
+        spec_ngram: int = 2,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -255,7 +258,19 @@ class LLMEngineCore:
             self.cache = None
         else:
             self.paged_cache = None
-            self.cache = bundle.init_cache(self.max_batch, self.max_seq_len)
+            # n-gram speculation verifies spec_k+1 positions per round and
+            # decode_steps rounds per dispatch; the cache carries that much
+            # slack so verify's dynamic_update_slice writes can never clamp
+            # at the buffer edge (a clamp would overwrite live K/V)
+            # from the CLAMPED spec_k (max(1, ...), applied again below):
+            # sizing from a raw spec_k<=0 would under-allocate and let
+            # verify's edge-clamped writes overwrite live K/V
+            spec_slack = (
+                self.decode_steps * (max(1, int(spec_k)) + 1) if speculation else 0
+            )
+            self.cache = bundle.init_cache(
+                self.max_batch, self.max_seq_len + spec_slack
+            )
             if self._cache_sharding is not None:
                 self.cache = {
                     k: jax.device_put(v, self._cache_sharding[k])
@@ -366,6 +381,97 @@ class LLMEngineCore:
             return toks.T, cache  # [B, decode_steps]
 
         self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
+
+        # -- n-gram speculative decoding (greedy; dense cache) -------------
+        # Fully on-device draft-and-verify: each scan round proposes spec_k
+        # draft tokens per slot by matching the last spec_ngram tokens
+        # against the slot's own history (prompt-lookup speculation), then
+        # ONE verify pass scores all spec_k+1 positions with a single weight
+        # read. Accepted-prefix + bonus token means every round emits 1 to
+        # spec_k+1 tokens — never fewer tokens/dispatch than the plain scan,
+        # and far fewer HBM weight reads per token when drafts hit
+        # (repetitive spans: summarization, extraction, code).
+        self._speculation = None
+        if speculation:
+            if speculation != "ngram":
+                raise ValueError("speculation must be 'ngram' (got {!r})".format(speculation))
+            if cache_mode != "dense":
+                raise ValueError("speculation requires engine.cache=dense")
+            if not hasattr(bundle, "verify"):
+                raise ValueError(
+                    "model bundle has no verify() surface; speculation "
+                    "needs a decoder with multi-position verification"
+                )
+            self._speculation = speculation
+        self._spec_k = max(1, int(spec_k))
+        self._spec_ngram = max(1, int(spec_ngram))
+        if self._speculation:
+            k_, n_ = self._spec_k, self._spec_ngram
+            buf_len = self.max_seq_len + self.decode_steps * (k_ + 1) + 1
+            self._tokbuf = np.zeros((self.max_batch, buf_len), np.int32)
+
+            def _spec_chunk(params, tokbuf, pending, cache, active):
+                t_idx = jnp.arange(buf_len, dtype=jnp.int32)
+
+                def round_body(carry, _):
+                    tokbuf, pending, cache = carry
+                    length = cache["length"]                        # [B]
+                    hist = length + 1  # known tokens incl. pending
+                    # ---- n-gram proposal from each slot's own history ----
+                    tail_pos = (hist[:, None] - n_ + jnp.arange(n_)[None]).clip(0)
+                    tail = jnp.take_along_axis(tokbuf, tail_pos, axis=1)  # [B,n]
+                    n_win = buf_len - n_ + 1
+                    match = jnp.ones((tokbuf.shape[0], n_win), bool)
+                    for j in range(n_):  # n_ is static and tiny
+                        match = match & (
+                            tokbuf[:, j : n_win + j] == tail[:, j : j + 1]
+                        )
+                    win_idx = jnp.arange(n_win, dtype=jnp.int32)[None]
+                    # window must end before the tail starts (a previous
+                    # occurrence, not the tail matching itself)
+                    valid = match & (win_idx < (hist - n_)[:, None] - n_ + 1)
+                    has = jnp.any(valid, axis=1)
+                    i_best = jnp.argmax(
+                        jnp.where(valid, win_idx, -1), axis=1
+                    ).astype(jnp.int32)                             # [B]
+                    draft_pos = (
+                        i_best[:, None] + n_ + jnp.arange(k_, dtype=jnp.int32)[None]
+                    ).clip(0, buf_len - 1)
+                    drafts = jnp.take_along_axis(tokbuf, draft_pos, axis=1)
+                    # no-match slots: draft the tail's last token repeated —
+                    # cheap, and a reject still emits the bonus token
+                    drafts = jnp.where(has[:, None], drafts, tail[:, -1:])
+                    # ---- one verify pass over pending + drafts ----------
+                    tokens_in = jnp.concatenate([pending[:, None], drafts], axis=1)
+                    logits, cache = bundle.verify(params, tokens_in, cache)
+                    g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+                    acc = jnp.sum(
+                        jnp.cumprod((drafts == g[:, :k_]).astype(jnp.int32), axis=1),
+                        axis=1,
+                    )                                                # [B] 0..k
+                    new_pending = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
+                    new_len = jnp.where(active, length + 1 + acc, length)
+                    cache = {**cache, "length": new_len.astype(jnp.int32)}
+                    # append the emitted tokens to the history buffer
+                    for i in range(k_ + 1):
+                        w = (t_idx[None] == (hist + i)[:, None]) & (
+                            (i <= acc) & active
+                        )[:, None]
+                        tokbuf = jnp.where(w, g[:, i : i + 1], tokbuf)
+                    pending = jnp.where(active, new_pending, pending)
+                    return (tokbuf, pending, cache), (g, acc)
+
+                (tokbuf, pending, cache), (gs, accs) = jax.lax.scan(
+                    round_body, (tokbuf, pending, cache), None,
+                    length=self.decode_steps,
+                )
+                # gs [rounds, B, k+1], accs [rounds, B]
+                return tokbuf, pending, cache, gs, accs
+
+            self._spec_chunk_jit = jax.jit(_spec_chunk, donate_argnums=(3,))
+        else:
+            self._tokbuf = None
+            self._spec_chunk_jit = None
 
         def _decode_paged_chunk(
             params, tokens, k_pools, v_pools, page_table, lengths0,
@@ -582,6 +688,14 @@ class LLMEngineCore:
         self._insert_prefill(slot, mini_cache, request.prompt_len)
         self._slot_req[slot] = request
         self._next_token[slot] = first_id
+        if self._tokbuf is not None:
+            # speculation history invariant: row holds the prompt plus every
+            # emitted token; length+1 tokens are known (pending included)
+            row = np.zeros(self._tokbuf.shape[1], np.int32)
+            ids = request.prompt_ids[: self._tokbuf.shape[1] - 1]
+            row[: len(ids)] = ids
+            row[len(ids)] = first_id
+            self._tokbuf[slot] = row
         self._temperature[slot] = request.temperature
         self._top_k[slot] = request.top_k
         self._top_p[slot] = request.top_p
@@ -672,6 +786,24 @@ class LLMEngineCore:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
+
+    def _dispatch_spec_chunk(self, active_mask: np.ndarray):
+        """Worker-thread side of a speculative dispatch: run the fused
+        draft-verify rounds and read back (gs [R,B,k+1], accs [R,B],
+        pending [B]). The host token buffer round-trips through the
+        executable so the on-device n-gram proposer sees each slot's full
+        history."""
+        tokbuf, pending, self.cache, gs, accs = self._spec_chunk_jit(
+            self.params,
+            jnp.asarray(self._tokbuf),
+            jnp.asarray(self._next_token),
+            self.cache,
+            jnp.asarray(active_mask),
+        )
+        # np.array (copy): np.asarray would alias the immutable device
+        # buffer and _commit_admission writes rows in place
+        self._tokbuf = np.array(tokbuf)
+        return np.asarray(gs), np.asarray(accs), np.asarray(pending)
 
     def _run_paged_chunk(self, active_mask: np.ndarray, sampling):
         """One fused paged-decode chunk (blocking device work; runs in a
@@ -795,6 +927,32 @@ class LLMEngineCore:
                 self._wake.clear()
                 continue
             # one fused decode chunk over the whole slot batch
+            use_spec = (
+                self._spec_chunk_jit is not None
+                and self.cache_mode == "dense"
+                and all(
+                    self._temperature[s] == 0.0
+                    for s in np.nonzero(active_mask)[0]
+                )
+            )
+            if use_spec:
+                # draft-and-verify rounds (greedy slots only): device work
+                # off-loop, emission on the loop thread like the plain path
+                gs, accs, pending = await asyncio.to_thread(
+                    self._dispatch_spec_chunk, active_mask
+                )
+                for r in range(gs.shape[0]):
+                    for slot in np.nonzero(active_mask)[0]:
+                        for i in range(int(accs[r, slot]) + 1):
+                            self._emit(int(slot), int(gs[r, slot, i]))
+                for slot in np.nonzero(active_mask)[0]:
+                    self._next_token[slot] = int(pending[slot])
+                if self._prefill_gate is not None:
+                    self._prefill_gate.deposit()
+                await asyncio.sleep(0)  # let HTTP handlers interleave
+                continue
+            # plain-path only: three host->device uploads the speculative
+            # branch (pure argmax) never needs
             sampling = SamplingParams(
                 temperature=jnp.asarray(self._temperature),
                 top_k=jnp.asarray(self._top_k),

@@ -168,6 +168,9 @@ class LLMEngineRequest(BaseEngineRequest):
                 "prefill_segments_per_decode", 2
             ),
             prefill_stall_timeout=engine_cfg.get("prefill_stall_timeout"),
+            speculation=engine_cfg.get("speculation"),
+            spec_k=int(engine_cfg.get("spec_k", 4)),
+            spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine

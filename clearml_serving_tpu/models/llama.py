@@ -310,9 +310,12 @@ def build(config: dict) -> SimpleNamespace:
         out = jnp.einsum("te,etd->td", weights.astype(x.dtype), expert_out)
         return out.reshape(b, s, d_).astype(x.dtype)
 
-    def _ffn(layer, x, valid=None):
+    def _ffn(layer, x, valid=None, dropless=False):
         if moe:
-            if x.shape[1] == 1:  # decode: one token per sequence
+            # decode and speculative verification must be dropless: capacity
+            # dropping makes logits depend on batch occupancy, which would
+            # break greedy-exactness (verify's argmax must equal decode's)
+            if dropless or x.shape[1] == 1:
                 return _ffn_moe_dropless(layer, x)
             return _ffn_moe(layer, x, valid)
         return _ffn_dense(layer, x)
@@ -422,6 +425,54 @@ def build(config: dict) -> SimpleNamespace:
 
         return _prefill_impl(params, tokens, seq_lens, cache, attend)
 
+    def _cached_chunk_layers(params, tokens, start, cache, ffn_kwargs):
+        """Shared layer loop for multi-token cached processing (chunked
+        prefill AND speculative verification): embed ``tokens`` [B, C] at
+        absolute positions ``start``..``start+C``, write their K/V into the
+        cache at those positions (per-sequence dynamic_update_slice), attend
+        causally over the whole sequence (cache beyond the chunk end is
+        stale -> masked), and return (x [B,C,D], k_new, v_new)."""
+        b, c = tokens.shape
+        max_len = cache["k"].shape[2]
+        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+        x = params["embed"][tokens]
+        t_idx = jnp.arange(max_len, dtype=jnp.int32)
+        mask = jnp.where(
+            t_idx[None, None, :] <= positions[:, :, None], 0.0, -jnp.inf
+        ).astype(jnp.float32)[:, None]                                      # [B,1,C,T]
+
+        def layer_body(carry, layer_and_kv):
+            x = carry
+            layer, k_cache, v_cache = layer_and_kv
+            h = _rms_norm(x, layer["attn_norm"], eps)
+            q, k, v = _qkv(layer, h, cos, sin)
+            k_cache = jax.vmap(
+                lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
+            )(k_cache, k.astype(k_cache.dtype), start)
+            v_cache = jax.vmap(
+                lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
+            )(v_cache, v.astype(v_cache.dtype), start)
+            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
+            h = _rms_norm(x, layer["ffn_norm"], eps)
+            return x + _ffn(layer, h, **ffn_kwargs), (k_cache, v_cache)
+
+        if scan_layers:
+            x, (k_new, v_new) = jax.lax.scan(
+                lambda x, xs: layer_body(x, xs),
+                x,
+                (params["layers"], cache["k"], cache["v"]),
+            )
+        else:
+            k_list, v_list = [], []
+            for i, layer in enumerate(params["layers"]):
+                x, (k_l, v_l) = layer_body(x, (layer, cache["k"][i], cache["v"][i]))
+                k_list.append(k_l)
+                v_list.append(v_l)
+            k_new = jnp.stack(k_list)
+            v_new = jnp.stack(v_list)
+        return x, k_new, v_new
+
     def prefill_chunk(params, tokens: jnp.ndarray, start: jnp.ndarray,
                       last_rel: jnp.ndarray, cache, *, with_logits: bool = True):
         """Incremental (chunked) prefill: process ``tokens`` [B, C] at
@@ -438,50 +489,12 @@ def build(config: dict) -> SimpleNamespace:
         chunked-prefill TTFT/TPOT smoothing from the serving literature).
         """
         b, c = tokens.shape
-        max_len = cache["k"].shape[2]
-        positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
-        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
-        x = params["embed"][tokens]
-        t_idx = jnp.arange(max_len, dtype=jnp.int32)
-        # key t visible to chunk query i iff t <= start + i (causal over the
-        # whole sequence; cache beyond the chunk end is stale -> masked)
-        q_abs = positions                                                   # [B, C]
-        mask = jnp.where(
-            t_idx[None, None, :] <= q_abs[:, :, None], 0.0, -jnp.inf
-        ).astype(jnp.float32)[:, None]                                      # [B,1,C,T]
         ffn_valid = (
             jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
         )  # pad tail of the final chunk never routes (MoE)
-
-        def layer_body(carry, layer_and_kv):
-            x = carry
-            layer, k_cache, v_cache = layer_and_kv
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)
-            k_cache = jax.vmap(
-                lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
-            )(k_cache, k.astype(k_cache.dtype), start)
-            v_cache = jax.vmap(
-                lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
-            )(v_cache, v.astype(v_cache.dtype), start)
-            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, ffn_valid), (k_cache, v_cache)
-
-        if scan_layers:
-            x, (k_new, v_new) = jax.lax.scan(
-                lambda x, xs: layer_body(x, xs),
-                x,
-                (params["layers"], cache["k"], cache["v"]),
-            )
-        else:
-            k_list, v_list = [], []
-            for i, layer in enumerate(params["layers"]):
-                x, (k_l, v_l) = layer_body(x, (layer, cache["k"][i], cache["v"][i]))
-                k_list.append(k_l)
-                v_list.append(v_l)
-            k_new = jnp.stack(k_list)
-            v_new = jnp.stack(v_list)
+        x, k_new, v_new = _cached_chunk_layers(
+            params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid}
+        )
         if with_logits:
             last_x = jnp.take_along_axis(
                 x, last_rel[:, None, None].clip(0, c - 1), axis=1
@@ -500,6 +513,33 @@ def build(config: dict) -> SimpleNamespace:
             ).astype(jnp.int32),
         }
         return last, cache
+
+    def verify(params, tokens: jnp.ndarray, cache):
+        """Speculative verification: process ``tokens`` [B, S] (the pending
+        token followed by S-1 draft tokens) at absolute positions
+        ``length``..``length+S-1``, attending causally over the cache plus
+        the chunk itself, and return logits at ALL S positions
+        ([B, S, vocab]) plus the cache with the chunk's K/V written.
+
+        ``length`` is deliberately NOT advanced: the caller accepts some
+        prefix of the drafts (argmax match) and sets the new length itself —
+        K/V written past the accepted point sit beyond ``length``, are
+        masked by every later attention, and get overwritten by subsequent
+        writes at the same positions. One weight read serves S positions,
+        which is the entire speculative-decoding win on an HBM-bound decode
+        (and amortizes the ~90 ms tunnel dispatch the same way the fused
+        decode scan does).
+
+        MoE routes DROPLESS here (like decode, unlike batched prefill):
+        capacity dropping would make verify's argmax depend on batch
+        occupancy and break the token-identical-to-plain-greedy guarantee.
+        """
+        start = cache["length"]                                    # [B]
+        x, k_new, v_new = _cached_chunk_layers(
+            params, tokens, start, cache, ffn_kwargs={"dropless": True}
+        )
+        logits = _logits(params, x)                                # [B, S, vocab]
+        return logits, {"k": k_new, "v": v_new, "length": start}
 
     def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache, mesh):
         """Sequence-parallel long-prompt prefill: exact ring attention over
@@ -659,6 +699,7 @@ def build(config: dict) -> SimpleNamespace:
         ffn=_ffn,
         prefill_ring=prefill_ring,
         decode=decode,
+        verify=verify,
         decode_paged=decode_paged,
         prepare_params=prepare_params,
         config=cfg,

@@ -209,3 +209,48 @@ def test_moe_int8_quantization_covers_expert_stacks():
     assert np.all(np.isfinite(out_q))
     # int8 is approximate but must track the full-precision logits closely
     assert np.mean(np.abs(out_q - out_f)) < 0.5
+
+
+def test_verify_matches_sequential_decode():
+    """Speculative verification: one verify() pass over S positions must
+    produce exactly the logits of S sequential decode() steps, leave
+    `length` untouched, and support partial-acceptance rollback (decoding
+    after length advance by fewer than S positions matches a sequential
+    cache)."""
+    import numpy as np
+
+    for scan in (False, True):
+        bundle = models.build_model(
+            "llama", {"preset": "llama-tiny", "dtype": "float32", "scan_layers": scan}
+        )
+        params = bundle.init(jax.random.PRNGKey(0))
+        cache = bundle.init_cache(2, 64)
+        prompt = jnp.asarray([[256, 5, 9, 0], [256, 7, 0, 0]], jnp.int32)
+        _, cache = bundle.prefill(
+            params, prompt, jnp.asarray([3, 2], jnp.int32), cache
+        )
+        tokens = jnp.asarray([[11, 3, 4, 5], [13, 6, 7, 8]], jnp.int32)
+        vlogits, vcache = bundle.verify(params, tokens, cache)
+        assert np.array_equal(
+            np.asarray(vcache["length"]), np.asarray(cache["length"])
+        )
+        c, ref = cache, []
+        for i in range(4):
+            lg, c = bundle.decode(params, tokens[:, i], c)
+            ref.append(np.asarray(lg))
+        np.testing.assert_allclose(
+            np.asarray(vlogits), np.stack(ref, axis=1), rtol=2e-4, atol=2e-4
+        )
+        # accept 1 draft (2 new tokens in cache) then decode: must equal a
+        # cache built by sequential decodes of the same two tokens
+        vc = dict(vcache)
+        vc["length"] = cache["length"] + 2
+        nxt = jnp.asarray([3, 6], jnp.int32)
+        lg_spec, _ = bundle.decode(params, nxt, vc)
+        c2 = cache
+        _, c2 = bundle.decode(params, tokens[:, 0], c2)
+        _, c2 = bundle.decode(params, tokens[:, 1], c2)
+        lg_ref, _ = bundle.decode(params, nxt, c2)
+        np.testing.assert_allclose(
+            np.asarray(lg_spec), np.asarray(lg_ref), rtol=2e-4, atol=2e-4
+        )
