@@ -89,6 +89,21 @@ def _measure(cfg, batch, seq_len, chunk, rounds, quantize):
         )
         return tokens, cache
 
+    # TTFT: one prompt prefill + first greedy token, batch 1 (the
+    # BASELINE.md target is p50 TTFT < 200 ms at prompt ~512)
+    p_len = min(512, seq_len)
+    ptokens = jnp.zeros((1, p_len), jnp.int32)
+    pcache = bundle.init_cache(1, seq_len)
+    prefill = jax.jit(bundle.prefill)
+    plogits, _ = prefill(params, ptokens, jnp.asarray([p_len], jnp.int32), pcache)
+    np.asarray(plogits)  # compile + warmup, readback-synced
+    t0 = time.perf_counter()
+    plogits, _ = prefill(params, ptokens, jnp.asarray([p_len], jnp.int32), pcache)
+    first = jnp.argmax(plogits)
+    np.asarray(first)
+    ttft_ms = (time.perf_counter() - t0) * 1e3
+    del pcache, plogits
+
     step = jax.jit(decode_chunk, donate_argnums=(2,))
     tokens = jnp.zeros((batch,), jnp.int32)
     rng = jax.random.PRNGKey(1)
@@ -102,7 +117,7 @@ def _measure(cfg, batch, seq_len, chunk, rounds, quantize):
         tokens, cache = step(params, tokens, cache, rng)
     np.asarray(tokens)  # data-dependent readback = true completion
     dt = time.perf_counter() - t0
-    return batch * chunk * rounds / dt
+    return batch * chunk * rounds / dt, ttft_ms
 
 
 def _emit(metric, value, platform, **extra):
@@ -134,13 +149,18 @@ def _tpu_worker() -> None:
     seq_len = int(os.environ.get("BENCH_SEQ", 1024))
     chunk = int(os.environ.get("BENCH_CHUNK", 25))
     rounds = int(os.environ.get("BENCH_ROUNDS", 4))
-    tok_s = _measure(cfg, batch, seq_len, chunk, rounds, quantize)
+    tok_s, ttft_ms = _measure(cfg, batch, seq_len, chunk, rounds, quantize)
+    extra = {
+        "ttft_p{}_b1_ms".format(min(512, seq_len)): round(ttft_ms, 2),
+        "ttft_target_ms": 200,  # BASELINE.md target is at prompt ~512
+    }
     _emit(
         "llm_decode_throughput_{}{}_b{}".format(
             cfg["preset"], "-int8" if quantize == "int8" else "", batch
         ),
         tok_s,
         "tpu",
+        **extra,
     )
 
 
@@ -153,12 +173,13 @@ def _cpu_smoke(note: str) -> None:
     except Exception:
         pass
     cfg = {"preset": "llama-tiny", "dtype": "float32"}
-    tok_s = _measure(cfg, batch=4, seq_len=128, chunk=5, rounds=2, quantize=None)
+    tok_s, ttft_ms = _measure(cfg, batch=4, seq_len=128, chunk=5, rounds=2, quantize=None)
     _emit(
         "llm_decode_throughput_llama-tiny_b4_cpusmoke",
         tok_s,
         "cpu",
         note=note,
+        ttft_p128_b1_ms=round(ttft_ms, 2),
     )
 
 

@@ -171,6 +171,13 @@ class LLMEngineCore:
         self.max_seq_len = int(max_seq_len)
         self.eos_token_id = eos_token_id
         self.decode_steps = max(1, int(decode_steps))
+        if cache_mode == "paged" and int(
+            bundle.config.get("sliding_window", 0) or 0
+        ):
+            raise ValueError(
+                "sliding_window models need engine.cache=dense (the paged "
+                "decode path does not window its attention yet)"
+            )
         if cache_mode not in ("dense", "paged"):
             raise ValueError("cache_mode must be 'dense' or 'paged'")
         self.cache_mode = cache_mode
@@ -183,7 +190,7 @@ class LLMEngineCore:
         # spreads across chips; SURVEY.md §5.7) — needs sp > 1 and a bundle
         # with a prefill_ring surface
         self._sp = int(dict(mesh.shape).get("sp", 1)) if mesh is not None else 1
-        if self._sp > 1 and not hasattr(bundle, "prefill_ring"):
+        if self._sp > 1 and getattr(bundle, "prefill_ring", None) is None:
             self._sp = 1
         self._long_threshold = (
             int(long_prefill_threshold)

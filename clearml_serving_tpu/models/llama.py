@@ -141,6 +141,13 @@ def build(config: dict) -> SimpleNamespace:
     moe_top_k = int(cfg.get("moe_top_k", 2))
     moe_capacity = float(cfg.get("moe_capacity_factor", 1.25))
 
+    # family deltas over the llama skeleton:
+    # - attn_bias: Qwen2-style additive QKV biases
+    # - sliding_window: Mistral-style local attention — key t is visible to
+    #   query position p iff p - W < t <= p (0 disables)
+    attn_bias = bool(cfg.get("attn_bias", False))
+    sliding_window = int(cfg.get("sliding_window", 0) or 0)
+
     def _init_layer(key):
         def dense(k, shape, fan_in):
             return (
@@ -156,6 +163,12 @@ def build(config: dict) -> SimpleNamespace:
             "wo": dense(k[3], (n_heads * head_dim, dim), n_heads * head_dim),
             "ffn_norm": jnp.ones((dim,), dtype),
         }
+        if attn_bias:
+            out.update(
+                bq=jnp.zeros((n_heads * head_dim,), dtype),
+                bk=jnp.zeros((n_kv * head_dim,), dtype),
+                bv=jnp.zeros((n_kv * head_dim,), dtype),
+            )
         if moe:
             out.update(
                 w_router=dense(k[7], (dim, n_experts), dim),
@@ -207,11 +220,27 @@ def build(config: dict) -> SimpleNamespace:
             return dequantize(w["_q8"], w["_scale"], dtype)
         return w
 
+    def _visible(q_pos, t_pos):
+        """Causal visibility (key position t, query position q): t <= q,
+        windowed to q - W < t when sliding_window is set. The ONE place the
+        window semantics live — every attention path builds its mask here."""
+        ok = t_pos <= q_pos
+        if sliding_window:
+            ok = ok & (t_pos > q_pos - sliding_window)
+        return ok
+
     def _qkv(layer, x, cos, sin):
         b, s, _ = x.shape
-        q = (x @ _w(layer, "wq")).reshape(b, s, n_heads, head_dim)
-        k = (x @ _w(layer, "wk")).reshape(b, s, n_kv, head_dim)
-        v = (x @ _w(layer, "wv")).reshape(b, s, n_kv, head_dim)
+        q = x @ _w(layer, "wq")
+        k = x @ _w(layer, "wk")
+        v = x @ _w(layer, "wv")
+        if attn_bias:  # Qwen2-style QKV biases (kept full precision)
+            q = q + layer["bq"]
+            k = k + layer["bk"]
+            v = v + layer["bv"]
+        q = q.reshape(b, s, n_heads, head_dim)
+        k = k.reshape(b, s, n_kv, head_dim)
+        v = v.reshape(b, s, n_kv, head_dim)
         return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
 
     def _attend(q, k, v, mask):
@@ -333,7 +362,8 @@ def build(config: dict) -> SimpleNamespace:
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
-        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+        idx = jnp.arange(s)
+        causal = _visible(idx[:, None], idx[None, :])
         mask = jnp.broadcast_to(
             jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None],
             (b, 1, s, s),
@@ -416,8 +446,9 @@ def build(config: dict) -> SimpleNamespace:
         b, s = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         valid = positions < seq_lens[:, None]                      # [B, S]
-        causal = jnp.tril(jnp.ones((s, s), dtype=bool))[None]
-        mask_b = causal & valid[:, None, :]                        # [B, S, T]
+        idx = jnp.arange(s)
+        causal = _visible(idx[:, None], idx[None, :])
+        mask_b = causal[None] & valid[:, None, :]                  # [B, S, T]
         mask = jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
 
         def attend(q, k, v):
@@ -438,9 +469,8 @@ def build(config: dict) -> SimpleNamespace:
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         x = params["embed"][tokens]
         t_idx = jnp.arange(max_len, dtype=jnp.int32)
-        mask = jnp.where(
-            t_idx[None, None, :] <= positions[:, :, None], 0.0, -jnp.inf
-        ).astype(jnp.float32)[:, None]                                      # [B,1,C,T]
+        visible = _visible(positions[:, :, None], t_idx[None, None, :])
+        mask = jnp.where(visible, 0.0, -jnp.inf).astype(jnp.float32)[:, None]  # [B,1,C,T]
 
         def layer_body(carry, layer_and_kv):
             x = carry
@@ -572,7 +602,7 @@ def build(config: dict) -> SimpleNamespace:
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         max_len = cache["k"].shape[2]
         t_idx = jnp.arange(max_len, dtype=jnp.int32)[None]         # [1, T]
-        attn_valid = t_idx <= cache["length"][:, None]             # [B, T]
+        attn_valid = _visible(cache["length"][:, None], t_idx)     # [B, T]
         mask = jnp.where(attn_valid, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
         x = params["embed"][tokens][:, None]                       # [B, 1, dim]
         # Per-sequence scatter at each sequence's own length (overwrite, so
@@ -697,7 +727,10 @@ def build(config: dict) -> SimpleNamespace:
         prefill=prefill,
         prefill_chunk=prefill_chunk,
         ffn=_ffn,
-        prefill_ring=prefill_ring,
+        # ring attention masks plain-causally inside the ring, so sliding
+        # window is unsupported on the sp long-prefill path (engine falls
+        # back to plain prefill when this is None)
+        prefill_ring=None if sliding_window else prefill_ring,
         decode=decode,
         verify=verify,
         decode_paged=decode_paged,

@@ -43,6 +43,10 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         "wq": col(None, "tp"),
         "wk": col(None, "tp"),
         "wv": col(None, "tp"),
+        # Qwen2-style QKV biases: 1-D over the tp-sharded output dim
+        "bq": col("tp"),
+        "bk": col("tp"),
+        "bv": col("tp"),
         "wo": col("tp", None),
         "ffn_norm": col(),
         "w_gate": col(None, "tp"),

@@ -16,12 +16,37 @@ import numpy as np
 
 
 def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
-    """(config_dict, params) from a transformers LlamaForCausalLM instance.
+    """(config_dict, params) from a transformers llama-family CausalLM:
+    LlamaForCausalLM, Qwen2ForCausalLM (QKV biases), MistralForCausalLM
+    (sliding-window attention) — same tensor naming, two config deltas.
     `dtype` sets both the stored weight dtype and the bundle's compute dtype
     (serving default: pass "bfloat16")."""
     hf_cfg = hf_model.config
-    if getattr(hf_cfg, "attention_bias", False):
-        raise ValueError("attention_bias=True checkpoints are not supported yet")
+    sd_keys = hf_model.state_dict().keys()
+    # Qwen2 sets no attention_bias flag pre-4.37-config models; detect from
+    # the checkpoint itself
+    attn_bias = bool(getattr(hf_cfg, "attention_bias", False)) or (
+        "model.layers.0.self_attn.q_proj.bias" in sd_keys
+    )
+    sliding = 0
+    if getattr(hf_cfg, "use_sliding_window", True):  # Mistral has no flag
+        sliding = int(getattr(hf_cfg, "sliding_window", 0) or 0)
+    if sliding:
+        # Qwen2 windows only layers >= max_window_layers; our bundle has one
+        # global window, so a MIXED checkpoint would silently mis-window the
+        # full-attention layers — refuse instead
+        mwl = getattr(hf_cfg, "max_window_layers", None)
+        n_layers_ = int(hf_cfg.num_hidden_layers)
+        if mwl is not None:
+            if int(mwl) >= n_layers_:
+                sliding = 0  # no layer actually slides
+            elif int(mwl) > 0:
+                raise ValueError(
+                    "mixed sliding/full attention (max_window_layers={} of {}"
+                    " layers) is not supported; re-export with "
+                    "use_sliding_window=False or convert a uniform-window "
+                    "checkpoint".format(mwl, n_layers_)
+                )
     rope_scaling = getattr(hf_cfg, "rope_scaling", None)
     config = {
         "vocab_size": int(hf_cfg.vocab_size),
@@ -36,6 +61,10 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
         "tie_embeddings": bool(getattr(hf_cfg, "tie_word_embeddings", False)),
         "dtype": dtype,
     }
+    if attn_bias:
+        config["attn_bias"] = True
+    if sliding and sliding < config["max_seq_len"]:
+        config["sliding_window"] = sliding
     if rope_scaling:
         # validated by the model build (llama3 scaling supported; others raise)
         config["rope_scaling"] = dict(rope_scaling)
@@ -56,19 +85,22 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
         params["lm_head"] = t("lm_head.weight").T
     for i in range(config["n_layers"]):
         pre = "model.layers.{}.".format(i)
-        params["layers"].append(
-            {
-                "attn_norm": t(pre + "input_layernorm.weight"),
-                "wq": t(pre + "self_attn.q_proj.weight").T,
-                "wk": t(pre + "self_attn.k_proj.weight").T,
-                "wv": t(pre + "self_attn.v_proj.weight").T,
-                "wo": t(pre + "self_attn.o_proj.weight").T,
-                "ffn_norm": t(pre + "post_attention_layernorm.weight"),
-                "w_gate": t(pre + "mlp.gate_proj.weight").T,
-                "w_up": t(pre + "mlp.up_proj.weight").T,
-                "w_down": t(pre + "mlp.down_proj.weight").T,
-            }
-        )
+        layer = {
+            "attn_norm": t(pre + "input_layernorm.weight"),
+            "wq": t(pre + "self_attn.q_proj.weight").T,
+            "wk": t(pre + "self_attn.k_proj.weight").T,
+            "wv": t(pre + "self_attn.v_proj.weight").T,
+            "wo": t(pre + "self_attn.o_proj.weight").T,
+            "ffn_norm": t(pre + "post_attention_layernorm.weight"),
+            "w_gate": t(pre + "mlp.gate_proj.weight").T,
+            "w_up": t(pre + "mlp.up_proj.weight").T,
+            "w_down": t(pre + "mlp.down_proj.weight").T,
+        }
+        if attn_bias:
+            layer["bq"] = t(pre + "self_attn.q_proj.bias")
+            layer["bk"] = t(pre + "self_attn.k_proj.bias")
+            layer["bv"] = t(pre + "self_attn.v_proj.bias")
+        params["layers"].append(layer)
     return config, params
 
 
