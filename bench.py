@@ -18,8 +18,19 @@ Robustness contract (the driver must ALWAYS capture a JSON line):
   backend.  It probes platform health in a subprocess with a timeout, runs
   the TPU measurement in a second subprocess with a timeout, and on any
   failure falls back to an in-process CPU smoke run (backend forced to CPU
-  via jax.config.update — never via JAX_PLATFORMS in the environment, which
-  hangs this image's sitecustomize at interpreter startup).
+  via jax.config.update, in-process).
+
+Backend identity (hard-won, round 3): the TPU is tunneled through an
+**experimental PJRT platform named "axon"** (see /root/.axon_site/
+sitecustomize.py) and the driver environment sets ``JAX_PLATFORMS=axon``.
+JAX never auto-selects an experimental platform, so stripping JAX_PLATFORMS
+from a child's env makes jax.devices() return CPU even when the tunnel is
+healthy — which is why rounds 1-2 never captured a TPU line.  Children that
+want the TPU must INHERIT ``JAX_PLATFORMS=axon``; the probe/worker accept
+platform "axon" (device_kind says TPU) as TPU.  A ``JAX_PLATFORMS`` value
+naming only cpu is still stripped from children: with it present at
+interpreter startup the sitecustomize PJRT registration has been observed to
+hang while the tunnel is down.
 
 NOTE on timing: some remote-TPU platforms (tunneled/axon) treat
 block_until_ready as a no-op — completion is only observable via a host
@@ -36,6 +47,14 @@ import sys
 import time
 
 TARGET_TOK_S = 1500.0  # BASELINE.md: Llama-3-8B class, tok/s/chip on v5e
+
+
+def is_tpu_device(dev) -> bool:
+    """True if this jax device is the TPU, under any of its names.
+
+    The tunneled chip registers as the experimental "axon" PJRT platform
+    (device_kind still says TPU); a direct attachment would say "tpu"."""
+    return dev.platform in ("tpu", "axon") or "TPU" in dev.device_kind
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
 # Budget for the TPU worker (cold 8B compile included — scan_layers keeps it
 # to ~one layer's compile). Kept under typical driver kill-timeouts so the
@@ -96,7 +115,7 @@ def _measure(cfg, batch, seq_len, chunk, rounds, quantize):
     pcache = bundle.init_cache(1, seq_len)
     prefill = jax.jit(bundle.prefill)
     plogits, _ = prefill(params, ptokens, jnp.asarray([p_len], jnp.int32), pcache)
-    np.asarray(plogits)  # compile + warmup, readback-synced
+    np.asarray(jnp.argmax(plogits))  # compile prefill AND argmax, readback-synced
     t0 = time.perf_counter()
     plogits, _ = prefill(params, ptokens, jnp.asarray([p_len], jnp.int32), pcache)
     first = jnp.argmax(plogits)
@@ -137,7 +156,16 @@ def _emit(metric, value, platform, **extra):
 
 
 def _tpu_worker() -> None:
-    """Runs in a subprocess with the default (TPU) backend."""
+    """Runs in a subprocess with JAX_PLATFORMS=axon inherited (the tunnel)."""
+    import jax
+
+    dev = jax.devices()[0]
+    if not is_tpu_device(dev):
+        raise SystemExit(
+            "worker backend is {}/{} — not a TPU".format(
+                dev.platform, dev.device_kind
+            )
+        )
     cfg = {
         "preset": os.environ.get("BENCH_PRESET", "llama3-8b"),
         "dtype": "bfloat16",
@@ -153,6 +181,7 @@ def _tpu_worker() -> None:
     extra = {
         "ttft_p{}_b1_ms".format(min(512, seq_len)): round(ttft_ms, 2),
         "ttft_target_ms": 200,  # BASELINE.md target is at prompt ~512
+        "backend": "{}:{}".format(dev.platform, dev.device_kind),
     }
     _emit(
         "llm_decode_throughput_{}{}_b{}".format(
@@ -184,26 +213,40 @@ def _cpu_smoke(note: str) -> None:
 
 
 def _subprocess_env():
-    """Env for child python processes.  JAX_PLATFORMS must NEVER leak into a
-    child's environment: this image's sitecustomize hangs at interpreter
-    startup when it is set (see .claude/skills/verify/SKILL.md)."""
-    return {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    """Env for child python processes that should reach the TPU.
+
+    ``JAX_PLATFORMS=axon`` must be INHERITED (the tunnel registers as the
+    experimental "axon" platform, which jax refuses to auto-select — see
+    module docstring).  A cpu-only value is dropped: that combination has
+    hung sitecustomize at interpreter startup while the tunnel is down."""
+    env = dict(os.environ)
+    plats = env.get("JAX_PLATFORMS", "")
+    if plats and "axon" not in plats and "tpu" not in plats:
+        env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+_PROBE_SNIPPET = (
+    "import jax, bench; "
+    "print('TPU_OK' if bench.is_tpu_device(jax.devices()[0]) else 'NOT_TPU')"
+)
 
 
 def _probe_tpu() -> bool:
-    """Check default-backend health in a throwaway subprocess (it can hang)."""
+    """Check backend health in a throwaway subprocess (it can hang)."""
     env = _subprocess_env()
     try:
         out = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            [sys.executable, "-c", _PROBE_SNIPPET],
             capture_output=True,
             text=True,
             timeout=PROBE_TIMEOUT,
             env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired:
         return False
-    return out.returncode == 0 and out.stdout.strip().endswith("tpu")
+    return out.returncode == 0 and "TPU_OK" in out.stdout
 
 
 def main() -> None:

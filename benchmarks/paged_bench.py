@@ -47,7 +47,14 @@ def _time(fn, *args, rounds=ROUNDS):
 def main() -> None:
     from clearml_serving_tpu.ops import paged_attention as pa
 
-    platform = jax.devices()[0].platform
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    import bench  # repo-root bench.py: shared TPU-identity helper
+
+    dev = jax.devices()[0]
+    platform = "tpu" if bench.is_tpu_device(dev) else dev.platform
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 5)
     n_pages = B * PAGES_PER_SEQ + 1

@@ -1,0 +1,122 @@
+"""Single-process TPU measurement battery (run by benchmarks/tpu_watch.sh).
+
+The tunnel grants flaky, possibly short-lived sessions, so once a connection
+is healthy everything must run in ONE process: device probe (with a SIGALRM
+watchdog — jax.devices() HANGS rather than errors while the tunnel is down),
+then the full battery:
+
+  1. headline 8B-int8 decode throughput + TTFT (same measurement bench.py's
+     TPU worker runs, via bench._measure) at a sweep of batch sizes
+  2. paged-attention kernel vs XLA gather vs dense (benchmarks/paged_bench.py)
+
+Results append to benchmarks/TPU_RESULTS.jsonl (committed as evidence) and
+echo to stdout.  Exit codes: 0 = battery complete, 3 = tunnel down (watchdog
+fired), 4 = backend present but not a TPU.
+
+Run via the inherited environment: JAX_PLATFORMS=axon must be present (the
+tunnel registers as the experimental "axon" PJRT platform; jax will not
+auto-select it — see bench.py's module docstring).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+OUT_PATH = REPO / "benchmarks" / "TPU_RESULTS.jsonl"
+PROBE_TIMEOUT = int(os.environ.get("BATTERY_PROBE_TIMEOUT", 150))
+
+
+def emit(obj: dict) -> None:
+    obj = dict(obj)
+    obj["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    line = json.dumps(obj)
+    print(line, flush=True)
+    with OUT_PATH.open("a") as f:
+        f.write(line + "\n")
+
+
+def main() -> int:
+    # Watchdog around first device touch.  A *Python* SIGALRM handler never
+    # fires here: the axon PJRT client init hangs inside C while HOLDING the
+    # GIL, so no bytecode ever runs again.  SIG_DFL makes the kernel kill the
+    # process directly (exit 142 = 128+SIGALRM), which the watcher loop
+    # treats as "tunnel down, retry".
+    signal.signal(signal.SIGALRM, signal.SIG_DFL)
+    signal.alarm(PROBE_TIMEOUT)
+    print("probing device (watchdog {}s)...".format(PROBE_TIMEOUT), flush=True)
+    import jax  # noqa: E402
+
+    import bench  # repo-root bench.py
+
+    dev = jax.devices()[0]
+    signal.alarm(0)
+    if not bench.is_tpu_device(dev):
+        print("backend is {}/{} — not a TPU".format(dev.platform, dev.device_kind))
+        return 4
+    backend = "{}:{}".format(dev.platform, dev.device_kind)
+    emit({"event": "tunnel_healthy", "backend": backend})
+    successes = 0
+
+    # -- phase 1: headline 8B int8 decode throughput + TTFT, batch sweep ----
+    cfg = {"preset": "llama3-8b", "dtype": "bfloat16", "scan_layers": True}
+    for batch in (8, 16, 32):
+        t0 = time.time()
+        try:
+            tok_s, ttft_ms = bench._measure(
+                cfg, batch=batch, seq_len=1024, chunk=25,
+                rounds=4, quantize="int8",
+            )
+            successes += 1
+            emit({
+                "metric": "llm_decode_throughput_llama3-8b-int8_b{}".format(batch),
+                "value": round(tok_s, 2),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s / bench.TARGET_TOK_S, 4),
+                "platform": "tpu",
+                "backend": backend,
+                "ttft_p512_b1_ms": round(ttft_ms, 2),
+                "wall_s": round(time.time() - t0, 1),
+            })
+        except Exception as ex:
+            emit({"metric": "llm_decode_throughput_llama3-8b-int8_b{}".format(batch),
+                  "error": repr(ex)[:300], "wall_s": round(time.time() - t0, 1)})
+
+    # -- phase 2: paged-attention kernel vs gather vs dense -----------------
+    from benchmarks import paged_bench
+
+    buf = io.StringIO()
+    t0 = time.time()
+    try:
+        with contextlib.redirect_stdout(buf):
+            paged_bench.main()
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "paged_bench", "error": repr(ex)[:300]})
+    for line in buf.getvalue().splitlines():
+        try:
+            emit(json.loads(line))
+        except Exception:
+            print(line, flush=True)
+    emit({
+        "event": "battery_done",
+        "paged_wall_s": round(time.time() - t0, 1),
+        "successes": successes,
+    })
+    # A probe that succeeded but zero completed measurements means the
+    # session died mid-battery: report "tunnel down" so the watcher retries
+    # instead of writing DONE with nothing but error records captured.
+    return 0 if successes else 3
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,36 +1,34 @@
 #!/bin/bash
 # Standing TPU-tunnel watcher (VERDICT r2 next-step #1: treat the 8B TPU
-# bench as a trigger, not a task).  Probes the tunnel; on the first healthy
-# probe runs the full measurement battery and writes results to
-# /tmp/tpu_watch/.  Run under tmux: `tmux new-session -d -s tpuwatch
-# 'bash benchmarks/tpu_watch.sh'`.
+# bench as a trigger, not a task).  Loops the single-process battery
+# (benchmarks/tpu_battery.py): the battery itself probes with a SIGALRM
+# watchdog and exits 3 while the tunnel is down, so the loop just re-runs
+# it every few minutes until it completes.  Run detached:
+#   nohup bash benchmarks/tpu_watch.sh >/tmp/tpu_watch.log 2>&1 &
+# IMPORTANT: the inherited env must keep JAX_PLATFORMS=axon (the tunnel's
+# experimental PJRT platform name) — do not strip or override it.
 set -u
 OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
-probe() {
-    timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null \
-        | grep -q tpu
-}
-
 i=0
 while true; do
     i=$((i + 1))
-    echo "$(date -u +%H:%M:%S) probe #$i"
-    if probe; then
-        echo "$(date -u +%H:%M:%S) TPU HEALTHY — running battery"
-        # 1. headline 8B int8 bench (generous budget: cold compile + tunnel)
-        BENCH_TPU_TIMEOUT=1500 BENCH_PROBE_TIMEOUT=120 \
-            python bench.py >"$OUT/bench_8b.json" 2>"$OUT/bench_8b.err"
-        echo "$(date -u +%H:%M:%S) bench done rc=$?"
-        # 2. paged-attention kernel vs gather vs dense (subprocess-free; the
-        #    probe above proved the backend answers)
-        timeout 900 python benchmarks/paged_bench.py \
-            >"$OUT/paged.json" 2>"$OUT/paged.err"
-        echo "$(date -u +%H:%M:%S) paged done rc=$?"
+    echo "$(date -u +%H:%M:%S) battery attempt #$i"
+    timeout "${BATTERY_TIMEOUT:-2400}" python benchmarks/tpu_battery.py
+    rc=$?
+    echo "$(date -u +%H:%M:%S) battery rc=$rc"
+    if [ "$rc" -eq 0 ]; then
         date -u +%FT%TZ >"$OUT/DONE"
         exit 0
     fi
-    sleep 240
+    if [ "$rc" -eq 4 ]; then
+        # backend present but not a TPU: a persistent env misconfiguration
+        # (JAX_PLATFORMS stripped/overridden) that retrying cannot fix
+        echo "FATAL: backend is not a TPU — check JAX_PLATFORMS=axon" \
+            | tee "$OUT/MISCONFIG"
+        exit 4
+    fi
+    sleep "${BATTERY_RETRY_SLEEP:-180}"
 done
