@@ -48,13 +48,9 @@ import time
 
 TARGET_TOK_S = 1500.0  # BASELINE.md: Llama-3-8B class, tok/s/chip on v5e
 
+# re-exported for the probe subprocess snippet (python -c "import bench; ...")
+from clearml_serving_tpu.utils.tpu import is_tpu_device  # noqa: E402
 
-def is_tpu_device(dev) -> bool:
-    """True if this jax device is the TPU, under any of its names.
-
-    The tunneled chip registers as the experimental "axon" PJRT platform
-    (device_kind still says TPU); a direct attachment would say "tpu"."""
-    return dev.platform in ("tpu", "axon") or "TPU" in dev.device_kind
 PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
 # Budget for the TPU worker (cold 8B compile included — scan_layers keeps it
 # to ~one layer's compile). Kept under typical driver kill-timeouts so the

@@ -21,11 +21,17 @@ Prints one JSON line per contender plus a "winner" summary line.
 from __future__ import annotations
 
 import json
+import sys
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# standalone runs (`python benchmarks/paged_bench.py`) need the repo root on
+# sys.path to reach the clearml_serving_tpu package
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 B, HKV, G, D = 16, 8, 4, 64
 PAGE = 16
@@ -47,14 +53,10 @@ def _time(fn, *args, rounds=ROUNDS):
 def main() -> None:
     from clearml_serving_tpu.ops import paged_attention as pa
 
-    import sys
-    from pathlib import Path
-
-    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-    import bench  # repo-root bench.py: shared TPU-identity helper
+    from clearml_serving_tpu.utils.tpu import is_tpu_device
 
     dev = jax.devices()[0]
-    platform = "tpu" if bench.is_tpu_device(dev) else dev.platform
+    platform = "tpu" if is_tpu_device(dev) else dev.platform
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 5)
     n_pages = B * PAGES_PER_SEQ + 1

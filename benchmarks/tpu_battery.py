@@ -56,11 +56,12 @@ def main() -> int:
     print("probing device (watchdog {}s)...".format(PROBE_TIMEOUT), flush=True)
     import jax  # noqa: E402
 
-    import bench  # repo-root bench.py
+    import bench  # repo-root bench.py (for _measure + TARGET_TOK_S)
+    from clearml_serving_tpu.utils.tpu import is_tpu_device
 
     dev = jax.devices()[0]
     signal.alarm(0)
-    if not bench.is_tpu_device(dev):
+    if not is_tpu_device(dev):
         print("backend is {}/{} — not a TPU".format(dev.platform, dev.device_kind))
         return 4
     backend = "{}:{}".format(dev.platform, dev.device_kind)

@@ -91,8 +91,13 @@ def _endpoint_input_spec(endpoint) -> Tuple[List[List[int]], List[str]]:
     return shapes, torch_types
 
 
-def load_bundle(path, endpoint=None) -> Tuple[Any, Any]:
+def load_bundle(path, endpoint=None, config_overrides=None) -> Tuple[Any, Any]:
     """Returns (model_bundle namespace, params).
+
+    ``config_overrides`` merges into the stored model config before the
+    architecture builds (native jax bundles only) — used by the llm engine
+    to enable serving-time features the checkpoint doesn't know about, e.g.
+    LoRA stacks (lora_rank/max_loras) or scan_layers.
 
     Dispatches on payload format — the breadth Triton's multi-backend repo
     gives the reference (triton_helper.py:159-183):
@@ -150,7 +155,10 @@ def load_bundle(path, endpoint=None) -> Tuple[Any, Any]:
         raise EndpointModelError(
             "not a jax model bundle (missing model_config.json): {}".format(path)
         )
-    bundle = models.build_model(meta["arch"], meta.get("config") or {})
+    model_cfg = dict(meta.get("config") or {})
+    if config_overrides:
+        model_cfg.update(config_overrides)
+    bundle = models.build_model(meta["arch"], model_cfg)
     params_bytes = (path / "params.msgpack").read_bytes()
     params = serialization.msgpack_restore(bytearray(params_bytes))
     params = jax.tree.map(jnp.asarray, params)

@@ -57,6 +57,9 @@ class GenRequest:
     top_k: int = 0
     top_p: float = 1.0
     stop_token_ids: Optional[List[int]] = None
+    # named LoRA adapter to apply (None = base model); resolved against the
+    # engine's adapter registry at validate/admission time
+    adapter: Optional[str] = None
     # filled by the engine:
     out_queue: "asyncio.Queue" = field(default_factory=asyncio.Queue)
     produced: int = 0
@@ -165,6 +168,10 @@ class LLMEngineCore:
         speculation: Optional[str] = None,
         spec_k: int = 4,
         spec_ngram: int = 2,
+        lora_adapters: Optional[Dict[str, Any]] = None,
+        prefix_cache: Optional[int] = None,
+        prefix_block: int = 64,
+        prefix_cache_bytes: Optional[int] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -204,6 +211,30 @@ class LLMEngineCore:
         # between this and max_seq_len fall back to plain prefill (rounding
         # the bucket UP past max_seq_len would crash the cache insert)
         self._long_cap = (self.max_seq_len // self._sp) * self._sp if self._sp > 1 else 0
+
+        # multi-LoRA: install each named adapter into the param tree's
+        # stacked factors (models/lora.py) BEFORE quantization/sharding —
+        # the stacks stay full precision (quantize only touches base
+        # projections) and shard/replicate per parallel/sharding.py
+        self._adapter_index: Dict[str, int] = {}
+        if lora_adapters:
+            from ..models import lora as lora_lib
+
+            if not int(getattr(bundle, "lora_rank", 0) or 0):
+                raise ValueError(
+                    "lora_adapters given but the model was built without "
+                    "lora_rank (set engine.lora.rank / config lora_rank)"
+                )
+            if len(lora_adapters) > int(bundle.max_loras):
+                raise ValueError(
+                    "{} adapters exceed max_loras {}".format(
+                        len(lora_adapters), bundle.max_loras
+                    )
+                )
+            for i, (name, tree) in enumerate(lora_adapters.items(), start=1):
+                params = lora_lib.install_adapter(params, i, tree)
+                self._adapter_index[name] = i
+        self._lora_enabled = bool(self._adapter_index)
 
         # int8 weight quantization: params live in HBM as int8 + scales; the
         # model's weight accessor (models/llama.py `_w`) dequantizes each
@@ -290,6 +321,7 @@ class LLMEngineCore:
         self._temperature = np.zeros(self.max_batch, np.float32)
         self._top_k = np.zeros(self.max_batch, np.int32)
         self._top_p = np.ones(self.max_batch, np.float32)
+        self._lora_slots = np.zeros(self.max_batch, np.int32)  # 0 = base
 
         self._pending: "asyncio.Queue[GenRequest]" = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
@@ -322,16 +354,25 @@ class LLMEngineCore:
 
         # -- compiled functions --------------------------------------------
 
-        def _prefill(params, tokens, seq_lens, cache_template):
-            return bundle.prefill(params, tokens, seq_lens, cache_template)
+        def _prefill(params, tokens, seq_lens, cache_template, lora_idx=None):
+            if lora_idx is None:  # static at trace: non-LoRA graphs unchanged
+                return bundle.prefill(params, tokens, seq_lens, cache_template)
+            return bundle.prefill(
+                params, tokens, seq_lens, cache_template, lora_idx
+            )
 
         self._prefill_jit = jax.jit(_prefill)
 
         if self._sp > 1:
 
-            def _prefill_ring(params, tokens, seq_lens, cache_template):
+            def _prefill_ring(params, tokens, seq_lens, cache_template,
+                              lora_idx=None):
+                if lora_idx is None:
+                    return bundle.prefill_ring(
+                        params, tokens, seq_lens, cache_template, self._mesh
+                    )
                 return bundle.prefill_ring(
-                    params, tokens, seq_lens, cache_template, self._mesh
+                    params, tokens, seq_lens, cache_template, self._mesh, lora_idx
                 )
 
             self._prefill_ring_jit = jax.jit(_prefill_ring)
@@ -357,6 +398,48 @@ class LLMEngineCore:
         else:
             self._chunked = 0
 
+        # automatic prefix caching (llm/prefix_cache.py): block-aligned
+        # prompt-prefix KV reuse across admissions — a hit assembles the
+        # stored KV into the mini cache and prefills only the remainder via
+        # prefill_chunk. Dense cache only; ring-prefill prompts skip it.
+        self._prefix = None
+        if (
+            prefix_cache
+            and hasattr(bundle, "prefill_chunk")
+            and cache_mode == "dense"
+        ):
+            from .prefix_cache import PrefixKVCache
+
+            self._prefix = PrefixKVCache(
+                int(prefix_cache), int(prefix_block), max_bytes=prefix_cache_bytes
+            )
+            self._prefix_chunk = self._chunked or int(prefix_block)
+
+            def _assemble(template, kpre, vpre, plen):
+                k = jax.lax.dynamic_update_slice(
+                    template["k"], kpre, (0, 0, 0, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    template["v"], vpre, (0, 0, 0, 0, 0)
+                )
+                return {
+                    "k": k,
+                    "v": v,
+                    "length": jnp.reshape(plen, (1,)).astype(jnp.int32),
+                }
+
+            self._assemble_prefix_jit = jax.jit(_assemble)
+            if self._chunked == 0:
+                # the hit path drives (the donating) prefill_chunk even when
+                # chunked prefill is not configured — it always owns its
+                # assembled cache, so no non-donating first-segment variant
+                # is needed here
+                self._prefill_chunk_jit = jax.jit(
+                    bundle.prefill_chunk,
+                    donate_argnums=(4,),
+                    static_argnames=("with_logits",),
+                )
+
         def _insert(cache, k_new, v_new, length, slot):
             k = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
             v = jax.lax.dynamic_update_slice(cache["v"], v_new, (0, slot, 0, 0, 0))
@@ -367,14 +450,18 @@ class LLMEngineCore:
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
-        def _decode_chunk(params, tokens, cache, active, sampling, rng):
+        def _decode_chunk(params, tokens, cache, active, sampling, rng,
+                          lora_idx=None):
             """`decode_steps` decode+sample steps fused in one executable
             (lax.scan) — host dispatch overhead amortizes over the chunk."""
 
             def body(carry, step_rng):
                 tokens, cache = carry
                 old_len = cache["length"]
-                logits, cache = bundle.decode(params, tokens, cache)
+                if lora_idx is None:
+                    logits, cache = bundle.decode(params, tokens, cache)
+                else:
+                    logits, cache = bundle.decode(params, tokens, cache, lora_idx)
                 # inactive slots: keep their length frozen (their garbage KV
                 # write sits beyond `length` and is masked / later overwritten)
                 cache["length"] = jnp.where(active, cache["length"], old_len)
@@ -417,7 +504,8 @@ class LLMEngineCore:
             buf_len = self.max_seq_len + self.decode_steps * (k_ + 1) + 1
             self._tokbuf = np.zeros((self.max_batch, buf_len), np.int32)
 
-            def _spec_chunk(params, tokbuf, pending, cache, active):
+            def _spec_chunk(params, tokbuf, pending, cache, active,
+                            lora_idx=None):
                 t_idx = jnp.arange(buf_len, dtype=jnp.int32)
 
                 def round_body(carry, _):
@@ -450,7 +538,12 @@ class LLMEngineCore:
                     drafts = jnp.where(has[:, None], drafts, tail[:, -1:])
                     # ---- one verify pass over pending + drafts ----------
                     tokens_in = jnp.concatenate([pending[:, None], drafts], axis=1)
-                    logits, cache = bundle.verify(params, tokens_in, cache)
+                    if lora_idx is None:
+                        logits, cache = bundle.verify(params, tokens_in, cache)
+                    else:
+                        logits, cache = bundle.verify(
+                            params, tokens_in, cache, lora_idx
+                        )
                     g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k+1]
                     acc = jnp.sum(
                         jnp.cumprod((drafts == g[:, :k_]).astype(jnp.int32), axis=1),
@@ -482,7 +575,7 @@ class LLMEngineCore:
 
         def _decode_paged_chunk(
             params, tokens, k_pools, v_pools, page_table, lengths0,
-            write_pages, write_offsets, sampling, rng,
+            write_pages, write_offsets, sampling, rng, lora_idx=None,
         ):
             """Paged-cache variant of the fused decode chunk. Page/offset
             write coordinates for every step come pre-computed from the host
@@ -491,10 +584,16 @@ class LLMEngineCore:
             def body(carry, xs):
                 tokens, k_pools, v_pools, step = carry
                 step_rng, wp, wo = xs
-                logits, k_pools, v_pools = bundle.decode_paged(
-                    params, tokens, k_pools, v_pools, page_table,
-                    lengths0 + step, wp, wo,
-                )
+                if lora_idx is None:
+                    logits, k_pools, v_pools = bundle.decode_paged(
+                        params, tokens, k_pools, v_pools, page_table,
+                        lengths0 + step, wp, wo,
+                    )
+                else:
+                    logits, k_pools, v_pools = bundle.decode_paged(
+                        params, tokens, k_pools, v_pools, page_table,
+                        lengths0 + step, wp, wo, lora_idx,
+                    )
                 sampled = sample_tokens(logits.astype(jnp.float32), sampling, step_rng)
                 return (sampled, k_pools, v_pools, step + 1), sampled
 
@@ -522,6 +621,19 @@ class LLMEngineCore:
                     len(request.prompt_ids), self.max_seq_len
                 )
             )
+        if request.adapter and request.adapter not in self._adapter_index:
+            raise ValueError(
+                "unknown lora adapter {!r} (loaded: {})".format(
+                    request.adapter, sorted(self._adapter_index) or "none"
+                )
+            )
+
+    @property
+    def adapter_names(self) -> List[str]:
+        return list(self._adapter_index)
+
+    def _slot_lora(self, request: GenRequest) -> int:
+        return self._adapter_index.get(request.adapter or "", 0)
 
     async def generate(self, request: GenRequest) -> AsyncIterator[int]:
         """Submit a request; yields sampled token ids as they decode."""
@@ -622,13 +734,21 @@ class LLMEngineCore:
             if template is None:
                 template = self.bundle.init_cache(1, template_len)
                 self._prefill_templates[template_len] = template
+        lora_i = self._slot_lora(request)
+        lora_arr = jnp.asarray([lora_i], jnp.int32) if self._lora_enabled else None
+        # automatic prefix caching: a stored block-aligned prefix of this
+        # prompt (same adapter) skips straight to its remainder
+        prefix_result = None
+        if self._prefix is not None and not use_ring:
+            prefix_result = self._prefix_admission(ids, lora_arr, lora_i)
         c = self._chunked
         # the chunked mini cache must be a multiple of C: a final chunk
         # overflowing the bucket would be CLAMPED backward by
         # dynamic_update_slice, silently overwriting earlier prompt K/V
         chunk_bucket = -(-bucket // c) * c if c else 0
         use_chunked = (
-            not use_ring
+            prefix_result is None
+            and not use_ring
             and c > 0
             and len(ids) > c
             and chunk_bucket <= self.max_seq_len
@@ -637,7 +757,9 @@ class LLMEngineCore:
             bucket = chunk_bucket
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(ids)] = ids
-        if use_chunked:
+        if prefix_result is not None:
+            last_logits, mini_cache = prefix_result
+        elif use_chunked:
             # incremental prefill: C-token segments attend over the cache so
             # far; the template is read (not donated) on the first segment
             with self._template_lock:
@@ -668,6 +790,7 @@ class LLMEngineCore:
                     jnp.asarray([len(seg) - 1], jnp.int32),
                     cache,
                     with_logits=(seg_i == n_segs - 1),
+                    lora_idx=lora_arr,
                 )
             mini_cache = cache
         else:
@@ -675,8 +798,11 @@ class LLMEngineCore:
             if self._prefill_gate is not None:
                 self._prefill_gate.acquire()
             last_logits, mini_cache = prefill_fn(
-                self.params, jnp.asarray(tokens), seq_lens, template
+                self.params, jnp.asarray(tokens), seq_lens, template, lora_arr
             )
+        if self._prefix is not None and not use_ring:
+            # make this prompt's prefix available to future admissions
+            self._prefix.store(ids, lora_i, mini_cache["k"], mini_cache["v"])
         first = self._sample_jit(
             last_logits.astype(jnp.float32),
             SamplingParams(
@@ -688,6 +814,56 @@ class LLMEngineCore:
         )
         first_id = int(np.asarray(first)[0])
         return first_id, mini_cache
+
+    def _prefix_admission(self, ids, lora_arr, lora_i):
+        """Prefix-cache hit path: assemble the stored prefix KV into a mini
+        cache and prefill only the remainder through prefill_chunk. Returns
+        (last_logits, mini_cache) or None (miss / doesn't fit)."""
+        hit = self._prefix.lookup(ids, lora_i)
+        if hit is None:
+            return None
+        c2 = self._prefix_chunk
+        prefix_len = hit["len"]
+        remainder = len(ids) - prefix_len
+        # the mini cache must cover the last segment's full C2 window...
+        required = prefix_len + -(-remainder // c2) * c2
+        # ...but its SIZE comes from the bounded engine bucket set — minting
+        # a size per (prefix_len, remainder) combination would permanently
+        # cache a fresh multi-hundred-MB template (8B-class) and recompile
+        # prefill_chunk for every new shape, turning "hits" into compile
+        # storms and an HBM leak
+        bucket = self._bucket_for(required)
+        if bucket < required or bucket > self.max_seq_len:
+            return None
+        with self._template_lock:
+            template = self._prefill_templates.get(bucket)
+            if template is None:
+                template = self.bundle.init_cache(1, bucket)
+                self._prefill_templates[bucket] = template
+        cache = self._assemble_prefix_jit(
+            template, hit["k"], hit["v"], jnp.asarray(prefix_len, jnp.int32)
+        )
+        last_logits = None
+        starts = list(range(prefix_len, len(ids), c2))
+        for si, s in enumerate(starts):
+            seg = ids[s : s + c2]
+            seg_tokens = np.zeros((1, c2), np.int32)
+            seg_tokens[0, : len(seg)] = seg
+            if self._prefill_gate is not None:
+                self._prefill_gate.acquire()
+            # the assembled cache is owned by this admission, so every
+            # segment may donate it (unlike the cold chunked path, whose
+            # first segment reads the shared template)
+            last_logits, cache = self._prefill_chunk_jit(
+                self.params,
+                jnp.asarray(seg_tokens),
+                jnp.asarray([s], jnp.int32),
+                jnp.asarray([len(seg) - 1], jnp.int32),
+                cache,
+                with_logits=(si == len(starts) - 1),
+                lora_idx=lora_arr,
+            )
+        return last_logits, cache
 
     def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache) -> None:
         """Loop-thread-only: route the prefilled KV into the shared cache and
@@ -706,6 +882,7 @@ class LLMEngineCore:
         self._temperature[slot] = request.temperature
         self._top_k[slot] = request.top_k
         self._top_p[slot] = request.top_p
+        self._lora_slots[slot] = self._slot_lora(request)
         self._emit(slot, first_id)
 
     async def _admission_task(self, request: GenRequest, slot: int) -> None:
@@ -806,6 +983,7 @@ class LLMEngineCore:
             jnp.asarray(self._next_token),
             self.cache,
             jnp.asarray(active_mask),
+            jnp.asarray(self._lora_slots) if self._lora_enabled else None,
         )
         # np.array (copy): np.asarray would alias the immutable device
         # buffer and _commit_admission writes rows in place
@@ -852,6 +1030,7 @@ class LLMEngineCore:
             jnp.asarray(write_offsets),
             sampling,
             self._next_rng(),
+            jnp.asarray(self._lora_slots) if self._lora_enabled else None,
         )
         return np.asarray(chunk), exhausted
 
@@ -986,6 +1165,7 @@ class LLMEngineCore:
                     jnp.asarray(active_mask),
                     sampling,
                     self._next_rng(),
+                    jnp.asarray(self._lora_slots) if self._lora_enabled else None,
                 )
                 chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
             if self._prefill_gate is not None:

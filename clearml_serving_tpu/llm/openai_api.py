@@ -68,13 +68,26 @@ class LLMEngineRequest(BaseEngineRequest):
         aux = self.endpoint.auxiliary_cfg if isinstance(self.endpoint.auxiliary_cfg, dict) else {}
         engine_cfg = dict(aux.get("engine") or {})
 
+        # multi-LoRA (reference vLLM knob `lora_modules`,
+        # preprocess_service.py:740-767): aux engine.lora = {"modules":
+        # {name: adapter_dir}, "rank": r?, "targets": [...]?, "max_loras": n?}
+        # — adapters load host-side, install into stacked factors, and route
+        # by the OpenAI request's `model` field (models/lora.py).
+        lora_overrides, lora_adapters = self._load_lora_cfg(engine_cfg)
+
         if self._model_local_path:
-            bundle, params = load_bundle(self._model_local_path)
+            bundle, params = load_bundle(
+                self._model_local_path, config_overrides=lora_overrides or None
+            )
         elif engine_cfg.get("preset"):
             # weightless demo/bench mode: architecture preset, random params
             bundle = models.build_model(
                 engine_cfg.get("arch", "llama"),
-                {"preset": engine_cfg["preset"], **(engine_cfg.get("config") or {})},
+                {
+                    "preset": engine_cfg["preset"],
+                    **(engine_cfg.get("config") or {}),
+                    **lora_overrides,
+                },
             )
             params = bundle.init(jax.random.PRNGKey(int(engine_cfg.get("seed", 0))))
         else:
@@ -171,11 +184,84 @@ class LLMEngineRequest(BaseEngineRequest):
             speculation=engine_cfg.get("speculation"),
             spec_k=int(engine_cfg.get("spec_k", 4)),
             spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
+            lora_adapters=lora_adapters,
+            prefix_cache=engine_cfg.get("prefix_cache"),
+            prefix_block=int(engine_cfg.get("prefix_block", 64)),
+            prefix_cache_bytes=(
+                int(float(engine_cfg["prefix_cache_mb"]) * (1 << 20))
+                if engine_cfg.get("prefix_cache_mb")
+                else None
+            ),
         )
         self._model_name = self.endpoint.serving_url
         return self.engine
 
+    def _load_lora_cfg(self, engine_cfg: Dict[str, Any]):
+        """(config_overrides, adapters) from the aux engine.lora block."""
+        from pathlib import Path
+
+        lora_cfg = dict(engine_cfg.get("lora") or {})
+        modules = dict(lora_cfg.get("modules") or {})
+        if not modules:
+            return {}, None
+        from ..models import lora as lora_lib
+        from ..models import llama as llama_mod
+
+        # layer count comes from the model config (stored bundle meta or the
+        # preset); adapters only apply to the llama-family decoder arch
+        if self._model_local_path:
+            from ..utils.files import read_json
+
+            meta = read_json(Path(self._model_local_path) / "model_config.json")
+            if not meta or meta.get("arch") != "llama":
+                raise EndpointModelError(
+                    "lora modules need a native llama-family bundle "
+                    "(got {!r})".format((meta or {}).get("arch"))
+                )
+            model_cfg = llama_mod.resolve_config(dict(meta.get("config") or {}))
+        else:
+            model_cfg = llama_mod.resolve_config(
+                {
+                    "preset": engine_cfg.get("preset", ""),
+                    **(engine_cfg.get("config") or {}),
+                }
+            )
+        n_layers = int(model_cfg["n_layers"])
+        adapters: Dict[str, Any] = {}
+        for name, p in modules.items():
+            path = Path(str(p))
+            if not path.is_absolute() and self._model_local_path:
+                cand = Path(self._model_local_path) / str(p)
+                if cand.exists():
+                    path = cand
+            adapters[name] = lora_lib.load_adapter(path, n_layers)
+        rank = int(lora_cfg.get("rank") or 0) or max(
+            ab["a"].shape[-1] for tree in adapters.values() for ab in tree.values()
+        )
+        targets = list(
+            lora_cfg.get("targets")
+            or sorted({t for tree in adapters.values() for t in tree})
+        )
+        overrides = {
+            "lora_rank": rank,
+            "lora_targets": targets,
+            "max_loras": max(len(adapters), int(lora_cfg.get("max_loras") or 0)),
+        }
+        return overrides, adapters
+
     # -- helpers ----------------------------------------------------------------
+
+    def _adapter_for(self, body: Dict[str, Any]) -> Optional[str]:
+        """OpenAI multi-LoRA routing: a `model` field naming a loaded adapter
+        selects it; anything else (endpoint name, absent) is the base model."""
+        name = body.get("model")
+        if (
+            self.engine is not None
+            and name
+            and name in getattr(self.engine, "_adapter_index", {})
+        ):
+            return name
+        return None
 
     def _gen_request_from_body(self, body: Dict[str, Any], prompt_ids: List[int]):
         from .engine import GenRequest
@@ -186,6 +272,7 @@ class LLMEngineRequest(BaseEngineRequest):
             temperature=float(body.get("temperature", 0.0) or 0.0),
             top_k=int(body.get("top_k", 0) or 0),
             top_p=float(body.get("top_p", 1.0) or 1.0),
+            adapter=self._adapter_for(body),
         )
 
     @staticmethod
@@ -442,17 +529,27 @@ class LLMEngineRequest(BaseEngineRequest):
         }
 
     async def v1_models(self, body: Dict[str, Any], state: dict, collect_fn=None):
-        return {
-            "object": "list",
-            "data": [
+        data = [
+            {
+                "id": self._model_name,
+                "object": "model",
+                "created": _now(),
+                "owned_by": "tpu-serving",
+            }
+        ]
+        # loaded LoRA adapters list as models with a parent (vLLM-compatible
+        # multi-LoRA discovery; select one via the request's `model` field)
+        for name in getattr(self.engine, "adapter_names", []) or []:
+            data.append(
                 {
-                    "id": self._model_name,
+                    "id": name,
                     "object": "model",
                     "created": _now(),
                     "owned_by": "tpu-serving",
+                    "parent": self._model_name,
                 }
-            ],
-        }
+            )
+        return {"object": "list", "data": data}
 
     async def version(self, body: Dict[str, Any], state: dict, collect_fn=None):
         """The 13th OpenAI route type (reference preprocess_service.py:890

@@ -148,6 +148,20 @@ def build(config: dict) -> SimpleNamespace:
     attn_bias = bool(cfg.get("attn_bias", False))
     sliding_window = int(cfg.get("sliding_window", 0) or 0)
 
+    # multi-LoRA serving (models/lora.py): stacked [A+1, in, r]/[A+1, r, out]
+    # factors per targeted projection, gathered per batch slot by lora_idx
+    # inside the layer body — one executable serves any adapter mix
+    lora_rank, lora_targets, max_loras = 0, (), 0
+    if cfg.get("lora_rank"):
+        from . import lora as lora_lib
+
+        lora_rank, lora_targets, max_loras = lora_lib.lora_spec(cfg)
+        if moe and any(t in ("w_gate", "w_up", "w_down") for t in lora_targets):
+            raise ValueError(
+                "lora FFN targets are unsupported for MoE layers "
+                "(expert-stacked weights); use attention targets"
+            )
+
     def _init_layer(key):
         def dense(k, shape, fan_in):
             return (
@@ -182,6 +196,17 @@ def build(config: dict) -> SimpleNamespace:
                 w_up=dense(k[5], (dim, ffn_dim), dim),
                 w_down=dense(k[6], (ffn_dim, dim), ffn_dim),
             )
+        if lora_rank:
+            from . import lora as lora_lib
+
+            for t in lora_targets:
+                d_in, d_out = lora_lib.target_dims(cfg, t)
+                out["lora_a_" + t] = jnp.zeros(
+                    (max_loras + 1, d_in, lora_rank), dtype
+                )
+                out["lora_b_" + t] = jnp.zeros(
+                    (max_loras + 1, lora_rank, d_out), dtype
+                )
         return out
 
     def init(rng) -> Dict[str, Any]:
@@ -229,11 +254,29 @@ def build(config: dict) -> SimpleNamespace:
             ok = ok & (t_pos > q_pos - sliding_window)
         return ok
 
-    def _qkv(layer, x, cos, sin):
+    def _lora_delta(layer, name, x, lora_idx):
+        """Batched per-slot LoRA delta: x [B,S,in] -> [B,S,out]. The gather
+        by lora_idx [B] selects each slot's adapter from the [A+1, ...]
+        stacks (index 0 = zeros = base model); two rank-r matmuls with f32
+        accumulation. Runs inside the (scanned) layer body so the stacks ride
+        the same layout machinery as the base weights."""
+        a = layer["lora_a_" + name][lora_idx]                  # [B, in, r]
+        b = layer["lora_b_" + name][lora_idx]                  # [B, r, out]
+        h = jnp.einsum("bsi,bir->bsr", x, a, preferred_element_type=jnp.float32)
+        return jnp.einsum(
+            "bsr,bro->bso", h, b, preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+
+    def _with_lora(layer, name, x, y, lora_idx):
+        if lora_idx is None or name not in lora_targets:
+            return y
+        return y + _lora_delta(layer, name, x, lora_idx)
+
+    def _qkv(layer, x, cos, sin, lora_idx=None):
         b, s, _ = x.shape
-        q = x @ _w(layer, "wq")
-        k = x @ _w(layer, "wk")
-        v = x @ _w(layer, "wv")
+        q = _with_lora(layer, "wq", x, x @ _w(layer, "wq"), lora_idx)
+        k = _with_lora(layer, "wk", x, x @ _w(layer, "wk"), lora_idx)
+        v = _with_lora(layer, "wv", x, x @ _w(layer, "wv"), lora_idx)
         if attn_bias:  # Qwen2-style QKV biases (kept full precision)
             q = q + layer["bq"]
             k = k + layer["bk"]
@@ -242,6 +285,9 @@ def build(config: dict) -> SimpleNamespace:
         k = k.reshape(b, s, n_kv, head_dim)
         v = v.reshape(b, s, n_kv, head_dim)
         return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
+
+    def _oproj(layer, attn, lora_idx=None):
+        return _with_lora(layer, "wo", attn, attn @ _w(layer, "wo"), lora_idx)
 
     def _attend(q, k, v, mask):
         """q: [B,S,Hq,D]; k,v: [B,T,Hkv,D]; mask: [B,1,S,T] additive."""
@@ -257,10 +303,11 @@ def build(config: dict) -> SimpleNamespace:
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
         return out.reshape(b, s, n_heads * head_dim)
 
-    def _ffn_dense(layer, x):
-        return (
-            jax.nn.silu(x @ _w(layer, "w_gate")) * (x @ _w(layer, "w_up"))
-        ) @ _w(layer, "w_down")
+    def _ffn_dense(layer, x, lora_idx=None):
+        gate = _with_lora(layer, "w_gate", x, x @ _w(layer, "w_gate"), lora_idx)
+        up = _with_lora(layer, "w_up", x, x @ _w(layer, "w_up"), lora_idx)
+        h = jax.nn.silu(gate) * up
+        return _with_lora(layer, "w_down", h, h @ _w(layer, "w_down"), lora_idx)
 
     def _moe_routing(layer, tokens):
         router_logits = (
@@ -339,7 +386,7 @@ def build(config: dict) -> SimpleNamespace:
         out = jnp.einsum("te,etd->td", weights.astype(x.dtype), expert_out)
         return out.reshape(b, s, d_).astype(x.dtype)
 
-    def _ffn(layer, x, valid=None, dropless=False):
+    def _ffn(layer, x, valid=None, dropless=False, lora_idx=None):
         if moe:
             # decode and speculative verification must be dropless: capacity
             # dropping makes logits depend on batch occupancy, which would
@@ -347,7 +394,7 @@ def build(config: dict) -> SimpleNamespace:
             if dropless or x.shape[1] == 1:
                 return _ffn_moe_dropless(layer, x)
             return _ffn_moe(layer, x, valid)
-        return _ffn_dense(layer, x)
+        return _ffn_dense(layer, x, lora_idx)
 
     def _logits(params, x):
         x = _rms_norm(x, params["final_norm"], eps)
@@ -356,7 +403,8 @@ def build(config: dict) -> SimpleNamespace:
 
     # -- full causal forward (training / no-cache prefill) -------------------
 
-    def apply(params, tokens: jnp.ndarray, positions: Optional[jnp.ndarray] = None):
+    def apply(params, tokens: jnp.ndarray, positions: Optional[jnp.ndarray] = None,
+              lora_idx: Optional[jnp.ndarray] = None):
         """tokens: [B, S] int32 -> logits [B, S, vocab] (causal)."""
         b, s = tokens.shape
         if positions is None:
@@ -372,10 +420,10 @@ def build(config: dict) -> SimpleNamespace:
 
         def layer_body(x, layer):
             h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)
-            x = x + _attend(q, k, v, mask) @ _w(layer, "wo")
+            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
+            x = x + _oproj(layer, _attend(q, k, v, mask), lora_idx)
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h)
+            return x + _ffn(layer, h, lora_idx=lora_idx)
 
         if scan_layers:
             x, _ = jax.lax.scan(
@@ -396,7 +444,7 @@ def build(config: dict) -> SimpleNamespace:
             "length": jnp.zeros((batch,), jnp.int32),
         }
 
-    def _prefill_impl(params, tokens, seq_lens, cache, attend_fn):
+    def _prefill_impl(params, tokens, seq_lens, cache, attend_fn, lora_idx=None):
         """Shared prefill body: embed -> layers (attend_fn pluggable) ->
         last-token logits + freshly written cache. Only the LAST position's
         hidden state is projected to vocab — materializing [B, S, vocab] to
@@ -410,10 +458,10 @@ def build(config: dict) -> SimpleNamespace:
 
         def layer_body(x, layer):
             h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)
-            x = x + attend_fn(q, k, v) @ _w(layer, "wo")
+            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
+            x = x + _oproj(layer, attend_fn(q, k, v), lora_idx)
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, ffn_valid), (k, v)
+            return x + _ffn(layer, h, ffn_valid, lora_idx=lora_idx), (k, v)
 
         if scan_layers:
             x, (k_stack, v_stack) = jax.lax.scan(layer_body, x, params["layers"])
@@ -440,7 +488,8 @@ def build(config: dict) -> SimpleNamespace:
         }
         return last, cache
 
-    def prefill(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache):
+    def prefill(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache,
+                lora_idx: Optional[jnp.ndarray] = None):
         """Right-padded tokens [B, S]; seq_lens [B]. Writes the cache and
         returns (last-token logits [B, vocab], cache)."""
         b, s = tokens.shape
@@ -454,9 +503,10 @@ def build(config: dict) -> SimpleNamespace:
         def attend(q, k, v):
             return _attend(q, k, v, mask)
 
-        return _prefill_impl(params, tokens, seq_lens, cache, attend)
+        return _prefill_impl(params, tokens, seq_lens, cache, attend, lora_idx)
 
-    def _cached_chunk_layers(params, tokens, start, cache, ffn_kwargs):
+    def _cached_chunk_layers(params, tokens, start, cache, ffn_kwargs,
+                             lora_idx=None):
         """Shared layer loop for multi-token cached processing (chunked
         prefill AND speculative verification): embed ``tokens`` [B, C] at
         absolute positions ``start``..``start+C``, write their K/V into the
@@ -476,16 +526,18 @@ def build(config: dict) -> SimpleNamespace:
             x = carry
             layer, k_cache, v_cache = layer_and_kv
             h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)
+            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
             k_cache = jax.vmap(
                 lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
             )(k_cache, k.astype(k_cache.dtype), start)
             v_cache = jax.vmap(
                 lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
             )(v_cache, v.astype(v_cache.dtype), start)
-            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
+            x = x + _oproj(layer, _attend(q, k_cache, v_cache, mask), lora_idx)
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, **ffn_kwargs), (k_cache, v_cache)
+            return x + _ffn(layer, h, lora_idx=lora_idx, **ffn_kwargs), (
+                k_cache, v_cache
+            )
 
         if scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
@@ -504,7 +556,8 @@ def build(config: dict) -> SimpleNamespace:
         return x, k_new, v_new
 
     def prefill_chunk(params, tokens: jnp.ndarray, start: jnp.ndarray,
-                      last_rel: jnp.ndarray, cache, *, with_logits: bool = True):
+                      last_rel: jnp.ndarray, cache, *, with_logits: bool = True,
+                      lora_idx: Optional[jnp.ndarray] = None):
         """Incremental (chunked) prefill: process ``tokens`` [B, C] at
         absolute positions ``start``..``start+C``, attending over everything
         already in ``cache`` plus the chunk itself (causal). Returns logits
@@ -523,7 +576,8 @@ def build(config: dict) -> SimpleNamespace:
             jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
         )  # pad tail of the final chunk never routes (MoE)
         x, k_new, v_new = _cached_chunk_layers(
-            params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid}
+            params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid},
+            lora_idx=lora_idx,
         )
         if with_logits:
             last_x = jnp.take_along_axis(
@@ -544,7 +598,8 @@ def build(config: dict) -> SimpleNamespace:
         }
         return last, cache
 
-    def verify(params, tokens: jnp.ndarray, cache):
+    def verify(params, tokens: jnp.ndarray, cache,
+               lora_idx: Optional[jnp.ndarray] = None):
         """Speculative verification: process ``tokens`` [B, S] (the pending
         token followed by S-1 draft tokens) at absolute positions
         ``length``..``length+S-1``, attending causally over the cache plus
@@ -566,12 +621,14 @@ def build(config: dict) -> SimpleNamespace:
         """
         start = cache["length"]                                    # [B]
         x, k_new, v_new = _cached_chunk_layers(
-            params, tokens, start, cache, ffn_kwargs={"dropless": True}
+            params, tokens, start, cache, ffn_kwargs={"dropless": True},
+            lora_idx=lora_idx,
         )
         logits = _logits(params, x)                                # [B, S, vocab]
         return logits, {"k": k_new, "v": v_new, "length": start}
 
-    def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache, mesh):
+    def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache,
+                     mesh, lora_idx: Optional[jnp.ndarray] = None):
         """Sequence-parallel long-prompt prefill: exact ring attention over
         the mesh's ``sp`` axis (parallel/ring_attention.py shard_map +
         ppermute), so a single prompt's attention spreads across chips and
@@ -593,9 +650,10 @@ def build(config: dict) -> SimpleNamespace:
             out = ring_attention(q, kf, vf, mesh, axis_name="sp", causal=True)
             return out.reshape(b, s, n_heads * head_dim).astype(q.dtype)
 
-        return _prefill_impl(params, tokens, seq_lens, cache, attend_sp)
+        return _prefill_impl(params, tokens, seq_lens, cache, attend_sp, lora_idx)
 
-    def decode(params, tokens: jnp.ndarray, cache):
+    def decode(params, tokens: jnp.ndarray, cache,
+               lora_idx: Optional[jnp.ndarray] = None):
         """One decode step. tokens: [B] int32. Returns (logits [B, vocab], cache)."""
         b = tokens.shape[0]
         positions = cache["length"][:, None]                       # [B, 1]
@@ -612,14 +670,14 @@ def build(config: dict) -> SimpleNamespace:
         def layer_body(x, xs):
             layer, k_cache_l, v_cache_l = xs
             h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)                     # k,v: [B,1,Hkv,D]
+            q, k, v = _qkv(layer, h, cos, sin, lora_idx)           # k,v: [B,1,Hkv,D]
             # cast to the cache dtype: params may be a different precision
             # than the cache (e.g. f32 checkpoint into a bf16 cache)
             k_cache = jnp.where(write, k.astype(k_cache_l.dtype), k_cache_l)
             v_cache = jnp.where(write, v.astype(v_cache_l.dtype), v_cache_l)
-            x = x + _attend(q, k_cache, v_cache, mask) @ _w(layer, "wo")
+            x = x + _oproj(layer, _attend(q, k_cache, v_cache, mask), lora_idx)
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h), (k_cache, v_cache)
+            return x + _ffn(layer, h, lora_idx=lora_idx), (k_cache, v_cache)
 
         if scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
@@ -654,6 +712,7 @@ def build(config: dict) -> SimpleNamespace:
         lengths,       # [B] int32 tokens present BEFORE this step
         write_page,    # [B] int32 page id for the new token
         write_offset,  # [B] int32 offset within that page
+        lora_idx=None,  # [B] int32 adapter index per slot (None = base)
     ):
         """One decode step over paged KV: writes the new token's K/V into the
         pools (scatter by (page, offset)), then attends via
@@ -669,7 +728,7 @@ def build(config: dict) -> SimpleNamespace:
             """One layer on its own pool slice [Hkv, N, P, D]; returns the
             updated pool slice (scatter of the new token's K/V)."""
             h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin)                     # q [B,1,H,D]
+            q, k, v = _qkv(layer, h, cos, sin, lora_idx)           # q [B,1,H,D]
             # index tuple (:, wp, wo): the advanced indices are CONTIGUOUS, so
             # the broadcast dim [B] lands after the sliced head dim ->
             # set() takes [Hkv, B, D].
@@ -682,9 +741,9 @@ def build(config: dict) -> SimpleNamespace:
                 q_grouped, k_pool_l, v_pool_l, page_table, lengths + 1
             )                                                      # [B,Hkv,G,D]
             attn = attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
-            x = x + attn @ _w(layer, "wo")
+            x = x + _oproj(layer, attn, lora_idx)
             h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h), k_pool_l, v_pool_l
+            return x + _ffn(layer, h, lora_idx=lora_idx), k_pool_l, v_pool_l
 
         if scan_layers:
             def scan_body(x, xs):
@@ -708,7 +767,9 @@ def build(config: dict) -> SimpleNamespace:
     def prepare_params(params):
         """Adapt a loaded param pytree to this build's layout: under
         scan_layers, a list/tuple of per-layer dicts (e.g. from a checkpoint
-        converter) is stacked into the [L, ...] pytree lax.scan consumes."""
+        converter) is stacked into the [L, ...] pytree lax.scan consumes.
+        When the build enables LoRA, checkpoints that predate it get zero
+        adapter stacks backfilled (index 0 = base model)."""
         layers = params.get("layers")
         if scan_layers and isinstance(layers, (list, tuple)):
             params = dict(params)
@@ -718,6 +779,38 @@ def build(config: dict) -> SimpleNamespace:
             params["layers"] = [
                 jax.tree.map(lambda x: x[i], layers) for i in range(n_layers)
             ]
+        if lora_rank:
+            from . import lora as lora_lib
+
+            params = dict(params)
+            layers = params["layers"]
+            if isinstance(layers, dict):
+                if "lora_a_" + lora_targets[0] not in layers:
+                    layers = dict(layers)
+                    for t in lora_targets:
+                        d_in, d_out = lora_lib.target_dims(cfg, t)
+                        layers["lora_a_" + t] = jnp.zeros(
+                            (n_layers, max_loras + 1, d_in, lora_rank), dtype
+                        )
+                        layers["lora_b_" + t] = jnp.zeros(
+                            (n_layers, max_loras + 1, lora_rank, d_out), dtype
+                        )
+                    params["layers"] = layers
+            else:
+                if layers and "lora_a_" + lora_targets[0] not in layers[0]:
+                    new_layers = []
+                    for layer in layers:
+                        layer = dict(layer)
+                        for t in lora_targets:
+                            d_in, d_out = lora_lib.target_dims(cfg, t)
+                            layer["lora_a_" + t] = jnp.zeros(
+                                (max_loras + 1, d_in, lora_rank), dtype
+                            )
+                            layer["lora_b_" + t] = jnp.zeros(
+                                (max_loras + 1, lora_rank, d_out), dtype
+                            )
+                        new_layers.append(layer)
+                    params["layers"] = new_layers
         return params
 
     return SimpleNamespace(
@@ -740,4 +833,6 @@ def build(config: dict) -> SimpleNamespace:
         n_kv_heads=n_kv,
         n_heads=n_heads,
         n_layers=n_layers,
+        lora_rank=lora_rank,
+        max_loras=max_loras,
     )

@@ -1,0 +1,359 @@
+"""Multi-LoRA adapter serving for the llama-family decoder.
+
+Reference capability: vLLM's ``lora_modules`` engine knob, surfaced by the
+reference's vLLM preprocess config (reference
+clearml_serving/serving/preprocess_service.py:740-767 wires
+``lora_modules``/``LoRAModulePath`` into the OpenAI serving layer, and
+examples/vllm/preprocess.py lists it among the model-config knobs). A served
+endpoint exposes its base model plus N named adapters; each request picks one
+by the OpenAI ``model`` field.
+
+TPU-first design — *stacked adapters, gathered per slot inside the layer*:
+
+- For every LoRA-targeted projection ``t`` each decoder layer carries two
+  stacks ``lora_a_t`` [A+1, in, r] and ``lora_b_t`` [A+1, r, out] where A =
+  ``max_loras``; index 0 is the base model (all-zero delta), adapters live at
+  1..A. Under ``scan_layers`` the stacks gain the leading layer dim like
+  every other layer weight and ride the same ``lax.scan``.
+- The batch carries ``lora_idx`` [B] int32. Inside the (scanned) layer body
+  the projection adds ``(x @ a[lora_idx]) @ b[lora_idx]`` — two small batched
+  matmuls (rank r), so ONE compiled executable serves any mix of adapters in
+  the same continuous batch; swapping adapters never recompiles. This is the
+  standard batched-LoRA trick (vLLM's SGMV kernels do the gather on CUDA);
+  on TPU the per-slot gather + einsum lowers to XLA gather + batched matmul
+  with no custom kernel needed at serving ranks (r ≤ 64).
+- Quantization composes: the int8 path (ops/quant.py) quantizes only the base
+  projections; LoRA stacks stay in the model dtype (they are small and
+  precision-critical).
+
+PEFT checkpoints (adapter_model.safetensors / .bin + adapter_config.json)
+convert via :func:`load_peft_adapter`; the ``alpha/r`` scaling folds into the
+B factor at load time so the serving graph has no runtime scale multiply.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# target name -> (in_dim, out_dim) resolver, given a resolved llama config
+_TARGET_DIMS = {
+    "wq": lambda c: (c["dim"], c["n_heads"] * (c["dim"] // c["n_heads"])),
+    "wk": lambda c: (c["dim"], c["n_kv_heads"] * (c["dim"] // c["n_heads"])),
+    "wv": lambda c: (c["dim"], c["n_kv_heads"] * (c["dim"] // c["n_heads"])),
+    "wo": lambda c: (c["n_heads"] * (c["dim"] // c["n_heads"]), c["dim"]),
+    "w_gate": lambda c: (c["dim"], c["ffn_dim"]),
+    "w_up": lambda c: (c["dim"], c["ffn_dim"]),
+    "w_down": lambda c: (c["ffn_dim"], c["dim"]),
+}
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+# HF PEFT module names -> our projection names
+_PEFT_NAME_MAP = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def lora_spec(cfg: dict) -> Tuple[int, Tuple[str, ...], int]:
+    """(rank, targets, max_loras) from a resolved llama config; rank 0 = off."""
+    rank = int(cfg.get("lora_rank", 0) or 0)
+    targets = tuple(cfg.get("lora_targets") or DEFAULT_TARGETS)
+    max_loras = int(cfg.get("max_loras", 4) or 4)
+    for t in targets:
+        if t not in _TARGET_DIMS:
+            raise ValueError(
+                "unknown lora target {!r} (supported: {})".format(
+                    t, sorted(_TARGET_DIMS)
+                )
+            )
+    return rank, targets, max_loras
+
+
+def target_dims(cfg: dict, target: str) -> Tuple[int, int]:
+    return tuple(int(x) for x in _TARGET_DIMS[target](cfg))
+
+
+def zero_stacks(cfg: dict, dtype) -> Dict[str, np.ndarray]:
+    """Per-layer zero LoRA stacks {lora_a_t: [A+1, in, r], lora_b_t: ...}.
+
+    Returned as numpy so callers can install adapters host-side before the
+    tree is placed on device."""
+    import jax.numpy as jnp
+
+    rank, targets, max_loras = lora_spec(cfg)
+    out: Dict[str, Any] = {}
+    for t in targets:
+        d_in, d_out = target_dims(cfg, t)
+        out["lora_a_" + t] = jnp.zeros((max_loras + 1, d_in, rank), dtype)
+        out["lora_b_" + t] = jnp.zeros((max_loras + 1, rank, d_out), dtype)
+    return out
+
+
+def install_adapter(params: Dict[str, Any], index: int, adapter: Dict[str, Any]):
+    """Write one adapter's factors into the param tree's LoRA stacks at
+    ``index`` (1-based; 0 is reserved for the base model).
+
+    ``adapter``: {target: {"a": [L, in, r], "b": [L, r, out]}} (layer-major,
+    as produced by :func:`load_peft_adapter`). Handles both the scan_layers
+    stacked layout (params["layers"] is a dict of [L, ...] arrays) and the
+    per-layer list layout. Returns the updated tree (functional)."""
+    if index < 1:
+        raise ValueError("adapter index must be >= 1 (0 is the base model)")
+    layers = params["layers"]
+    stacked = isinstance(layers, dict)
+    params = dict(params)
+    if stacked:
+        layers = dict(layers)
+        for t, ab in adapter.items():
+            a_key, b_key = "lora_a_" + t, "lora_b_" + t
+            if a_key not in layers:
+                raise ValueError(
+                    "model was not built with lora target {!r} "
+                    "(set lora_targets)".format(t)
+                )
+            if index >= layers[a_key].shape[1]:
+                raise ValueError(
+                    "adapter index {} exceeds max_loras {}".format(
+                        index, layers[a_key].shape[1] - 1
+                    )
+                )
+            r_have = layers[a_key].shape[-1]
+            a = np.asarray(ab["a"], dtype=np.float32)
+            b = np.asarray(ab["b"], dtype=np.float32)
+            if a.shape[-1] > r_have:
+                raise ValueError(
+                    "adapter rank {} exceeds built lora_rank {}".format(
+                        a.shape[-1], r_have
+                    )
+                )
+            # lower-rank adapters zero-pad up to the built rank (the padded
+            # columns contribute nothing: a's extra columns meet b's zero rows)
+            if a.shape[-1] < r_have:
+                pad = r_have - a.shape[-1]
+                a = np.pad(a, ((0, 0), (0, 0), (0, pad)))
+                b = np.pad(b, ((0, 0), (0, pad), (0, 0)))
+            layers[a_key] = layers[a_key].at[:, index].set(
+                a.astype(layers[a_key].dtype)
+            )
+            layers[b_key] = layers[b_key].at[:, index].set(
+                b.astype(layers[b_key].dtype)
+            )
+        params["layers"] = layers
+    else:
+        new_layers = []
+        for li, layer in enumerate(layers):
+            layer = dict(layer)
+            for t, ab in adapter.items():
+                a_key, b_key = "lora_a_" + t, "lora_b_" + t
+                if a_key not in layer:
+                    raise ValueError(
+                        "model was not built with lora target {!r}".format(t)
+                    )
+                if index >= layer[a_key].shape[0]:
+                    raise ValueError(
+                        "adapter index {} exceeds max_loras {}".format(
+                            index, layer[a_key].shape[0] - 1
+                        )
+                    )
+                r_have = layer[a_key].shape[-1]
+                a = np.asarray(ab["a"][li], dtype=np.float32)
+                b = np.asarray(ab["b"][li], dtype=np.float32)
+                if a.shape[-1] > r_have:
+                    raise ValueError(
+                        "adapter rank {} exceeds built lora_rank {}".format(
+                            a.shape[-1], r_have
+                        )
+                    )
+                if a.shape[-1] < r_have:
+                    pad = r_have - a.shape[-1]
+                    a = np.pad(a, ((0, 0), (0, pad)))
+                    b = np.pad(b, ((0, pad), (0, 0)))
+                layer[a_key] = layer[a_key].at[index].set(
+                    a.astype(layer[a_key].dtype)
+                )
+                layer[b_key] = layer[b_key].at[index].set(
+                    b.astype(layer[b_key].dtype)
+                )
+            new_layers.append(layer)
+        params["layers"] = new_layers
+    return params
+
+
+def merge_adapter_into_weights(params: Dict[str, Any], adapter: Dict[str, Any]):
+    """Dense-merge an adapter into base weights (W + A @ B) — the classic
+    offline merge, used by tests as the ground truth the batched path must
+    match. Only supports the per-layer list layout with plain (unquantized)
+    weights."""
+    import jax.numpy as jnp
+
+    params = dict(params)
+    new_layers = []
+    for li, layer in enumerate(params["layers"]):
+        layer = dict(layer)
+        for t, ab in adapter.items():
+            delta = jnp.asarray(ab["a"][li], jnp.float32) @ jnp.asarray(
+                ab["b"][li], jnp.float32
+            )
+            layer[t] = (layer[t].astype(jnp.float32) + delta).astype(layer[t].dtype)
+        new_layers.append(layer)
+    params["layers"] = new_layers
+    return params
+
+
+# -- adapter file formats -----------------------------------------------------
+
+def load_adapter(path, n_layers: int) -> Dict[str, Any]:
+    """Load an adapter directory in either supported format:
+
+    - PEFT (HF): adapter_config.json + adapter_model.safetensors/.bin
+    - native: lora_config.json + lora.msgpack ({target: {"a": [L,in,r], ...}})
+    """
+    path = Path(path)
+    if (path / "adapter_config.json").exists():
+        return load_peft_adapter(path, n_layers)
+    if (path / "lora.msgpack").exists():
+        from flax import serialization
+
+        tree = serialization.msgpack_restore(
+            bytearray((path / "lora.msgpack").read_bytes())
+        )
+        return {t: {"a": np.asarray(ab["a"]), "b": np.asarray(ab["b"])}
+                for t, ab in tree.items()}
+    raise ValueError(
+        "not a LoRA adapter dir (no adapter_config.json or lora.msgpack): {}".format(
+            path
+        )
+    )
+
+
+def save_adapter(path, adapter: Dict[str, Any]) -> None:
+    """Write the native adapter format."""
+    from flax import serialization
+
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    tree = {t: {"a": np.asarray(ab["a"]), "b": np.asarray(ab["b"])}
+            for t, ab in adapter.items()}
+    (path / "lora.msgpack").write_bytes(serialization.msgpack_serialize(tree))
+    (path / "lora_config.json").write_text(json.dumps(
+        {t: {"rank": int(tree[t]["a"].shape[-1])} for t in tree}
+    ))
+
+
+def load_peft_adapter(path, n_layers: int) -> Dict[str, Any]:
+    """HF PEFT LoRA checkpoint -> {target: {"a": [L, in, r], "b": [L, r, out]}}.
+
+    PEFT stores per-module ``lora_A.weight`` [r, in] and ``lora_B.weight``
+    [out, r] under keys like
+    ``base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight``.
+    The delta is ``(alpha / r) * B @ A``; the scaling folds into B here so
+    serving needs no extra multiply. Layers a checkpoint omits get zeros."""
+    path = Path(path)
+    cfg = json.loads((path / "adapter_config.json").read_text())
+    alpha = float(cfg.get("lora_alpha", cfg.get("alpha", 1.0)))
+    rank = int(cfg.get("r", cfg.get("rank", 0)) or 0)
+    state = _load_peft_state_dict(path)
+    if not state:
+        raise ValueError("empty PEFT adapter state dict in {}".format(path))
+    if not rank:
+        rank = next(iter(state.values())).shape[0]
+    scale = alpha / float(rank)
+
+    # group keys: (layer_index, our_target) -> {"A": ..., "B": ...}
+    grouped: Dict[Tuple[int, str], Dict[str, np.ndarray]] = {}
+    for key, tensor in state.items():
+        parts = key.split(".")
+        if "lora_A" in parts:
+            which = "A"
+        elif "lora_B" in parts:
+            which = "B"
+        else:
+            continue
+        layer_idx = None
+        target = None
+        for i, p in enumerate(parts):
+            if p == "layers" and i + 1 < len(parts) and parts[i + 1].isdigit():
+                layer_idx = int(parts[i + 1])
+            if p in _PEFT_NAME_MAP:
+                target = _PEFT_NAME_MAP[p]
+        if layer_idx is None or target is None:
+            continue
+        grouped.setdefault((layer_idx, target), {})[which] = np.asarray(
+            tensor, dtype=np.float32
+        )
+
+    targets = sorted({t for (_l, t) in grouped})
+    out: Dict[str, Any] = {}
+    for t in targets:
+        a_layers, b_layers = [], []
+        # shapes from any present layer
+        sample = next(v for (l, tt), v in grouped.items() if tt == t)
+        d_in = sample["A"].shape[1]
+        d_out = sample["B"].shape[0]
+        for li in range(n_layers):
+            entry = grouped.get((li, t))
+            if entry is None or "A" not in entry or "B" not in entry:
+                a_layers.append(np.zeros((d_in, rank), np.float32))
+                b_layers.append(np.zeros((rank, d_out), np.float32))
+            else:
+                a_layers.append(entry["A"].T)                   # [in, r]
+                b_layers.append(scale * entry["B"].T)           # [r, out]
+        out[t] = {"a": np.stack(a_layers), "b": np.stack(b_layers)}
+    return out
+
+
+def _load_peft_state_dict(path: Path) -> Dict[str, np.ndarray]:
+    st_file = path / "adapter_model.safetensors"
+    if st_file.exists():
+        try:
+            from safetensors.numpy import load_file
+
+            return dict(load_file(str(st_file)))
+        except ImportError:
+            # safetensors-without-library fallback: the format is a JSON
+            # header + raw little-endian tensors; parse it directly
+            return _read_safetensors(st_file)
+    bin_file = path / "adapter_model.bin"
+    if bin_file.exists():
+        import torch
+
+        sd = torch.load(str(bin_file), map_location="cpu", weights_only=True)
+        return {k: v.float().numpy() for k, v in sd.items()}
+    raise ValueError("no adapter_model.safetensors/.bin in {}".format(path))
+
+
+_ST_DTYPES = {
+    "F32": np.float32, "F16": np.float16, "BF16": None,  # bf16 special-cased
+    "F64": np.float64, "I64": np.int64, "I32": np.int32,
+}
+
+
+def _read_safetensors(path: Path) -> Dict[str, np.ndarray]:
+    raw = path.read_bytes()
+    hdr_len = int.from_bytes(raw[:8], "little")
+    header = json.loads(raw[8 : 8 + hdr_len].decode("utf-8"))
+    base = 8 + hdr_len
+    out = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        lo, hi = meta["data_offsets"]
+        buf = raw[base + lo : base + hi]
+        dt = meta["dtype"]
+        if dt == "BF16":
+            u16 = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
+            arr = u16.view(np.float32)
+        else:
+            arr = np.frombuffer(buf, _ST_DTYPES[dt])
+        out[name] = arr.reshape(meta["shape"]).astype(np.float32)
+    return out

@@ -58,6 +58,17 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         "w_gate_e": col("ep", None, "tp"),
         "w_up_e": col("ep", None, "tp"),
         "w_down_e": col("ep", "tp", None),
+        # LoRA stacks [A+1, in, r]/[A+1, r, out] (models/lora.py): the B
+        # factor shards its output dim like the base weight (column-parallel
+        # targets) and the A factor shards its input dim for the
+        # row-parallel targets (wo/w_down); the rank dim never shards
+        "lora_a_wq": col(), "lora_b_wq": col(None, None, "tp"),
+        "lora_a_wk": col(), "lora_b_wk": col(None, None, "tp"),
+        "lora_a_wv": col(), "lora_b_wv": col(None, None, "tp"),
+        "lora_a_wo": col(None, "tp", None), "lora_b_wo": col(),
+        "lora_a_w_gate": col(), "lora_b_w_gate": col(None, None, "tp"),
+        "lora_a_w_up": col(), "lora_b_w_up": col(None, None, "tp"),
+        "lora_a_w_down": col(None, "tp", None), "lora_b_w_down": col(),
     }
     # spec structure must mirror the actual param keys (dense layers carry
     # w_gate/..., MoE layers carry w_router/w_*_e)

@@ -636,6 +636,20 @@ class ModelRequestProcessor:
     def _normalize_endpoint_url(self, endpoint: str, version: Optional[str] = None) -> str:
         return "{}/{}".format(endpoint.rstrip("/"), version) if version else endpoint.strip("/")
 
+    def _resolve_lora_alias(self, name: str) -> Optional[str]:
+        """Endpoint whose aux ``engine.lora.modules`` declares adapter
+        ``name`` (config-driven, so it works before the endpoint's engine has
+        ever been constructed). None if nothing claims the name."""
+        for registry in (self._endpoints, self._model_monitoring_endpoints):
+            for url, ep in registry.items():
+                aux = ep.auxiliary_cfg if isinstance(ep.auxiliary_cfg, dict) else {}
+                modules = ((aux.get("engine") or {}).get("lora") or {}).get(
+                    "modules"
+                ) or {}
+                if name in modules:
+                    return url
+        return None
+
     def _get_processor(self, url: str) -> BaseEngineRequest:
         processor = self._engine_processor_lookup.get(url)
         if processor is None:
@@ -664,12 +678,19 @@ class ModelRequestProcessor:
             if canary_url:
                 url = canary_url
             if url not in self._endpoints and url not in self._model_monitoring_endpoints:
-                raise EndpointNotFoundException(
-                    "endpoint {!r} not found (have: {})".format(
-                        url,
-                        sorted(list(self._endpoints) + list(self._model_monitoring_endpoints)),
+                # OpenAI multi-LoRA: an adapter name declared in some llm
+                # endpoint's aux engine.lora.modules serves as a top-level
+                # model name (vLLM-compatible); route it to that endpoint —
+                # the engine applies the adapter per the body's `model` field
+                alias = self._resolve_lora_alias(url)
+                if alias is None:
+                    raise EndpointNotFoundException(
+                        "endpoint {!r} not found (have: {})".format(
+                            url,
+                            sorted(list(self._endpoints) + list(self._model_monitoring_endpoints)),
+                        )
                     )
-                )
+                url = alias
             processor = self._get_processor(url)
             tic = time.monotonic()
             entry = self._telemetry.setdefault(

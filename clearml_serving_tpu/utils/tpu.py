@@ -1,0 +1,19 @@
+"""TPU backend identity helpers.
+
+The serving image's TPU is tunneled through an **experimental PJRT platform
+named "axon"** (registered by the image's sitecustomize); jax reports the
+device's platform as "axon" while device_kind still says TPU. A directly
+attached chip reports platform "tpu". Everything that needs to answer "is
+this device the TPU?" — the bench driver, the tunnel-watcher battery, the
+kernel microbenches — shares this one predicate so a future rename only has
+one place to miss.
+"""
+
+from __future__ import annotations
+
+
+def is_tpu_device(dev) -> bool:
+    """True if this jax device is the TPU, under any of its names."""
+    return dev.platform in ("tpu", "axon") or "TPU" in getattr(
+        dev, "device_kind", ""
+    )

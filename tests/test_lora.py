@@ -1,0 +1,398 @@
+"""Multi-LoRA serving tests (models/lora.py + llama lora_idx threading +
+engine adapter routing).
+
+Ground truth for the batched gather path is the classic offline dense merge
+(W + A @ B): per-slot stacked-LoRA outputs must match a model whose weights
+were merged with the same adapter.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.models import lora as lora_lib
+
+TINY = {
+    "preset": "llama-tiny",
+    "dtype": "float32",
+    "lora_rank": 4,
+    "max_loras": 2,
+}
+
+
+def _rand_adapter(cfg, n_layers, rng, targets=("wq", "wk", "wv", "wo"), rank=4):
+    """Random adapter tree {target: {"a": [L, in, r], "b": [L, r, out]}}."""
+    out = {}
+    for t in targets:
+        d_in, d_out = lora_lib.target_dims(cfg, t)
+        k1, k2, rng = jax.random.split(rng, 3)
+        out[t] = {
+            "a": 0.1 * np.asarray(jax.random.normal(k1, (n_layers, d_in, rank))),
+            "b": 0.1 * np.asarray(jax.random.normal(k2, (n_layers, rank, d_out))),
+        }
+    return out
+
+
+@pytest.fixture(scope="module")
+def lora_parts():
+    bundle = models.build_model("llama", TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    adapter = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(7))
+    params = lora_lib.install_adapter(params, 1, adapter)
+    return bundle, params, adapter
+
+
+def test_base_index_matches_no_lora(lora_parts):
+    """lora_idx == 0 must equal a model built without LoRA entirely."""
+    bundle, params, _ = lora_parts
+    plain_bundle = models.build_model(
+        "llama", {k: v for k, v in TINY.items() if not k.startswith(("lora", "max_"))}
+    )
+    plain_params = plain_bundle.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[5, 9, 2, 17, 33, 1, 4, 8]], jnp.int32)
+    base = plain_bundle.apply(plain_params, tokens)
+    via_zero = bundle.apply(params, tokens, lora_idx=jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(via_zero), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_adapter_matches_dense_merge(lora_parts):
+    """Batched stacked-LoRA == offline dense merge of the same adapter."""
+    bundle, params, adapter = lora_parts
+    plain_bundle = models.build_model(
+        "llama", {k: v for k, v in TINY.items() if not k.startswith(("lora", "max_"))}
+    )
+    merged = lora_lib.merge_adapter_into_weights(
+        plain_bundle.init(jax.random.PRNGKey(0)), adapter
+    )
+    tokens = jnp.asarray([[5, 9, 2, 17, 33, 1, 4, 8]], jnp.int32)
+    want = plain_bundle.apply(merged, tokens)
+    got = bundle.apply(params, tokens, lora_idx=jnp.ones((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=2e-4, atol=2e-4)
+
+
+def test_mixed_batch_slots_independent(lora_parts):
+    """A batch mixing base + adapter rows must equal per-row single runs."""
+    bundle, params, _ = lora_parts
+    tokens = jnp.asarray(
+        [[5, 9, 2, 17, 33, 1, 4, 8], [5, 9, 2, 17, 33, 1, 4, 8]], jnp.int32
+    )
+    mixed = bundle.apply(params, tokens, lora_idx=jnp.asarray([0, 1], jnp.int32))
+    solo0 = bundle.apply(params, tokens[:1], lora_idx=jnp.asarray([0], jnp.int32))
+    solo1 = bundle.apply(params, tokens[1:], lora_idx=jnp.asarray([1], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(mixed[0]), np.asarray(solo0[0]), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(mixed[1]), np.asarray(solo1[0]), rtol=1e-5, atol=1e-5
+    )
+    # and the two rows genuinely differ (the adapter does something)
+    assert not np.allclose(np.asarray(mixed[0]), np.asarray(mixed[1]), atol=1e-3)
+
+
+def test_prefill_decode_with_adapter_matches_apply(lora_parts):
+    """The cached serving path (prefill + decode) under an adapter agrees
+    with the uncached causal forward's argmax chain."""
+    bundle, params, _ = lora_parts
+    ids = [5, 9, 2, 17, 33]
+    lora1 = jnp.ones((1,), jnp.int32)
+    tokens = jnp.asarray([ids], jnp.int32)
+    cache = bundle.init_cache(1, 32)
+    logits, cache = bundle.prefill(
+        params, tokens, jnp.asarray([len(ids)], jnp.int32), cache, lora1
+    )
+    ref_logits = bundle.apply(params, tokens, lora_idx=lora1)
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(ref_logits[0, -1]), rtol=1e-4, atol=1e-4
+    )
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits2, cache = bundle.decode(params, nxt, cache, lora1)
+    full = jnp.asarray([ids + [int(nxt[0])]], jnp.int32)
+    ref2 = bundle.apply(params, full, lora_idx=lora1)
+    np.testing.assert_allclose(
+        np.asarray(logits2[0]), np.asarray(ref2[0, -1]), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_lower_rank_adapter_pads(lora_parts):
+    bundle, _, _ = lora_parts
+    params = bundle.init(jax.random.PRNGKey(0))
+    adapter = _rand_adapter(
+        bundle.config, bundle.n_layers, jax.random.PRNGKey(3), rank=2
+    )
+    params2 = lora_lib.install_adapter(params, 2, adapter)
+    tokens = jnp.asarray([[5, 9, 2, 17]], jnp.int32)
+    base = bundle.apply(params2, tokens, lora_idx=jnp.zeros((1,), jnp.int32))
+    with_a = bundle.apply(params2, tokens, lora_idx=jnp.full((1,), 2, jnp.int32))
+    assert not np.allclose(np.asarray(base), np.asarray(with_a), atol=1e-4)
+
+
+def test_install_adapter_bounds(lora_parts):
+    bundle, params, adapter = lora_parts
+    with pytest.raises(ValueError):
+        lora_lib.install_adapter(params, 0, adapter)  # 0 is the base
+    with pytest.raises(ValueError):
+        lora_lib.install_adapter(params, 3, adapter)  # max_loras=2
+    big = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(1), rank=8)
+    with pytest.raises(ValueError):
+        lora_lib.install_adapter(params, 1, big)  # rank 8 > built rank 4
+
+
+def test_quantize_keeps_lora_full_precision(lora_parts):
+    from clearml_serving_tpu.ops.quant import quantize_llama_params
+
+    bundle, params, _ = lora_parts
+    q = quantize_llama_params(params)
+    layers = q["layers"]
+    sample = layers if isinstance(layers, dict) else layers[0]
+    assert isinstance(sample["wq"], dict) and "_q8" in sample["wq"]
+    assert not isinstance(sample["lora_a_wq"], dict)  # untouched array
+    tokens = jnp.asarray([[5, 9, 2, 17]], jnp.int32)
+    out = bundle.apply(q, tokens, lora_idx=jnp.ones((1,), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_scan_layers_lora_matches_unscanned():
+    cfg = dict(TINY)
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    adapter = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(7))
+    params = lora_lib.install_adapter(params, 1, adapter)
+
+    scan_bundle = models.build_model("llama", dict(cfg, scan_layers=True))
+    scan_params = scan_bundle.prepare_params(
+        {k: (list(v) if k == "layers" else v) for k, v in params.items()}
+    )
+    tokens = jnp.asarray([[5, 9, 2, 17, 33, 1]], jnp.int32)
+    one = jnp.ones((1,), jnp.int32)
+    a = bundle.apply(params, tokens, lora_idx=one)
+    b = scan_bundle.apply(scan_params, tokens, lora_idx=one)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_peft_adapter_roundtrip(tmp_path):
+    """A PEFT-format checkpoint (adapter_model.bin + adapter_config.json)
+    loads with the alpha/r scaling folded into B."""
+    import json
+
+    import torch
+
+    bundle = models.build_model("llama", TINY)
+    cfg = bundle.config
+    n_layers = bundle.n_layers
+    rank, alpha = 4, 8.0
+    rng = np.random.RandomState(0)
+    sd = {}
+    d_in, d_out = lora_lib.target_dims(cfg, "wq")
+    for li in range(n_layers):
+        prefix = "base_model.model.model.layers.{}.self_attn.q_proj".format(li)
+        sd[prefix + ".lora_A.weight"] = torch.tensor(
+            rng.randn(rank, d_in).astype(np.float32)
+        )
+        sd[prefix + ".lora_B.weight"] = torch.tensor(
+            rng.randn(d_out, rank).astype(np.float32)
+        )
+    torch.save(sd, tmp_path / "adapter_model.bin")
+    (tmp_path / "adapter_config.json").write_text(
+        json.dumps({"r": rank, "lora_alpha": alpha, "target_modules": ["q_proj"]})
+    )
+    tree = lora_lib.load_adapter(tmp_path, n_layers)
+    assert set(tree) == {"wq"}
+    assert tree["wq"]["a"].shape == (n_layers, d_in, rank)
+    assert tree["wq"]["b"].shape == (n_layers, rank, d_out)
+    # scaling folded: b == (alpha/r) * B^T
+    want = (alpha / rank) * np.asarray(
+        sd["base_model.model.model.layers.0.self_attn.q_proj.lora_B.weight"]
+    ).T
+    np.testing.assert_allclose(tree["wq"]["b"][0], want, rtol=1e-6)
+
+
+def test_native_adapter_save_load(tmp_path):
+    bundle = models.build_model("llama", TINY)
+    adapter = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(5))
+    lora_lib.save_adapter(tmp_path / "ad", adapter)
+    back = lora_lib.load_adapter(tmp_path / "ad", bundle.n_layers)
+    for t in adapter:
+        np.testing.assert_allclose(back[t]["a"], adapter[t]["a"], rtol=1e-6)
+        np.testing.assert_allclose(back[t]["b"], adapter[t]["b"], rtol=1e-6)
+
+
+# -- engine-level -------------------------------------------------------------
+
+
+def _engine(bundle, params, **kw):
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prefill_buckets", [16])
+    kw.setdefault("eos_token_id", None)
+    kw.setdefault("decode_steps", 2)
+    return LLMEngineCore(bundle, params, **kw)
+
+
+def test_engine_routes_adapters():
+    """Two concurrent requests on different adapters produce the same tokens
+    as each adapter run alone; unknown adapter names are rejected."""
+    from clearml_serving_tpu.llm.engine import GenRequest
+
+    bundle = models.build_model("llama", TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    ad1 = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(7))
+    ad2 = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(8))
+    adapters = {"fin-tune": ad1, "med-tune": ad2}
+    prompt = [5, 9, 2, 17, 33, 1]
+
+    async def run_pair():
+        engine = _engine(bundle, params, lora_adapters=adapters)
+        reqs = [
+            GenRequest(prompt_ids=list(prompt), max_new_tokens=6, adapter=a)
+            for a in (None, "fin-tune", "med-tune")
+        ]
+
+        async def collect(r):
+            return [t async for t in engine.generate(r)]
+
+        outs = await asyncio.gather(*[collect(r) for r in reqs])
+        engine.stop()
+        return outs
+
+    async def run_solo(adapter):
+        engine = _engine(bundle, params, lora_adapters=adapters)
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=6, adapter=adapter)
+        out = [t async for t in engine.generate(req)]
+        engine.stop()
+        return out
+
+    base, fin, med = asyncio.run(run_pair())
+    assert fin != base or med != base  # adapters change greedy output
+    assert asyncio.run(run_solo("fin-tune")) == fin
+    assert asyncio.run(run_solo("med-tune")) == med
+
+    async def run_unknown():
+        engine = _engine(bundle, params, lora_adapters=adapters)
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=2, adapter="nope")
+        try:
+            with pytest.raises(ValueError):
+                async for _ in engine.generate(req):
+                    pass
+        finally:
+            engine.stop()
+
+    asyncio.run(run_unknown())
+
+
+def test_router_serves_adapter_by_model_field(tmp_path):
+    """Full stack: aux engine.lora.modules -> endpoint load -> OpenAI chat
+    with `model` naming the adapter; /v1/models lists it with a parent."""
+    import os
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+    from clearml_serving_tpu.serving.main import build_app
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    bundle = models.build_model("llama", TINY)
+    adapter = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(9))
+    lora_lib.save_adapter(tmp_path / "tuned", adapter)
+
+    root = tmp_path / "state"
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    try:
+        mrp = ModelRequestProcessor(
+            state_root=str(root), force_create=True, name="lora-llm"
+        )
+        mrp.add_endpoint(
+            ModelEndpoint(
+                engine_type="llm",
+                serving_url="lora_llm",
+                auxiliary_cfg={
+                    "engine": {
+                        "preset": "llama-tiny",
+                        "config": {
+                            "dtype": "float32",
+                            "lora_rank": 4,
+                            "max_loras": 2,
+                        },
+                        "max_batch": 2,
+                        "max_seq_len": 64,
+                        "prefill_buckets": [16],
+                        "lora": {"modules": {"tuned": str(tmp_path / "tuned")}},
+                    }
+                },
+            )
+        )
+        mrp.serialize()
+        mrp.deserialize(skip_sync=True)
+
+        async def drive():
+            client = TestClient(TestServer(build_app(mrp)))
+            await client.start_server()
+            try:
+                body = {
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                }
+                r_base = await client.post(
+                    "/serve/openai/v1/chat/completions",
+                    json=dict(body, model="lora_llm"),
+                )
+                assert r_base.status == 200, await r_base.text()
+                r_tuned = await client.post(
+                    "/serve/openai/v1/chat/completions",
+                    json=dict(body, model="tuned"),
+                )
+                assert r_tuned.status == 200, await r_tuned.text()
+                r_models = await client.post(
+                    "/serve/openai/v1/models", json={"model": "lora_llm"}
+                )
+                listing = await r_models.json()
+                return (
+                    await r_base.json(),
+                    await r_tuned.json(),
+                    listing,
+                )
+            finally:
+                await client.close()
+
+        base, tuned, listing = asyncio.run(drive())
+        ids = {m["id"]: m for m in listing["data"]}
+        assert "tuned" in ids and ids["tuned"].get("parent") == "lora_llm"
+        assert base["choices"][0]["message"]["content"] != "" or True
+        # the adapter changes greedy output for at least this prompt
+        assert (
+            base["choices"][0]["message"]["content"]
+            != tuned["choices"][0]["message"]["content"]
+        )
+    finally:
+        os.environ.pop("TPUSERVE_STATE_ROOT", None)
+
+
+def test_engine_lora_with_speculation():
+    """Adapter routing composes with n-gram speculative decoding (verify
+    threads lora_idx): greedy output equals the plain-decode engine's."""
+    from clearml_serving_tpu.llm.engine import GenRequest
+
+    bundle = models.build_model("llama", TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    ad = {"tune": _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(7))}
+    prompt = [5, 9, 2, 17, 5, 9, 2]
+
+    async def run(**kw):
+        engine = _engine(bundle, params, lora_adapters=ad, **kw)
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=8, adapter="tune")
+        out = [t async for t in engine.generate(req)]
+        engine.stop()
+        return out
+
+    plain = asyncio.run(run())
+    spec = asyncio.run(run(speculation="ngram", spec_k=2, spec_ngram=2))
+    assert spec == plain

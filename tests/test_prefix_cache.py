@@ -1,0 +1,191 @@
+"""Automatic prefix caching tests (llm/prefix_cache.py + engine hit path).
+
+Correctness bar: an engine WITH the prefix cache must emit exactly the greedy
+tokens of an engine WITHOUT it, for both the first (miss+store) and second
+(hit) admission of a shared prompt, and for prompts sharing only a prefix.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.prefix_cache import PrefixKVCache
+
+CFG = {"preset": "llama-tiny", "dtype": "float32"}
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model("llama", CFG)
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+def _engine(bundle, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 160)
+    kw.setdefault("prefill_buckets", [32, 64, 128])
+    kw.setdefault("eos_token_id", None)
+    kw.setdefault("decode_steps", 2)
+    return LLMEngineCore(bundle, params, **kw)
+
+
+def _gen(engine, prompt, n=6):
+    async def run():
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=n)
+        out = [t async for t in engine.generate(req)]
+        return out
+
+    return asyncio.run(run())
+
+
+# -- unit ---------------------------------------------------------------------
+
+
+def test_block_alignment_and_lookup():
+    cache = PrefixKVCache(max_entries=4, block=4)
+    ids = list(range(11))  # prefix cap = floor(10/4)*4 = 8
+    assert cache.longest_prefix_len(len(ids)) == 8
+    k = np.zeros((2, 1, 16, 2, 4), np.float32)
+    cache.store(ids, 0, k, k)
+    hit = cache.lookup(ids, 0)
+    assert hit is not None and hit["len"] == 8
+    assert hit["k"].shape[2] == 8
+    # a prompt sharing only the first 4 tokens still hits at p=4? No entry
+    # at 4 was stored (only the longest, 8), so this is a miss.
+    assert cache.lookup(ids[:4] + [99, 98, 97, 96, 95], 0) is None
+    # but a LONGER prompt sharing the 8-prefix hits
+    assert cache.lookup(ids[:8] + [55, 44, 33], 0)["len"] == 8
+
+
+def test_lora_keys_are_separate():
+    cache = PrefixKVCache(max_entries=4, block=2)
+    ids = [1, 2, 3, 4, 5]
+    k = np.zeros((1, 1, 8, 1, 2), np.float32)
+    cache.store(ids, 0, k, k)
+    assert cache.lookup(ids, 0) is not None
+    assert cache.lookup(ids, 1) is None  # adapter 1 never stored
+
+
+def test_lru_eviction():
+    cache = PrefixKVCache(max_entries=2, block=2)
+    k = np.zeros((1, 1, 8, 1, 2), np.float32)
+    cache.store([1, 2, 3], 0, k, k)
+    cache.store([4, 5, 6], 0, k, k)
+    assert cache.lookup([1, 2, 3], 0) is not None  # touch -> MRU
+    cache.store([7, 8, 9], 0, k, k)                # evicts [4,5,6]
+    assert cache.lookup([4, 5, 6], 0) is None
+    assert cache.lookup([1, 2, 3], 0) is not None
+    assert cache.lookup([7, 8, 9], 0) is not None
+
+
+# -- engine -------------------------------------------------------------------
+
+
+def test_hit_emits_identical_tokens(parts):
+    bundle, params = parts
+    prompt = [(i * 7 + 3) % 256 for i in range(40)]  # > one 16-token block
+
+    plain = _engine(bundle, params)
+    want = _gen(plain, prompt)
+    plain.stop()
+
+    cached = _engine(bundle, params, prefix_cache=8, prefix_block=16)
+    first = _gen(cached, prompt)   # miss + store
+    second = _gen(cached, prompt)  # hit
+    assert cached._prefix.hits == 1
+    assert cached._prefix.misses == 1
+    cached.stop()
+    assert first == want
+    assert second == want
+
+
+def test_shared_system_prefix_divergent_tails(parts):
+    bundle, params = parts
+    system = [(i * 5 + 1) % 256 for i in range(32)]
+    tail_a = [9, 8, 7, 6, 5]
+    tail_b = [100, 101, 102]
+
+    plain = _engine(bundle, params)
+    want_a = _gen(plain, system + tail_a)
+    want_b = _gen(plain, system + tail_b)
+    plain.stop()
+
+    cached = _engine(bundle, params, prefix_cache=8, prefix_block=16)
+    got_a = _gen(cached, system + tail_a)  # stores the 32-token prefix
+    got_b = _gen(cached, system + tail_b)  # hits it, prefills only the tail
+    assert cached._prefix.hits >= 1
+    cached.stop()
+    assert got_a == want_a
+    assert got_b == want_b
+
+
+def test_prefix_composes_with_chunked_prefill(parts):
+    bundle, params = parts
+    prompt = [(i * 11 + 2) % 256 for i in range(50)]
+
+    plain = _engine(bundle, params)
+    want = _gen(plain, prompt)
+    plain.stop()
+
+    cached = _engine(
+        bundle, params, prefix_cache=4, prefix_block=16, chunked_prefill_size=16
+    )
+    first = _gen(cached, prompt)
+    second = _gen(cached, prompt)
+    cached.stop()
+    assert first == want
+    assert second == want
+
+
+def test_prefix_composes_with_lora(parts):
+    """Adapter-specific prefixes: the same prompt under two adapters must not
+    cross-contaminate cached KV."""
+    from clearml_serving_tpu.models import lora as lora_lib
+
+    bundle = models.build_model(
+        "llama", dict(CFG, lora_rank=4, max_loras=2)
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(3)
+    ad = {}
+    for t in ("wq", "wv"):
+        d_in, d_out = lora_lib.target_dims(bundle.config, t)
+        k1, k2, rng = jax.random.split(rng, 3)
+        ad[t] = {
+            "a": 0.2 * np.asarray(
+                jax.random.normal(k1, (bundle.n_layers, d_in, 4))
+            ),
+            "b": 0.2 * np.asarray(
+                jax.random.normal(k2, (bundle.n_layers, 4, d_out))
+            ),
+        }
+    adapters = {"tuned": ad}
+    prompt = [(i * 3 + 5) % 256 for i in range(36)]
+
+    def gen(engine, adapter):
+        async def run():
+            req = GenRequest(
+                prompt_ids=list(prompt), max_new_tokens=6, adapter=adapter
+            )
+            return [t async for t in engine.generate(req)]
+
+        return asyncio.run(run())
+
+    plain = _engine(bundle, params, lora_adapters=adapters)
+    want_base = gen(plain, None)
+    want_tuned = gen(plain, "tuned")
+    plain.stop()
+
+    cached = _engine(
+        bundle, params, lora_adapters=adapters, prefix_cache=8, prefix_block=16
+    )
+    assert gen(cached, None) == want_base     # miss+store (base key)
+    assert gen(cached, "tuned") == want_tuned  # MISS: adapter key differs
+    assert gen(cached, "tuned") == want_tuned  # hit on the adapter's entry
+    assert gen(cached, None) == want_base      # hit on the base entry
+    cached.stop()
