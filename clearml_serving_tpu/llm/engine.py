@@ -44,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .sampling import SamplingParams, sample_tokens
+from .sampling import SamplingExtras, SamplingParams, sample_tokens
 
 _DEFAULT_PREFILL_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048]
 
@@ -57,6 +57,15 @@ class GenRequest:
     top_k: int = 0
     top_p: float = 1.0
     stop_token_ids: Optional[List[int]] = None
+    # OpenAI/vLLM sampling-parameter parity (applied on-device as batch data)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    seed: Optional[int] = None
+    logit_bias: Optional[Dict[int, float]] = None
+    # number of top-alternative logprobs to record per emitted token
+    # (None = no logprob tracking; 0 = chosen token's logprob only)
+    logprobs: Optional[int] = None
     # named LoRA adapter to apply (None = base model); resolved against the
     # engine's adapter registry at validate/admission time
     adapter: Optional[str] = None
@@ -67,6 +76,13 @@ class GenRequest:
     submitted_at: float = field(default_factory=time.time)
     first_token_at: Optional[float] = None
     error: Optional[BaseException] = None
+    # per emitted token (when logprobs is not None): {"id", "logprob",
+    # "top_ids", "top_logprobs"}; entry i is appended BEFORE token i is
+    # queued, so a consumer that just received token i may read entry i
+    logprob_entries: List[dict] = field(default_factory=list)
+    # set by the API layer when a stop STRING matched in the decoded text
+    # (stop token ids are handled by the engine; strings need detokenization)
+    stopped_on_string: bool = False
     # set by the consumer (e.g. an SSE wrapper on client disconnect); the
     # engine frees the slot and KV pages at the next emission point instead
     # of decoding the request to max_new_tokens for nobody
@@ -172,6 +188,7 @@ class LLMEngineCore:
         prefix_cache: Optional[int] = None,
         prefix_block: int = 64,
         prefix_cache_bytes: Optional[int] = None,
+        logprobs_k: int = 8,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -322,6 +339,18 @@ class LLMEngineCore:
         self._top_k = np.zeros(self.max_batch, np.int32)
         self._top_p = np.ones(self.max_batch, np.float32)
         self._lora_slots = np.zeros(self.max_batch, np.int32)  # 0 = base
+        # sampling extras (penalties / bias / seeds): host mirrors per slot;
+        # the [B, V] device state (generated-token counts, prompt mask, dense
+        # bias) allocates lazily on the first request that needs any of it
+        self._vocab = int(bundle.config.get("vocab_size", 0))
+        self._presence = np.zeros(self.max_batch, np.float32)
+        self._frequency = np.zeros(self.max_batch, np.float32)
+        self._repetition = np.ones(self.max_batch, np.float32)
+        self._seeds = np.full(self.max_batch, -1, np.int64)
+        self._slot_extra = np.zeros(self.max_batch, bool)
+        self._counts_dev = None   # [B, V] int32 generated-token histogram
+        self._bias_dev = None     # [B, V] float32 dense logit bias
+        self._pmask_dev = None    # [B, V] bool prompt-token mask
 
         self._pending: "asyncio.Queue[GenRequest]" = asyncio.Queue()
         self._loop_task: Optional[asyncio.Task] = None
@@ -450,13 +479,29 @@ class LLMEngineCore:
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
-        def _decode_chunk(params, tokens, cache, active, sampling, rng,
-                          lora_idx=None):
-            """`decode_steps` decode+sample steps fused in one executable
-            (lax.scan) — host dispatch overhead amortizes over the chunk."""
+        self._lp_k = max(1, int(logprobs_k))
 
-            def body(carry, step_rng):
-                tokens, cache = carry
+        def _lp_of(logits, sampled, nb):
+            """(chosen logprob [B], top ids [B,K], top logprobs [B,K]) from
+            RAW (pre-penalty) logits — reported logprobs are the model's."""
+            lp_full = jax.nn.log_softmax(logits)
+            chosen = lp_full[jnp.arange(nb), sampled]
+            top_lp, top_id = jax.lax.top_k(lp_full, self._lp_k)
+            return chosen, top_id.astype(jnp.int32), top_lp
+
+        def _decode_chunk(params, tokens, cache, active, sampling, rng,
+                          lora_idx=None, extras=None, counts=None, pmask=None,
+                          want_lp=False):
+            """`decode_steps` decode+sample steps fused in one executable
+            (lax.scan) — host dispatch overhead amortizes over the chunk.
+            ``extras``/``counts``/``pmask`` (penalties, bias, seeds, token
+            histogram) are optional: the no-extras trace is unchanged.
+            ``want_lp`` (static) additionally emits per-token logprobs."""
+            nb = tokens.shape[0]
+
+            def body(carry, xs):
+                tokens, cache, counts = carry
+                step_rng, step_off = xs
                 old_len = cache["length"]
                 if lora_idx is None:
                     logits, cache = bundle.decode(params, tokens, cache)
@@ -465,16 +510,39 @@ class LLMEngineCore:
                 # inactive slots: keep their length frozen (their garbage KV
                 # write sits beyond `length` and is masked / later overwritten)
                 cache["length"] = jnp.where(active, cache["length"], old_len)
-                sampled = sample_tokens(
-                    logits.astype(jnp.float32), sampling, step_rng
-                )
-                return (sampled, cache), sampled
+                logits = logits.astype(jnp.float32)
+                if extras is None:
+                    sampled = sample_tokens(logits, sampling, step_rng)
+                else:
+                    ex = extras._replace(counters=extras.counters + step_off)
+                    sampled = sample_tokens(
+                        logits, sampling, step_rng, ex, counts, pmask
+                    )
+                    counts = counts.at[jnp.arange(nb), sampled].add(
+                        active.astype(jnp.int32)
+                    )
+                out = (sampled, _lp_of(logits, sampled, nb)) if want_lp else sampled
+                return (sampled, cache, counts), out
 
             rngs = jax.random.split(rng, self.decode_steps)
-            (_, cache), toks = jax.lax.scan(body, (tokens, cache), rngs)
-            return toks.T, cache  # [B, decode_steps]
+            steps = jnp.arange(self.decode_steps, dtype=jnp.int32)
+            (_, cache, counts), out = jax.lax.scan(
+                body, (tokens, cache, counts), (rngs, steps)
+            )
+            if want_lp:
+                toks, (chosen, top_id, top_lp) = out
+                # [steps, ...] -> batch-major
+                lp = (chosen.T, jnp.swapaxes(top_id, 0, 1), jnp.swapaxes(top_lp, 0, 1))
+                return toks.T, cache, counts, lp
+            return out.T, cache, counts, None  # [B, decode_steps]
 
-        self._decode_chunk_jit = jax.jit(_decode_chunk, donate_argnums=(2,))
+        self._decode_chunk_jit = jax.jit(
+            _decode_chunk, donate_argnums=(2,), static_argnames=("want_lp",)
+        )
+        # first-token (admission) logprobs from the prefill logits
+        self._first_lp_jit = jax.jit(
+            lambda logits, chosen: _lp_of(logits, chosen, logits.shape[0])
+        )
 
         # -- n-gram speculative decoding (greedy; dense cache) -------------
         # Fully on-device draft-and-verify: each scan round proposes spec_k
@@ -576,13 +644,18 @@ class LLMEngineCore:
         def _decode_paged_chunk(
             params, tokens, k_pools, v_pools, page_table, lengths0,
             write_pages, write_offsets, sampling, rng, lora_idx=None,
+            extras=None, counts=None, pmask=None, want_lp=False,
         ):
             """Paged-cache variant of the fused decode chunk. Page/offset
             write coordinates for every step come pre-computed from the host
             page allocator (write_pages/offsets: [B, steps])."""
+            nb = tokens.shape[0]
+            active = jnp.asarray(
+                lengths0 > 0
+            )  # paged slots with content; inactive rows count nothing
 
             def body(carry, xs):
-                tokens, k_pools, v_pools, step = carry
+                tokens, k_pools, v_pools, counts, step = carry
                 step_rng, wp, wo = xs
                 if lora_idx is None:
                     logits, k_pools, v_pools = bundle.decode_paged(
@@ -594,19 +667,35 @@ class LLMEngineCore:
                         params, tokens, k_pools, v_pools, page_table,
                         lengths0 + step, wp, wo, lora_idx,
                     )
-                sampled = sample_tokens(logits.astype(jnp.float32), sampling, step_rng)
-                return (sampled, k_pools, v_pools, step + 1), sampled
+                logits = logits.astype(jnp.float32)
+                if extras is None:
+                    sampled = sample_tokens(logits, sampling, step_rng)
+                else:
+                    ex = extras._replace(counters=extras.counters + step)
+                    sampled = sample_tokens(
+                        logits, sampling, step_rng, ex, counts, pmask
+                    )
+                    counts = counts.at[jnp.arange(nb), sampled].add(
+                        active.astype(jnp.int32)
+                    )
+                out = (sampled, _lp_of(logits, sampled, nb)) if want_lp else sampled
+                return (sampled, k_pools, v_pools, counts, step + 1), out
 
             rngs = jax.random.split(rng, self.decode_steps)
-            (_, k_pools, v_pools, _), toks = jax.lax.scan(
+            (_, k_pools, v_pools, counts, _), out = jax.lax.scan(
                 body,
-                (tokens, k_pools, v_pools, jnp.int32(0)),
+                (tokens, k_pools, v_pools, counts, jnp.int32(0)),
                 (rngs, write_pages.T, write_offsets.T),
             )
-            return toks.T, k_pools, v_pools
+            if want_lp:
+                toks, (chosen, top_id, top_lp) = out
+                lp = (chosen.T, jnp.swapaxes(top_id, 0, 1), jnp.swapaxes(top_lp, 0, 1))
+                return toks.T, k_pools, v_pools, counts, lp
+            return out.T, k_pools, v_pools, counts, None
 
         self._decode_paged_chunk_jit = jax.jit(
-            _decode_paged_chunk, donate_argnums=(2, 3)
+            _decode_paged_chunk, donate_argnums=(2, 3),
+            static_argnames=("want_lp",),
         )
         self._sample_jit = sample_tokens
 
@@ -627,6 +716,28 @@ class LLMEngineCore:
                     request.adapter, sorted(self._adapter_index) or "none"
                 )
             )
+        if request.logit_bias:
+            for tok in request.logit_bias:
+                try:
+                    tok_i = int(tok)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        "logit_bias keys must be token ids (got {!r})".format(tok)
+                    )
+                if not (0 <= tok_i < self._vocab):
+                    raise ValueError(
+                        "logit_bias token id {} out of range for vocab {}".format(
+                            tok_i, self._vocab
+                        )
+                    )
+        if request.repetition_penalty is not None and request.repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+        if request.logprobs is not None and request.logprobs > self._lp_k:
+            raise ValueError(
+                "logprobs={} exceeds the engine's logprobs_k={}".format(
+                    request.logprobs, self._lp_k
+                )
+            )
 
     @property
     def adapter_names(self) -> List[str]:
@@ -634,6 +745,90 @@ class LLMEngineCore:
 
     def _slot_lora(self, request: GenRequest) -> int:
         return self._adapter_index.get(request.adapter or "", 0)
+
+    # -- sampling extras (penalties / bias / seeds) -------------------------
+
+    @staticmethod
+    def _request_has_extras(request: GenRequest) -> bool:
+        return bool(
+            request.presence_penalty
+            or request.frequency_penalty
+            or (request.repetition_penalty and request.repetition_penalty != 1.0)
+            or request.seed is not None
+            or request.logit_bias
+        )
+
+    def _ensure_extras_state(self) -> None:
+        if self._counts_dev is None:
+            self._counts_dev = jnp.zeros((self.max_batch, self._vocab), jnp.int32)
+            self._bias_dev = jnp.zeros((self.max_batch, self._vocab), jnp.float32)
+            self._pmask_dev = jnp.zeros((self.max_batch, self._vocab), bool)
+
+            def _set_row(counts, bias, pmask, slot, first_tok, bias_row, pmask_row):
+                # reset the slot's histogram to just the prefill-sampled
+                # token (it IS generated output for penalty purposes)
+                counts = counts.at[slot].set(0).at[slot, first_tok].set(1)
+                bias = bias.at[slot].set(bias_row)
+                pmask = pmask.at[slot].set(pmask_row)
+                return counts, bias, pmask
+
+            self._set_sampling_row_jit = jax.jit(
+                _set_row, donate_argnums=(0, 1, 2)
+            )
+
+    def _extras_active(self, active_mask: np.ndarray) -> bool:
+        return self._counts_dev is not None and bool(
+            np.any(self._slot_extra[active_mask])
+        )
+
+    def _batch_extras(self) -> "SamplingExtras":
+        produced = np.asarray(
+            [r.produced if r is not None else 0 for r in self._slot_req],
+            np.int32,
+        )
+        seeds = np.where(
+            self._seeds < 0, -1, self._seeds & 0x7FFFFFFF
+        ).astype(np.int32)
+        return SamplingExtras(
+            presence=jnp.asarray(self._presence),
+            frequency=jnp.asarray(self._frequency),
+            repetition=jnp.asarray(self._repetition),
+            bias=self._bias_dev,
+            seeds=jnp.asarray(seeds),
+            counters=jnp.asarray(produced),
+        )
+
+    def _bias_pmask_rows(self, request: GenRequest):
+        bias = np.zeros(self._vocab, np.float32)
+        if request.logit_bias:
+            for tok, bv in request.logit_bias.items():
+                tok = int(tok)
+                if 0 <= tok < self._vocab:
+                    bias[tok] = float(bv)
+        pmask = np.zeros(self._vocab, bool)
+        ids = [t for t in request.prompt_ids if 0 <= t < self._vocab]
+        pmask[ids] = True
+        return bias, pmask
+
+    def _request_extras_row(self, request: GenRequest):
+        """Single-row extras for admission (first-token) sampling."""
+        bias, pmask = self._bias_pmask_rows(request)
+        seed = -1 if request.seed is None else int(request.seed) & 0x7FFFFFFF
+        extras = SamplingExtras(
+            presence=jnp.asarray([request.presence_penalty], jnp.float32),
+            frequency=jnp.asarray([request.frequency_penalty], jnp.float32),
+            repetition=jnp.asarray(
+                [request.repetition_penalty or 1.0], jnp.float32
+            ),
+            bias=jnp.asarray(bias[None]),
+            seeds=jnp.asarray([seed], jnp.int32),
+            counters=jnp.zeros((1,), jnp.int32),
+        )
+        return (
+            extras,
+            jnp.zeros((1, self._vocab), jnp.int32),
+            jnp.asarray(pmask[None]),
+        )
 
     async def generate(self, request: GenRequest) -> AsyncIterator[int]:
         """Submit a request; yields sampled token ids as they decode."""
@@ -803,17 +998,30 @@ class LLMEngineCore:
         if self._prefix is not None and not use_ring:
             # make this prompt's prefix available to future admissions
             self._prefix.store(ids, lora_i, mini_cache["k"], mini_cache["v"])
-        first = self._sample_jit(
-            last_logits.astype(jnp.float32),
-            SamplingParams(
-                temperature=jnp.asarray([request.temperature], jnp.float32),
-                top_k=jnp.asarray([request.top_k], jnp.int32),
-                top_p=jnp.asarray([request.top_p], jnp.float32),
-            ),
-            self._next_rng(),
+        sp = SamplingParams(
+            temperature=jnp.asarray([request.temperature], jnp.float32),
+            top_k=jnp.asarray([request.top_k], jnp.int32),
+            top_p=jnp.asarray([request.top_p], jnp.float32),
         )
+        logits32 = last_logits.astype(jnp.float32)
+        if self._request_has_extras(request):
+            extras, counts0, pmask0 = self._request_extras_row(request)
+            first = self._sample_jit(
+                logits32, sp, self._next_rng(), extras, counts0, pmask0
+            )
+        else:
+            first = self._sample_jit(logits32, sp, self._next_rng())
         first_id = int(np.asarray(first)[0])
-        return first_id, mini_cache
+        first_lp = None
+        if request.logprobs is not None:
+            chosen, tid, tlp = self._first_lp_jit(logits32, first)
+            first_lp = {
+                "id": first_id,
+                "logprob": float(np.asarray(chosen)[0]),
+                "top_ids": np.asarray(tid)[0].tolist(),
+                "top_logprobs": np.asarray(tlp)[0].tolist(),
+            }
+        return first_id, mini_cache, first_lp
 
     def _prefix_admission(self, ids, lora_arr, lora_i):
         """Prefix-cache hit path: assemble the stored prefix KV into a mini
@@ -865,7 +1073,7 @@ class LLMEngineCore:
             )
         return last_logits, cache
 
-    def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache) -> None:
+    def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache, first_lp=None) -> None:
         """Loop-thread-only: route the prefilled KV into the shared cache and
         activate the slot. Never runs concurrently with a decode chunk."""
         self._insert_prefill(slot, mini_cache, request.prompt_len)
@@ -883,13 +1091,44 @@ class LLMEngineCore:
         self._top_k[slot] = request.top_k
         self._top_p[slot] = request.top_p
         self._lora_slots[slot] = self._slot_lora(request)
-        self._emit(slot, first_id)
+        self._presence[slot] = request.presence_penalty
+        self._frequency[slot] = request.frequency_penalty
+        self._repetition[slot] = request.repetition_penalty or 1.0
+        # mask BEFORE the int64 store: JSON ints are unbounded and a seed
+        # >= 2**63 would overflow the numpy slot array on the loop thread
+        self._seeds[slot] = (
+            -1 if request.seed is None else int(request.seed) & 0x7FFFFFFF
+        )
+        has_extras = self._request_has_extras(request)
+        self._slot_extra[slot] = has_extras
+        if has_extras or self._counts_dev is not None:
+            # the [B, V] state exists as soon as anyone needs it; rows must
+            # then be reset on EVERY admission (stale bias/mask from a
+            # previous occupant would leak into this request)
+            self._ensure_extras_state()
+            bias_row, pmask_row = self._bias_pmask_rows(request)
+            (
+                self._counts_dev,
+                self._bias_dev,
+                self._pmask_dev,
+            ) = self._set_sampling_row_jit(
+                self._counts_dev,
+                self._bias_dev,
+                self._pmask_dev,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(first_id, jnp.int32),
+                jnp.asarray(bias_row),
+                jnp.asarray(pmask_row),
+            )
+        self._emit(slot, first_id, first_lp)
 
     async def _admission_task(self, request: GenRequest, slot: int) -> None:
         """Background prefill for one request; reserves `slot` via
         self._admitting until committed or failed."""
         try:
-            first_id, mini_cache = await asyncio.to_thread(self._prefill_device, request)
+            first_id, mini_cache, first_lp = await asyncio.to_thread(
+                self._prefill_device, request
+            )
         except Exception as ex:
             # a failed admission fails only its own request
             request.error = ex
@@ -902,7 +1141,7 @@ class LLMEngineCore:
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
             return
-        await self._ready.put((request, slot, first_id, mini_cache))
+        await self._ready.put((request, slot, first_id, mini_cache, first_lp))
         self._wake_loop()
         if self._loop_task is None or self._loop_task.done():
             # loop died between prefill and hand-off: nobody will commit —
@@ -922,7 +1161,7 @@ class LLMEngineCore:
                 jnp.asarray(n_tokens, jnp.int32), slot,
             )
 
-    def _emit(self, slot: int, token_id: int) -> None:
+    def _emit(self, slot: int, token_id: int, lp: dict | None = None) -> None:
         request = self._slot_req[slot]
         if request is None:
             return
@@ -933,6 +1172,9 @@ class LLMEngineCore:
             if self.paged_cache is not None:
                 self.paged_cache.pool.free(slot)
             return
+        if lp is not None and request.logprobs is not None:
+            # appended BEFORE the token is queued (see GenRequest contract)
+            request.logprob_entries.append(lp)
         request.produced += 1
         if request.first_token_at is None:
             request.first_token_at = time.time()  # client-observable TTFT
@@ -954,7 +1196,7 @@ class LLMEngineCore:
     def _drain_ready(self, err: BaseException) -> None:
         """Fail every completed-but-uncommitted admission (loop is exiting)."""
         while not self._ready.empty():
-            request, slot, _first, _cache = self._ready.get_nowait()
+            request, slot, _first, _cache, _lp = self._ready.get_nowait()
             self._admitting.discard(slot)
             request.error = err
             request.out_queue.put_nowait(_FINISHED)
@@ -990,7 +1232,8 @@ class LLMEngineCore:
         self._tokbuf = np.array(tokbuf)
         return np.asarray(gs), np.asarray(accs), np.asarray(pending)
 
-    def _run_paged_chunk(self, active_mask: np.ndarray, sampling):
+    def _run_paged_chunk(self, active_mask: np.ndarray, sampling,
+                         want_lp: bool = False):
         """One fused paged-decode chunk (blocking device work; runs in a
         worker thread). Pre-allocates each active slot's pages for the whole
         chunk host-side, hands the per-step write coordinates to the scan.
@@ -1019,7 +1262,14 @@ class LLMEngineCore:
                 write_pages[slot, i] = page
                 write_offsets[slot, i] = offset
         page_table = pool.page_table(self._pages_per_seq)
-        chunk, self.paged_cache.k, self.paged_cache.v = self._decode_paged_chunk_jit(
+        use_extras = self._extras_active(active_mask)
+        (
+            chunk,
+            self.paged_cache.k,
+            self.paged_cache.v,
+            new_counts,
+            lp,
+        ) = self._decode_paged_chunk_jit(
             self.params,
             jnp.asarray(self._next_token),
             self.paged_cache.k,
@@ -1031,8 +1281,15 @@ class LLMEngineCore:
             sampling,
             self._next_rng(),
             jnp.asarray(self._lora_slots) if self._lora_enabled else None,
+            self._batch_extras() if use_extras else None,
+            self._counts_dev if use_extras else None,
+            self._pmask_dev if use_extras else None,
+            want_lp=want_lp,
         )
-        return np.asarray(chunk), exhausted
+        if use_extras:
+            self._counts_dev = new_counts
+        lp_np = tuple(np.asarray(a) for a in lp) if lp is not None else None
+        return np.asarray(chunk), exhausted, lp_np
 
     async def _run_loop(self) -> None:
         try:
@@ -1090,12 +1347,12 @@ class LLMEngineCore:
                 task.add_done_callback(self._admission_tasks.discard)
             # commit finished prefills (loop thread; between decode chunks)
             while not self._ready.empty():
-                request, slot, first_id, mini_cache = self._ready.get_nowait()
+                request, slot, first_id, mini_cache, first_lp = self._ready.get_nowait()
                 self._admitting.discard(slot)
                 if request.cancelled:
                     request.out_queue.put_nowait(_FINISHED)
                     continue
-                self._commit_admission(request, slot, first_id, mini_cache)
+                self._commit_admission(request, slot, first_id, mini_cache, first_lp)
             active_mask = np.array([r is not None for r in self._slot_req])
             if self._prefill_gate is not None:
                 # open the gate while decode idles; pace prefills while active
@@ -1113,6 +1370,11 @@ class LLMEngineCore:
                 self._wake.clear()
                 continue
             # one fused decode chunk over the whole slot batch
+            want_lp = any(
+                self._slot_req[s] is not None
+                and self._slot_req[s].logprobs is not None
+                for s in np.nonzero(active_mask)[0]
+            )
             use_spec = (
                 self._spec_chunk_jit is not None
                 and self.cache_mode == "dense"
@@ -1120,6 +1382,13 @@ class LLMEngineCore:
                     self._temperature[s] == 0.0
                     for s in np.nonzero(active_mask)[0]
                 )
+                # penalties/bias change the greedy argmax per emitted token;
+                # the verify pass does not model them — fall back to the
+                # plain chunk whenever an active slot carries extras
+                and not bool(np.any(self._slot_extra[active_mask]))
+                # logprob tracking also needs the plain chunk (the verify
+                # pass reports no per-token distributions)
+                and not want_lp
             )
             if use_spec:
                 # draft-and-verify rounds (greedy slots only): device work
@@ -1145,8 +1414,8 @@ class LLMEngineCore:
                 top_p=jnp.asarray(self._top_p),
             )
             if self.cache_mode == "paged":
-                chunk_np, exhausted = await asyncio.to_thread(
-                    self._run_paged_chunk, active_mask, sampling
+                chunk_np, exhausted, lp_np = await asyncio.to_thread(
+                    self._run_paged_chunk, active_mask, sampling, want_lp
                 )
                 for slot in exhausted:
                     request = self._slot_req[slot]
@@ -1158,7 +1427,8 @@ class LLMEngineCore:
                         self._slot_req[slot] = None
                         self.paged_cache.pool.free(slot)
             else:
-                chunk, self.cache = self._decode_chunk_jit(
+                use_extras = self._extras_active(active_mask)
+                chunk, self.cache, new_counts, lp = self._decode_chunk_jit(
                     self.params,
                     jnp.asarray(self._next_token),
                     self.cache,
@@ -1166,15 +1436,33 @@ class LLMEngineCore:
                     sampling,
                     self._next_rng(),
                     jnp.asarray(self._lora_slots) if self._lora_enabled else None,
+                    self._batch_extras() if use_extras else None,
+                    self._counts_dev if use_extras else None,
+                    self._pmask_dev if use_extras else None,
+                    want_lp=want_lp,
                 )
+                if use_extras:
+                    self._counts_dev = new_counts
                 chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
+                lp_np = (
+                    tuple(np.asarray(a) for a in lp) if lp is not None else None
+                )
             if self._prefill_gate is not None:
                 # decode chunk done: grant the next prefill-dispatch budget
                 self._prefill_gate.deposit()
             for slot in np.nonzero(active_mask)[0]:
                 self._next_token[slot] = int(chunk_np[slot, -1])
-                for token_id in chunk_np[slot]:
+                for i, token_id in enumerate(chunk_np[slot]):
                     # _emit frees the slot on finish; the rest of the chunk for
                     # that slot is dropped by the None check inside _emit
-                    self._emit(int(slot), int(token_id))
+                    entry = None
+                    if lp_np is not None:
+                        chosen, top_id, top_lp = lp_np
+                        entry = {
+                            "id": int(token_id),
+                            "logprob": float(chosen[slot, i]),
+                            "top_ids": top_id[slot, i].tolist(),
+                            "top_logprobs": top_lp[slot, i].tolist(),
+                        }
+                    self._emit(int(slot), int(token_id), entry)
             await asyncio.sleep(0)  # let HTTP handlers interleave

@@ -263,17 +263,53 @@ class LLMEngineRequest(BaseEngineRequest):
             return name
         return None
 
-    def _gen_request_from_body(self, body: Dict[str, Any], prompt_ids: List[int]):
+    def _gen_request_from_body(self, body: Dict[str, Any], prompt_ids: List[int],
+                               chat: bool = True):
         from .engine import GenRequest
 
+        logit_bias = body.get("logit_bias") or None
+        if logit_bias is not None:
+            logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
+        # logprobs: chat uses `logprobs: bool` + `top_logprobs: int`;
+        # completions uses `logprobs: int` directly (0 = chosen token only)
+        if chat:
+            logprobs = (
+                int(body.get("top_logprobs", 0) or 0)
+                if body.get("logprobs")
+                else None
+            )
+        else:
+            raw_lp = body.get("logprobs")
+            logprobs = int(raw_lp) if raw_lp is not None and raw_lp is not False else None
         return GenRequest(
             prompt_ids=prompt_ids,
             max_new_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 128),
             temperature=float(body.get("temperature", 0.0) or 0.0),
             top_k=int(body.get("top_k", 0) or 0),
             top_p=float(body.get("top_p", 1.0) or 1.0),
+            presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
+            repetition_penalty=float(body.get("repetition_penalty", 1.0) or 1.0),
+            seed=(int(body["seed"]) if body.get("seed") is not None else None),
+            logit_bias=logit_bias,
+            logprobs=logprobs,
             adapter=self._adapter_for(body),
         )
+
+    def _n_requests(self, body: Dict[str, Any], prompt_ids: List[int],
+                    chat: bool = True):
+        """OpenAI `n` choices: n independent requests through the continuous
+        batch; seeded requests offset the seed per choice so choices differ."""
+        n = int(body.get("n", 1) or 1)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        requests = []
+        for i in range(n):
+            r = self._gen_request_from_body(body, list(prompt_ids), chat=chat)
+            if r.seed is not None and i:
+                r.seed = r.seed + i
+            requests.append(r)
+        return requests
 
     @staticmethod
     def _report_gen_stats(request, collect_fn) -> None:
@@ -288,10 +324,48 @@ class LLMEngineRequest(BaseEngineRequest):
             stats["ttft"] = round(request.first_token_at - request.submitted_at, 6)
         collect_fn(stats)
 
-    async def _collect_text(self, request) -> Dict[str, Any]:
+    @staticmethod
+    def _stops_from_body(body: Dict[str, Any]) -> List[str]:
+        """OpenAI `stop`: str | [str] (stop TOKEN ids go through the engine;
+        strings are matched on the decoded text here)."""
+        stop = body.get("stop")
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            return [stop] if stop else []
+        return [str(s) for s in stop if s]
+
+    @staticmethod
+    def _first_stop_hit(text: str, stops: List[str]) -> int:
+        """Earliest index where any stop string occurs, or -1."""
+        hits = [text.find(s) for s in stops]
+        hits = [h for h in hits if h >= 0]
+        return min(hits) if hits else -1
+
+    async def _collect_text(self, request, stops: Optional[List[str]] = None) -> Dict[str, Any]:
         ids: List[int] = []
+        stops = stops or []
+        # stop scanning decodes only a TAIL window per token (a full decode
+        # per token would be O(T^2) of blocking tokenizer work on the event
+        # loop): every token decodes to >= 1 character, so a window of
+        # max-stop-length + margin tokens always covers a newly completed
+        # stop match; the full decode happens once, on hit or at the end
+        window = (max(len(s) for s in stops) + 8) if stops else 0
         async for token in self.engine.generate(request):
             ids.append(token)
+            if stops:
+                tail = self.tokenizer.decode(ids[-window:])
+                if self._first_stop_hit(tail, stops) >= 0:
+                    # OpenAI semantics: output excludes the stop sequence
+                    request.stopped_on_string = True
+                    request.cancel()
+                    text = self.tokenizer.decode(ids)
+                    cut = self._first_stop_hit(text, stops)
+                    return {
+                        "text": text[:cut] if cut >= 0 else text,
+                        "ids": ids,
+                        "finish_reason": "stop",
+                    }
         eos = self.tokenizer.eos_token_id
         if ids and eos is not None and ids[-1] == eos:
             ids = ids[:-1]
@@ -300,11 +374,14 @@ class LLMEngineRequest(BaseEngineRequest):
             finish = self._finish_reason(request)
         return {"text": self.tokenizer.decode(ids), "ids": ids, "finish_reason": finish}
 
-    async def _stream_deltas(self, request) -> AsyncIterator[Dict[str, Any]]:
+    async def _stream_deltas(self, request, stops: Optional[List[str]] = None) -> AsyncIterator[Dict[str, Any]]:
         """Yields text deltas (incremental decode keeps multi-byte tokens
-        correct for HF tokenizers)."""
+        correct for HF tokenizers). Stop strings hold back a potential
+        stop-prefix tail so matched stops are never partially emitted."""
         ids: List[int] = []
         sent = ""
+        stops = stops or []
+        holdback = max((len(s) for s in stops), default=1) - 1
         eos = self.tokenizer.eos_token_id
         async for token in self.engine.generate(request):
             if eos is not None and token == eos:
@@ -313,6 +390,15 @@ class LLMEngineRequest(BaseEngineRequest):
             text = self.tokenizer.decode(ids)
             if text.endswith("�"):  # partial multi-byte sequence
                 continue
+            if stops:
+                cut = self._first_stop_hit(text, stops)
+                if cut >= 0:
+                    request.stopped_on_string = True
+                    request.cancel()
+                    if cut > len(sent):
+                        yield {"delta": text[len(sent):cut]}
+                    return
+                text = text[: len(text) - holdback] if holdback else text
             if len(text) > len(sent):
                 yield {"delta": text[len(sent):]}
                 sent = text
@@ -320,17 +406,75 @@ class LLMEngineRequest(BaseEngineRequest):
         # the replacement character (truncated multi-byte at stop, or a real
         # '�' from the tokenizer), it must not be silently dropped
         text = self.tokenizer.decode(ids)
+        if stops:
+            cut = self._first_stop_hit(text, stops)
+            if cut >= 0:
+                request.stopped_on_string = True
+                text = text[:cut]
         if len(text) > len(sent):
             yield {"delta": text[len(sent):]}
 
     def _finish_reason(self, request) -> str:
         """OpenAI semantics: "length" covers BOTH max_tokens truncation and
         hitting the model's context limit."""
+        if request.stopped_on_string:
+            return "stop"
         if request.produced >= request.max_new_tokens:
             return "length"
         if request.prompt_len + request.produced >= self.engine.max_seq_len:
             return "length"
         return "stop"
+
+    # -- logprob formatting (OpenAI chat vs completions shapes) ---------------
+
+    def _token_str(self, tid: int) -> str:
+        return self.tokenizer.decode([int(tid)])
+
+    def _chat_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
+        k = int(request.logprobs or 0)
+        content = []
+        for entry, tid in zip(request.logprob_entries, ids):
+            tok = self._token_str(tid)
+            item = {
+                "token": tok,
+                "logprob": entry["logprob"],
+                "bytes": list(tok.encode("utf-8")),
+            }
+            item["top_logprobs"] = [
+                {
+                    "token": self._token_str(t),
+                    "logprob": lp,
+                    "bytes": list(self._token_str(t).encode("utf-8")),
+                }
+                for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k])
+            ]
+            content.append(item)
+        return {"content": content}
+
+    def _completion_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
+        k = int(request.logprobs or 0)
+        tokens, token_logprobs, top_logprobs, offsets = [], [], [], []
+        offset = 0
+        for entry, tid in zip(request.logprob_entries, ids):
+            tok = self._token_str(tid)
+            tokens.append(tok)
+            token_logprobs.append(entry["logprob"])
+            top_logprobs.append(
+                {
+                    self._token_str(t): lp
+                    for t, lp in zip(
+                        entry["top_ids"][:k], entry["top_logprobs"][:k]
+                    )
+                }
+            )
+            offsets.append(offset)
+            offset += len(tok)
+        return {
+            "tokens": tokens,
+            "token_logprobs": token_logprobs,
+            "top_logprobs": top_logprobs,
+            "text_offset": offsets,
+        }
 
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
@@ -359,12 +503,18 @@ class LLMEngineRequest(BaseEngineRequest):
         # encode_chat: no special-token re-add — HF chat templates already
         # emit BOS in the template text (double-BOS degrades fidelity)
         prompt_ids = self.tokenizer.encode_chat(prompt)
-        request = self._gen_request_from_body(body, prompt_ids)
+        stops = self._stops_from_body(body)
         model = body.get("model", self._model_name)
         completion_id = _gen_id("chatcmpl")
         created = _now()
 
         if body.get("stream"):
+            if int(body.get("n", 1) or 1) != 1:
+                raise EndpointModelError("streaming supports a single choice (n=1)")
+            request = self._gen_request_from_body(body, prompt_ids)
+            # SSE chunks carry no logprobs field; tracking them would slow
+            # the whole batch (and disable speculation) for data nobody sees
+            request.logprobs = None
             # validate BEFORE returning the stream — a late ValueError would
             # abort mid-SSE after the 200 headers are already sent
             self.engine.validate(request)
@@ -379,7 +529,7 @@ class LLMEngineRequest(BaseEngineRequest):
                     }
                     yield "data: {}\n\n".format(json.dumps(first))
                     try:
-                        async for piece in self._stream_deltas(request):
+                        async for piece in self._stream_deltas(request, stops):
                             chunk = {
                                 "id": completion_id, "object": "chat.completion.chunk",
                                 "created": created, "model": model,
@@ -410,24 +560,37 @@ class LLMEngineRequest(BaseEngineRequest):
 
             return StreamingOutput(sse())
 
-        result = await self._collect_text(request)
-        self._report_gen_stats(request, collect_fn)
+        requests = self._n_requests(body, prompt_ids)
+        results = await asyncio.gather(
+            *[self._collect_text(r, stops) for r in requests]
+        )
+        for r in requests:
+            self._report_gen_stats(r, collect_fn)
+        choices = []
+        for i, (r, res) in enumerate(zip(requests, results)):
+            choice = {
+                "index": i,
+                "message": {"role": "assistant", "content": res["text"]},
+                "finish_reason": res["finish_reason"],
+                "logprobs": (
+                    self._chat_logprobs(r, res["ids"])
+                    if r.logprobs is not None
+                    else None
+                ),
+            }
+            choices.append(choice)
         return {
             "id": completion_id,
             "object": "chat.completion",
             "created": created,
             "model": model,
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": result["text"]},
-                    "finish_reason": result["finish_reason"],
-                }
-            ],
+            "choices": choices,
+            # OpenAI semantics: the prompt counts once regardless of n
             "usage": {
-                "prompt_tokens": request.prompt_len,
-                "completion_tokens": request.produced,
-                "total_tokens": request.prompt_len + request.produced,
+                "prompt_tokens": requests[0].prompt_len,
+                "completion_tokens": sum(r.produced for r in requests),
+                "total_tokens": requests[0].prompt_len
+                + sum(r.produced for r in requests),
             },
         }
 
@@ -459,6 +622,7 @@ class LLMEngineRequest(BaseEngineRequest):
     async def v1_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
         self._require_engine("v1/completions")
         prompt_id_lists = self._encode_prompts(body.get("prompt") or "")
+        stops = self._stops_from_body(body)
         model = body.get("model", self._model_name)
         completion_id = _gen_id("cmpl")
         created = _now()
@@ -468,13 +632,19 @@ class LLMEngineRequest(BaseEngineRequest):
                 raise EndpointModelError(
                     "streaming completions support a single prompt per request"
                 )
-            request = self._gen_request_from_body(body, prompt_id_lists[0])
+            if int(body.get("n", 1) or 1) != 1:
+                raise EndpointModelError("streaming supports a single choice (n=1)")
+            request = self._gen_request_from_body(
+                body, prompt_id_lists[0], chat=False
+            )
+            # SSE chunks carry no logprobs field (see chat stream path)
+            request.logprobs = None
             self.engine.validate(request)
 
             async def sse():
                 try:
                     try:
-                        async for piece in self._stream_deltas(request):
+                        async for piece in self._stream_deltas(request, stops):
                             chunk = {
                                 "id": completion_id, "object": "text_completion",
                                 "created": created, "model": model,
@@ -504,27 +674,41 @@ class LLMEngineRequest(BaseEngineRequest):
 
             return StreamingOutput(sse())
 
-        # one choice per prompt, generated concurrently through the continuous
-        # batch (OpenAI batched-prompt semantics)
-        requests = [
-            self._gen_request_from_body(body, ids) for ids in prompt_id_lists
-        ]
-        results = await asyncio.gather(*[self._collect_text(r) for r in requests])
+        # n choices per prompt, all generated concurrently through the
+        # continuous batch (OpenAI batched-prompt semantics: choice index is
+        # prompt-major, prompt_idx * n + choice_idx)
+        requests: List[Any] = []
+        for ids in prompt_id_lists:
+            requests.extend(self._n_requests(body, ids, chat=False))
+        results = await asyncio.gather(
+            *[self._collect_text(r, stops) for r in requests]
+        )
         for r in requests:
             self._report_gen_stats(r, collect_fn)
+        choices = []
+        for i, (r, res) in enumerate(zip(requests, results)):
+            choice = {
+                "index": i,
+                "text": res["text"],
+                "finish_reason": res["finish_reason"],
+                "logprobs": (
+                    self._completion_logprobs(r, res["ids"])
+                    if r.logprobs is not None
+                    else None
+                ),
+            }
+            choices.append(choice)
+        prompt_tokens = sum(len(ids) for ids in prompt_id_lists)
         return {
             "id": completion_id,
             "object": "text_completion",
             "created": created,
             "model": model,
-            "choices": [
-                {"index": i, "text": res["text"], "finish_reason": res["finish_reason"]}
-                for i, res in enumerate(results)
-            ],
+            "choices": choices,
             "usage": {
-                "prompt_tokens": sum(r.prompt_len for r in requests),
+                "prompt_tokens": prompt_tokens,
                 "completion_tokens": sum(r.produced for r in requests),
-                "total_tokens": sum(r.prompt_len + r.produced for r in requests),
+                "total_tokens": prompt_tokens + sum(r.produced for r in requests),
             },
         }
 

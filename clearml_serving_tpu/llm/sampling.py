@@ -1,14 +1,32 @@
 """Batched token sampling — one jitted function for the whole decode batch.
 
-Per-slot temperature / top-k / top-p as data (arrays over the batch), never as
-Python branches, so a single XLA executable covers every mix of sampling
-settings in the continuous batch (recompilation-free, SURVEY.md §7 hard part 1).
+Per-slot temperature / top-k / top-p / penalties / seeds as data (arrays over
+the batch), never as Python branches, so a single XLA executable covers every
+mix of sampling settings in the continuous batch (recompilation-free,
+SURVEY.md §7 hard part 1).
+
+OpenAI/vLLM sampling-parameter parity (reference §2.8 route surface):
+- ``presence_penalty`` / ``frequency_penalty``: subtracted from the logits of
+  tokens already generated (vLLM semantics: output tokens only), presence as
+  a flat hit, frequency scaled by the count.
+- ``repetition_penalty``: multiplicative push-down on every token seen in the
+  prompt OR the output (vLLM semantics), divide positive logits, multiply
+  negative ones.
+- ``logit_bias``: dense additive bias row per slot (built host-side from the
+  OpenAI sparse {token_id: bias} map).
+- ``seed``: per-request deterministic sampling stream — the row's key is
+  fold_in(PRNGKey(seed), tokens_generated_so_far), so identical requests
+  replay identical samples regardless of batch composition; unseeded rows
+  draw from the engine's shared stream (split per row).
+
+All extras are optional (None skips their compute at trace time, keeping the
+no-extras graph identical to the minimal sampler).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -18,6 +36,17 @@ class SamplingParams(NamedTuple):
     temperature: jnp.ndarray  # [B] float32; 0 => greedy
     top_k: jnp.ndarray        # [B] int32; 0 => disabled
     top_p: jnp.ndarray        # [B] float32; 1.0 => disabled
+
+
+class SamplingExtras(NamedTuple):
+    """Per-slot penalty/bias/seed state (all optional as a bundle)."""
+
+    presence: jnp.ndarray    # [B] f32; 0 disables
+    frequency: jnp.ndarray   # [B] f32; 0 disables
+    repetition: jnp.ndarray  # [B] f32; 1.0 disables
+    bias: jnp.ndarray        # [B, V] f32 dense additive bias
+    seeds: jnp.ndarray       # [B] int32; < 0 => unseeded (shared stream)
+    counters: jnp.ndarray    # [B] int32 tokens generated so far (seed stream)
 
 
 def make_sampling_params(batch, temperature=0.0, top_k=0, top_p=1.0):
@@ -30,14 +59,65 @@ def make_sampling_params(batch, temperature=0.0, top_k=0, top_p=1.0):
     )
 
 
+def penalize_logits(
+    logits: jnp.ndarray,
+    extras: SamplingExtras,
+    counts: Optional[jnp.ndarray],
+    prompt_mask: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """Apply bias + penalties to raw logits [B, V] (before temperature).
+
+    ``counts`` [B, V] int32: per-slot generated-token histogram.
+    ``prompt_mask`` [B, V] bool: tokens present in the prompt."""
+    logits = logits + extras.bias
+    if counts is not None:
+        counts_f = counts.astype(jnp.float32)
+        logits = logits - extras.frequency[:, None] * counts_f
+        logits = logits - extras.presence[:, None] * (counts_f > 0)
+    seen = None
+    if counts is not None:
+        seen = counts > 0
+    if prompt_mask is not None:
+        seen = prompt_mask if seen is None else (seen | prompt_mask)
+    if seen is not None:
+        rp = extras.repetition[:, None]
+        logits = jnp.where(
+            seen,
+            jnp.where(logits > 0, logits / rp, logits * rp),
+            logits,
+        )
+    return logits
+
+
+def _row_keys(rng: jax.Array, extras: SamplingExtras, batch: int):
+    """Per-row PRNG keys: seeded rows get fold_in(PRNGKey(seed), counter);
+    unseeded rows split the shared stream."""
+    shared = jax.random.split(rng, batch)                     # [B, 2] u32
+    seeded = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+    )(jnp.maximum(extras.seeds, 0), extras.counters)
+    use_seed = (extras.seeds >= 0)[:, None]
+    return jnp.where(use_seed, seeded, shared)
+
+
 @partial(jax.jit, donate_argnums=())
-def sample_tokens(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array):
+def sample_tokens(
+    logits: jnp.ndarray,
+    params: SamplingParams,
+    rng: jax.Array,
+    extras: Optional[SamplingExtras] = None,
+    counts: Optional[jnp.ndarray] = None,
+    prompt_mask: Optional[jnp.ndarray] = None,
+):
     """logits: [B, V] float32 -> token ids [B] int32.
 
     Rows with temperature == 0 take the argmax; others sample from the
-    temperature-scaled, top-k/top-p-filtered distribution.
+    temperature-scaled, top-k/top-p-filtered distribution. Penalties/bias
+    (extras) apply to BOTH paths — greedy decoding respects them too.
     """
     b, v = logits.shape
+    if extras is not None:
+        logits = penalize_logits(logits, extras, counts, prompt_mask)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
@@ -62,5 +142,11 @@ def sample_tokens(logits: jnp.ndarray, params: SamplingParams, rng: jax.Array):
     ).min(axis=-1, keepdims=True)                                  # lowest kept logit
     scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    if extras is None:
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    else:
+        keys = _row_keys(rng, extras, b)
+        sampled = jax.vmap(
+            lambda key, row: jax.random.categorical(key, row)
+        )(keys, scaled).astype(jnp.int32)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,224 @@
+"""OpenAI request-parameter parity at the route level: stop strings,
+n choices, logprobs, penalties/seed passthrough (llm/openai_api.py)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.main import build_app
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+
+@pytest.fixture(scope="module")
+def llm_served(tmp_path_factory):
+    import os
+
+    root = tmp_path_factory.mktemp("state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="llmp")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 4,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32],
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def _run(mrp, fn):
+    async def runner():
+        client = TestClient(TestServer(build_app(mrp)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def _chat_body(**kw):
+    body = {
+        "model": "tiny_llm",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+    }
+    body.update(kw)
+    return body
+
+
+def test_n_choices(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(n=3, temperature=1.0, seed=5),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    assert len(out["choices"]) == 3
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    # seeded choices offset per index -> not all identical (vocab 512,
+    # temperature 1: three identical 8-token outputs would be astronomical)
+    texts = [c["message"]["content"] for c in out["choices"]]
+    assert len(set(texts)) > 1
+    assert out["usage"]["completion_tokens"] == sum(
+        1 for c in texts for _ in c
+    ) or out["usage"]["completion_tokens"] > 0
+
+
+def test_chat_logprobs(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(logprobs=True, top_logprobs=3, max_tokens=4),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    lp = out["choices"][0]["logprobs"]
+    assert lp is not None and "content" in lp
+    assert len(lp["content"]) >= 1
+    entry = lp["content"][0]
+    assert set(entry) == {"token", "logprob", "bytes", "top_logprobs"}
+    assert len(entry["top_logprobs"]) == 3
+    assert entry["logprob"] <= 0.0
+    # top alternatives are sorted descending
+    tops = [t["logprob"] for t in entry["top_logprobs"]]
+    assert tops == sorted(tops, reverse=True)
+
+
+def test_completions_logprobs_and_offsets(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={
+                "model": "tiny_llm",
+                "prompt": "abc",
+                "max_tokens": 4,
+                "logprobs": 2,
+            },
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    lp = out["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(lp["text_offset"])
+    assert all(len(d) <= 2 for d in lp["top_logprobs"])
+    # text offsets are cumulative over the decoded tokens
+    assert lp["text_offset"][0] == 0
+    for i in range(1, len(lp["tokens"])):
+        assert lp["text_offset"][i] == lp["text_offset"][i - 1] + len(
+            lp["tokens"][i - 1]
+        )
+
+
+# logit_bias {42:+200, 43:+100} with presence_penalty 150 forces the exact
+# byte sequence 42,43,42,42,... ("*+***" under the byte tokenizer): after
+# the first '*' its logit drops to 50 so '+' (100) wins, then both are
+# penalized (50 vs -50) and '*' repeats. Deterministic text to stop on.
+_FORCED = {"logit_bias": {"42": 200.0, "43": 100.0}, "presence_penalty": 150.0}
+
+
+def test_stop_string_truncates(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(max_tokens=8, **_FORCED),
+        )
+        base = (await r.json())["choices"][0]["message"]["content"]
+        r2 = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(max_tokens=8, stop="**", **_FORCED),
+        )
+        assert r2.status == 200, await r2.text()
+        return base, await r2.json()
+
+    base, out = _run(llm_served, fn)
+    assert base.startswith("*+**")
+    text = out["choices"][0]["message"]["content"]
+    assert text == "*+"  # truncated before the first "**" occurrence
+    assert out["choices"][0]["finish_reason"] == "stop"
+
+
+def test_stop_string_streaming(llm_served):
+    async def fn(client):
+        r2 = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(max_tokens=8, stop=["**"], stream=True, **_FORCED),
+        )
+        assert r2.status == 200
+        return (await r2.read()).decode()
+
+    raw = _run(llm_served, fn)
+    import json as _json
+
+    pieces = []
+    finish = None
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        chunk = _json.loads(line[6:])
+        for ch in chunk.get("choices", []):
+            delta = ch.get("delta", {})
+            if "content" in delta:
+                pieces.append(delta["content"])
+            if ch.get("finish_reason"):
+                finish = ch["finish_reason"]
+    assert "".join(pieces) == "*+"
+    assert finish == "stop"
+
+
+def test_streaming_rejects_multi_choice(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(n=2, stream=True),
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
+
+
+def test_penalties_and_seed_passthrough(llm_served):
+    """Seeded sampled requests reproduce through the HTTP surface."""
+
+    async def fn(client):
+        body = _chat_body(temperature=1.0, seed=42, max_tokens=6)
+        r1 = await client.post("/serve/openai/v1/chat/completions", json=body)
+        r2 = await client.post("/serve/openai/v1/chat/completions", json=body)
+        return (await r1.json()), (await r2.json())
+
+    a, b = _run(llm_served, fn)
+    assert (
+        a["choices"][0]["message"]["content"]
+        == b["choices"][0]["message"]["content"]
+    )
+
+
+def test_bad_logit_bias_is_422(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(logit_bias={"999999": 5}),
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
