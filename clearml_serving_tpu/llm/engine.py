@@ -44,7 +44,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .sampling import SamplingExtras, SamplingParams, sample_tokens
+from .sampling import (
+    SamplingExtras,
+    SamplingParams,
+    penalize_logits,
+    sample_tokens,
+)
 
 _DEFAULT_PREFILL_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048]
 
@@ -188,7 +193,7 @@ class LLMEngineCore:
         prefix_cache: Optional[int] = None,
         prefix_block: int = 64,
         prefix_cache_bytes: Optional[int] = None,
-        logprobs_k: int = 8,
+        logprobs_k: int = 20,  # OpenAI's top_logprobs ceiling
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -202,6 +207,10 @@ class LLMEngineCore:
                 "sliding_window models need engine.cache=dense (the paged "
                 "decode path does not window its attention yet)"
             )
+        if cache_mode == "paged" and getattr(
+            bundle, "paged_unsupported_reason", None
+        ):
+            raise ValueError(bundle.paged_unsupported_reason)
         if cache_mode not in ("dense", "paged"):
             raise ValueError("cache_mode must be 'dense' or 'paged'")
         self.cache_mode = cache_mode
@@ -482,8 +491,10 @@ class LLMEngineCore:
         self._lp_k = max(1, int(logprobs_k))
 
         def _lp_of(logits, sampled, nb):
-            """(chosen logprob [B], top ids [B,K], top logprobs [B,K]) from
-            RAW (pre-penalty) logits — reported logprobs are the model's."""
+            """(chosen logprob [B], top ids [B,K], top logprobs [B,K]).
+            Callers pass the PENALIZED logits when bias/penalties are active
+            — reported logprobs reflect what was actually sampled from
+            (OpenAI semantics for logit_bias)."""
             lp_full = jax.nn.log_softmax(logits)
             chosen = lp_full[jnp.arange(nb), sampled]
             top_lp, top_id = jax.lax.top_k(lp_full, self._lp_k)
@@ -513,15 +524,24 @@ class LLMEngineCore:
                 logits = logits.astype(jnp.float32)
                 if extras is None:
                     sampled = sample_tokens(logits, sampling, step_rng)
+                    lp_src = logits
                 else:
                     ex = extras._replace(counters=extras.counters + step_off)
                     sampled = sample_tokens(
                         logits, sampling, step_rng, ex, counts, pmask
                     )
+                    # reported logprobs reflect bias/penalties (OpenAI
+                    # semantics); XLA CSEs this against the sampler's own
+                    # penalize pass
+                    lp_src = (
+                        penalize_logits(logits, ex, counts, pmask)
+                        if want_lp
+                        else logits
+                    )
                     counts = counts.at[jnp.arange(nb), sampled].add(
                         active.astype(jnp.int32)
                     )
-                out = (sampled, _lp_of(logits, sampled, nb)) if want_lp else sampled
+                out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
                 return (sampled, cache, counts), out
 
             rngs = jax.random.split(rng, self.decode_steps)
@@ -670,15 +690,21 @@ class LLMEngineCore:
                 logits = logits.astype(jnp.float32)
                 if extras is None:
                     sampled = sample_tokens(logits, sampling, step_rng)
+                    lp_src = logits
                 else:
                     ex = extras._replace(counters=extras.counters + step)
                     sampled = sample_tokens(
                         logits, sampling, step_rng, ex, counts, pmask
                     )
+                    lp_src = (
+                        penalize_logits(logits, ex, counts, pmask)
+                        if want_lp
+                        else logits
+                    )
                     counts = counts.at[jnp.arange(nb), sampled].add(
                         active.astype(jnp.int32)
                     )
-                out = (sampled, _lp_of(logits, sampled, nb)) if want_lp else sampled
+                out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
                 return (sampled, k_pools, v_pools, counts, step + 1), out
 
             rngs = jax.random.split(rng, self.decode_steps)
@@ -732,12 +758,15 @@ class LLMEngineCore:
                     )
         if request.repetition_penalty is not None and request.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
-        if request.logprobs is not None and request.logprobs > self._lp_k:
-            raise ValueError(
-                "logprobs={} exceeds the engine's logprobs_k={}".format(
-                    request.logprobs, self._lp_k
+        if request.logprobs is not None:
+            if request.logprobs < 0:
+                raise ValueError("logprobs must be >= 0")
+            if request.logprobs > self._lp_k:
+                raise ValueError(
+                    "logprobs={} exceeds the engine's logprobs_k={}".format(
+                        request.logprobs, self._lp_k
+                    )
                 )
-            )
 
     @property
     def adapter_names(self) -> List[str]:
@@ -1004,17 +1033,21 @@ class LLMEngineCore:
             top_p=jnp.asarray([request.top_p], jnp.float32),
         )
         logits32 = last_logits.astype(jnp.float32)
+        lp_src = logits32
         if self._request_has_extras(request):
             extras, counts0, pmask0 = self._request_extras_row(request)
             first = self._sample_jit(
                 logits32, sp, self._next_rng(), extras, counts0, pmask0
             )
+            if request.logprobs is not None:
+                # reported logprobs reflect bias/penalties (OpenAI semantics)
+                lp_src = penalize_logits(logits32, extras, counts0, pmask0)
         else:
             first = self._sample_jit(logits32, sp, self._next_rng())
         first_id = int(np.asarray(first)[0])
         first_lp = None
         if request.logprobs is not None:
-            chosen, tid, tlp = self._first_lp_jit(logits32, first)
+            chosen, tid, tlp = self._first_lp_jit(lp_src, first)
             first_lp = {
                 "id": first_id,
                 "logprob": float(np.asarray(chosen)[0]),

@@ -187,6 +187,7 @@ class LLMEngineRequest(BaseEngineRequest):
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),
             prefix_block=int(engine_cfg.get("prefix_block", 64)),
+            logprobs_k=int(engine_cfg.get("logprobs_k", 20)),
             prefix_cache_bytes=(
                 int(float(engine_cfg["prefix_cache_mb"]) * (1 << 20))
                 if engine_cfg.get("prefix_cache_mb")
@@ -361,8 +362,20 @@ class LLMEngineRequest(BaseEngineRequest):
                     request.cancel()
                     text = self.tokenizer.decode(ids)
                     cut = self._first_stop_hit(text, stops)
+                    if cut >= 0:
+                        # trim ids to the tokens that produce text[:cut] so
+                        # logprobs/usage stay consistent with the returned
+                        # text (no phantom stop-sequence tokens)
+                        j = len(ids)
+                        while j > 0 and len(
+                            self.tokenizer.decode(ids[: j - 1])
+                        ) >= cut:
+                            j -= 1
+                        ids = ids[:j]
+                        request.produced = len(ids)
+                        text = text[:cut]
                     return {
-                        "text": text[:cut] if cut >= 0 else text,
+                        "text": text,
                         "ids": ids,
                         "finish_reason": "stop",
                     }
@@ -383,6 +396,27 @@ class LLMEngineRequest(BaseEngineRequest):
         stops = stops or []
         holdback = max((len(s) for s in stops), default=1) - 1
         eos = self.tokenizer.eos_token_id
+        lp_cursor = 0
+
+        def _tokens_covering(n_chars: int) -> int:
+            """Smallest token count whose decoded prefix covers n_chars (the
+            same criterion the non-streaming stop trim uses)."""
+            j = len(ids)
+            while j > 0 and len(self.tokenizer.decode(ids[: j - 1])) >= n_chars:
+                j -= 1
+            return j
+
+        def take_entries(upto_tokens: int):
+            """Logprob entries for tokens [lp_cursor, upto_tokens) — only
+            tokens whose text has actually been emitted, so streamed entries
+            never lead the deltas or include held-back/stop tokens."""
+            nonlocal lp_cursor
+            if request.logprobs is None:
+                return None
+            new = request.logprob_entries[lp_cursor:upto_tokens]
+            lp_cursor = max(lp_cursor, upto_tokens)
+            return new
+
         async for token in self.engine.generate(request):
             if eos is not None and token == eos:
                 break
@@ -395,24 +429,41 @@ class LLMEngineRequest(BaseEngineRequest):
                 if cut >= 0:
                     request.stopped_on_string = True
                     request.cancel()
-                    if cut > len(sent):
-                        yield {"delta": text[len(sent):cut]}
+                    # trim to the tokens producing text[:cut] so streamed
+                    # entries/usage match the non-streaming path exactly
+                    j = _tokens_covering(cut)
+                    del ids[j:]
+                    request.produced = j
+                    entries = take_entries(j)
+                    if cut > len(sent) or entries:
+                        yield {"delta": text[len(sent):cut],
+                               "entries": entries}
                     return
                 text = text[: len(text) - holdback] if holdback else text
             if len(text) > len(sent):
-                yield {"delta": text[len(sent):]}
+                prev = len(sent)
                 sent = text
+                yield {
+                    "delta": text[prev:],
+                    "entries": take_entries(_tokens_covering(len(text))),
+                }
         # flush any held-back tail: if the final decode legitimately ends with
         # the replacement character (truncated multi-byte at stop, or a real
-        # '�' from the tokenizer), it must not be silently dropped
+        # '�' from the tokenizer), it must not be silently dropped — and
+        # logprob entries for tokens that decoded to EMPTY text (so no delta
+        # ever carried them) still need a final (possibly empty-delta) piece
         text = self.tokenizer.decode(ids)
         if stops:
             cut = self._first_stop_hit(text, stops)
             if cut >= 0:
                 request.stopped_on_string = True
                 text = text[:cut]
-        if len(text) > len(sent):
-            yield {"delta": text[len(sent):]}
+                j = _tokens_covering(cut)
+                del ids[j:]
+                request.produced = j
+        tail_entries = take_entries(len(ids))
+        if len(text) > len(sent) or tail_entries:
+            yield {"delta": text[len(sent):], "entries": tail_entries}
 
     def _finish_reason(self, request) -> str:
         """OpenAI semantics: "length" covers BOTH max_tokens truncation and
@@ -430,43 +481,47 @@ class LLMEngineRequest(BaseEngineRequest):
     def _token_str(self, tid: int) -> str:
         return self.tokenizer.decode([int(tid)])
 
-    def _chat_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
-        k = int(request.logprobs or 0)
+    def _chat_lp_entries(self, entries: List[dict], k: int) -> List[dict]:
+        """Chat-shape logprob items from engine entries ({"id", "logprob",
+        "top_ids", "top_logprobs"}); shared by the streaming chunks and the
+        final response."""
         content = []
-        for entry, tid in zip(request.logprob_entries, ids):
-            tok = self._token_str(tid)
-            item = {
-                "token": tok,
-                "logprob": entry["logprob"],
-                "bytes": list(tok.encode("utf-8")),
-            }
-            item["top_logprobs"] = [
+        for entry in entries:
+            tok = self._token_str(entry["id"])
+            tops = []
+            for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k]):
+                ts = self._token_str(t)
+                tops.append(
+                    {"token": ts, "logprob": lp, "bytes": list(ts.encode("utf-8"))}
+                )
+            content.append(
                 {
-                    "token": self._token_str(t),
-                    "logprob": lp,
-                    "bytes": list(self._token_str(t).encode("utf-8")),
-                }
-                for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k])
-            ]
-            content.append(item)
-        return {"content": content}
-
-    def _completion_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
-        k = int(request.logprobs or 0)
-        tokens, token_logprobs, top_logprobs, offsets = [], [], [], []
-        offset = 0
-        for entry, tid in zip(request.logprob_entries, ids):
-            tok = self._token_str(tid)
-            tokens.append(tok)
-            token_logprobs.append(entry["logprob"])
-            top_logprobs.append(
-                {
-                    self._token_str(t): lp
-                    for t, lp in zip(
-                        entry["top_ids"][:k], entry["top_logprobs"][:k]
-                    )
+                    "token": tok,
+                    "logprob": entry["logprob"],
+                    "bytes": list(tok.encode("utf-8")),
+                    "top_logprobs": tops,
                 }
             )
+        return content
+
+    def _chat_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
+        return {
+            "content": self._chat_lp_entries(
+                request.logprob_entries[: len(ids)], int(request.logprobs or 0)
+            )
+        }
+
+    def _completion_lp_entries(self, entries: List[dict], k: int,
+                               offset: int = 0) -> Dict[str, Any]:
+        tokens, token_logprobs, top_logprobs, offsets = [], [], [], []
+        for entry in entries:
+            tok = self._token_str(entry["id"])
+            tokens.append(tok)
+            token_logprobs.append(entry["logprob"])
+            tops = {}
+            for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k]):
+                tops[self._token_str(t)] = lp
+            top_logprobs.append(tops)
             offsets.append(offset)
             offset += len(tok)
         return {
@@ -475,6 +530,11 @@ class LLMEngineRequest(BaseEngineRequest):
             "top_logprobs": top_logprobs,
             "text_offset": offsets,
         }
+
+    def _completion_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
+        return self._completion_lp_entries(
+            request.logprob_entries[: len(ids)], int(request.logprobs or 0)
+        )
 
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
@@ -512,9 +572,6 @@ class LLMEngineRequest(BaseEngineRequest):
             if int(body.get("n", 1) or 1) != 1:
                 raise EndpointModelError("streaming supports a single choice (n=1)")
             request = self._gen_request_from_body(body, prompt_ids)
-            # SSE chunks carry no logprobs field; tracking them would slow
-            # the whole batch (and disable speculation) for data nobody sees
-            request.logprobs = None
             # validate BEFORE returning the stream — a late ValueError would
             # abort mid-SSE after the 200 headers are already sent
             self.engine.validate(request)
@@ -530,11 +587,20 @@ class LLMEngineRequest(BaseEngineRequest):
                     yield "data: {}\n\n".format(json.dumps(first))
                     try:
                         async for piece in self._stream_deltas(request, stops):
+                            choice = {"index": 0,
+                                      "delta": {"content": piece["delta"]},
+                                      "finish_reason": None}
+                            if piece.get("entries") is not None:
+                                choice["logprobs"] = {
+                                    "content": self._chat_lp_entries(
+                                        piece["entries"],
+                                        int(request.logprobs or 0),
+                                    )
+                                }
                             chunk = {
                                 "id": completion_id, "object": "chat.completion.chunk",
                                 "created": created, "model": model,
-                                "choices": [{"index": 0, "delta": {"content": piece["delta"]},
-                                             "finish_reason": None}],
+                                "choices": [choice],
                             }
                             yield "data: {}\n\n".format(json.dumps(chunk))
                     except Exception as ex:
@@ -637,19 +703,30 @@ class LLMEngineRequest(BaseEngineRequest):
             request = self._gen_request_from_body(
                 body, prompt_id_lists[0], chat=False
             )
-            # SSE chunks carry no logprobs field (see chat stream path)
-            request.logprobs = None
             self.engine.validate(request)
 
             async def sse():
+                lp_offset = 0
                 try:
                     try:
                         async for piece in self._stream_deltas(request, stops):
+                            choice = {"index": 0, "text": piece["delta"],
+                                      "finish_reason": None}
+                            if piece.get("entries") is not None:
+                                lp = self._completion_lp_entries(
+                                    piece["entries"],
+                                    int(request.logprobs or 0),
+                                    offset=lp_offset,
+                                )
+                                lp_offset = (
+                                    lp["text_offset"][-1] + len(lp["tokens"][-1])
+                                    if lp["tokens"] else lp_offset
+                                )
+                                choice["logprobs"] = lp
                             chunk = {
                                 "id": completion_id, "object": "text_completion",
                                 "created": created, "model": model,
-                                "choices": [{"index": 0, "text": piece["delta"],
-                                             "finish_reason": None}],
+                                "choices": [choice],
                             }
                             yield "data: {}\n\n".format(json.dumps(chunk))
                     except Exception as ex:

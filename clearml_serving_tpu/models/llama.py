@@ -18,6 +18,7 @@ the OpenAI-compatible route surface (reference preprocess_service.py:619-1348).
 
 from __future__ import annotations
 
+from functools import partial
 from types import SimpleNamespace
 from typing import Any, Dict, Optional, Tuple
 
@@ -54,11 +55,17 @@ def resolve_config(config: dict) -> dict:
     return cfg
 
 
-def _rms_norm(x, weight, eps):
-    # fp32 accumulation regardless of activation dtype.
+def _rms_norm(x, weight, eps, offset=0.0):
+    # fp32 accumulation regardless of activation dtype. ``offset`` supports
+    # the Gemma convention of zero-initialized weights applied as (1 + w).
     x32 = x.astype(jnp.float32)
     norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+    return (norm * (offset + weight.astype(jnp.float32))).astype(x.dtype)
+
+
+def _softcap(x, cap):
+    """Gemma-2 logit soft-capping: cap * tanh(x / cap)."""
+    return cap * jnp.tanh(x / cap)
 
 
 def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
@@ -122,9 +129,39 @@ def build(config: dict) -> SimpleNamespace:
     _rope_freqs(dim // int(cfg["n_heads"]), theta, rope_scaling)  # fail fast on bad cfg
     eps = float(cfg["norm_eps"])
     dtype = jnp.dtype(cfg["dtype"])
-    head_dim = dim // n_heads
+    # head_dim may be decoupled from dim (Gemma-2: 16 heads x 256 > dim)
+    head_dim = int(cfg.get("head_dim") or dim // n_heads)
     assert n_heads % n_kv == 0, "n_heads must be divisible by n_kv_heads"
     group = n_heads // n_kv
+
+    # Gemma-family deltas over the llama skeleton:
+    # - norm_offset: RMSNorm weights stored zero-init, applied as (1 + w)
+    # - hidden_act "gelu_tanh": GeGLU instead of SiLU-GLU
+    # - embed_scale: embeddings multiplied by sqrt(dim) (converter supplies
+    #   the numeric value)
+    # - query_scale: attention score scale override (Gemma-2's
+    #   query_pre_attn_scalar**-0.5 instead of head_dim**-0.5)
+    # - attn/final logit softcap (Gemma-2)
+    # - post_block_norms: extra norms on each sublayer OUTPUT before the
+    #   residual add (Gemma-2's post_attention/post_feedforward norms)
+    # - alt_window: per-layer local/global attention interleave (Gemma-2);
+    #   each layer carries an ``attn_global`` scalar selecting its mask
+    norm_offset = 1.0 if cfg.get("norm_offset") else 0.0
+    hidden_act = str(cfg.get("hidden_act", "silu"))
+    if hidden_act == "silu":
+        _act = jax.nn.silu
+    elif hidden_act in ("gelu_tanh", "gelu_pytorch_tanh"):
+        _act = partial(jax.nn.gelu, approximate=True)
+    elif hidden_act == "gelu":
+        _act = partial(jax.nn.gelu, approximate=False)
+    else:
+        raise ValueError("unsupported hidden_act {!r}".format(hidden_act))
+    embed_scale = float(cfg.get("embed_scale") or 0.0)
+    query_scale = float(cfg.get("query_scale") or head_dim ** -0.5)
+    attn_softcap = float(cfg.get("attn_logit_softcap") or 0.0)
+    final_softcap = float(cfg.get("final_logit_softcap") or 0.0)
+    post_block_norms = bool(cfg.get("post_block_norms"))
+    alt_window = bool(cfg.get("alt_window"))
 
     # -- init ---------------------------------------------------------------
 
@@ -162,6 +199,15 @@ def build(config: dict) -> SimpleNamespace:
                 "(expert-stacked weights); use attention targets"
             )
 
+    if alt_window and not sliding_window:
+        raise ValueError("alt_window needs a nonzero sliding_window")
+    # per-layer global/full-attention flags for the Gemma-2 interleave:
+    # default is the Gemma-2 pattern (odd layers global, even local)
+    attn_global_layers = cfg.get("attn_global_layers")
+    if alt_window and attn_global_layers is None:
+        attn_global_layers = [1.0 if (i % 2 == 1) else 0.0 for i in range(n_layers)]
+    norm_init = jnp.zeros if norm_offset else jnp.ones
+
     def _init_layer(key):
         def dense(k, shape, fan_in):
             return (
@@ -170,13 +216,20 @@ def build(config: dict) -> SimpleNamespace:
 
         k = jax.random.split(key, 8)
         out = {
-            "attn_norm": jnp.ones((dim,), dtype),
+            "attn_norm": norm_init((dim,), dtype),
             "wq": dense(k[0], (dim, n_heads * head_dim), dim),
             "wk": dense(k[1], (dim, n_kv * head_dim), dim),
             "wv": dense(k[2], (dim, n_kv * head_dim), dim),
             "wo": dense(k[3], (n_heads * head_dim, dim), n_heads * head_dim),
-            "ffn_norm": jnp.ones((dim,), dtype),
+            "ffn_norm": norm_init((dim,), dtype),
         }
+        if post_block_norms:
+            out.update(
+                post_attn_norm=norm_init((dim,), dtype),
+                post_ffn_norm=norm_init((dim,), dtype),
+            )
+        if alt_window:
+            out["attn_global"] = jnp.zeros((), jnp.float32)  # set by init()
         if attn_bias:
             out.update(
                 bq=jnp.zeros((n_heads * head_dim,), dtype),
@@ -218,15 +271,24 @@ def build(config: dict) -> SimpleNamespace:
         keys = jax.random.split(rng, 3)
         params: Dict[str, Any] = {
             "embed": dense(keys[0], (vocab, dim), dim),
-            "final_norm": jnp.ones((dim,), dtype),
+            "final_norm": norm_init((dim,), dtype),
         }
         if not cfg["tie_embeddings"]:
             params["lm_head"] = dense(keys[1], (dim, vocab), dim)
         layer_keys = jax.random.split(keys[2], n_layers)
         if scan_layers:
             params["layers"] = jax.vmap(_init_layer)(layer_keys)
+            if alt_window:
+                params["layers"]["attn_global"] = jnp.asarray(
+                    attn_global_layers, jnp.float32
+                )
         else:
             params["layers"] = [_init_layer(k) for k in layer_keys]
+            if alt_window:
+                for i, layer in enumerate(params["layers"]):
+                    layer["attn_global"] = jnp.asarray(
+                        attn_global_layers[i], jnp.float32
+                    )
         return params
 
 
@@ -245,14 +307,29 @@ def build(config: dict) -> SimpleNamespace:
             return dequantize(w["_q8"], w["_scale"], dtype)
         return w
 
-    def _visible(q_pos, t_pos):
+    def _visible_w(q_pos, t_pos, window):
         """Causal visibility (key position t, query position q): t <= q,
-        windowed to q - W < t when sliding_window is set. The ONE place the
+        windowed to q - W < t when ``window`` is set. The ONE place the
         window semantics live — every attention path builds its mask here."""
         ok = t_pos <= q_pos
-        if sliding_window:
-            ok = ok & (t_pos > q_pos - sliding_window)
+        if window:
+            ok = ok & (t_pos > q_pos - window)
         return ok
+
+    def _build_masks(build_fn):
+        """``build_fn(window) -> mask``. Uniform models get one mask; under
+        the Gemma-2 interleave (alt_window) BOTH masks build once per forward
+        and each layer selects its own via ``attn_global`` (a scanned scalar,
+        so lax.scan keeps one compiled layer body)."""
+        if alt_window:
+            return (build_fn(0), build_fn(sliding_window))
+        return build_fn(sliding_window)
+
+    def _layer_mask(layer, masks):
+        if not alt_window:
+            return masks
+        mask_global, mask_local = masks
+        return jnp.where(layer["attn_global"] != 0, mask_global, mask_local)
 
     def _lora_delta(layer, name, x, lora_idx):
         """Batched per-slot LoRA delta: x [B,S,in] -> [B,S,out]. The gather
@@ -297,7 +374,9 @@ def build(config: dict) -> SimpleNamespace:
         qg = q.reshape(b, s, n_kv, group, head_dim)
         scores = jnp.einsum(
             "bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32
-        ) * (head_dim ** -0.5)
+        ) * query_scale
+        if attn_softcap:
+            scores = _softcap(scores, attn_softcap)  # before the mask (HF)
         scores = scores + mask[:, :, None, :, :]  # mask broadcast over groups
         probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
         out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
@@ -306,7 +385,7 @@ def build(config: dict) -> SimpleNamespace:
     def _ffn_dense(layer, x, lora_idx=None):
         gate = _with_lora(layer, "w_gate", x, x @ _w(layer, "w_gate"), lora_idx)
         up = _with_lora(layer, "w_up", x, x @ _w(layer, "w_up"), lora_idx)
-        h = jax.nn.silu(gate) * up
+        h = _act(gate) * up
         return _with_lora(layer, "w_down", h, h @ _w(layer, "w_down"), lora_idx)
 
     def _moe_routing(layer, tokens):
@@ -397,9 +476,38 @@ def build(config: dict) -> SimpleNamespace:
         return _ffn_dense(layer, x, lora_idx)
 
     def _logits(params, x):
-        x = _rms_norm(x, params["final_norm"], eps)
+        x = _rms_norm(x, params["final_norm"], eps, norm_offset)
         head = _w(params, "lm_head") if "lm_head" in params else params["embed"].T
-        return (x @ head).astype(jnp.float32)
+        out = (x @ head).astype(jnp.float32)
+        if final_softcap:
+            out = _softcap(out, final_softcap)
+        return out
+
+    def _embed(params, tokens):
+        x = params["embed"][tokens]
+        if embed_scale:
+            # Gemma normalizer: applied in the ACTIVATION dtype like HF
+            # (sqrt(dim) cast to bf16/f32 before the multiply)
+            x = x * jnp.asarray(embed_scale, x.dtype)
+        return x
+
+    def _block(layer, x, attn_fn, lora_idx, ffn_kwargs=None):
+        """One decoder block around pluggable attention: pre-norm ->
+        attention -> (post-norm) -> residual -> pre-norm -> FFN ->
+        (post-norm) -> residual. The ONE place the residual structure
+        lives — every forward path (full, prefill, chunk, decode) runs
+        through it, so family deltas (Gemma-2 post-block norms, norm
+        offsets) apply everywhere by construction."""
+        h = _rms_norm(x, layer["attn_norm"], eps, norm_offset)
+        attn_out = _oproj(layer, attn_fn(layer, h), lora_idx)
+        if post_block_norms:
+            attn_out = _rms_norm(attn_out, layer["post_attn_norm"], eps, norm_offset)
+        x = x + attn_out
+        h = _rms_norm(x, layer["ffn_norm"], eps, norm_offset)
+        ffn_out = _ffn(layer, h, lora_idx=lora_idx, **(ffn_kwargs or {}))
+        if post_block_norms:
+            ffn_out = _rms_norm(ffn_out, layer["post_ffn_norm"], eps, norm_offset)
+        return x + ffn_out
 
     # -- full causal forward (training / no-cache prefill) -------------------
 
@@ -411,19 +519,22 @@ def build(config: dict) -> SimpleNamespace:
             positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         idx = jnp.arange(s)
-        causal = _visible(idx[:, None], idx[None, :])
-        mask = jnp.broadcast_to(
-            jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None],
-            (b, 1, s, s),
+        masks = _build_masks(
+            lambda w: jnp.broadcast_to(
+                jnp.where(
+                    _visible_w(idx[:, None], idx[None, :], w), 0.0, -jnp.inf
+                ).astype(jnp.float32)[None, None],
+                (b, 1, s, s),
+            )
         )
-        x = params["embed"][tokens]
+        x = _embed(params, tokens)
 
         def layer_body(x, layer):
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
-            x = x + _oproj(layer, _attend(q, k, v, mask), lora_idx)
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, lora_idx=lora_idx)
+            def attn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)
+                return _attend(q, k, v, _layer_mask(layer_, masks))
+
+            return _block(layer, x, attn, lora_idx)
 
         if scan_layers:
             x, _ = jax.lax.scan(
@@ -454,14 +565,18 @@ def build(config: dict) -> SimpleNamespace:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         ffn_valid = positions < seq_lens[:, None]  # pads never route (MoE)
-        x = params["embed"][tokens]
+        x = _embed(params, tokens)
 
         def layer_body(x, layer):
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
-            x = x + _oproj(layer, attend_fn(q, k, v), lora_idx)
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, ffn_valid, lora_idx=lora_idx), (k, v)
+            stash = []
+
+            def attn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)
+                stash.append((k, v))
+                return attend_fn(layer_, q, k, v)
+
+            x = _block(layer, x, attn, lora_idx, ffn_kwargs={"valid": ffn_valid})
+            return x, stash[0]
 
         if scan_layers:
             x, (k_stack, v_stack) = jax.lax.scan(layer_body, x, params["layers"])
@@ -496,12 +611,16 @@ def build(config: dict) -> SimpleNamespace:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
         valid = positions < seq_lens[:, None]                      # [B, S]
         idx = jnp.arange(s)
-        causal = _visible(idx[:, None], idx[None, :])
-        mask_b = causal[None] & valid[:, None, :]                  # [B, S, T]
-        mask = jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
 
-        def attend(q, k, v):
-            return _attend(q, k, v, mask)
+        def build(w):
+            causal = _visible_w(idx[:, None], idx[None, :], w)
+            mask_b = causal[None] & valid[:, None, :]              # [B, S, T]
+            return jnp.where(mask_b, 0.0, -jnp.inf).astype(jnp.float32)[:, None]
+
+        masks = _build_masks(build)
+
+        def attend(layer, q, k, v):
+            return _attend(q, k, v, _layer_mask(layer, masks))
 
         return _prefill_impl(params, tokens, seq_lens, cache, attend, lora_idx)
 
@@ -517,27 +636,34 @@ def build(config: dict) -> SimpleNamespace:
         max_len = cache["k"].shape[2]
         positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
-        x = params["embed"][tokens]
+        x = _embed(params, tokens)
         t_idx = jnp.arange(max_len, dtype=jnp.int32)
-        visible = _visible(positions[:, :, None], t_idx[None, None, :])
-        mask = jnp.where(visible, 0.0, -jnp.inf).astype(jnp.float32)[:, None]  # [B,1,C,T]
+        masks = _build_masks(
+            lambda w: jnp.where(
+                _visible_w(positions[:, :, None], t_idx[None, None, :], w),
+                0.0,
+                -jnp.inf,
+            ).astype(jnp.float32)[:, None]                         # [B,1,C,T]
+        )
 
         def layer_body(carry, layer_and_kv):
             x = carry
             layer, k_cache, v_cache = layer_and_kv
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin, lora_idx)
-            k_cache = jax.vmap(
-                lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
-            )(k_cache, k.astype(k_cache.dtype), start)
-            v_cache = jax.vmap(
-                lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
-            )(v_cache, v.astype(v_cache.dtype), start)
-            x = x + _oproj(layer, _attend(q, k_cache, v_cache, mask), lora_idx)
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, lora_idx=lora_idx, **ffn_kwargs), (
-                k_cache, v_cache
-            )
+            stash = []
+
+            def attn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)
+                k_c = jax.vmap(
+                    lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
+                )(k_cache, k.astype(k_cache.dtype), start)
+                v_c = jax.vmap(
+                    lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
+                )(v_cache, v.astype(v_cache.dtype), start)
+                stash.append((k_c, v_c))
+                return _attend(q, k_c, v_c, _layer_mask(layer_, masks))
+
+            x = _block(layer, x, attn, lora_idx, ffn_kwargs=ffn_kwargs)
+            return x, stash[0]
 
         if scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
@@ -642,7 +768,7 @@ def build(config: dict) -> SimpleNamespace:
 
         b, s = tokens.shape
 
-        def attend_sp(q, k, v):
+        def attend_sp(layer, q, k, v):
             # GQA: repeat KV heads to query heads for the ring (activation
             # cost only; weights untouched)
             kf = jnp.repeat(k, group, axis=2)
@@ -660,24 +786,31 @@ def build(config: dict) -> SimpleNamespace:
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
         max_len = cache["k"].shape[2]
         t_idx = jnp.arange(max_len, dtype=jnp.int32)[None]         # [1, T]
-        attn_valid = _visible(cache["length"][:, None], t_idx)     # [B, T]
-        mask = jnp.where(attn_valid, 0.0, -jnp.inf).astype(jnp.float32)[:, None, None]
-        x = params["embed"][tokens][:, None]                       # [B, 1, dim]
+        masks = _build_masks(
+            lambda w: jnp.where(
+                _visible_w(cache["length"][:, None], t_idx, w), 0.0, -jnp.inf
+            ).astype(jnp.float32)[:, None, None]
+        )
+        x = _embed(params, tokens)[:, None]                        # [B, 1, dim]
         # Per-sequence scatter at each sequence's own length (overwrite, so
         # stale values from a recycled batch slot cannot leak through).
         write = (t_idx == cache["length"][:, None])[:, :, None, None]  # [B,T,1,1]
 
         def layer_body(x, xs):
             layer, k_cache_l, v_cache_l = xs
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin, lora_idx)           # k,v: [B,1,Hkv,D]
-            # cast to the cache dtype: params may be a different precision
-            # than the cache (e.g. f32 checkpoint into a bf16 cache)
-            k_cache = jnp.where(write, k.astype(k_cache_l.dtype), k_cache_l)
-            v_cache = jnp.where(write, v.astype(v_cache_l.dtype), v_cache_l)
-            x = x + _oproj(layer, _attend(q, k_cache, v_cache, mask), lora_idx)
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, lora_idx=lora_idx), (k_cache, v_cache)
+            stash = []
+
+            def attn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # k,v: [B,1,Hkv,D]
+                # cast to the cache dtype: params may be a different precision
+                # than the cache (e.g. f32 checkpoint into a bf16 cache)
+                k_cache = jnp.where(write, k.astype(k_cache_l.dtype), k_cache_l)
+                v_cache = jnp.where(write, v.astype(v_cache_l.dtype), v_cache_l)
+                stash.append((k_cache, v_cache))
+                return _attend(q, k_cache, v_cache, _layer_mask(layer_, masks))
+
+            x = _block(layer, x, attn, lora_idx)
+            return x, stash[0]
 
         if scan_layers:
             x, (k_new, v_new) = jax.lax.scan(
@@ -722,28 +855,37 @@ def build(config: dict) -> SimpleNamespace:
         b = tokens.shape[0]
         positions = lengths[:, None]                               # [B, 1]
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
-        x = params["embed"][tokens][:, None]                       # [B, 1, dim]
+        x = _embed(params, tokens)[:, None]                        # [B, 1, dim]
+        # the Pallas kernel scales scores by head_dim**-0.5 internally; a
+        # family query_scale override folds into q before the kernel
+        q_prescale = query_scale * (head_dim ** 0.5)
 
         def layer_body(x, layer, k_pool_l, v_pool_l):
             """One layer on its own pool slice [Hkv, N, P, D]; returns the
             updated pool slice (scatter of the new token's K/V)."""
-            h = _rms_norm(x, layer["attn_norm"], eps)
-            q, k, v = _qkv(layer, h, cos, sin, lora_idx)           # q [B,1,H,D]
-            # index tuple (:, wp, wo): the advanced indices are CONTIGUOUS, so
-            # the broadcast dim [B] lands after the sliced head dim ->
-            # set() takes [Hkv, B, D].
-            k_hm = k[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
-            v_hm = v[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
-            k_pool_l = k_pool_l.at[:, write_page, write_offset].set(k_hm)
-            v_pool_l = v_pool_l.at[:, write_page, write_offset].set(v_hm)
-            q_grouped = q[:, 0].reshape(b, n_kv, group, head_dim)
-            attn = paged_attention(
-                q_grouped, k_pool_l, v_pool_l, page_table, lengths + 1
-            )                                                      # [B,Hkv,G,D]
-            attn = attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
-            x = x + _oproj(layer, attn, lora_idx)
-            h = _rms_norm(x, layer["ffn_norm"], eps)
-            return x + _ffn(layer, h, lora_idx=lora_idx), k_pool_l, v_pool_l
+            stash = []
+
+            def attn_fn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # q [B,1,H,D]
+                # index tuple (:, wp, wo): the advanced indices are
+                # CONTIGUOUS, so the broadcast dim [B] lands after the sliced
+                # head dim -> set() takes [Hkv, B, D].
+                k_hm = k[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
+                v_hm = v[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
+                k_p = k_pool_l.at[:, write_page, write_offset].set(k_hm)
+                v_p = v_pool_l.at[:, write_page, write_offset].set(v_hm)
+                stash.append((k_p, v_p))
+                q_grouped = q[:, 0].reshape(b, n_kv, group, head_dim)
+                if q_prescale != 1.0:
+                    q_grouped = q_grouped * jnp.asarray(q_prescale, q_grouped.dtype)
+                attn = paged_attention(
+                    q_grouped, k_p, v_p, page_table, lengths + 1
+                )                                                  # [B,Hkv,G,D]
+                return attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
+
+            x = _block(layer, x, attn_fn, lora_idx)
+            k_pool_l, v_pool_l = stash[0]
+            return x, k_pool_l, v_pool_l
 
         if scan_layers:
             def scan_body(x, xs):
@@ -835,4 +977,13 @@ def build(config: dict) -> SimpleNamespace:
         n_layers=n_layers,
         lora_rank=lora_rank,
         max_loras=max_loras,
+        # the paged kernel has no score soft-capping; the engine refuses
+        # cache=paged for such models (alt_window is covered by the existing
+        # sliding_window guard)
+        paged_unsupported_reason=(
+            "attention logit softcapping (Gemma-2) is not supported by the "
+            "paged decode kernel; use engine.cache=dense"
+            if attn_softcap
+            else None
+        ),
     )

@@ -39,12 +39,17 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+def _head_dim(c) -> int:
+    # may be decoupled from dim (Gemma-2 heads)
+    return int(c.get("head_dim") or c["dim"] // c["n_heads"])
+
+
 # target name -> (in_dim, out_dim) resolver, given a resolved llama config
 _TARGET_DIMS = {
-    "wq": lambda c: (c["dim"], c["n_heads"] * (c["dim"] // c["n_heads"])),
-    "wk": lambda c: (c["dim"], c["n_kv_heads"] * (c["dim"] // c["n_heads"])),
-    "wv": lambda c: (c["dim"], c["n_kv_heads"] * (c["dim"] // c["n_heads"])),
-    "wo": lambda c: (c["n_heads"] * (c["dim"] // c["n_heads"]), c["dim"]),
+    "wq": lambda c: (c["dim"], c["n_heads"] * _head_dim(c)),
+    "wk": lambda c: (c["dim"], c["n_kv_heads"] * _head_dim(c)),
+    "wv": lambda c: (c["dim"], c["n_kv_heads"] * _head_dim(c)),
+    "wo": lambda c: (c["n_heads"] * _head_dim(c), c["dim"]),
     "w_gate": lambda c: (c["dim"], c["ffn_dim"]),
     "w_up": lambda c: (c["dim"], c["ffn_dim"]),
     "w_down": lambda c: (c["ffn_dim"], c["dim"]),

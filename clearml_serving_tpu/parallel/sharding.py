@@ -49,6 +49,11 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         "bv": col("tp"),
         "wo": col("tp", None),
         "ffn_norm": col(),
+        # Gemma-2 extras: post-sublayer norms replicate like the other
+        # norms; the per-layer global/local flag is a scalar
+        "post_attn_norm": col(),
+        "post_ffn_norm": col(),
+        "attn_global": col(),
         "w_gate": col(None, "tp"),
         "w_up": col(None, "tp"),
         "w_down": col("tp", None),

@@ -48,6 +48,8 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
                     "checkpoint".format(mwl, n_layers_)
                 )
     rope_scaling = getattr(hf_cfg, "rope_scaling", None)
+    model_type = str(getattr(hf_cfg, "model_type", "llama"))
+    gemma = model_type in ("gemma", "gemma2")
     config = {
         "vocab_size": int(hf_cfg.vocab_size),
         "dim": int(hf_cfg.hidden_size),
@@ -63,11 +65,50 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
     }
     if attn_bias:
         config["attn_bias"] = True
-    if sliding and sliding < config["max_seq_len"]:
-        config["sliding_window"] = sliding
     if rope_scaling:
         # validated by the model build (llama3 scaling supported; others raise)
         config["rope_scaling"] = dict(rope_scaling)
+    if gemma:
+        # Gemma family deltas: zero-init (1+w) norms, GeGLU, sqrt(dim) embed
+        # scaling, head_dim decoupled from dim
+        config["norm_offset"] = True
+        # HF forces gelu_pytorch_tanh whenever hidden_activation is unset —
+        # original Gemma-1.0 configs carry hidden_act="gelu" but transformers
+        # ignores it (GemmaMLP warns and uses the tanh approximation), so
+        # falling back to hidden_act here would silently diverge
+        config["hidden_act"] = str(
+            getattr(hf_cfg, "hidden_activation", None) or "gelu_pytorch_tanh"
+        )
+        config["embed_scale"] = float(config["dim"]) ** 0.5
+        config["head_dim"] = int(
+            getattr(hf_cfg, "head_dim", config["dim"] // config["n_heads"])
+        )
+    if model_type == "gemma2":
+        # Gemma-2: logit softcaps, query_pre_attn_scalar score scale,
+        # post-sublayer norms, interleaved local/global attention
+        if getattr(hf_cfg, "attn_logit_softcapping", None):
+            config["attn_logit_softcap"] = float(hf_cfg.attn_logit_softcapping)
+        if getattr(hf_cfg, "final_logit_softcapping", None):
+            config["final_logit_softcap"] = float(hf_cfg.final_logit_softcapping)
+        if getattr(hf_cfg, "query_pre_attn_scalar", None):
+            config["query_scale"] = float(hf_cfg.query_pre_attn_scalar) ** -0.5
+        config["post_block_norms"] = True
+        sliding = int(getattr(hf_cfg, "sliding_window", 0) or 0)
+        layer_types = list(getattr(hf_cfg, "layer_types", None) or [])
+        if not layer_types:
+            # HF Gemma-2 default: even layers slide, odd layers are global
+            layer_types = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(config["n_layers"])
+            ]
+        is_global = [1.0 if t == "full_attention" else 0.0 for t in layer_types]
+        if sliding and any(g == 0.0 for g in is_global):
+            config["sliding_window"] = sliding
+            if any(g == 1.0 for g in is_global):
+                config["alt_window"] = True
+                config["attn_global_layers"] = is_global
+    elif sliding and sliding < config["max_seq_len"]:
+        config["sliding_window"] = sliding
     sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
     import jax.numpy as jnp
 
@@ -83,6 +124,7 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
     }
     if not config["tie_embeddings"]:
         params["lm_head"] = t("lm_head.weight").T
+    gemma2 = model_type == "gemma2"
     for i in range(config["n_layers"]):
         pre = "model.layers.{}.".format(i)
         layer = {
@@ -91,11 +133,24 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
             "wk": t(pre + "self_attn.k_proj.weight").T,
             "wv": t(pre + "self_attn.v_proj.weight").T,
             "wo": t(pre + "self_attn.o_proj.weight").T,
-            "ffn_norm": t(pre + "post_attention_layernorm.weight"),
+            # Gemma-2 renames: its pre_feedforward_layernorm plays the
+            # standard pre-FFN role; post_attention_layernorm becomes the
+            # post-sublayer norm
+            "ffn_norm": t(
+                pre + ("pre_feedforward_layernorm.weight" if gemma2
+                       else "post_attention_layernorm.weight")
+            ),
             "w_gate": t(pre + "mlp.gate_proj.weight").T,
             "w_up": t(pre + "mlp.up_proj.weight").T,
             "w_down": t(pre + "mlp.down_proj.weight").T,
         }
+        if gemma2:
+            layer["post_attn_norm"] = t(pre + "post_attention_layernorm.weight")
+            layer["post_ffn_norm"] = t(pre + "post_feedforward_layernorm.weight")
+            if config.get("alt_window"):
+                layer["attn_global"] = np.float32(
+                    config["attn_global_layers"][i]
+                )
         if attn_bias:
             layer["bq"] = t(pre + "self_attn.q_proj.bias")
             layer["bk"] = t(pre + "self_attn.k_proj.bias")

@@ -218,3 +218,132 @@ def test_sliding_window_decode_matches_forward():
         )
         got.append(int(np.argmax(np.asarray(logits)[0])))
     assert got == expected
+
+
+def test_converted_gemma_matches_hf_logits():
+    """Gemma-1 = llama skeleton + (1+w) norms, GeGLU, sqrt(dim) embed
+    scaling, explicit head_dim, tied embeddings."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    config = GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32,  # decoupled: 4 * 32 = 128 != hidden_size
+        rms_norm_eps=1e-6, rope_theta=10000.0, max_position_embeddings=128,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf = GemmaForCausalLM(config)
+    hf.eval()
+    cfg = _convert_and_compare(hf, atol=5e-4)
+    assert cfg.get("norm_offset") is True
+    assert cfg.get("head_dim") == 32
+    assert cfg.get("tie_embeddings") is True
+
+
+def test_converted_gemma2_matches_hf_logits():
+    """Gemma-2 adds logit softcaps, query_pre_attn_scalar scaling,
+    post-sublayer norms, and interleaved local/global attention — the
+    sliding window must bite (seq_len > window) to prove the interleave."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    config = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, rope_theta=10000.0,
+        max_position_embeddings=128, sliding_window=8,
+        query_pre_attn_scalar=64, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    hf = Gemma2ForCausalLM(config)
+    hf.eval()
+    cfg = _convert_and_compare(hf, seq_len=24, atol=5e-4)
+    assert cfg.get("alt_window") is True
+    assert cfg.get("post_block_norms") is True
+    assert cfg.get("attn_logit_softcap") == 50.0
+
+
+def test_gemma2_decode_matches_forward():
+    """The cached serving path honors the per-layer local/global interleave:
+    greedy prefill+decode equals the full forward's argmax chain."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    import jax.numpy as jnp
+
+    from convert_model import convert_hf_llama
+
+    from clearml_serving_tpu import models
+
+    config = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, max_position_embeddings=128,
+        sliding_window=6, query_pre_attn_scalar=64,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(5)
+    hf = Gemma2ForCausalLM(config)
+    hf.eval()
+    cfg, params = convert_hf_llama(hf)
+    bundle = models.build_model("llama", cfg)
+    params = bundle.prepare_params(params)
+
+    prompt = np.random.RandomState(2).randint(1, 120, (1, 12)).tolist()[0]
+    seq = list(prompt)
+    for _ in range(6):
+        logits = bundle.apply(params, jnp.asarray([seq], jnp.int32))
+        seq.append(int(np.argmax(np.asarray(logits)[0, -1])))
+    expected = seq[len(prompt):]
+
+    cache = bundle.init_cache(1, 64)
+    last, cache = bundle.prefill(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cache,
+    )
+    got = [int(np.argmax(np.asarray(last)[0]))]
+    for _ in range(5):
+        logits, cache = bundle.decode(
+            params, jnp.asarray([got[-1]], jnp.int32), cache
+        )
+        got.append(int(np.argmax(np.asarray(logits)[0])))
+    assert got == expected
+
+
+def test_gemma2_scan_layers_matches_unscanned():
+    """The alt-window interleave survives scan stacking (attn_global rides
+    the scanned layer pytree)."""
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    import jax.numpy as jnp
+
+    from convert_model import convert_hf_llama
+
+    from clearml_serving_tpu import models
+
+    config = Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, max_position_embeddings=128,
+        sliding_window=6, query_pre_attn_scalar=64,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(6)
+    hf = Gemma2ForCausalLM(config)
+    hf.eval()
+    cfg, params = convert_hf_llama(hf)
+    tokens = np.random.RandomState(3).randint(0, 120, (1, 16), dtype=np.int64)
+
+    import jax
+
+    plain = models.build_model("llama", cfg)
+    a = plain.apply(params, jnp.asarray(tokens, jnp.int32))
+
+    scan_bundle = models.build_model("llama", dict(cfg, scan_layers=True))
+    scan_params = scan_bundle.prepare_params(
+        {k: (list(v) if k == "layers" else v) for k, v in params.items()}
+    )
+    b = scan_bundle.apply(scan_params, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)

@@ -186,6 +186,60 @@ def test_stop_string_streaming(llm_served):
     assert finish == "stop"
 
 
+def test_streaming_emits_logprobs(llm_served):
+    """OpenAI streaming parity: SSE chunks carry logprobs.content entries
+    covering every emitted token."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(
+                max_tokens=4, stream=True, logprobs=True, top_logprobs=2
+            ),
+        )
+        assert r.status == 200
+        return (await r.read()).decode()
+
+    raw = _run(llm_served, fn)
+    import json as _json
+
+    entries = []
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        chunk = _json.loads(line[6:])
+        for ch in chunk.get("choices", []):
+            lp = ch.get("logprobs")
+            if lp:
+                entries.extend(lp["content"])
+    assert len(entries) >= 1
+    for e in entries:
+        assert e["logprob"] <= 0.0
+        assert len(e["top_logprobs"]) == 2
+
+
+def test_stop_with_logprobs_is_consistent(llm_served):
+    """Stop truncation trims logprob entries and usage to the returned text."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(
+                max_tokens=8, stop="**", logprobs=True, top_logprobs=1,
+                **_FORCED,
+            ),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    choice = out["choices"][0]
+    assert choice["message"]["content"] == "*+"
+    toks = [e["token"] for e in choice["logprobs"]["content"]]
+    assert toks == ["*", "+"]  # no phantom stop-sequence tokens
+    assert out["usage"]["completion_tokens"] == 2
+
+
 def test_streaming_rejects_multi_choice(llm_served):
     async def fn(client):
         r = await client.post(
