@@ -168,6 +168,8 @@ def _tpu_worker() -> None:
         "scan_layers": os.environ.get("BENCH_SCAN_LAYERS", "1").lower()
         in ("1", "true", "yes"),
     }
+    if os.environ.get("BENCH_KV_QUANT"):
+        cfg["kv_quant"] = os.environ["BENCH_KV_QUANT"]
     quantize = os.environ.get("BENCH_QUANTIZE", "int8")
     batch = int(os.environ.get("BENCH_BATCH", 8))
     seq_len = int(os.environ.get("BENCH_SEQ", 1024))

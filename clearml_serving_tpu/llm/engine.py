@@ -292,7 +292,9 @@ class LLMEngineCore:
                 self.params = shard_params(
                     mesh, params, llama_quantized_param_sharding(mesh, params)
                 )
-            self._cache_sharding = llama_cache_sharding(mesh)
+            self._cache_sharding = llama_cache_sharding(
+                mesh, quantized=bool(bundle.config.get("kv_quant"))
+            )
         else:
             self.params = params
             self._cache_sharding = None
@@ -453,18 +455,15 @@ class LLMEngineCore:
             )
             self._prefix_chunk = self._chunked or int(prefix_block)
 
-            def _assemble(template, kpre, vpre, plen):
-                k = jax.lax.dynamic_update_slice(
-                    template["k"], kpre, (0, 0, 0, 0, 0)
-                )
-                v = jax.lax.dynamic_update_slice(
-                    template["v"], vpre, (0, 0, 0, 0, 0)
-                )
-                return {
-                    "k": k,
-                    "v": v,
-                    "length": jnp.reshape(plen, (1,)).astype(jnp.int32),
+            def _assemble(template, prefix_bufs, plen):
+                out = {
+                    name: jax.lax.dynamic_update_slice(
+                        template[name], pre, (0,) * template[name].ndim
+                    )
+                    for name, pre in prefix_bufs.items()
                 }
+                out["length"] = jnp.reshape(plen, (1,)).astype(jnp.int32)
+                return out
 
             self._assemble_prefix_jit = jax.jit(_assemble)
             if self._chunked == 0:
@@ -478,13 +477,22 @@ class LLMEngineCore:
                     static_argnames=("with_logits",),
                 )
 
-        def _insert(cache, k_new, v_new, length, slot):
-            k = jax.lax.dynamic_update_slice(cache["k"], k_new, (0, slot, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(cache["v"], v_new, (0, slot, 0, 0, 0))
-            lengths = jax.lax.dynamic_update_slice(
+        def _insert(cache, mini_kv, length, slot):
+            """Route a prefilled mini cache's buffers into the slot batch.
+            Generic over the cache's buffer keys (k/v plus the int8 KV
+            path's k_scale/v_scale)."""
+            out = {}
+            for key, buf in cache.items():
+                if key == "length":
+                    continue
+                zeros = (0,) * (buf.ndim - 2)
+                out[key] = jax.lax.dynamic_update_slice(
+                    buf, mini_kv[key], (0, slot) + zeros
+                )
+            out["length"] = jax.lax.dynamic_update_slice(
                 cache["length"], length[None].astype(jnp.int32), (slot,)
             )
-            return {"k": k, "v": v, "length": lengths}
+            return out
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
@@ -1026,7 +1034,10 @@ class LLMEngineCore:
             )
         if self._prefix is not None and not use_ring:
             # make this prompt's prefix available to future admissions
-            self._prefix.store(ids, lora_i, mini_cache["k"], mini_cache["v"])
+            self._prefix.store(
+                ids, lora_i,
+                {k: v for k, v in mini_cache.items() if k != "length"},
+            )
         sp = SamplingParams(
             temperature=jnp.asarray([request.temperature], jnp.float32),
             top_k=jnp.asarray([request.top_k], jnp.int32),
@@ -1081,8 +1092,12 @@ class LLMEngineCore:
             if template is None:
                 template = self.bundle.init_cache(1, bucket)
                 self._prefill_templates[bucket] = template
+        prefix_bufs = {
+            name: buf for name, buf in hit.items()
+            if name not in ("len", "nbytes")
+        }
         cache = self._assemble_prefix_jit(
-            template, hit["k"], hit["v"], jnp.asarray(prefix_len, jnp.int32)
+            template, prefix_bufs, jnp.asarray(prefix_len, jnp.int32)
         )
         last_logits = None
         starts = list(range(prefix_len, len(ids), c2))
@@ -1190,8 +1205,10 @@ class LLMEngineCore:
             self.paged_cache.write_prompt(slot, k_stack, v_stack, n_tokens)
         else:
             self.cache = self._insert_jit(
-                self.cache, mini_cache["k"], mini_cache["v"],
-                jnp.asarray(n_tokens, jnp.int32), slot,
+                self.cache,
+                {k: v for k, v in mini_cache.items() if k != "length"},
+                jnp.asarray(n_tokens, jnp.int32),
+                slot,
             )
 
     def _emit(self, slot: int, token_id: int, lp: dict | None = None) -> None:

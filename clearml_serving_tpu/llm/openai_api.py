@@ -74,10 +74,15 @@ class LLMEngineRequest(BaseEngineRequest):
         # — adapters load host-side, install into stacked factors, and route
         # by the OpenAI request's `model` field (models/lora.py).
         lora_overrides, lora_adapters = self._load_lora_cfg(engine_cfg)
+        cfg_overrides = dict(lora_overrides)
+        if engine_cfg.get("kv_quant"):
+            # int8 KV cache: a serving-time build knob like lora, so it can
+            # be set per endpoint without touching the stored bundle config
+            cfg_overrides["kv_quant"] = str(engine_cfg["kv_quant"])
 
         if self._model_local_path:
             bundle, params = load_bundle(
-                self._model_local_path, config_overrides=lora_overrides or None
+                self._model_local_path, config_overrides=cfg_overrides or None
             )
         elif engine_cfg.get("preset"):
             # weightless demo/bench mode: architecture preset, random params
@@ -86,7 +91,7 @@ class LLMEngineRequest(BaseEngineRequest):
                 {
                     "preset": engine_cfg["preset"],
                     **(engine_cfg.get("config") or {}),
-                    **lora_overrides,
+                    **cfg_overrides,
                 },
             )
             params = bundle.init(jax.random.PRNGKey(int(engine_cfg.get("seed", 0))))
@@ -343,6 +348,15 @@ class LLMEngineRequest(BaseEngineRequest):
         hits = [h for h in hits if h >= 0]
         return min(hits) if hits else -1
 
+    def _tokens_covering(self, ids: List[int], n_chars: int) -> int:
+        """Smallest token count whose decoded prefix covers n_chars — the
+        single criterion both the streaming and non-streaming paths use to
+        trim tokens/logprobs/usage to emitted text."""
+        j = len(ids)
+        while j > 0 and len(self.tokenizer.decode(ids[: j - 1])) >= n_chars:
+            j -= 1
+        return j
+
     async def _collect_text(self, request, stops: Optional[List[str]] = None) -> Dict[str, Any]:
         ids: List[int] = []
         stops = stops or []
@@ -366,12 +380,7 @@ class LLMEngineRequest(BaseEngineRequest):
                         # trim ids to the tokens that produce text[:cut] so
                         # logprobs/usage stay consistent with the returned
                         # text (no phantom stop-sequence tokens)
-                        j = len(ids)
-                        while j > 0 and len(
-                            self.tokenizer.decode(ids[: j - 1])
-                        ) >= cut:
-                            j -= 1
-                        ids = ids[:j]
+                        ids = ids[: self._tokens_covering(ids, cut)]
                         request.produced = len(ids)
                         text = text[:cut]
                     return {
@@ -398,24 +407,22 @@ class LLMEngineRequest(BaseEngineRequest):
         eos = self.tokenizer.eos_token_id
         lp_cursor = 0
 
-        def _tokens_covering(n_chars: int) -> int:
-            """Smallest token count whose decoded prefix covers n_chars (the
-            same criterion the non-streaming stop trim uses)."""
-            j = len(ids)
-            while j > 0 and len(self.tokenizer.decode(ids[: j - 1])) >= n_chars:
-                j -= 1
-            return j
-
         def take_entries(upto_tokens: int):
             """Logprob entries for tokens [lp_cursor, upto_tokens) — only
             tokens whose text has actually been emitted, so streamed entries
             never lead the deltas or include held-back/stop tokens."""
             nonlocal lp_cursor
-            if request.logprobs is None:
-                return None
             new = request.logprob_entries[lp_cursor:upto_tokens]
             lp_cursor = max(lp_cursor, upto_tokens)
             return new
+
+        def entries_for(n_chars: int):
+            """None when logprobs are off — and then the token-boundary
+            decode (O(ids)) is skipped entirely, so plain streams pay no
+            extra detokenization."""
+            if request.logprobs is None:
+                return None
+            return take_entries(self._tokens_covering(ids, n_chars))
 
         async for token in self.engine.generate(request):
             if eos is not None and token == eos:
@@ -431,10 +438,10 @@ class LLMEngineRequest(BaseEngineRequest):
                     request.cancel()
                     # trim to the tokens producing text[:cut] so streamed
                     # entries/usage match the non-streaming path exactly
-                    j = _tokens_covering(cut)
+                    j = self._tokens_covering(ids, cut)
                     del ids[j:]
                     request.produced = j
-                    entries = take_entries(j)
+                    entries = take_entries(j) if request.logprobs is not None else None
                     if cut > len(sent) or entries:
                         yield {"delta": text[len(sent):cut],
                                "entries": entries}
@@ -445,7 +452,7 @@ class LLMEngineRequest(BaseEngineRequest):
                 sent = text
                 yield {
                     "delta": text[prev:],
-                    "entries": take_entries(_tokens_covering(len(text))),
+                    "entries": entries_for(len(text)),
                 }
         # flush any held-back tail: if the final decode legitimately ends with
         # the replacement character (truncated multi-byte at stop, or a real
@@ -458,10 +465,12 @@ class LLMEngineRequest(BaseEngineRequest):
             if cut >= 0:
                 request.stopped_on_string = True
                 text = text[:cut]
-                j = _tokens_covering(cut)
+                j = self._tokens_covering(ids, cut)
                 del ids[j:]
                 request.produced = j
-        tail_entries = take_entries(len(ids))
+        tail_entries = (
+            take_entries(len(ids)) if request.logprobs is not None else None
+        )
         if len(text) > len(sent) or tail_entries:
             yield {"delta": text[len(sent):], "entries": tail_entries}
 

@@ -73,10 +73,12 @@ class PrefixKVCache:
             self.misses += 1
             return None
 
-    def store(self, ids: List[int], lora: int, k, v) -> None:
-        """Store the prompt's largest block-aligned prefix KV. ``k``/``v``
-        are the admission's prefill buffers [L, 1, bucket, H, D] (any bucket
-        >= the prefix length); slices are taken here."""
+    def store(self, ids: List[int], lora: int, bufs: Dict[str, Any]) -> None:
+        """Store the prompt's largest block-aligned prefix KV. ``bufs`` maps
+        cache buffer keys (k/v, plus k_scale/v_scale on the int8-KV path) to
+        the admission's prefill buffers [L, 1, bucket, ...] with the token
+        dim at axis 2 (any bucket >= the prefix length); slices are taken
+        here."""
         p = self.longest_prefix_len(len(ids))
         if p < self.block:
             return
@@ -85,15 +87,16 @@ class PrefixKVCache:
             if key in self._entries:
                 self._entries.move_to_end(key)
                 return
-            k_slice, v_slice = k[:, :, :p], v[:, :, :p]
-            nbytes = int(getattr(k_slice, "nbytes", 0)) + int(
-                getattr(v_slice, "nbytes", 0)
+            slices = {name: buf[:, :, :p] for name, buf in bufs.items()}
+            nbytes = sum(
+                int(getattr(s, "nbytes", 0)) for s in slices.values()
             )
             if nbytes > self.max_bytes:
                 return  # a single over-budget prefix is never worth the HBM
-            self._entries[key] = {
-                "k": k_slice, "v": v_slice, "len": p, "nbytes": nbytes,
-            }
+            entry = dict(slices)
+            entry["len"] = p
+            entry["nbytes"] = nbytes
+            self._entries[key] = entry
             self._bytes += nbytes
             while (
                 len(self._entries) > self.max_entries

@@ -547,13 +547,43 @@ def build(config: dict) -> SimpleNamespace:
 
     # -- dense KV cache serving path -----------------------------------------
 
+    # int8 KV cache (cfg kv_quant="int8"): K/V store as int8 with a per
+    # (token, head) f32 scale — cache HBM roughly halves, which is what buys
+    # the larger decode batches on a 16 GB chip (weights int8 + bf16 KV at
+    # b=32/s=1024 for an 8B model would not fit). Dequant happens next to the
+    # attention matmul (XLA fuses it into the HBM read).
+    kv_quant = str(cfg.get("kv_quant") or "")
+    if kv_quant not in ("", "int8"):
+        raise ValueError("kv_quant must be 'int8' (got {!r})".format(kv_quant))
+
+    def _kv_store(x):
+        """bf16 [..., D] -> (stored, scale|None): per-vector symmetric int8."""
+        if not kv_quant:
+            return x.astype(dtype), None
+        x32 = x.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(x32), axis=-1)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(
+            jnp.round(x32 / scale[..., None]), -127, 127
+        ).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+
+    def _kv_load(stored, scale):
+        if scale is None:
+            return stored
+        return (stored.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
     def init_cache(batch: int, max_len: int) -> Dict[str, jnp.ndarray]:
         shape = (n_layers, batch, max_len, n_kv, head_dim)
-        return {
-            "k": jnp.zeros(shape, dtype),
-            "v": jnp.zeros(shape, dtype),
+        out = {
+            "k": jnp.zeros(shape, jnp.int8 if kv_quant else dtype),
+            "v": jnp.zeros(shape, jnp.int8 if kv_quant else dtype),
             "length": jnp.zeros((batch,), jnp.int32),
         }
+        if kv_quant:
+            out["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            out["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return out
 
     def _prefill_impl(params, tokens, seq_lens, cache, attend_fn, lora_idx=None):
         """Shared prefill body: embed -> layers (attend_fn pluggable) ->
@@ -594,13 +624,17 @@ def build(config: dict) -> SimpleNamespace:
         last = _logits(params, last_x)[:, 0]                       # [B, vocab]
         max_len = cache["k"].shape[2]
         pad = max_len - s
-        k_full = jnp.pad(k_stack, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-        v_full = jnp.pad(v_stack, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        pad5 = ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0))
+        k_q, k_s = _kv_store(k_stack)
+        v_q, v_s = _kv_store(v_stack)
         cache = {
-            "k": k_full.astype(dtype),
-            "v": v_full.astype(dtype),
+            "k": jnp.pad(k_q, pad5),
+            "v": jnp.pad(v_q, pad5),
             "length": seq_lens.astype(jnp.int32),
         }
+        if kv_quant:
+            cache["k_scale"] = jnp.pad(k_s, pad5[:-1])
+            cache["v_scale"] = jnp.pad(v_s, pad5[:-1])
         return last, cache
 
     def prefill(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache,
@@ -631,7 +665,7 @@ def build(config: dict) -> SimpleNamespace:
         absolute positions ``start``..``start+C``, write their K/V into the
         cache at those positions (per-sequence dynamic_update_slice), attend
         causally over the whole sequence (cache beyond the chunk end is
-        stale -> masked), and return (x [B,C,D], k_new, v_new)."""
+        stale -> masked), and return (x [B,C,D], {"k","v"[,scales]})."""
         b, c = tokens.shape
         max_len = cache["k"].shape[2]
         positions = start[:, None] + jnp.arange(c, dtype=jnp.int32)[None]  # [B, C]
@@ -646,40 +680,67 @@ def build(config: dict) -> SimpleNamespace:
             ).astype(jnp.float32)[:, None]                         # [B,1,C,T]
         )
 
+        def _write_chunk(buf, values, width):
+            """Per-sequence dynamic_update_slice of a [B, C, ...] chunk into
+            a [B, T, ...] buffer at each row's start position."""
+            zeros = (0,) * width
+            return jax.vmap(
+                lambda b_, v_, p: jax.lax.dynamic_update_slice(
+                    b_, v_, (p,) + zeros
+                )
+            )(buf, values.astype(buf.dtype), start)
+
         def layer_body(carry, layer_and_kv):
             x = carry
-            layer, k_cache, v_cache = layer_and_kv
+            if kv_quant:
+                layer, k_cache, v_cache, k_sc, v_sc = layer_and_kv
+            else:
+                layer, k_cache, v_cache = layer_and_kv
+                k_sc = v_sc = None
             stash = []
 
             def attn(layer_, h):
                 q, k, v = _qkv(layer_, h, cos, sin, lora_idx)
-                k_c = jax.vmap(
-                    lambda buf, kn, p: jax.lax.dynamic_update_slice(buf, kn, (p, 0, 0))
-                )(k_cache, k.astype(k_cache.dtype), start)
-                v_c = jax.vmap(
-                    lambda buf, vn, p: jax.lax.dynamic_update_slice(buf, vn, (p, 0, 0))
-                )(v_cache, v.astype(v_cache.dtype), start)
-                stash.append((k_c, v_c))
-                return _attend(q, k_c, v_c, _layer_mask(layer_, masks))
+                k_q, k_s = _kv_store(k)
+                v_q, v_s = _kv_store(v)
+                k_c = _write_chunk(k_cache, k_q, 2)
+                v_c = _write_chunk(v_cache, v_q, 2)
+                if kv_quant:
+                    k_s_c = _write_chunk(k_sc, k_s, 1)
+                    v_s_c = _write_chunk(v_sc, v_s, 1)
+                    stash.append((k_c, v_c, k_s_c, v_s_c))
+                    k_full = _kv_load(k_c, k_s_c)
+                    v_full = _kv_load(v_c, v_s_c)
+                else:
+                    stash.append((k_c, v_c))
+                    k_full, v_full = k_c, v_c
+                return _attend(q, k_full, v_full, _layer_mask(layer_, masks))
 
             x = _block(layer, x, attn, lora_idx, ffn_kwargs=ffn_kwargs)
             return x, stash[0]
 
-        if scan_layers:
-            x, (k_new, v_new) = jax.lax.scan(
-                lambda x, xs: layer_body(x, xs),
-                x,
-                (params["layers"], cache["k"], cache["v"]),
-            )
+        if kv_quant:
+            xs = (params["layers"], cache["k"], cache["v"],
+                  cache["k_scale"], cache["v_scale"])
         else:
-            k_list, v_list = [], []
+            xs = (params["layers"], cache["k"], cache["v"])
+        if scan_layers:
+            x, new_bufs = jax.lax.scan(lambda x, t: layer_body(x, t), x, xs)
+        else:
+            per_layer = []
             for i, layer in enumerate(params["layers"]):
-                x, (k_l, v_l) = layer_body(x, (layer, cache["k"][i], cache["v"][i]))
-                k_list.append(k_l)
-                v_list.append(v_l)
-            k_new = jnp.stack(k_list)
-            v_new = jnp.stack(v_list)
-        return x, k_new, v_new
+                tup = tuple(a[i] for a in xs[1:])
+                x, bufs = layer_body(x, (layer,) + tup)
+                per_layer.append(bufs)
+            new_bufs = tuple(
+                jnp.stack([bufs[j] for bufs in per_layer])
+                for j in range(len(per_layer[0]))
+            )
+        out = {"k": new_bufs[0], "v": new_bufs[1]}
+        if kv_quant:
+            out["k_scale"] = new_bufs[2]
+            out["v_scale"] = new_bufs[3]
+        return x, out
 
     def prefill_chunk(params, tokens: jnp.ndarray, start: jnp.ndarray,
                       last_rel: jnp.ndarray, cache, *, with_logits: bool = True,
@@ -701,7 +762,7 @@ def build(config: dict) -> SimpleNamespace:
         ffn_valid = (
             jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
         )  # pad tail of the final chunk never routes (MoE)
-        x, k_new, v_new = _cached_chunk_layers(
+        x, new_kv = _cached_chunk_layers(
             params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid},
             lora_idx=lora_idx,
         )
@@ -715,13 +776,12 @@ def build(config: dict) -> SimpleNamespace:
             # that matmul reads the whole vocab projection from HBM just to
             # be discarded
             last = jnp.zeros((b, 1), jnp.float32)
-        cache = {
-            "k": k_new,
-            "v": v_new,
-            "length": jnp.maximum(
+        cache = dict(
+            new_kv,
+            length=jnp.maximum(
                 cache["length"], start + last_rel + 1
             ).astype(jnp.int32),
-        }
+        )
         return last, cache
 
     def verify(params, tokens: jnp.ndarray, cache,
@@ -746,12 +806,12 @@ def build(config: dict) -> SimpleNamespace:
         occupancy and break the token-identical-to-plain-greedy guarantee.
         """
         start = cache["length"]                                    # [B]
-        x, k_new, v_new = _cached_chunk_layers(
+        x, new_kv = _cached_chunk_layers(
             params, tokens, start, cache, ffn_kwargs={"dropless": True},
             lora_idx=lora_idx,
         )
         logits = _logits(params, x)                                # [B, S, vocab]
-        return logits, {"k": k_new, "v": v_new, "length": start}
+        return logits, dict(new_kv, length=start)
 
     def prefill_ring(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray, cache,
                      mesh, lora_idx: Optional[jnp.ndarray] = None):
@@ -795,43 +855,64 @@ def build(config: dict) -> SimpleNamespace:
         # Per-sequence scatter at each sequence's own length (overwrite, so
         # stale values from a recycled batch slot cannot leak through).
         write = (t_idx == cache["length"][:, None])[:, :, None, None]  # [B,T,1,1]
+        write_s = write[..., 0]                                    # [B,T,1]
 
         def layer_body(x, xs):
-            layer, k_cache_l, v_cache_l = xs
+            if kv_quant:
+                layer, k_cache_l, v_cache_l, k_sc_l, v_sc_l = xs
+            else:
+                layer, k_cache_l, v_cache_l = xs
+                k_sc_l = v_sc_l = None
             stash = []
 
             def attn(layer_, h):
                 q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # k,v: [B,1,Hkv,D]
-                # cast to the cache dtype: params may be a different precision
-                # than the cache (e.g. f32 checkpoint into a bf16 cache)
-                k_cache = jnp.where(write, k.astype(k_cache_l.dtype), k_cache_l)
-                v_cache = jnp.where(write, v.astype(v_cache_l.dtype), v_cache_l)
-                stash.append((k_cache, v_cache))
-                return _attend(q, k_cache, v_cache, _layer_mask(layer_, masks))
+                # cast/quantize to the cache storage: params may be a
+                # different precision than the cache
+                k_q, k_s = _kv_store(k)
+                v_q, v_s = _kv_store(v)
+                k_cache = jnp.where(write, k_q.astype(k_cache_l.dtype), k_cache_l)
+                v_cache = jnp.where(write, v_q.astype(v_cache_l.dtype), v_cache_l)
+                if kv_quant:
+                    k_sc = jnp.where(write_s, k_s, k_sc_l)
+                    v_sc = jnp.where(write_s, v_s, v_sc_l)
+                    stash.append((k_cache, v_cache, k_sc, v_sc))
+                    k_full = _kv_load(k_cache, k_sc)
+                    v_full = _kv_load(v_cache, v_sc)
+                else:
+                    stash.append((k_cache, v_cache))
+                    k_full, v_full = k_cache, v_cache
+                return _attend(q, k_full, v_full, _layer_mask(layer_, masks))
 
             x = _block(layer, x, attn, lora_idx)
             return x, stash[0]
 
-        if scan_layers:
-            x, (k_new, v_new) = jax.lax.scan(
-                layer_body, x, (params["layers"], cache["k"], cache["v"])
-            )
+        if kv_quant:
+            xs_all = (params["layers"], cache["k"], cache["v"],
+                      cache["k_scale"], cache["v_scale"])
         else:
-            ks, vs = [], []
+            xs_all = (params["layers"], cache["k"], cache["v"])
+        if scan_layers:
+            x, new_bufs = jax.lax.scan(layer_body, x, xs_all)
+        else:
+            per_layer = []
             for li, layer in enumerate(params["layers"]):
-                x, (k_cache, v_cache) = layer_body(
-                    x, (layer, cache["k"][li], cache["v"][li])
-                )
-                ks.append(k_cache)
-                vs.append(v_cache)
-            k_new = jnp.stack(ks)
-            v_new = jnp.stack(vs)
+                tup = tuple(a[li] for a in xs_all[1:])
+                x, bufs = layer_body(x, (layer,) + tup)
+                per_layer.append(bufs)
+            new_bufs = tuple(
+                jnp.stack([bufs[j] for bufs in per_layer])
+                for j in range(len(per_layer[0]))
+            )
         logits = _logits(params, x)[:, 0]
         cache = {
-            "k": k_new,
-            "v": v_new,
+            "k": new_bufs[0],
+            "v": new_bufs[1],
             "length": cache["length"] + 1,
         }
+        if kv_quant:
+            cache["k_scale"] = new_bufs[2]
+            cache["v_scale"] = new_bufs[3]
         return logits, cache
 
     # -- paged KV serving path (pools from llm/kv_cache.PagedKVCache) --------
@@ -962,10 +1043,20 @@ def build(config: dict) -> SimpleNamespace:
         prefill=prefill,
         prefill_chunk=prefill_chunk,
         ffn=_ffn,
-        # ring attention masks plain-causally inside the ring, so sliding
-        # window is unsupported on the sp long-prefill path (engine falls
-        # back to plain prefill when this is None)
-        prefill_ring=None if sliding_window else prefill_ring,
+        # ring attention masks plain-causally inside the ring with the
+        # default head_dim**-0.5 score scale and no soft-capping, so any
+        # family that windows, rescales, or softcaps is unsupported on the
+        # sp long-prefill path (engine falls back to plain prefill when
+        # this is None)
+        prefill_ring=(
+            None
+            if (
+                sliding_window
+                or attn_softcap
+                or abs(query_scale - head_dim ** -0.5) > 1e-12
+            )
+            else prefill_ring
+        ),
         decode=decode,
         verify=verify,
         decode_paged=decode_paged,
@@ -984,6 +1075,11 @@ def build(config: dict) -> SimpleNamespace:
             "attention logit softcapping (Gemma-2) is not supported by the "
             "paged decode kernel; use engine.cache=dense"
             if attn_softcap
-            else None
+            else (
+                "kv_quant applies to the dense cache only; use "
+                "engine.cache=dense"
+                if kv_quant
+                else None
+            )
         ),
     )

@@ -92,12 +92,18 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def llama_cache_sharding(mesh) -> Dict[str, Any]:
-    """Dense KV cache [L, B, T, Hkv, D]: batch over dp, kv heads over tp."""
+def llama_cache_sharding(mesh, quantized: bool = False) -> Dict[str, Any]:
+    """Dense KV cache [L, B, T, Hkv, D]: batch over dp, kv heads over tp.
+    The int8 variant adds per-(token, head) scale buffers [L, B, T, Hkv]."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     kv = NamedSharding(mesh, P(None, "dp", None, "tp", None))
-    return {"k": kv, "v": kv, "length": NamedSharding(mesh, P("dp"))}
+    out = {"k": kv, "v": kv, "length": NamedSharding(mesh, P("dp"))}
+    if quantized:
+        sc = NamedSharding(mesh, P(None, "dp", None, "tp"))
+        out["k_scale"] = sc
+        out["v_scale"] = sc
+    return out
 
 
 def shard_params(mesh, params: Dict[str, Any], shardings: Dict[str, Any]):

@@ -1,0 +1,158 @@
+"""int8 KV cache tests (cfg kv_quant="int8"): storage layout, numeric
+closeness to the bf16 cache, and engine-path composition (chunked prefill,
+speculation, prefix cache)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+CFG = {"preset": "llama-tiny", "dtype": "float32"}
+QCFG = dict(CFG, kv_quant="int8")
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model("llama", CFG)
+    qbundle = models.build_model("llama", QCFG)
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, qbundle, params
+
+
+def test_cache_layout(parts):
+    _, qbundle, _ = parts
+    cache = qbundle.init_cache(2, 32)
+    assert cache["k"].dtype == jnp.int8
+    assert cache["v"].dtype == jnp.int8
+    assert cache["k_scale"].shape == cache["k"].shape[:-1]
+    assert cache["k_scale"].dtype == jnp.float32
+
+
+def test_quantized_decode_close_to_fp(parts):
+    """Prefill + decode over the int8 cache tracks the bf16-cache logits
+    (relative L2 error bounded; int8 per-vector quantization is ~0.4% RMS)."""
+    bundle, qbundle, params = parts
+    ids = [5, 9, 2, 17, 33, 8, 1, 40]
+    tokens = jnp.asarray([ids], jnp.int32)
+    lens = jnp.asarray([len(ids)], jnp.int32)
+
+    ref_logits, ref_cache = bundle.prefill(params, tokens, lens, bundle.init_cache(1, 32))
+    q_logits, q_cache = qbundle.prefill(params, tokens, lens, qbundle.init_cache(1, 32))
+    # prefill logits identical: prefill attends over live (unquantized) K/V
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(q_logits), rtol=1e-5, atol=1e-5
+    )
+
+    nxt = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
+    for _ in range(4):
+        ref_logits, ref_cache = bundle.decode(params, nxt, ref_cache)
+        q_logits, q_cache = qbundle.decode(params, nxt, q_cache)
+        a, b = np.asarray(ref_logits), np.asarray(q_logits)
+        rel = np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-9)
+        assert rel < 0.05, rel
+        nxt = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
+
+
+def test_chunked_prefill_matches_full_prefill_quantized(parts):
+    """Chunked prefill tracks full prefill under kv_quant. The paths are not
+    bit-identical: full prefill attends over LIVE (unquantized) K/V while
+    chunked prefill reads back what it quantized, so outputs differ by
+    bounded quantization noise."""
+    _, qbundle, params = parts
+    ids = [(i * 7 + 3) % 256 for i in range(24)]
+
+    def rel(a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        return np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-9)
+
+    full_logits, full_cache = qbundle.prefill(
+        params, jnp.asarray([ids], jnp.int32),
+        jnp.asarray([len(ids)], jnp.int32), qbundle.init_cache(1, 48),
+    )
+    cache = qbundle.init_cache(1, 48)
+    c = 8
+    for s in range(0, len(ids), c):
+        seg = ids[s : s + c]
+        seg_tokens = np.zeros((1, c), np.int32)
+        seg_tokens[0, : len(seg)] = seg
+        chunk_logits, cache = qbundle.prefill_chunk(
+            params, jnp.asarray(seg_tokens), jnp.asarray([s], jnp.int32),
+            jnp.asarray([len(seg) - 1], jnp.int32), cache,
+        )
+    assert rel(full_logits, chunk_logits) < 0.05
+    nxt = jnp.argmax(full_logits, axis=-1).astype(jnp.int32)
+    l1, _ = qbundle.decode(params, nxt, full_cache)
+    l2, _ = qbundle.decode(params, nxt, cache)
+    assert rel(l1, l2) < 0.05
+
+
+def _engine(bundle, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("prefill_buckets", [16, 32])
+    kw.setdefault("eos_token_id", None)
+    kw.setdefault("decode_steps", 2)
+    return LLMEngineCore(bundle, params, **kw)
+
+
+def _gen(engine, prompt, n=8, **req_kw):
+    async def run():
+        req = GenRequest(prompt_ids=list(prompt), max_new_tokens=n, **req_kw)
+        return [t async for t in engine.generate(req)]
+
+    return asyncio.run(run())
+
+
+def test_engine_generates_deterministically(parts):
+    _, qbundle, params = parts
+    prompt = [5, 9, 2, 17, 33]
+    e1 = _engine(qbundle, params)
+    a = _gen(e1, prompt)
+    e1.stop()
+    e2 = _engine(qbundle, params)
+    b = _gen(e2, prompt)
+    e2.stop()
+    assert a == b and len(a) == 8
+
+
+def test_speculation_exact_under_kv_quant(parts):
+    """Greedy n-gram speculation must stay token-identical to the plain
+    chunk when both run over the int8 cache (verify shares the cache math)."""
+    _, qbundle, params = parts
+    prompt = [5, 9, 2, 17, 5, 9, 2]
+    plain = _engine(qbundle, params)
+    want = _gen(plain, prompt)
+    plain.stop()
+    spec = _engine(qbundle, params, speculation="ngram", spec_k=2, spec_ngram=2)
+    got = _gen(spec, prompt)
+    spec.stop()
+    assert got == want
+
+
+def test_prefix_cache_composes_with_kv_quant(parts):
+    _, qbundle, params = parts
+    prompt = [(i * 5 + 1) % 256 for i in range(40)]
+    plain = _engine(qbundle, params, max_seq_len=160, prefill_buckets=[32, 64])
+    want = _gen(plain, prompt, n=6)
+    plain.stop()
+    cached = _engine(
+        qbundle, params, max_seq_len=160, prefill_buckets=[32, 64],
+        prefix_cache=4, prefix_block=16,
+    )
+    first = _gen(cached, prompt, n=6)
+    second = _gen(cached, prompt, n=6)
+    assert cached._prefix.hits >= 1
+    cached.stop()
+    assert first == want
+    assert second == want
+
+
+def test_paged_cache_rejects_kv_quant(parts):
+    _, qbundle, params = parts
+    with pytest.raises(ValueError):
+        _engine(qbundle, params, cache_mode="paged")

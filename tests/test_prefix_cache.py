@@ -51,7 +51,7 @@ def test_block_alignment_and_lookup():
     ids = list(range(11))  # prefix cap = floor(10/4)*4 = 8
     assert cache.longest_prefix_len(len(ids)) == 8
     k = np.zeros((2, 1, 16, 2, 4), np.float32)
-    cache.store(ids, 0, k, k)
+    cache.store(ids, 0, {"k": k, "v": k})
     hit = cache.lookup(ids, 0)
     assert hit is not None and hit["len"] == 8
     assert hit["k"].shape[2] == 8
@@ -66,7 +66,7 @@ def test_lora_keys_are_separate():
     cache = PrefixKVCache(max_entries=4, block=2)
     ids = [1, 2, 3, 4, 5]
     k = np.zeros((1, 1, 8, 1, 2), np.float32)
-    cache.store(ids, 0, k, k)
+    cache.store(ids, 0, {"k": k, "v": k})
     assert cache.lookup(ids, 0) is not None
     assert cache.lookup(ids, 1) is None  # adapter 1 never stored
 
@@ -74,10 +74,10 @@ def test_lora_keys_are_separate():
 def test_lru_eviction():
     cache = PrefixKVCache(max_entries=2, block=2)
     k = np.zeros((1, 1, 8, 1, 2), np.float32)
-    cache.store([1, 2, 3], 0, k, k)
-    cache.store([4, 5, 6], 0, k, k)
+    cache.store([1, 2, 3], 0, {"k": k, "v": k})
+    cache.store([4, 5, 6], 0, {"k": k, "v": k})
     assert cache.lookup([1, 2, 3], 0) is not None  # touch -> MRU
-    cache.store([7, 8, 9], 0, k, k)                # evicts [4,5,6]
+    cache.store([7, 8, 9], 0, {"k": k, "v": k})                # evicts [4,5,6]
     assert cache.lookup([4, 5, 6], 0) is None
     assert cache.lookup([1, 2, 3], 0) is not None
     assert cache.lookup([7, 8, 9], 0) is not None
