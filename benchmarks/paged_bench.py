@@ -2,9 +2,11 @@
 
 Answers the standing question from ops/paged_attention.py's header: does the
 r2 multi-page double-buffered-DMA kernel beat the plain-XLA page gather (the
-r1 kernel lost, 4.3 vs 3.1 ms)?  Shapes match the r1 measurement so numbers
-are comparable: b=16 hkv=8 g=4 d=64, 16-token pages, 64 pages/seq, bf16
-pools, sequences half-full (512 tokens live of 1024 capacity).
+r1 kernel lost, 4.3 vs 3.1 ms)?  Shapes are the r1 measurement's except
+d=128 (Llama-3's real head_dim — Mosaic cannot lane-align a d=64 page plane,
+so d=64 takes the XLA fallback by construction): b=16 hkv=8 g=4 d=128,
+16-token pages, 64 pages/seq, bf16 pools, sequences half-full (512 tokens
+live of 1024 capacity).
 
 Contenders:
 - pallas[pb=N]   ops.paged_attention (r2 kernel), pages_per_block sweep
@@ -33,7 +35,7 @@ import numpy as np
 # sys.path to reach the clearml_serving_tpu package
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-B, HKV, G, D = 16, 8, 4, 64
+B, HKV, G, D = 16, 8, 4, 128
 PAGE = 16
 PAGES_PER_SEQ = 64
 LIVE_TOKENS = PAGE * PAGES_PER_SEQ // 2  # half-full steady state

@@ -26,16 +26,34 @@ Three wins over the r1 BlockSpec-pipeline version (one page per grid step):
   every page before ``@pl.when`` skipped its compute — HBM traffic scaled
   with max capacity, not actual tokens, forfeiting paged attention's point.
 - **MXU-sized blocks**: flash updates see [G, pages_per_block*P] score tiles
-  (128 wide at defaults) instead of [G, 16] slivers.
+  (512 wide at the measured-best pb=32 default) instead of [G, 16] slivers.
 - **bf16 operand feed**: K/V stream into the dot products in pool dtype
   (bf16) with f32 accumulation (preferred_element_type) — half the DMA bytes
   of the r1 kernel's eager f32 casts.
 
 r1 measurement (v5e, b=16 hkv=8 g=4 d=64, 16-token pages, 64 pages/seq):
 the one-page-per-step kernel matched the XLA gather to bf16 epsilon but ran
-~1.4x slower (4.3 vs 3.1 ms). This rewrite exists to flip that; re-measure on
-TPU and record here (tunnel down at rewrite time; correctness is pinned by
-interpret-mode tests incl. ragged tails and empty slots).
+~1.4x slower (4.3 vs 3.1 ms). The rewrite flipped that.
+
+r3 measurement (v5e via axon tunnel, 2026-07-29; benchmarks/paged_bench.py,
+b=16 hkv=8 g=4 **d=128** — Llama-3's real head_dim; d=64 cannot lane-align
+on Mosaic and takes the XLA fallback by construction — 16-token pages,
+64 pages/seq, 512 live tokens):
+
+    pallas pb=32   2.391 ms   <- default (1.15x faster than the gather)
+    pallas pb=16   2.662 ms
+    xla_gather     2.744 ms
+    dense_fullcap  2.560 ms
+    pallas pb=8    3.290 ms
+    pallas pb=4    2.817 ms
+
+Output matches the XLA reference to bf16 epsilon on hardware (maxdiff
+0.002). Raw run lines live in benchmarks/TPU_RESULTS.jsonl (the
+``post_fix_d128`` records; the errored pallas_pb* lines above them are this
+same kernel BEFORE the fixes). Mosaic portability notes baked into the
+kernel: never insert a
+minor dim on an i1 vector (build masks via 2-D i32 iota compares), and DMA
+slices must be lane-aligned (D % 128 == 0 gates the Pallas path).
 """
 
 from __future__ import annotations
@@ -171,9 +189,13 @@ def _paged_attention_kernel(
             valid = token_ids < length
             scores = jnp.where(valid, scores, -jnp.inf)
             # rows past length were never DMA'd: their buffer bytes are
-            # arbitrary (NaN/inf poisons 0*v), so zero them before the matmul
-            row_valid = valid[0]                                # [PB*P]
-            v = jnp.where(row_valid[:, None], v, jnp.zeros_like(v))
+            # arbitrary (NaN/inf poisons 0*v), so zero them before the matmul.
+            # Mask built as a 2-D i32 iota compare: Mosaic cannot insert a
+            # minor dim on an i1 vector (bool[:, None] fails to compile).
+            row_ids = i * block_tokens + jax.lax.broadcasted_iota(
+                jnp.int32, (block_tokens, 1), 0
+            )
+            v = jnp.where(row_ids < length, v, jnp.zeros_like(v))
 
             block_max = jnp.maximum(jnp.max(scores, axis=1), -1e30)
             m_new = jnp.maximum(m_prev, block_max)              # [G]
@@ -201,7 +223,7 @@ def _paged_attention_kernel(
 
 def paged_attention(
     q, k_pool, v_pool, page_table, lengths, *,
-    pages_per_block: int = 8, interpret: bool = False,
+    pages_per_block: int = 32, interpret: bool = False,
 ):
     """Pallas paged decode attention (falls back to XLA off-TPU).
 
@@ -213,6 +235,17 @@ def paged_attention(
         return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
     on_tpu = jax.devices()[0].platform == "tpu"
     if not on_tpu and not interpret:
+        return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
+    if on_tpu and not interpret and (
+        q.shape[-1] % 128 != 0 or k_pool.shape[2] % 16 != 0
+    ):
+        # Mosaic requires DMA slices tile-aligned: a [P, D] page plane with
+        # D < 128 cannot be sliced out of the pool (measured on v5e: D=64
+        # fails "slice shape along dimension 3 must be aligned to tiling"),
+        # and a page_size off the 16-sublane bf16 tile would misalign the
+        # k_buf/v_buf destination offsets (j*P). Known-misaligned shapes
+        # route to the XLA gather instead of failing at compile time;
+        # Llama-class heads (D=128, 16-token pages) take the kernel.
         return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
 
     b, hkv, g, d = q.shape
