@@ -72,12 +72,14 @@ def _measure(cfg, batch, seq_len, chunk, rounds, quantize):
     from clearml_serving_tpu.llm.sampling import SamplingParams, sample_tokens
 
     enable_persistent_compilation_cache()
-    if quantize == "int8":
-        # int8 tree built directly (never materializes full-precision 8B);
-        # the model's weight accessor dequantizes per layer inside the scan
+    if quantize in ("int8", "int4"):
+        # quantized tree built directly (never materializes full-precision
+        # 8B); the model's weight accessor dequantizes per layer in the scan
         from clearml_serving_tpu.ops.quant import random_quantized_llama
 
-        bundle, params = random_quantized_llama(cfg, seed=0)
+        bundle, params = random_quantized_llama(
+            cfg, seed=0, bits=4 if quantize == "int4" else 8
+        )
     else:
         bundle = models.build_model("llama", cfg)
         params = bundle.init(jax.random.PRNGKey(0))
@@ -168,10 +170,13 @@ def _tpu_worker() -> None:
         "scan_layers": os.environ.get("BENCH_SCAN_LAYERS", "1").lower()
         in ("1", "true", "yes"),
     }
-    if os.environ.get("BENCH_KV_QUANT"):
-        cfg["kv_quant"] = os.environ["BENCH_KV_QUANT"]
+    # defaults are the best measured v5e config (benchmarks/TPU_RESULTS.jsonl
+    # 2026-07-29): b32 + int8 KV = 859 tok/s vs 477 at the old b8 default
+    kv_quant = os.environ.get("BENCH_KV_QUANT", "int8")
+    if kv_quant and kv_quant != "none":
+        cfg["kv_quant"] = kv_quant
     quantize = os.environ.get("BENCH_QUANTIZE", "int8")
-    batch = int(os.environ.get("BENCH_BATCH", 8))
+    batch = int(os.environ.get("BENCH_BATCH", 32))
     seq_len = int(os.environ.get("BENCH_SEQ", 1024))
     chunk = int(os.environ.get("BENCH_CHUNK", 25))
     rounds = int(os.environ.get("BENCH_ROUNDS", 4))
@@ -182,8 +187,11 @@ def _tpu_worker() -> None:
         "backend": "{}:{}".format(dev.platform, dev.device_kind),
     }
     _emit(
-        "llm_decode_throughput_{}{}_b{}".format(
-            cfg["preset"], "-int8" if quantize == "int8" else "", batch
+        "llm_decode_throughput_{}{}{}_b{}".format(
+            cfg["preset"],
+            "-{}".format(quantize) if quantize else "",
+            "-kv{}".format(cfg["kv_quant"]) if cfg.get("kv_quant") else "",
+            batch,
         ),
         tok_s,
         "tpu",

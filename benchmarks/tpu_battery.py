@@ -69,21 +69,32 @@ def main() -> int:
     successes = 0
 
     # -- phase 1: headline 8B int8 decode throughput + TTFT, batch sweep ----
-    # b8/b16 with a bf16 KV cache; b32 needs the int8 KV cache to fit next
-    # to the int8 weights on a 16 GB chip
+    # b16 with a bf16 KV cache; b32+ need the int8 KV cache to fit next
+    # to the int8 weights on a 16 GB chip (measured 2026-07-29: b8=477,
+    # b16=738, b32-kvint8=859 tok/s — throughput still climbing with batch,
+    # so the sweep now explores upward + a longer fused chunk)
     base_cfg = {"preset": "llama3-8b", "dtype": "bfloat16", "scan_layers": True}
-    for batch, kv in ((8, None), (16, None), (32, "int8")):
+    for batch, kv, chunk, wq in (
+        (16, None, 25, "int8"),
+        (32, "int8", 25, "int8"),
+        (48, "int8", 25, "int8"),
+        (64, "int8", 25, "int8"),
+        (64, "int8", 50, "int8"),
+        (32, "int8", 25, "int4"),   # w4a16: weight reads halve vs int8
+        (64, "int8", 25, "int4"),
+    ):
         cfg = dict(base_cfg, **({"kv_quant": kv} if kv else {}))
         t0 = time.time()
         try:
             tok_s, ttft_ms = bench._measure(
-                cfg, batch=batch, seq_len=1024, chunk=25,
-                rounds=4, quantize="int8",
+                cfg, batch=batch, seq_len=1024, chunk=chunk,
+                rounds=4, quantize=wq,
             )
             successes += 1
             emit({
-                "metric": "llm_decode_throughput_llama3-8b-int8_b{}{}".format(
-                    batch, "-kvint8" if kv else ""
+                "metric": "llm_decode_throughput_llama3-8b-{}_b{}{}{}".format(
+                    wq, batch, "-kvint8" if kv else "",
+                    "-c{}".format(chunk) if chunk != 25 else "",
                 ),
                 "value": round(tok_s, 2),
                 "unit": "tok/s/chip",

@@ -266,12 +266,15 @@ class LLMEngineCore:
         # model's weight accessor (models/llama.py `_w`) dequantizes each
         # weight INSIDE the traced layer body — per layer even under
         # scan_layers — so XLA fuses dequant next to each consumer matmul and
-        # weights at rest stay int8 (HBM ~halves).
+        # weights at rest stay int8 (HBM ~halves) or group-int4 (~quarters;
+        # the decode path is weight-read bound, so bytes saved are tok/s).
         self._quantized = False
-        if quantize == "int8":
+        if quantize in ("int8", "int4"):
             from ..ops.quant import quantize_llama_params
 
-            params = quantize_llama_params(params)
+            params = quantize_llama_params(
+                params, bits=4 if quantize == "int4" else 8
+            )
             self._quantized = True
         elif quantize:
             raise ValueError("unsupported quantize mode {!r}".format(quantize))

@@ -295,16 +295,21 @@ def build(config: dict) -> SimpleNamespace:
     # -- shared layer math ----------------------------------------------------
 
     def _w(container, name):
-        """Weight accessor with inline int8 dequantization: a leaf may be a
-        plain array or {"_q8": int8, "_scale": f32} (ops/quant.py). Because
-        this runs INSIDE the (possibly scanned) layer body, XLA dequantizes
-        one layer at a time next to its consumer matmul — weights at rest
-        stay int8 in HBM even under scan_layers."""
+        """Weight accessor with inline dequantization: a leaf may be a plain
+        array, {"_q8": int8, "_scale": f32}, or {"_q4": packed uint8,
+        "_scale4": f32} (ops/quant.py). Because this runs INSIDE the
+        (possibly scanned) layer body, XLA dequantizes one layer at a time
+        next to its consumer matmul — weights at rest stay quantized in HBM
+        even under scan_layers."""
         w = container[name]
         if isinstance(w, dict) and "_q8" in w:
             from ..ops.quant import dequantize
 
             return dequantize(w["_q8"], w["_scale"], dtype)
+        if isinstance(w, dict) and "_q4" in w:
+            from ..ops.quant import dequantize_int4
+
+            return dequantize_int4(w["_q4"], w["_scale4"], dtype)
         return w
 
     def _visible_w(q_pos, t_pos, window):

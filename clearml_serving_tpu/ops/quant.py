@@ -4,6 +4,19 @@ An 8B-param model in bf16 (16 GB) does not fit one v5e chip's HBM next to a KV
 cache — int8 weights (8 GB) do. Symmetric per-output-channel int8 with an f32
 scale; dequantization happens in VMEM fused into the matmul by XLA, so HBM
 traffic (the decode bottleneck) halves.
+
+int4 halves it again (8B -> ~4 GB): symmetric **group-quantized** 4-bit
+(AWQ/GPTQ-style w4a16 — per-(128-input-row group, output channel) scales
+recover most of the quality a single per-channel scale loses at 4 bits), two
+nibbles packed per uint8 byte so the HBM win is real on every backend rather
+than depending on XLA s4 packing. Unpack (mask/shift) + dequant fuse into the
+consumer matmul's operand pipeline exactly like the int8 path.
+
+Leaf formats (pytree leaves produced by quantize_llama_params):
+    int8: {"_q8": int8 [..., K, N],     "_scale":  f32 [..., 1, N]}
+    int4: {"_q4": uint8 [..., K//2, N], "_scale4": f32 [..., K//g, N]}
+_scale4 has the same rank as the weight (groups axis in the K slot), so TP
+sharding specs transfer unchanged (parallel/sharding.py).
 """
 
 from __future__ import annotations
@@ -32,13 +45,66 @@ def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarr
     return (x @ dequantize(q, scale, x.dtype)).astype(x.dtype)
 
 
-def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
-    """Quantize every projection matrix of a llama param pytree to int8;
-    norms/embeddings stay bf16. Serve by calling `dequant_llama_params`
-    INSIDE the jitted step function (see llm/engine.py) — XLA then fuses each
-    dequant next to its consumer matmul and frees the bf16 buffer after use,
-    so weights at rest stay int8. Calling dequant eagerly (outside jit)
-    materializes a full bf16 copy and defeats the purpose."""
+INT4_GROUP = 128  # input rows per scale group (AWQ/GPTQ convention)
+
+
+def int4_groups(k: int, group: int = INT4_GROUP) -> int:
+    """Number of scale groups for a K-row input dim: K//group, falling back
+    to one per-channel group when K doesn't divide (the single source of
+    truth for the fallback rule — quantize_int4 and random tree builders
+    must agree or benchmark trees diverge from real-checkpoint trees)."""
+    return k // group if group and k % group == 0 else 1
+
+
+def quantize_int4(
+    w: jnp.ndarray, axis: int = -2, group: int = INT4_GROUP
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """w float [..., K, N] -> (packed uint8 [..., K//2, N], scale f32
+    [..., K//group, N]). Symmetric signed 4-bit in [-8, 7], stored as
+    unsigned nibbles (q+8); rows 2i/2i+1 pack into byte i's low/high nibble.
+    K not divisible by ``group`` falls back to one group (per-channel)."""
+    if axis not in (-2, w.ndim - 2):
+        raise ValueError("int4 quantization packs along axis -2")
+    k, n = w.shape[-2], w.shape[-1]
+    if k % 2:
+        raise ValueError("int4 packing needs an even input dim, got {}".format(k))
+    g = k // int4_groups(k, group)
+    w32 = w.astype(jnp.float32)
+    shaped = w32.reshape(*w.shape[:-2], k // g, g, n)
+    absmax = jnp.max(jnp.abs(shaped), axis=-2, keepdims=True)   # [.., K//g, 1, N]
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(shaped / scale), -8, 7)
+    u = (q + 8).astype(jnp.uint8).reshape(*w.shape[:-2], k, n)
+    packed = u[..., 0::2, :] | (u[..., 1::2, :] << 4)           # [.., K//2, N]
+    return packed, jnp.squeeze(scale, -2).astype(jnp.float32)
+
+
+def dequantize_int4(
+    packed: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16
+) -> jnp.ndarray:
+    """Inverse of quantize_int4 (run INSIDE jit: XLA fuses unpack + scale
+    into the consumer matmul, weights at rest stay 4-bit in HBM)."""
+    k2, n = packed.shape[-2], packed.shape[-1]
+    lo = (packed & 0xF).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    q = jnp.stack([lo, hi], axis=-2)                            # [.., K//2, 2, N]
+    qf = q.reshape(*packed.shape[:-2], k2 * 2, n).astype(jnp.float32) - 8.0
+    ng = scale.shape[-2]
+    g = (k2 * 2) // ng
+    shaped = qf.reshape(*qf.shape[:-2], ng, g, n) * scale[..., :, None, :]
+    return shaped.reshape(qf.shape).astype(dtype)
+
+
+def quantize_llama_params(params: Dict[str, Any], bits: int = 8) -> Dict[str, Any]:
+    """Quantize every projection matrix of a llama param pytree to int8 (or
+    group-int4 with ``bits=4``); norms/embeddings stay bf16. Serve by calling
+    `dequant_llama_params` INSIDE the jitted step function (see
+    llm/engine.py) — XLA then fuses each dequant next to its consumer matmul
+    and frees the bf16 buffer after use, so weights at rest stay quantized.
+    Calling dequant eagerly (outside jit) materializes a full bf16 copy and
+    defeats the purpose."""
+    if bits not in (4, 8):
+        raise ValueError("bits must be 4 or 8, got {}".format(bits))
     quant_keys = {
         "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
         # MoE expert stacks [E, in, out] quantize the same way (axis=-2 is
@@ -53,8 +119,12 @@ def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
                 if key in quant_keys:
                     # axis=-2 is the input (reduction) dim for both plain
                     # [in, out] matrices and scan_layers-stacked [L, in, out]
-                    qv, s = quantize_int8(value, axis=-2)
-                    out[key] = {"_q8": qv, "_scale": s}
+                    if bits == 4:
+                        qv, s = quantize_int4(value, axis=-2)
+                        out[key] = {"_q4": qv, "_scale4": s}
+                    else:
+                        qv, s = quantize_int8(value, axis=-2)
+                        out[key] = {"_q8": qv, "_scale": s}
                 else:
                     out[key] = _q(value)
             return out
@@ -65,8 +135,8 @@ def quantize_llama_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return _q(params)
 
 
-def random_quantized_llama(config: dict, seed: int = 0):
-    """(bundle, params) with the int8 tree built DIRECTLY — full-precision
+def random_quantized_llama(config: dict, seed: int = 0, bits: int = 8):
+    """(bundle, params) with the int8/int4 tree built DIRECTLY — full-precision
     weights are never materialized, so an 8B model initializes inside a single
     chip's HBM. For benchmarks and weightless demo endpoints (throughput is
     weight-value-independent); real checkpoints go through
@@ -85,19 +155,30 @@ def random_quantized_llama(config: dict, seed: int = 0):
     vocab = int(cfg["vocab_size"])
     dtype = jnp.dtype(cfg["dtype"])
 
-    def qstack(key, shape):
+    def _qleaf(key, shape):  # shape = (K, N), possibly under a leading stack
+        k_in = shape[-2]
+        if bits == 4:
+            groups = int4_groups(k_in)
+            return {
+                "_q4": jax.random.randint(
+                    key, shape[:-2] + (k_in // 2, shape[-1]), 0, 256, jnp.uint8
+                ),
+                "_scale4": jnp.full(
+                    shape[:-2] + (groups, shape[-1]), 0.01, jnp.float32
+                ),
+            }
         return {
-            "_q8": jax.random.randint(key, (n_layers,) + shape, -127, 128, jnp.int8),
-            "_scale": jnp.full((n_layers, 1, shape[1]), 0.01, jnp.float32),
+            "_q8": jax.random.randint(key, shape, -127, 128, jnp.int8),
+            "_scale": jnp.full(shape[:-2] + (1, shape[-1]), 0.01, jnp.float32),
         }
+
+    def qstack(key, shape):
+        return _qleaf(key, (n_layers,) + shape)
 
     ks = jax.random.split(jax.random.PRNGKey(seed), 9)
     params = {
         "embed": (jax.random.normal(ks[0], (vocab, dim)) * 0.02).astype(dtype),
-        "lm_head": {
-            "_q8": jax.random.randint(ks[1], (dim, vocab), -127, 128, jnp.int8),
-            "_scale": jnp.full((1, vocab), 0.01, jnp.float32),
-        },
+        "lm_head": _qleaf(ks[1], (dim, vocab)),
         "final_norm": jnp.ones((dim,), dtype),
         "layers": {
             "attn_norm": jnp.ones((n_layers, dim), dtype),
@@ -121,6 +202,8 @@ def dequant_llama_params(params: Dict[str, Any], dtype=jnp.bfloat16) -> Dict[str
         if isinstance(tree, dict):
             if "_q8" in tree:
                 return dequantize(tree["_q8"], tree["_scale"], dtype)
+            if "_q4" in tree:
+                return dequantize_int4(tree["_q4"], tree["_scale4"], dtype)
             return {k: _dq(v) for k, v in tree.items()}
         if isinstance(tree, list):
             return [_dq(v) for v in tree]

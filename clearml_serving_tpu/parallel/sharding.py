@@ -130,15 +130,17 @@ def batch_sharding(mesh):
 
 
 def llama_quantized_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
-    """NamedSharding pytree for an int8-quantized llama tree (ops/quant.py
-    layout: each projection is {"_q8": [..., in, out] int8, "_scale":
-    [..., 1, out] f32}).
+    """NamedSharding pytree for a quantized llama tree (ops/quant.py layouts:
+    int8 {"_q8": [..., in, out], "_scale": [..., 1, out]} or int4
+    {"_q4": [..., in//2, out], "_scale4": [..., in//group, out]}).
 
-    The _q8 tensor takes the bf16 weight's TP spec unchanged; the _scale
-    tensor takes the same spec with the input (reduction, -2) axis entry
-    cleared — its input dim is 1 and cannot shard. Without this the whole
-    int8 tree replicates on every chip (r1 VERDICT weak #2), defeating TP
-    memory scaling exactly in the 8B-on-8-chip case.
+    The _q8/_q4 tensor takes the bf16 weight's TP spec unchanged (int4's
+    packed input dim and _scale4's group dim both divide the input axis
+    contiguously, so input-axis sharding remains valid); the int8 _scale
+    takes the same spec with the input (reduction, -2) axis entry cleared —
+    its input dim is 1 and cannot shard. Without this the whole quantized
+    tree replicates on every chip (r1 VERDICT weak #2), defeating TP memory
+    scaling exactly in the 8B-on-8-chip case.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -160,6 +162,27 @@ def llama_quantized_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, An
                     "_q8": shard_node,
                     "_scale": _scale_spec(shard_node, param_node["_q8"].ndim),
                 }
+            if "_q4" in param_node:
+                # both tensors keep the weight spec: packed K//2 and the
+                # K//group scale rows shard along the input axis the same
+                # way the unpacked K rows do (contiguous division). The
+                # scale's group count can be too coarse to split (e.g. the
+                # single-group K<group fallback) — replicate its input axis
+                # then, like the int8 scale.
+                scale4 = param_node["_scale4"]
+                spec = list(shard_node.spec)
+                spec += [None] * (scale4.ndim - len(spec))
+                ent = spec[-2]
+                axes = ent if isinstance(ent, tuple) else (ent,)
+                ways = 1
+                for ax in axes:
+                    if ax is not None:
+                        ways *= mesh.shape[ax]
+                if ent is not None and scale4.shape[-2] % ways != 0:
+                    sspec = _scale_spec(shard_node, scale4.ndim)
+                else:
+                    sspec = shard_node
+                return {"_q4": shard_node, "_scale4": sspec}
             return {k: _walk(param_node[k], shard_node[k]) for k in param_node}
         if isinstance(param_node, list):
             return [_walk(p, s) for p, s in zip(param_node, shard_node)]

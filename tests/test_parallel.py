@@ -127,6 +127,41 @@ def test_quantized_llama_tp_sharding():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-3, atol=2e-3)
 
 
+def test_int4_llama_tp_sharding():
+    """The int4 tree TP-shards like the bf16 weights: packed payload splits
+    over tp; a scale whose group count can't split (llama-tiny's K<group
+    single-group fallback) replicates its input axis instead of failing."""
+    from clearml_serving_tpu.ops.quant import quantize_llama_params
+    from clearml_serving_tpu.parallel import llama_quantized_param_sharding
+
+    mesh = make_mesh({"dp": 1, "tp": 8})
+    bundle = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
+    params = bundle.init(jax.random.PRNGKey(0))
+    qparams = quantize_llama_params(params, bits=4)
+    shardings = llama_quantized_param_sharding(mesh, qparams)
+    sharded = shard_params(mesh, qparams, shardings)
+
+    wq = sharded["layers"][0]["wq"]
+    assert wq["_q4"].sharding.spec == (None, "tp")
+    assert wq["_q4"].addressable_shards[0].data.size == wq["_q4"].size // 8
+    # column-parallel scale shards its output axis with the weight
+    assert (
+        wq["_scale4"].addressable_shards[0].data.shape[-1]
+        == wq["_scale4"].shape[-1] // 8
+    )
+    # row-parallel wo: packed input dim sharded; the single-group scale's
+    # input axis cannot split 8 ways and must replicate
+    wo = sharded["layers"][0]["wo"]
+    assert wo["_q4"].sharding.spec == ("tp", None)
+    assert wo["_q4"].addressable_shards[0].data.size == wo["_q4"].size // 8
+    assert wo["_scale4"].addressable_shards[0].data.shape[-2] == 1
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 512)
+    expected = bundle.apply(qparams, tokens)
+    out = jax.jit(bundle.apply)(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-3, atol=2e-3)
+
+
 def test_prefill_ring_matches_prefill():
     """sp-sharded ring prefill must produce the same last-token logits and
     KV cache as the plain prefill (ring attention leaves serving shelf-ware
