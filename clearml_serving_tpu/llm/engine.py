@@ -74,6 +74,12 @@ class GenRequest:
     # named LoRA adapter to apply (None = base model); resolved against the
     # engine's adapter registry at validate/admission time
     adapter: Optional[str] = None
+    # grammar constraint (llm/guided.py GuidedSpec); compiled at admission,
+    # enforced on device inside the decode scan
+    guided: Optional[Any] = None
+    # engine-internal: combined-table DFA state after the first token
+    _gstate0: int = -1
+    _guided_key: Optional[str] = None
     # filled by the engine:
     out_queue: "asyncio.Queue" = field(default_factory=asyncio.Queue)
     produced: int = 0
@@ -194,6 +200,7 @@ class LLMEngineCore:
         prefix_block: int = 64,
         prefix_cache_bytes: Optional[int] = None,
         logprobs_k: int = 20,  # OpenAI's top_logprobs ceiling
+        tokenizer=None,  # required for guided decoding (token byte tables)
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -380,6 +387,24 @@ class LLMEngineCore:
         self._ready: "asyncio.Queue" = asyncio.Queue()
         self._admitting: set = set()
         self._admission_tasks: set = set()  # strong refs; see _run_loop_inner
+        # guided decoding (llm/guided.py): grammars compile once per unique
+        # spec into a COMBINED state space (per-grammar state offsets) so
+        # mixed-grammar batches share one mask/byte-table pair on device.
+        # Retraces are bounded by padding the combined state count to
+        # power-of-two buckets.
+        self._guided_lock = threading.Lock()
+        self._tokenizer = tokenizer
+        self._grammars: Dict[str, dict] = {}      # key -> entry
+        self._gmask_np: Optional[np.ndarray] = None   # [S, Vb] uint8
+        self._gbyte_np: Optional[np.ndarray] = None   # [S, 256] int16
+        self._gmask_dev = None
+        self._gbyte_dev = None
+        self._gtok_dev = None                     # (tok_bytes, tok_len)
+        self._gtok_np = None
+        self._gtok_bytes = None                   # cached token_byte_table
+        self._gstate = np.full(self.max_batch, -1, np.int32)
+        self._slot_guided_key: List[Optional[str]] = [None] * self.max_batch
+        self._guided_dirty = False
         # decode-first prefill pacing (None/0 disables the policy)
         self._prefill_gate = (
             _PrefillGate(
@@ -511,18 +536,54 @@ class LLMEngineCore:
             top_lp, top_id = jax.lax.top_k(lp_full, self._lp_k)
             return chosen, top_id.astype(jnp.int32), top_lp
 
+        def _guided_mask(logits, gstate, guided):
+            """Constrain logits to the slots' grammar states (llm/guided.py
+            compiled tables). gstate < 0 = unguided slot."""
+            mask_bits, _bt, _tb, _tl = guided
+            nb = logits.shape[0]
+            guided_on = gstate >= 0
+            rows = mask_bits[jnp.clip(gstate, 0)]               # [B, Vb] u8
+            bits = (rows[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+            allowed = bits.reshape(nb, -1)[:, : logits.shape[-1]] > 0
+            allowed = jnp.where(guided_on[:, None], allowed, True)
+            # a fully-masked row (cannot happen for pruned grammars; belt
+            # and braces) degrades to unconstrained instead of NaN
+            any_ok = jnp.any(allowed, axis=-1, keepdims=True)
+            allowed = allowed | ~any_ok
+            return jnp.where(allowed, logits, jnp.float32(-1e30))
+
+        def _guided_advance(gstate, sampled, ok, guided):
+            """Walk the sampled token's bytes through the byte DFA (on
+            device; Lmax tiny gathers). Zero-length tokens (EOS/specials)
+            leave the state in place — EOS finishes the request anyway."""
+            _mb, byte_trans, tok_bytes, tok_len = guided
+            tb = tok_bytes[sampled]                              # [B, L]
+            tl = tok_len[sampled]                                # [B]
+            s0 = jnp.clip(gstate, 0)
+
+            def step(i, s):
+                nxt = byte_trans[
+                    jnp.clip(s, 0), tb[:, i].astype(jnp.int32)
+                ].astype(jnp.int32)
+                return jnp.where(i < tl, nxt, s)
+
+            walked = jax.lax.fori_loop(0, tok_bytes.shape[1], step, s0)
+            return jnp.where((gstate >= 0) & ok, walked, gstate)
+
         def _decode_chunk(params, tokens, cache, active, sampling, rng,
                           lora_idx=None, extras=None, counts=None, pmask=None,
-                          want_lp=False):
+                          guided=None, gstate=None, want_lp=False):
             """`decode_steps` decode+sample steps fused in one executable
             (lax.scan) — host dispatch overhead amortizes over the chunk.
             ``extras``/``counts``/``pmask`` (penalties, bias, seeds, token
             histogram) are optional: the no-extras trace is unchanged.
+            ``guided``/``gstate`` (grammar tables + per-slot DFA states)
+            constrain sampling on device when present.
             ``want_lp`` (static) additionally emits per-token logprobs."""
             nb = tokens.shape[0]
 
             def body(carry, xs):
-                tokens, cache, counts = carry
+                tokens, cache, counts, gstate = carry
                 step_rng, step_off = xs
                 old_len = cache["length"]
                 if lora_idx is None:
@@ -533,6 +594,8 @@ class LLMEngineCore:
                 # write sits beyond `length` and is masked / later overwritten)
                 cache["length"] = jnp.where(active, cache["length"], old_len)
                 logits = logits.astype(jnp.float32)
+                if guided is not None:
+                    logits = _guided_mask(logits, gstate, guided)
                 if extras is None:
                     sampled = sample_tokens(logits, sampling, step_rng)
                     lp_src = logits
@@ -552,20 +615,24 @@ class LLMEngineCore:
                     counts = counts.at[jnp.arange(nb), sampled].add(
                         active.astype(jnp.int32)
                     )
+                if guided is not None:
+                    gstate = _guided_advance(gstate, sampled, active, guided)
                 out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
-                return (sampled, cache, counts), out
+                return (sampled, cache, counts, gstate), out
 
             rngs = jax.random.split(rng, self.decode_steps)
             steps = jnp.arange(self.decode_steps, dtype=jnp.int32)
-            (_, cache, counts), out = jax.lax.scan(
-                body, (tokens, cache, counts), (rngs, steps)
+            if gstate is None:
+                gstate = jnp.full((nb,), -1, jnp.int32)
+            (_, cache, counts, gstate), out = jax.lax.scan(
+                body, (tokens, cache, counts, gstate), (rngs, steps)
             )
             if want_lp:
                 toks, (chosen, top_id, top_lp) = out
                 # [steps, ...] -> batch-major
                 lp = (chosen.T, jnp.swapaxes(top_id, 0, 1), jnp.swapaxes(top_lp, 0, 1))
-                return toks.T, cache, counts, lp
-            return out.T, cache, counts, None  # [B, decode_steps]
+                return toks.T, cache, counts, lp, gstate
+            return out.T, cache, counts, None, gstate  # [B, decode_steps]
 
         self._decode_chunk_jit = jax.jit(
             _decode_chunk, donate_argnums=(2,), static_argnames=("want_lp",)
@@ -675,7 +742,8 @@ class LLMEngineCore:
         def _decode_paged_chunk(
             params, tokens, k_pools, v_pools, page_table, lengths0,
             write_pages, write_offsets, sampling, rng, lora_idx=None,
-            extras=None, counts=None, pmask=None, want_lp=False,
+            extras=None, counts=None, pmask=None, guided=None, gstate=None,
+            want_lp=False,
         ):
             """Paged-cache variant of the fused decode chunk. Page/offset
             write coordinates for every step come pre-computed from the host
@@ -686,7 +754,7 @@ class LLMEngineCore:
             )  # paged slots with content; inactive rows count nothing
 
             def body(carry, xs):
-                tokens, k_pools, v_pools, counts, step = carry
+                tokens, k_pools, v_pools, counts, step, gstate = carry
                 step_rng, wp, wo = xs
                 if lora_idx is None:
                     logits, k_pools, v_pools = bundle.decode_paged(
@@ -699,6 +767,8 @@ class LLMEngineCore:
                         lengths0 + step, wp, wo, lora_idx,
                     )
                 logits = logits.astype(jnp.float32)
+                if guided is not None:
+                    logits = _guided_mask(logits, gstate, guided)
                 if extras is None:
                     sampled = sample_tokens(logits, sampling, step_rng)
                     lp_src = logits
@@ -715,20 +785,24 @@ class LLMEngineCore:
                     counts = counts.at[jnp.arange(nb), sampled].add(
                         active.astype(jnp.int32)
                     )
+                if guided is not None:
+                    gstate = _guided_advance(gstate, sampled, active, guided)
                 out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
-                return (sampled, k_pools, v_pools, counts, step + 1), out
+                return (sampled, k_pools, v_pools, counts, step + 1, gstate), out
 
             rngs = jax.random.split(rng, self.decode_steps)
-            (_, k_pools, v_pools, counts, _), out = jax.lax.scan(
+            if gstate is None:
+                gstate = jnp.full((nb,), -1, jnp.int32)
+            (_, k_pools, v_pools, counts, _, gstate), out = jax.lax.scan(
                 body,
-                (tokens, k_pools, v_pools, counts, jnp.int32(0)),
+                (tokens, k_pools, v_pools, counts, jnp.int32(0), gstate),
                 (rngs, write_pages.T, write_offsets.T),
             )
             if want_lp:
                 toks, (chosen, top_id, top_lp) = out
                 lp = (chosen.T, jnp.swapaxes(top_id, 0, 1), jnp.swapaxes(top_lp, 0, 1))
-                return toks.T, k_pools, v_pools, counts, lp
-            return out.T, k_pools, v_pools, counts, None
+                return toks.T, k_pools, v_pools, counts, lp, gstate
+            return out.T, k_pools, v_pools, counts, None, gstate
 
         self._decode_paged_chunk_jit = jax.jit(
             _decode_paged_chunk, donate_argnums=(2, 3),
@@ -778,6 +852,169 @@ class LLMEngineCore:
                         request.logprobs, self._lp_k
                     )
                 )
+        if request.guided is not None:
+            from . import guided as _g
+
+            if self._tokenizer is None:
+                raise ValueError(
+                    "guided decoding needs the engine's tokenizer "
+                    "(constructed without one)"
+                )
+            if self.eos_token_id is None:
+                raise ValueError("guided decoding requires an eos token")
+            spec = request.guided
+            if spec.kind not in ("regex", "json_schema", "json_object"):
+                raise ValueError("unknown guided kind {!r}".format(spec.kind))
+            # cheap syntactic pre-flight so 4xx errors precede streaming
+            # headers; the full (token-lifting) compile runs at admission
+            try:
+                if spec.kind == "regex":
+                    _g._Parser(spec.payload).parse()
+                elif spec.kind == "json_schema":
+                    import json as _json
+
+                    _g.json_schema_to_regex(_json.loads(spec.payload))
+            except _g.RegexError as ex:
+                raise ValueError("invalid guided grammar: {}".format(ex))
+            except Exception as ex:
+                raise ValueError("invalid guided schema: {}".format(ex))
+
+    # -- guided-decoding registry (llm/guided.py) ------------------------
+
+    def _ensure_grammar(self, request: GenRequest) -> dict:
+        """Compile (or reuse) the request's grammar and splice it into the
+        COMBINED device tables. Runs in the admission worker thread — the
+        compile (DFA + token lifting) can take seconds for large vocabs.
+        Returns the registry entry {offset, n_states, terminal, refs}."""
+        from . import guided as _g
+
+        key = request.guided.cache_key()
+        with self._guided_lock:
+            entry = self._grammars.get(key)
+            if entry is not None:
+                entry["refs"] += 1
+                request._guided_key = key
+                return entry
+        # the O(V) token byte table is per-tokenizer: build once, reuse for
+        # every grammar (compile AND device walk share it)
+        with self._guided_lock:
+            token_bytes = self._gtok_bytes
+        if token_bytes is None:
+            token_bytes = _g.token_byte_table(self._tokenizer, self._vocab)
+        # compile outside the lock (pure); splice under it
+        grammar = _g.compile_guided(
+            request.guided, self._tokenizer, self._vocab, self.eos_token_id,
+            token_bytes=token_bytes,
+        )
+        with self._guided_lock:
+            entry = self._grammars.get(key)
+            if entry is not None:  # raced another admission; reuse theirs
+                entry["refs"] += 1
+                request._guided_key = key
+                return entry
+            if self._gtok_bytes is None:
+                self._gtok_bytes = token_bytes
+            if self._gtok_dev is None:
+                tb, tl = _g.build_token_byte_arrays(token_bytes)
+                self._gtok_np = (tb, tl)
+                self._gtok_dev = (jnp.asarray(tb), jnp.asarray(tl))
+            # int16 device states: bound the combined table so offsets can
+            # never wrap; fails only THIS request, and only when many
+            # distinct grammars are concurrently alive
+            total = self._gmask_np.shape[0] if self._gmask_np is not None else 0
+            if total + grammar.n_states > 32000:
+                raise ValueError(
+                    "guided-grammar state budget exhausted ({} + {} states); "
+                    "retry when active grammars drain".format(
+                        total, grammar.n_states
+                    )
+                )
+            # opportunistic compaction, ONLY when every grammar is dead
+            # (refs==0 means no slot state and no in-flight admission holds
+            # a key — a partial rebuild would shift offsets under states
+            # computed by concurrent admissions, so all-or-nothing)
+            if self._grammars and all(
+                e["refs"] <= 0 for e in self._grammars.values()
+            ):
+                self._grammars.clear()
+                self._gmask_np = None
+                self._gbyte_np = None
+                self._guided_dirty = True
+            offset = self._gmask_np.shape[0] if self._gmask_np is not None else 0
+            entry = {
+                "offset": offset,
+                "n_states": grammar.n_states,
+                "terminal": offset + grammar.terminal,
+                "start": offset + grammar.start,
+                "refs": 1,
+                "grammar": grammar,
+            }
+            self._grammars[key] = entry
+            self._append_guided_tables_locked(grammar)
+            request._guided_key = key
+            return entry
+
+    def _append_guided_tables_locked(self, grammar) -> None:
+        from . import guided as _g
+
+        offset = self._gmask_np.shape[0] if self._gmask_np is not None else 0
+        byte = grammar.byte_trans.astype(np.int32)
+        byte = np.where(byte == _g.DEAD, _g.DEAD, byte + offset).astype(np.int16)
+        if self._gmask_np is None:
+            self._gmask_np = grammar.mask_bits.copy()
+            self._gbyte_np = byte
+        else:
+            self._gmask_np = np.vstack([self._gmask_np, grammar.mask_bits])
+            self._gbyte_np = np.vstack([self._gbyte_np, byte])
+        self._guided_dirty = True
+
+    def _guided_device_tables(self):
+        """(mask_bits, byte_trans, tok_bytes, tok_len) on device, padded to
+        power-of-two state counts so trace shapes are bucketed."""
+        with self._guided_lock:
+            if self._gmask_np is None:
+                return None
+            if self._guided_dirty or self._gmask_dev is None:
+                s = self._gmask_np.shape[0]
+                bucket = 1
+                while bucket < s:
+                    bucket *= 2
+                pad = bucket - s
+                mask = np.vstack(
+                    [self._gmask_np,
+                     np.zeros((pad, self._gmask_np.shape[1]), np.uint8)]
+                )
+                byte = np.vstack(
+                    [self._gbyte_np, np.full((pad, 256), -1, np.int16)]
+                )
+                self._gmask_dev = jnp.asarray(mask)
+                self._gbyte_dev = jnp.asarray(byte)
+                self._guided_dirty = False
+            return (self._gmask_dev, self._gbyte_dev) + self._gtok_dev
+
+    def _release_guided(self, slot: int) -> None:
+        """Slot freed: clear its DFA state and deref its grammar. The key is
+        captured at commit time in _slot_guided_key because _slot_req[slot]
+        is already None on some finish paths."""
+        self._gstate[slot] = -1
+        key = self._slot_guided_key[slot]
+        if key is None:
+            return
+        self._slot_guided_key[slot] = None
+        self._deref_guided_key(key)
+
+    def _deref_guided_request(self, request: GenRequest) -> None:
+        """Admission failed/dropped before its slot commit: return the
+        grammar ref taken by _ensure_grammar."""
+        if request._guided_key is not None:
+            key, request._guided_key = request._guided_key, None
+            self._deref_guided_key(key)
+
+    def _deref_guided_key(self, key: str) -> None:
+        with self._guided_lock:
+            entry = self._grammars.get(key)
+            if entry is not None:
+                entry["refs"] -= 1
 
     @property
     def adapter_names(self) -> List[str]:
@@ -1047,6 +1284,17 @@ class LLMEngineCore:
             top_p=jnp.asarray([request.top_p], jnp.float32),
         )
         logits32 = last_logits.astype(jnp.float32)
+        gentry = None
+        if request.guided is not None:
+            # compile/register the grammar (slow part; we're in the
+            # admission worker thread) and constrain the FIRST token here —
+            # subsequent tokens are constrained inside the decode scan
+            gentry = self._ensure_grammar(request)
+            row = self._gmask_np[gentry["start"]]
+            allowed = np.unpackbits(row, bitorder="little")[: self._vocab] > 0
+            logits32 = jnp.where(
+                jnp.asarray(allowed)[None, :], logits32, jnp.float32(-1e30)
+            )
         lp_src = logits32
         if self._request_has_extras(request):
             extras, counts0, pmask0 = self._request_extras_row(request)
@@ -1059,6 +1307,20 @@ class LLMEngineCore:
         else:
             first = self._sample_jit(logits32, sp, self._next_rng())
         first_id = int(np.asarray(first)[0])
+        if gentry is not None:
+            # host-side byte walk for the first token's state advance
+            if first_id == self.eos_token_id:
+                request._gstate0 = gentry["terminal"]
+            else:
+                s = gentry["start"]
+                with self._guided_lock:
+                    byte_np = self._gbyte_np
+                    tb, tl = self._gtok_np
+                for b in tb[first_id][: int(tl[first_id])]:
+                    s = int(byte_np[s, int(b)])
+                    if s < 0:
+                        break
+                request._gstate0 = s
         first_lp = None
         if request.logprobs is not None:
             chosen, tid, tlp = self._first_lp_jit(lp_src, first)
@@ -1150,6 +1412,12 @@ class LLMEngineCore:
         self._seeds[slot] = (
             -1 if request.seed is None else int(request.seed) & 0x7FFFFFFF
         )
+        if request._guided_key is not None:
+            # transfer the grammar ref from the request to the slot; the
+            # first token may already have completed the match (terminal)
+            self._slot_guided_key[slot] = request._guided_key
+            request._guided_key = None
+            self._gstate[slot] = request._gstate0
         has_extras = self._request_has_extras(request)
         self._slot_extra[slot] = has_extras
         if has_extras or self._counts_dev is not None:
@@ -1182,12 +1450,14 @@ class LLMEngineCore:
             )
         except Exception as ex:
             # a failed admission fails only its own request
+            self._deref_guided_request(request)
             request.error = ex
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
             self._wake_loop()
             return
         if self._stopped:
+            self._deref_guided_request(request)
             request.error = RuntimeError("engine stopped")
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
@@ -1222,6 +1492,7 @@ class LLMEngineCore:
             # consumer is gone — free the slot (and its KV pages) early
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
+            self._release_guided(slot)
             if self.paged_cache is not None:
                 self.paged_cache.pool.free(slot)
             return
@@ -1243,6 +1514,7 @@ class LLMEngineCore:
         ):
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
+            self._release_guided(slot)
             if self.paged_cache is not None:
                 self.paged_cache.pool.free(slot)  # recycle the slot's pages
 
@@ -1251,6 +1523,7 @@ class LLMEngineCore:
         while not self._ready.empty():
             request, slot, _first, _cache, _lp = self._ready.get_nowait()
             self._admitting.discard(slot)
+            self._deref_guided_request(request)
             request.error = err
             request.out_queue.put_nowait(_FINISHED)
 
@@ -1265,6 +1538,7 @@ class LLMEngineCore:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
+                self._release_guided(slot)
 
     def _dispatch_spec_chunk(self, active_mask: np.ndarray):
         """Worker-thread side of a speculative dispatch: run the fused
@@ -1316,12 +1590,15 @@ class LLMEngineCore:
                 write_offsets[slot, i] = offset
         page_table = pool.page_table(self._pages_per_seq)
         use_extras = self._extras_active(active_mask)
+        use_guided = bool(np.any(self._gstate[active_mask] >= 0))
+        gtables = self._guided_device_tables() if use_guided else None
         (
             chunk,
             self.paged_cache.k,
             self.paged_cache.v,
             new_counts,
             lp,
+            gstate_out,
         ) = self._decode_paged_chunk_jit(
             self.params,
             jnp.asarray(self._next_token),
@@ -1337,10 +1614,16 @@ class LLMEngineCore:
             self._batch_extras() if use_extras else None,
             self._counts_dev if use_extras else None,
             self._pmask_dev if use_extras else None,
+            gtables,
+            jnp.asarray(self._gstate) if gtables is not None else None,
             want_lp=want_lp,
         )
         if use_extras:
             self._counts_dev = new_counts
+        if gtables is not None:
+            # np.array (copy): asarray would alias the immutable device
+            # buffer and commit/release paths write rows in place
+            self._gstate = np.array(gstate_out)
         lp_np = tuple(np.asarray(a) for a in lp) if lp is not None else None
         return np.asarray(chunk), exhausted, lp_np
 
@@ -1403,6 +1686,7 @@ class LLMEngineCore:
                 request, slot, first_id, mini_cache, first_lp = self._ready.get_nowait()
                 self._admitting.discard(slot)
                 if request.cancelled:
+                    self._deref_guided_request(request)
                     request.out_queue.put_nowait(_FINISHED)
                     continue
                 self._commit_admission(request, slot, first_id, mini_cache, first_lp)
@@ -1442,6 +1726,9 @@ class LLMEngineCore:
                 # logprob tracking also needs the plain chunk (the verify
                 # pass reports no per-token distributions)
                 and not want_lp
+                # grammar masks change the argmax too; the verify pass does
+                # not model them
+                and not bool(np.any(self._gstate[active_mask] >= 0))
             )
             if use_spec:
                 # draft-and-verify rounds (greedy slots only): device work
@@ -1478,10 +1765,13 @@ class LLMEngineCore:
                         )
                         request.out_queue.put_nowait(_FINISHED)
                         self._slot_req[slot] = None
+                        self._release_guided(slot)
                         self.paged_cache.pool.free(slot)
             else:
                 use_extras = self._extras_active(active_mask)
-                chunk, self.cache, new_counts, lp = self._decode_chunk_jit(
+                use_guided = bool(np.any(self._gstate[active_mask] >= 0))
+                gtables = self._guided_device_tables() if use_guided else None
+                chunk, self.cache, new_counts, lp, gstate_out = self._decode_chunk_jit(
                     self.params,
                     jnp.asarray(self._next_token),
                     self.cache,
@@ -1492,11 +1782,23 @@ class LLMEngineCore:
                     self._batch_extras() if use_extras else None,
                     self._counts_dev if use_extras else None,
                     self._pmask_dev if use_extras else None,
+                    gtables,
+                    jnp.asarray(self._gstate) if gtables is not None else None,
                     want_lp=want_lp,
                 )
                 if use_extras:
                     self._counts_dev = new_counts
-                chunk_np = await asyncio.to_thread(np.asarray, chunk)  # device sync off-loop
+                # device sync off-loop (gstate readback included — a
+                # blocking np.array here would stall SSE flushes and
+                # admissions for the whole chunk)
+                chunk_np, gstate_np = await asyncio.to_thread(
+                    lambda: (
+                        np.asarray(chunk),
+                        np.array(gstate_out) if gtables is not None else None,
+                    )
+                )
+                if gstate_np is not None:
+                    self._gstate = gstate_np
                 lp_np = (
                     tuple(np.asarray(a) for a in lp) if lp is not None else None
                 )

@@ -198,6 +198,7 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("prefix_cache_mb")
                 else None
             ),
+            tokenizer=self.tokenizer,  # guided decoding needs token bytes
         )
         self._model_name = self.endpoint.serving_url
         return self.engine
@@ -300,7 +301,42 @@ class LLMEngineRequest(BaseEngineRequest):
             logit_bias=logit_bias,
             logprobs=logprobs,
             adapter=self._adapter_for(body),
+            guided=self._guided_spec(body),
         )
+
+    @staticmethod
+    def _guided_spec(body: Dict[str, Any]):
+        """OpenAI ``response_format`` (json_object / json_schema) and
+        vLLM-style ``guided_regex`` / ``guided_json`` extras -> GuidedSpec.
+        Enforced on device by the engine's grammar tables (llm/guided.py);
+        the reference's vLLM engine applies the same surface host-side."""
+        import json as _json
+
+        from .guided import GuidedSpec
+
+        if body.get("guided_regex"):
+            return GuidedSpec("regex", str(body["guided_regex"]))
+        if body.get("guided_json") is not None:
+            schema = body["guided_json"]
+            if isinstance(schema, str):
+                schema = _json.loads(schema)
+            return GuidedSpec("json_schema", _json.dumps(schema, sort_keys=True))
+        rf = body.get("response_format")
+        if not rf:
+            return None
+        if isinstance(rf, str):  # audio routes use a plain string; tolerate
+            return None
+        kind = rf.get("type")
+        if kind == "json_object":
+            return GuidedSpec("json_object")
+        if kind == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if schema is None:
+                raise ValueError("response_format.json_schema.schema missing")
+            return GuidedSpec("json_schema", _json.dumps(schema, sort_keys=True))
+        if kind in (None, "text"):
+            return None
+        raise ValueError("unsupported response_format type {!r}".format(kind))
 
     def _n_requests(self, body: Dict[str, Any], prompt_ids: List[int],
                     chat: bool = True):

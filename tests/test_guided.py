@@ -1,0 +1,333 @@
+"""Guided (grammar-constrained) decoding: regex/JSON-schema compiler, token
+DFA tables, engine enforcement under sampling, OpenAI response_format route.
+
+Reference surface: vLLM's guided decoding reaches the reference through
+request bodies forwarded by clearml_serving/serving/preprocess_service.py;
+here the constraint compiles to on-device tables (llm/guided.py)."""
+
+import asyncio
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.guided import (
+    ByteDFA,
+    GuidedSpec,
+    RegexError,
+    TokenDFA,
+    compile_guided,
+    json_schema_to_regex,
+    json_value_regex,
+    token_byte_table,
+)
+from clearml_serving_tpu.llm.tokenizer import ByteTokenizer
+
+
+# ------------------------------------------------------------ compiler
+
+@pytest.mark.parametrize(
+    "pattern,accept,reject",
+    [
+        ("(yes|no|maybe)", ["yes", "no", "maybe"], ["ye", "nope", ""]),
+        (r"-?(0|[1-9][0-9]*)", ["0", "-7", "142"], ["01", "-", "+1"]),
+        (r"[a-c]{2,3}x", ["abx", "cabx"], ["ax", "abcax", "abX"]),
+        (r"a+b*c?", ["a", "aab", "abc", "ac"], ["", "b", "cc"]),
+        (r"\d\d:\d\d", ["09:30"], ["9:30", "09-30"]),
+        (r"[^0-9]+", ["abc", "x!"], ["a1", "7"]),
+    ],
+)
+def test_regex_dfa(pattern, accept, reject):
+    dfa = ByteDFA.from_regex(pattern)
+    for s in accept:
+        assert dfa.matches(s.encode()), (pattern, s)
+    for s in reject:
+        assert not dfa.matches(s.encode()), (pattern, s)
+
+
+def test_regex_errors():
+    for bad in ["(a", "a)", "[a", "*a", "a{2"]:
+        with pytest.raises(RegexError):
+            ByteDFA.from_regex(bad)
+
+
+def test_json_schema_regex_roundtrip():
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"}, "maxItems": 3},
+            "kind": {"enum": ["cat", "dog"]},
+        },
+        "required": ["name", "age", "kind"],
+    }
+    dfa = ByteDFA.from_regex(json_schema_to_regex(schema))
+    ok = {"name": "bo", "age": 3, "tags": ["a", "b"], "kind": "cat"}
+    assert dfa.matches(json.dumps(ok, separators=(",", ":")).encode())
+    no_tags = {"name": "bo", "age": 3, "kind": "dog"}
+    assert dfa.matches(json.dumps(no_tags, separators=(",", ":")).encode())
+    assert not dfa.matches(b'{"name":3,"age":3,"kind":"cat"}')   # wrong type
+    assert not dfa.matches(b'{"age":3,"kind":"cat"}')            # missing req
+    assert not dfa.matches(b'{"name":"bo","age":3,"kind":"fox"}')  # bad enum
+
+
+def test_json_value_regex_bounded_depth():
+    dfa = ByteDFA.from_regex(json_value_regex(2))
+    for v in ['{"a": 1}', "[1,2]", '"x"', "true", '{"a": [1,2]}']:
+        assert dfa.matches(v.encode()), v
+    assert not dfa.matches(b'{"a":}')
+    # depth 3 nesting exceeds a depth-2 value regex
+    assert not dfa.matches(b'{"a": {"b": [1]}}')
+    assert ByteDFA.from_regex(json_value_regex(3)).matches(b'{"a": {"b": [1]}}')
+
+
+def test_json_schema_optional_property_commas():
+    """Optional properties must keep comma separators valid for EVERY subset
+    (regression: optionals used to concatenate without commas)."""
+    schema = {
+        "type": "object",
+        "properties": {
+            "a": {"type": "integer"},   # optional, before first required
+            "b": {"type": "integer"},   # required
+            "c": {"type": "integer"},   # optional, after
+            "d": {"type": "integer"},   # optional, after
+        },
+        "required": ["b"],
+    }
+    dfa = ByteDFA.from_regex(json_schema_to_regex(schema))
+    for ok in [
+        {"b": 2},
+        {"a": 1, "b": 2},
+        {"b": 2, "c": 3},
+        {"a": 1, "b": 2, "c": 3, "d": 4},
+        {"b": 2, "d": 4},
+    ]:
+        assert dfa.matches(json.dumps(ok, separators=(",", ":")).encode()), ok
+    assert not dfa.matches(b'{"a":1"b":2}')     # missing comma
+    assert not dfa.matches(b'{"a":1,"b":2,}')   # trailing comma
+    assert not dfa.matches(b'{"a":1}')          # missing required
+
+    all_optional = {
+        "type": "object",
+        "properties": {
+            "x": {"type": "integer"},
+            "y": {"type": "integer"},
+            "z": {"type": "integer"},
+        },
+        "required": [],
+    }
+    dfa = ByteDFA.from_regex(json_schema_to_regex(all_optional))
+    for ok in [{}, {"x": 1}, {"y": 2}, {"x": 1, "z": 3}, {"x": 1, "y": 2, "z": 3}]:
+        assert dfa.matches(json.dumps(ok, separators=(",", ":")).encode()), ok
+    assert not dfa.matches(b'{"x":1"y":2}')
+    assert not dfa.matches(b'{,}')
+
+
+class _StubHF:
+    """Mimics the transformers surface token_byte_table touches."""
+
+    def __init__(self, pieces, special_ids):
+        self._pieces = pieces
+        self.all_special_ids = special_ids
+
+    def convert_ids_to_tokens(self, ids):
+        return [self._pieces[i] for i in ids]
+
+
+class _StubTokenizer:
+    def __init__(self, pieces, special_ids):
+        self._tok = _StubHF(pieces, special_ids)
+        self.bos_token_id = 0
+        self.eos_token_id = 1
+        self.pad_token_id = None
+
+
+def test_token_byte_table_sentencepiece_convention():
+    # '▁world' must contribute b' world' (HF decode([id]) strips the space —
+    # the regression this mapping exists to avoid) and '<0x0A>' is a raw byte
+    tok = _StubTokenizer(["<s>", "</s>", "▁world", "<0x0A>", "ab"], [0, 1])
+    table = token_byte_table(tok, 5)
+    assert table[0] is None and table[1] is None
+    assert table[2] == b" world"
+    assert table[3] == b"\n"
+    assert table[4] == b"ab"
+
+
+def test_token_byte_table_byte_level_convention():
+    # GPT-2 alphabet: 'Ġ' (U+0120) is the space byte; 'Ċ' (U+010A) newline
+    tok = _StubTokenizer(["<s>", "</s>", "Ġworld", "Ċ", "ab"], [0, 1])
+    table = token_byte_table(tok, 5)
+    assert table[2] == b" world"
+    assert table[3] == b"\n"
+    assert table[4] == b"ab"
+
+
+def test_json_object_regex_requires_object():
+    from clearml_serving_tpu.llm.guided import json_object_regex
+
+    dfa = ByteDFA.from_regex(json_object_regex(2))
+    assert dfa.matches(b'{"a": 1}')
+    assert dfa.matches(b"{}")
+    # bare values are NOT acceptable for OpenAI json_object mode
+    for v in [b"true", b"3", b'"x"', b"[1,2]"]:
+        assert not dfa.matches(v), v
+
+
+def test_token_dfa_walk_and_eos():
+    tok = ByteTokenizer(512)
+    g = compile_guided(GuidedSpec("regex", "cat|dog"), tok, 512, tok.eos_token_id)
+    # mask bit check: from start only 'c' and 'd' lead anywhere
+    start_row = np.unpackbits(g.mask_bits[g.start], bitorder="little")[:512]
+    allowed = set(np.nonzero(start_row)[0].tolist())
+    assert allowed == {ord("c"), ord("d")}
+    # byte walk 'c' 'a' 't' then eos allowed, not before
+    s = g.start
+    for b in b"cat":
+        s = int(g.byte_trans[s, b])
+        assert s >= 0
+    row = np.unpackbits(g.mask_bits[s], bitorder="little")[:512]
+    assert row[tok.eos_token_id] == 1
+    assert np.unpackbits(g.mask_bits[g.start], bitorder="little")[tok.eos_token_id] == 0
+
+
+def test_token_dfa_prunes_dead_ends():
+    # 'a' followed by a byte no token can produce (0x00 is a real token for
+    # ByteTokenizer, so use a grammar whose tail requires an over-long token)
+    tok = ByteTokenizer(512)
+    tokens = token_byte_table(tok, 512)
+    dfa = ByteDFA.from_regex("ab")
+    tdfa = TokenDFA.build(dfa, tokens, tok.eos_token_id)
+    # every token admitted from every state leads to a token-live state
+    live = (tdfa.table != -1).any(axis=1)
+    tgt = tdfa.table[tdfa.table != -1]
+    assert live[tgt].all()
+
+
+# ------------------------------------------------------------ engine
+
+@pytest.fixture(scope="module")
+def guided_engine():
+    tok = ByteTokenizer(512)
+    bundle = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=4, max_seq_len=128, prefill_buckets=[16, 32],
+        eos_token_id=tok.eos_token_id, tokenizer=tok,
+    )
+    return engine, tok
+
+
+def _gen(engine, req):
+    async def run():
+        out = []
+        async for t in engine.generate(req):
+            out.append(t)
+        return out
+
+    return asyncio.run(run())
+
+
+def _text(tok, toks):
+    return tok.decode(t for t in toks if t != tok.eos_token_id)
+
+
+def test_engine_regex_constrains_sampling(guided_engine):
+    engine, tok = guided_engine
+    # high temperature: without the grammar, a random tiny model emits
+    # arbitrary bytes; with it, output MUST be one of the alternatives
+    for seed_prompt in ("Q:", "R:", "S:"):
+        toks = _gen(engine, GenRequest(
+            prompt_ids=tok.encode(seed_prompt), max_new_tokens=24,
+            temperature=0.9, guided=GuidedSpec("regex", "(yes|no|maybe)"),
+        ))
+        assert _text(tok, toks) in ("yes", "no", "maybe")
+    assert all(e["refs"] == 0 for e in engine._grammars.values())
+
+
+def test_engine_json_schema_output_parses(guided_engine):
+    engine, tok = guided_engine
+    schema = json.dumps({
+        "type": "object",
+        "properties": {"n": {"type": "integer"}, "ok": {"type": "boolean"}},
+        "required": ["n", "ok"],
+    })
+    toks = _gen(engine, GenRequest(
+        prompt_ids=tok.encode("x:"), max_new_tokens=200, temperature=0.8,
+        seed=5,  # deterministic completion before the token cap
+        guided=GuidedSpec("json_schema", schema),
+    ))
+    assert toks[-1] == tok.eos_token_id, "expected EOS completion"
+    obj = json.loads(_text(tok, toks))
+    assert isinstance(obj["n"], int) and isinstance(obj["ok"], bool)
+
+
+def test_engine_mixed_grammars_in_one_batch(guided_engine):
+    engine, tok = guided_engine
+
+    async def both():
+        r1 = GenRequest(prompt_ids=tok.encode("a:"), max_new_tokens=16,
+                        temperature=0.9,
+                        guided=GuidedSpec("regex", "(red|green|blue)"))
+        r2 = GenRequest(prompt_ids=tok.encode("b:"), max_new_tokens=16,
+                        temperature=0.9, guided=GuidedSpec("regex", "[0-9]{3}"))
+        r3 = GenRequest(prompt_ids=tok.encode("c:"), max_new_tokens=4,
+                        temperature=0.9)  # unguided alongside
+
+        async def col(r):
+            out = []
+            async for t in engine.generate(r):
+                out.append(t)
+            return out
+
+        return await asyncio.gather(col(r1), col(r2), col(r3))
+
+    o1, o2, _o3 = asyncio.run(both())
+    assert _text(tok, o1) in ("red", "green", "blue")
+    t2 = _text(tok, o2)
+    assert len(t2) == 3 and t2.isdigit()
+
+
+def test_engine_greedy_guided(guided_engine):
+    """Greedy decoding under a grammar is deterministic and constrained."""
+    engine, tok = guided_engine
+    req = lambda: GenRequest(  # noqa: E731
+        prompt_ids=tok.encode("t:"), max_new_tokens=16, temperature=0.0,
+        guided=GuidedSpec("regex", "(alpha|beta|gamma)"),
+    )
+    a = _gen(engine, req())
+    b = _gen(engine, req())
+    assert a == b
+    assert _text(tok, a) in ("alpha", "beta", "gamma")
+
+
+def test_validate_rejects_bad_grammars(guided_engine):
+    engine, tok = guided_engine
+    with pytest.raises(ValueError):
+        engine.validate(GenRequest(
+            prompt_ids=[256], guided=GuidedSpec("regex", "(unclosed")
+        ))
+    with pytest.raises(ValueError):
+        engine.validate(GenRequest(
+            prompt_ids=[256], guided=GuidedSpec("json_schema", "{not json")
+        ))
+    with pytest.raises(ValueError):
+        engine.validate(GenRequest(
+            prompt_ids=[256], guided=GuidedSpec("nope", "x")
+        ))
+
+
+def test_engine_without_tokenizer_rejects_guided():
+    bundle = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=257,
+    )
+    with pytest.raises(ValueError):
+        engine.validate(GenRequest(
+            prompt_ids=[256], guided=GuidedSpec("regex", "ab")
+        ))
