@@ -35,6 +35,10 @@ if {force_cpu}:
     import jax
     jax.config.update("jax_platforms", "cpu")
 os.environ["TPUSERVE_STATE_ROOT"] = {state_root!r}
+import jax as _jax  # record the REAL backend for the report artifact
+_d = _jax.devices()[0]
+with open(os.path.join({state_root!r}, "backend.txt"), "w") as _f:
+    _f.write("{{}}:{{}}".format(_d.platform, _d.device_kind))
 import joblib
 from sklearn.datasets import load_iris
 from sklearn.linear_model import LogisticRegression
@@ -120,7 +124,16 @@ def main():
         repo=str(REPO), state_root=state_root, port=PORT,
         force_cpu=force_cpu, preset=preset,
     )
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # env plumbing (hard-won, bench.py module docstring): the TPU registers
+    # as the experimental "axon" platform which jax never auto-selects, so a
+    # --platform default run must INHERIT JAX_PLATFORMS=axon or the router
+    # silently lands on CPU. A cpu-forced run strips it instead — that value
+    # in a child's env has hung sitecustomize while the tunnel is down (the
+    # boot snippet forces cpu in-process).
+    if force_cpu:
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    else:
+        env = dict(os.environ)
     proc = subprocess.Popen(
         [sys.executable, "-c", boot],
         stdout=subprocess.DEVNULL,
@@ -135,8 +148,14 @@ def main():
             sys.exit(1)
 
         base = "http://127.0.0.1:{}".format(PORT)
+        try:
+            with open(os.path.join(state_root, "backend.txt")) as f:
+                backend = f.read().strip()
+        except OSError:
+            backend = "unknown"
         report = {
             "platform": args.platform,
+            "backend": backend,
             "llm_preset": preset,
             "n": args.n,
             "concurrency": args.c,

@@ -21,6 +21,12 @@ while true; do
     echo "$(date -u +%H:%M:%S) battery rc=$rc"
     if [ "$rc" -eq 0 ]; then
         date -u +%FT%TZ >"$OUT/DONE"
+        # battery banked: also capture request-level percentiles on the TPU
+        # (BASELINE.md metric is req/s + p50/p99 TTFT per endpoint; the CPU
+        # artifact exists, this is the TPU counterpart). Best-effort.
+        echo "$(date -u +%H:%M:%S) loadtest (tpu) starting"
+        timeout "${LOADTEST_TIMEOUT:-1200}" python benchmarks/loadtest_report.py \
+            --platform default && echo "loadtest done" || echo "loadtest failed"
         exit 0
     fi
     if [ "$rc" -eq 4 ]; then

@@ -195,6 +195,7 @@ class LLMEngineCore:
         speculation: Optional[str] = None,
         spec_k: int = 4,
         spec_ngram: int = 2,
+        pipeline_chunk: int = 512,
         lora_adapters: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[int] = None,
         prefix_block: int = 64,
@@ -446,6 +447,33 @@ class LLMEngineCore:
             self._prefill_ring_jit = jax.jit(_prefill_ring)
         else:
             self._prefill_ring_jit = None
+
+        # pipeline-parallel prefill over the mesh's pp axis: long prompts
+        # flow through layer-stage slabs as sequence-chunk microbatches
+        # (models/llama.py prefill_pipeline) so all pp groups compute
+        # concurrently instead of all-gathering weights per layer. Gated to
+        # configs the stage body reproduces exactly (no LoRA here: adapter
+        # stacks ride the scanned layer axis the pipeline re-slabs).
+        self._pp = int(dict(mesh.shape).get("pp", 1)) if mesh is not None else 1
+        self._pp_chunk = max(1, int(pipeline_chunk))
+        if (
+            self._pp > 1
+            and getattr(bundle, "prefill_pipeline", None) is not None
+            and bundle.n_layers % self._pp == 0
+            and not lora_adapters
+        ):
+
+            def _prefill_pp(params, tokens, seq_lens, cache_template,
+                            lora_idx=None):
+                assert lora_idx is None
+                return bundle.prefill_pipeline(
+                    params, tokens, seq_lens, cache_template,
+                    stages=self._pp, chunk=self._pp_chunk,
+                )
+
+            self._prefill_pipeline_jit = jax.jit(_prefill_pp)
+        else:
+            self._prefill_pipeline_jit = None
 
         # chunked prefill: bound each admission dispatch to C tokens so
         # decode chunks interleave on the device stream between prompt
@@ -1184,6 +1212,20 @@ class LLMEngineCore:
             self._prefill_ring_jit is not None
             and self._long_threshold < len(ids) <= self._long_cap
         )
+        use_pp = False
+        if (
+            not use_ring
+            and self._prefill_pipeline_jit is not None
+            and len(ids) > self._long_threshold
+        ):
+            pp_bucket = -(-len(ids) // self._pp_chunk) * self._pp_chunk
+            # only pipeline when there are at least as many microbatches as
+            # stages — below that the fill/drain bubble dominates and the
+            # plain bucketed prefill is faster (m=1 would be fully serial)
+            use_pp = (
+                pp_bucket <= self.max_seq_len
+                and pp_bucket // self._pp_chunk >= self._pp
+            )
         if use_ring:
             # sp-sharded long prefill: pad to a multiple of the sp axis,
             # never past the sp-divisible cap
@@ -1191,6 +1233,8 @@ class LLMEngineCore:
                 -(-len(ids) // self._long_step) * self._long_step,
                 self._long_cap,
             )
+        elif use_pp:
+            bucket = pp_bucket  # pipeline pads to whole sequence chunks
         else:
             bucket = self._bucket_for(len(ids))
         tokens = np.zeros((1, bucket), np.int32)
@@ -1221,6 +1265,7 @@ class LLMEngineCore:
         use_chunked = (
             prefix_result is None
             and not use_ring
+            and not use_pp
             and c > 0
             and len(ids) > c
             and chunk_bucket <= self.max_seq_len
@@ -1266,7 +1311,12 @@ class LLMEngineCore:
                 )
             mini_cache = cache
         else:
-            prefill_fn = self._prefill_ring_jit if use_ring else self._prefill_jit
+            if use_ring:
+                prefill_fn = self._prefill_ring_jit
+            elif use_pp:
+                prefill_fn = self._prefill_pipeline_jit
+            else:
+                prefill_fn = self._prefill_jit
             if self._prefill_gate is not None:
                 self._prefill_gate.acquire()
             last_logits, mini_cache = prefill_fn(

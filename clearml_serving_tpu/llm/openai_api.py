@@ -189,6 +189,7 @@ class LLMEngineRequest(BaseEngineRequest):
             speculation=engine_cfg.get("speculation"),
             spec_k=int(engine_cfg.get("spec_k", 4)),
             spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
+            pipeline_chunk=int(engine_cfg.get("pipeline_chunk", 512)),
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),
             prefix_block=int(engine_cfg.get("prefix_block", 64)),

@@ -789,6 +789,137 @@ def build(config: dict) -> SimpleNamespace:
         )
         return last, cache
 
+    def prefill_pipeline(params, tokens: jnp.ndarray, seq_lens: jnp.ndarray,
+                         cache, *, stages: int, chunk: int):
+        """Pipeline-parallel chunked prefill over the mesh's ``pp`` axis.
+
+        TRUE pipeline parallelism (a GPipe-style inference schedule), not
+        just weight-stack sharding: the scan-stacked layers reshape to
+        [stages, L/stages] slabs (the pp-sharded layer axis splits
+        contiguously, so each pp device group holds exactly one slab), the
+        prompt splits into sequence chunks (the microbatches), and chunks
+        flow through stages — at tick t stage s processes chunk t-s, so
+        after the S-tick fill every pp group computes concurrently instead
+        of idling while other groups' layers run. Activations hop stages
+        through a shifted [stages, ...] buffer; XLA lowers the shift across
+        the pp-sharded axis to a collective-permute on ICI. Causality makes
+        sequence chunks valid microbatches: chunk c attends over its
+        stage's cache slab holding chunks 0..c, which necessarily passed
+        through that stage on earlier ticks.
+
+        Scope (callers fall back to prefill_chunk): scan_layers stacked
+        weights, dense KV (no kv_quant), dense FFN (no MoE), no LoRA.
+        Reference parity: vLLM serves pipeline-parallel over NCCL P2P
+        (--pipeline-parallel-size); this is the GSPMD equivalent.
+        """
+        if not scan_layers:
+            raise ValueError("prefill_pipeline requires scan_layers")
+        if kv_quant:
+            raise ValueError("prefill_pipeline does not support kv_quant")
+        if n_experts:
+            raise ValueError("prefill_pipeline does not support MoE")
+        if n_layers % stages:
+            raise ValueError(
+                "stages {} must divide n_layers {}".format(stages, n_layers)
+            )
+        b, s = tokens.shape
+        if s % chunk:
+            raise ValueError("padded length {} not a multiple of chunk {}".format(s, chunk))
+        m = s // chunk
+        lps = n_layers // stages
+        layers_st = jax.tree.map(
+            lambda a: a.reshape((stages, lps) + a.shape[1:]), params["layers"]
+        )
+        max_len = cache["k"].shape[2]
+        kc = cache["k"].reshape(stages, lps, b, max_len, n_kv, head_dim)
+        vc = cache["v"].reshape(stages, lps, b, max_len, n_kv, head_dim)
+        emb_all = _embed(params, tokens)                        # [b, s, d]
+        dim_model = emb_all.shape[-1]
+        x_buf = jnp.zeros((stages, b, chunk, dim_model), emb_all.dtype)
+        out = jnp.zeros((b, s, dim_model), emb_all.dtype)
+        t_idx = jnp.arange(max_len, dtype=jnp.int32)
+
+        def stage_apply(w_slab, x, kc_s, vc_s, c_idx):
+            """One stage's layers over one chunk. c_idx: which chunk this
+            stage holds this tick (may be out of range — the caller masks
+            the cache commit, so clamped garbage writes are discarded)."""
+            start = jnp.clip(c_idx, 0, m - 1) * chunk            # scalar
+            rel = jnp.arange(chunk, dtype=jnp.int32)
+            positions = jnp.broadcast_to(start + rel, (b, chunk))
+            cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+            masks = _build_masks(
+                lambda w: jnp.where(
+                    _visible_w(positions[:, :, None], t_idx[None, None, :], w)
+                    & (t_idx[None, None, :] < seq_lens[:, None, None]),
+                    0.0,
+                    -jnp.inf,
+                ).astype(jnp.float32)[:, None]                   # [b,1,C,T]
+            )
+
+            def layer_body(x, wkv):
+                w_l, k_l, v_l = wkv
+                stash = []
+
+                def attn(layer_, h):
+                    q, k, v = _qkv(layer_, h, cos, sin, None)
+                    k_c = jax.lax.dynamic_update_slice(
+                        k_l, k.astype(k_l.dtype), (0, start, 0, 0)
+                    )
+                    v_c = jax.lax.dynamic_update_slice(
+                        v_l, v.astype(v_l.dtype), (0, start, 0, 0)
+                    )
+                    stash.append((k_c, v_c))
+                    return _attend(q, k_c, v_c, _layer_mask(layer_, masks))
+
+                x = _block(w_l, x, attn, None)
+                return x, stash[0]
+
+            x, (kc_new, vc_new) = jax.lax.scan(
+                layer_body, x, (w_slab, kc_s, vc_s)
+            )
+            return x, kc_new, vc_new
+
+        def tick(t, carry):
+            x_buf, kc, vc, out = carry
+            inj = jax.lax.dynamic_slice(
+                emb_all,
+                (0, jnp.clip(t, 0, m - 1) * chunk, 0),
+                (b, chunk, dim_model),
+            )
+            x_in = jnp.concatenate([inj[None], x_buf[:-1]], axis=0)
+            cs = t - jnp.arange(stages, dtype=jnp.int32)         # [stages]
+            x_out, kc_new, vc_new = jax.vmap(stage_apply)(
+                layers_st, x_in, kc, vc, cs
+            )
+            valid = (cs >= 0) & (cs < m)
+            sel = valid[:, None, None, None, None, None]
+            kc = jnp.where(sel, kc_new, kc)
+            vc = jnp.where(sel, vc_new, vc)
+            # drain: the LAST stage just finished chunk t-(stages-1)
+            c_last = t - (stages - 1)
+            drained = jax.lax.dynamic_update_slice(
+                out,
+                x_out[-1].astype(out.dtype),
+                (0, jnp.clip(c_last, 0, m - 1) * chunk, 0),
+            )
+            out = jnp.where((c_last >= 0) & (c_last < m), drained, out)
+            return x_out, kc, vc, out
+
+        x_buf, kc, vc, out = jax.lax.fori_loop(
+            0, m + stages - 1, lambda t, c: tick(t, c),
+            (x_buf, kc, vc, out),
+        )
+        last_x = jnp.take_along_axis(
+            out, (seq_lens - 1)[:, None, None].clip(0, s - 1), axis=1
+        )                                                        # [b,1,d]
+        last = _logits(params, last_x)[:, 0]
+        new_cache = {
+            "k": kc.reshape(n_layers, b, max_len, n_kv, head_dim),
+            "v": vc.reshape(n_layers, b, max_len, n_kv, head_dim),
+            "length": jnp.maximum(cache["length"], seq_lens).astype(jnp.int32),
+        }
+        return last, new_cache
+
     def verify(params, tokens: jnp.ndarray, cache,
                lora_idx: Optional[jnp.ndarray] = None):
         """Speculative verification: process ``tokens`` [B, S] (the pending
@@ -1065,6 +1196,13 @@ def build(config: dict) -> SimpleNamespace:
         decode=decode,
         verify=verify,
         decode_paged=decode_paged,
+        # pipeline-parallel prefill: gated to configs whose forward the
+        # pipeline stage body reproduces exactly (see prefill_pipeline doc)
+        prefill_pipeline=(
+            prefill_pipeline
+            if (scan_layers and not kv_quant and not n_experts)
+            else None
+        ),
         prepare_params=prepare_params,
         config=cfg,
         head_dim=head_dim,
