@@ -74,6 +74,8 @@ class GenRequest:
     # named LoRA adapter to apply (None = base model); resolved against the
     # engine's adapter registry at validate/admission time
     adapter: Optional[str] = None
+    # vLLM min_tokens: suppress EOS until this many tokens were generated
+    min_tokens: int = 0
     # grammar constraint (llm/guided.py GuidedSpec); compiled at admission,
     # enforced on device inside the decode scan
     guided: Optional[Any] = None
@@ -104,6 +106,11 @@ class GenRequest:
 
 
 _FINISHED = object()
+
+# stop tokens honored by min_tokens suppression per request (requests with
+# more stop ids than this keep finishing on all of them — only the floor's
+# suppression is bounded)
+_STOP_SLOTS = 8
 
 
 class _PrefillGate:
@@ -369,6 +376,11 @@ class LLMEngineCore:
         self._frequency = np.zeros(self.max_batch, np.float32)
         self._repetition = np.ones(self.max_batch, np.float32)
         self._seeds = np.full(self.max_batch, -1, np.int64)
+        self._min_tokens = np.zeros(self.max_batch, np.int32)
+        # per-slot stop-token sets for min_tokens suppression (the same set
+        # _emit finishes on: stop_token_ids or [eos]); -1-padded, first
+        # _STOP_SLOTS honored
+        self._stop_rows = np.full((self.max_batch, _STOP_SLOTS), -1, np.int32)
         self._slot_extra = np.zeros(self.max_batch, bool)
         self._counts_dev = None   # [B, V] int32 generated-token histogram
         self._bias_dev = None     # [B, V] float32 dense logit bias
@@ -871,6 +883,15 @@ class LLMEngineCore:
                     )
         if request.repetition_penalty is not None and request.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if request.min_tokens:
+            if request.min_tokens < 0:
+                raise ValueError("min_tokens must be >= 0")
+            if request.min_tokens > request.max_new_tokens:
+                raise ValueError(
+                    "min_tokens {} exceeds max_tokens {}".format(
+                        request.min_tokens, request.max_new_tokens
+                    )
+                )
         if request.logprobs is not None:
             if request.logprobs < 0:
                 raise ValueError("logprobs must be >= 0")
@@ -1053,6 +1074,17 @@ class LLMEngineCore:
 
     # -- sampling extras (penalties / bias / seeds) -------------------------
 
+    def _request_stop_row(self, request: GenRequest) -> "np.ndarray":
+        """The stop set min_tokens suppresses — identical to what _emit
+        finishes on: stop_token_ids if given, else the engine eos."""
+        ids = request.stop_token_ids or (
+            [self.eos_token_id] if self.eos_token_id is not None else []
+        )
+        row = np.full(_STOP_SLOTS, -1, np.int32)
+        for i, t in enumerate(ids[:_STOP_SLOTS]):
+            row[i] = int(t)
+        return row
+
     @staticmethod
     def _request_has_extras(request: GenRequest) -> bool:
         return bool(
@@ -1061,6 +1093,7 @@ class LLMEngineCore:
             or (request.repetition_penalty and request.repetition_penalty != 1.0)
             or request.seed is not None
             or request.logit_bias
+            or request.min_tokens > 0
         )
 
     def _ensure_extras_state(self) -> None:
@@ -1101,6 +1134,8 @@ class LLMEngineCore:
             bias=self._bias_dev,
             seeds=jnp.asarray(seeds),
             counters=jnp.asarray(produced),
+            min_new=jnp.asarray(self._min_tokens),
+            stop=jnp.asarray(self._stop_rows),
         )
 
     def _bias_pmask_rows(self, request: GenRequest):
@@ -1128,6 +1163,11 @@ class LLMEngineCore:
             bias=jnp.asarray(bias[None]),
             seeds=jnp.asarray([seed], jnp.int32),
             counters=jnp.zeros((1,), jnp.int32),
+            min_new=jnp.asarray(
+                [min(max(0, int(request.min_tokens or 0)), 2**31 - 1)],
+                jnp.int32,
+            ),
+            stop=jnp.asarray(self._request_stop_row(request)[None]),
         )
         return (
             extras,
@@ -1462,6 +1502,10 @@ class LLMEngineCore:
         self._seeds[slot] = (
             -1 if request.seed is None else int(request.seed) & 0x7FFFFFFF
         )
+        self._min_tokens[slot] = min(
+            max(0, int(request.min_tokens or 0)), 2**31 - 1
+        )
+        self._stop_rows[slot] = self._request_stop_row(request)
         if request._guided_key is not None:
             # transfer the grammar ref from the request to the slot; the
             # first token may already have completed the match (terminal)

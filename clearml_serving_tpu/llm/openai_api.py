@@ -302,6 +302,7 @@ class LLMEngineRequest(BaseEngineRequest):
             logit_bias=logit_bias,
             logprobs=logprobs,
             adapter=self._adapter_for(body),
+            min_tokens=int(body.get("min_tokens", 0) or 0),
             guided=self._guided_spec(body),
         )
 
@@ -315,6 +316,18 @@ class LLMEngineRequest(BaseEngineRequest):
 
         from .guided import GuidedSpec
 
+        if body.get("guided_choice"):
+            from .guided import _regex_escape_literal
+
+            choices = body["guided_choice"]
+            if not isinstance(choices, (list, tuple)) or not choices:
+                raise ValueError("guided_choice must be a non-empty list")
+            return GuidedSpec(
+                "regex",
+                "({})".format(
+                    "|".join(_regex_escape_literal(str(c)) for c in choices)
+                ),
+            )
         if body.get("guided_regex"):
             return GuidedSpec("regex", str(body["guided_regex"]))
         if body.get("guided_json") is not None:

@@ -47,6 +47,11 @@ class SamplingExtras(NamedTuple):
     bias: jnp.ndarray        # [B, V] f32 dense additive bias
     seeds: jnp.ndarray       # [B] int32; < 0 => unseeded (shared stream)
     counters: jnp.ndarray    # [B] int32 tokens generated so far (seed stream)
+    # vLLM min_tokens: the request's stop tokens (EOS and stop_token_ids)
+    # are suppressed until `min_new` tokens were generated (None fields
+    # disable — old constructions stay valid)
+    min_new: Optional[jnp.ndarray] = None  # [B] int32; 0 disables
+    stop: Optional[jnp.ndarray] = None     # [B, K] int32, -1-padded
 
 
 def make_sampling_params(batch, temperature=0.0, top_k=0, top_p=1.0):
@@ -86,6 +91,23 @@ def penalize_logits(
             jnp.where(logits > 0, logits / rp, logits * rp),
             logits,
         )
+    if extras.min_new is not None and extras.stop is not None:
+        v_idx = jnp.arange(logits.shape[-1], dtype=jnp.int32)
+        is_stop = jnp.any(
+            v_idx[None, None, :] == extras.stop[:, :, None], axis=1
+        )                                                       # [B, V]
+        # never blank the whole row: when an upstream constraint (a guided
+        # grammar in an accepting-only state) leaves stop tokens as the only
+        # admissible choices, the grammar wins over the min_tokens floor —
+        # suppressing them too would force a grammar-violating sample
+        others_alive = jnp.any(
+            jnp.where(is_stop, -jnp.inf, logits) > jnp.float32(-1e29),
+            axis=-1, keepdims=True,
+        )
+        blocked = (
+            (extras.counters < extras.min_new)[:, None] & is_stop & others_alive
+        )
+        logits = jnp.where(blocked, jnp.float32(-1e30), logits)
     return logits
 
 

@@ -320,6 +320,22 @@ def test_validate_rejects_bad_grammars(guided_engine):
         ))
 
 
+def test_guided_choice_maps_to_regex():
+    from clearml_serving_tpu.llm.openai_api import LLMEngineRequest
+
+    spec = LLMEngineRequest._guided_spec(
+        {"guided_choice": ["yes", "no", "not.sure"]}
+    )
+    assert spec.kind == "regex"
+    dfa = ByteDFA.from_regex(spec.payload)
+    assert dfa.matches(b"yes") and dfa.matches(b"not.sure")
+    assert not dfa.matches(b"notXsure")  # the dot is escaped, not wildcard
+    # empty list is falsy -> unconstrained; non-list is a 4xx
+    assert LLMEngineRequest._guided_spec({"guided_choice": []}) is None
+    with pytest.raises(ValueError):
+        LLMEngineRequest._guided_spec({"guided_choice": "bad"})
+
+
 def test_engine_without_tokenizer_rejects_guided():
     bundle = models.build_model("llama", {"preset": "llama-tiny", "dtype": "float32"})
     params = bundle.init(jax.random.PRNGKey(0))

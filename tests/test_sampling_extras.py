@@ -226,6 +226,88 @@ def test_invalid_logit_bias_rejected(parts):
         engine.stop()
 
 
+def test_min_tokens_math():
+    # eos (col 3) carries the top logit but is suppressed until counters
+    # reach min_new; stop sets are [B, K] -1-padded
+    logits = jnp.asarray([[0.0, 0.0, 0.0, 5.0]] * 2, jnp.float32)
+    ex = _extras(2, 4, counters=jnp.asarray([1, 4], jnp.int32))._replace(
+        min_new=jnp.asarray([3, 3], jnp.int32),
+        stop=jnp.asarray([[3, -1], [3, -1]], jnp.int32),
+    )
+    out = np.asarray(penalize_logits(logits, ex, None, None))
+    assert out[0, 3] < -1e29          # row 0: 1 < 3 -> suppressed
+    assert out[1, 3] == 5.0           # row 1: 4 >= 3 -> allowed
+
+
+def test_min_tokens_suppresses_custom_stop_ids():
+    # both stop tokens (cols 1 and 3) blocked until the floor
+    logits = jnp.zeros((1, 4), jnp.float32)
+    ex = _extras(1, 4, counters=jnp.asarray([0], jnp.int32))._replace(
+        min_new=jnp.asarray([2], jnp.int32),
+        stop=jnp.asarray([[1, 3]], jnp.int32),
+    )
+    out = np.asarray(penalize_logits(logits, ex, None, None))
+    assert out[0, 1] < -1e29 and out[0, 3] < -1e29
+    assert out[0, 0] == 0.0 and out[0, 2] == 0.0
+
+
+def test_min_tokens_never_blanks_constrained_row():
+    """When an upstream constraint (guided grammar at accept) leaves ONLY
+    stop tokens admissible, the floor must yield instead of blanking the
+    row (grammar wins — a blank row would sample a violating token)."""
+    logits = jnp.full((1, 4), -1e30, jnp.float32).at[0, 3].set(1.0)
+    ex = _extras(1, 4, counters=jnp.asarray([0], jnp.int32))._replace(
+        min_new=jnp.asarray([5], jnp.int32),
+        stop=jnp.asarray([[3, -1]], jnp.int32),
+    )
+    out = np.asarray(penalize_logits(logits, ex, None, None))
+    assert out[0, 3] == 1.0  # eos stays available: nothing else is
+
+
+def test_min_tokens_engine_defers_eos(parts):
+    """A logit_bias that makes EOS the greedy pick must not end generation
+    before min_tokens tokens were produced (vLLM min_tokens semantics)."""
+    bundle, params = parts
+    engine = _engine(bundle, params, eos_token_id=257)
+    toks = _gen(
+        engine,
+        prompt_ids=[5, 9, 2],
+        max_new_tokens=8,
+        logit_bias={257: 100.0},       # EOS wins whenever it is allowed
+        min_tokens=4,
+    )
+    engine.stop()
+    # exactly: 4 forced non-eos tokens, then the biased EOS fires
+    assert len(toks) == 5 and toks[-1] == 257
+    assert all(t != 257 for t in toks[:4])
+
+
+def test_min_tokens_suppresses_request_stop_tokens(parts):
+    """Custom stop_token_ids must also respect the floor (vLLM semantics:
+    min_tokens suppresses eos AND stop ids)."""
+    bundle, params = parts
+    engine = _engine(bundle, params, eos_token_id=257)
+    toks = _gen(
+        engine,
+        prompt_ids=[5, 9, 2],
+        max_new_tokens=8,
+        stop_token_ids=[42],
+        logit_bias={42: 100.0},
+        min_tokens=4,
+    )
+    engine.stop()
+    assert len(toks) == 5 and toks[-1] == 42
+    assert all(t != 42 for t in toks[:4])
+
+
+def test_min_tokens_exceeding_max_tokens_rejected(parts):
+    bundle, params = parts
+    engine = _engine(bundle, params, eos_token_id=257)
+    with pytest.raises(ValueError):
+        engine.validate(GenRequest(prompt_ids=[1], max_new_tokens=4, min_tokens=9))
+    engine.stop()
+
+
 def test_paged_cache_with_penalties(parts):
     bundle, params = parts
     engine = _engine(bundle, params, cache_mode="paged", page_size=16)
