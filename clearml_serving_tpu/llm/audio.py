@@ -275,6 +275,37 @@ class AudioCore:
             )
         return out
 
+    @staticmethod
+    def words_from_segments(segments: List[dict]) -> List[dict]:
+        """OpenAI ``timestamp_granularities=["word"]`` payload from decoded
+        segments (each must carry "text"/"start"/"end").
+
+        Word times interpolate each segment's span proportionally to
+        character length — the standard lightweight approximation (exact
+        Whisper word timing needs DTW over cross-attention alignment heads,
+        which the fused decode scan does not emit; segment boundaries remain
+        model-exact timestamp tokens)."""
+        words: List[dict] = []
+        for seg in segments:
+            text = seg.get("text") or ""
+            tokens = text.split()
+            if not tokens:
+                continue
+            span = max(float(seg["end"]) - float(seg["start"]), 0.0)
+            total_chars = sum(len(w) for w in tokens) or 1
+            cursor = float(seg["start"])
+            for w in tokens:
+                dur = span * (len(w) / total_chars)
+                words.append(
+                    {
+                        "word": w,
+                        "start": round(cursor, 2),
+                        "end": round(min(cursor + dur, float(seg["end"])), 2),
+                    }
+                )
+                cursor += dur
+        return words
+
     def _encode_and_prime(self, pcms: List[np.ndarray], prompt: List[int]):
         """Shared admission preamble (caller must hold self._lock): mel
         batch -> encoder -> cache primed with all but the LAST prompt token.

@@ -1150,7 +1150,13 @@ class LLMEngineRequest(BaseEngineRequest):
                 segments = self.audio.parse_segments(windows, duration)
                 for seg in segments:
                     seg["text"] = self.tokenizer.decode(seg["tokens"])
-                out["segments"] = segments
+                granularities = body.get("timestamp_granularities") or ["segment"]
+                if isinstance(granularities, str):
+                    granularities = [granularities]
+                if "segment" in granularities:
+                    out["segments"] = segments
+                if "word" in granularities:
+                    out["words"] = self.audio.words_from_segments(segments)
         return out
 
     async def v1_audio_transcriptions(self, body, state, collect_fn=None):

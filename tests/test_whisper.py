@@ -476,3 +476,64 @@ def test_verbose_json_segments_route(ts_audio_served):
         assert all(t < 350 for t in seg["tokens"]) or seg["tokens"] == []
     # the top-level text contains no marker tokens (they decode per segment)
     assert isinstance(out["text"], str)
+
+
+def test_words_from_segments():
+    from clearml_serving_tpu.llm.audio import AudioCore
+
+    segs = [
+        {"text": "ab cdef", "start": 1.0, "end": 4.0},
+        {"text": "", "start": 4.0, "end": 5.0},       # empty: no words
+        {"text": "x", "start": 5.0, "end": 5.5},
+    ]
+    words = AudioCore.words_from_segments(segs)
+    assert [w["word"] for w in words] == ["ab", "cdef", "x"]
+    # proportional by characters: "ab" gets 2/6 of 3s, "cdef" 4/6
+    assert words[0]["start"] == pytest.approx(1.0)
+    assert words[0]["end"] == pytest.approx(2.0)
+    assert words[1]["start"] == pytest.approx(2.0)
+    assert words[1]["end"] == pytest.approx(4.0)
+    assert words[2]["start"] == pytest.approx(5.0)
+    assert words[2]["end"] == pytest.approx(5.5)
+    # monotone, within-span
+    for w in words:
+        assert w["start"] <= w["end"]
+
+
+def test_word_granularity_route(ts_audio_served):
+    import asyncio
+    import base64
+
+    async def fn():
+        return await ts_audio_served.process_request(
+            "ts_whisper",
+            None,
+            {
+                "file": base64.b64encode(_tone_wav(0.6)).decode(),
+                "response_format": "verbose_json",
+                "timestamp_granularities": ["word", "segment"],
+            },
+            serve_type="v1/audio/transcriptions",
+        )
+
+    out = asyncio.run(fn())
+    assert "segments" in out and "words" in out
+    for w in out["words"]:
+        assert set(w) == {"word", "start", "end"}
+        assert 0.0 <= w["start"] <= w["end"] <= out["duration"] + 1e-6
+
+    # word-only granularity omits segments (OpenAI shape)
+    async def fn2():
+        return await ts_audio_served.process_request(
+            "ts_whisper",
+            None,
+            {
+                "file": base64.b64encode(_tone_wav(0.6)).decode(),
+                "response_format": "verbose_json",
+                "timestamp_granularities": ["word"],
+            },
+            serve_type="v1/audio/transcriptions",
+        )
+
+    out2 = asyncio.run(fn2())
+    assert "words" in out2 and "segments" not in out2
