@@ -892,6 +892,16 @@ class LLMEngineCore:
                         request.min_tokens, request.max_new_tokens
                     )
                 )
+            if len(request.stop_token_ids or []) > _STOP_SLOTS:
+                # suppression rows are fixed-width; an unsuppressed stop id
+                # could end the sequence before the floor (ADVICE r3) —
+                # reject up front instead of silently under-enforcing
+                raise ValueError(
+                    "min_tokens supports at most {} stop_token_ids "
+                    "(got {})".format(
+                        _STOP_SLOTS, len(request.stop_token_ids)
+                    )
+                )
         if request.logprobs is not None:
             if request.logprobs < 0:
                 raise ValueError("logprobs must be >= 0")

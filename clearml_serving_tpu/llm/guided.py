@@ -28,9 +28,15 @@ numpy)--> token table. JSON schemas lower to regexes (Outlines-style);
 ``json_object`` mode uses a bounded-nesting JSON value regex.
 
 Supported regex subset: literals (UTF-8), ``.`` ``|`` ``( )`` ``* + ?``
-``{m}`` ``{m,n}``, classes ``[a-z^...]``, escapes ``\\d \\w \\s \\n \\r
-\\t`` and escaped metacharacters. Anchoring is implicit (whole-string
-match), as is standard for constrained generation.
+``{m}`` ``{m,n}``, classes ``[a-z^...]``, escapes ``\\d \\w \\s \\D \\W
+\\S \\n \\r \\t \\f \\v`` and escaped metacharacters. Anchoring is
+implicit (whole-string match), as is standard for constrained
+generation; a leading ``^`` / trailing ``$`` are accepted as no-ops.
+Anything outside the subset (``\\b`` ``\\B`` ``\\A`` ``\\Z``,
+backreferences, mid-pattern anchors, lookaround) raises RegexError so
+unsupported patterns fail the 4xx pre-flight instead of mis-compiling
+into a grammar that forces literal characters (Outlines/xgrammar treat
+these as anchors/classes; silently diverging would corrupt output).
 """
 
 from __future__ import annotations
@@ -82,10 +88,20 @@ _WORD = (
     | {0x5F}
 )
 _SPACE = frozenset(b" \t\n\r\f\v")
+_ALL = frozenset(range(256))
 
 
 class RegexError(ValueError):
     pass
+
+
+# named escape -> byte set, shared by _escape (pattern level) and
+# _class_atom (inside [...]) so the two can never drift apart
+_ESCAPE_SETS = {"d": _DIGIT, "w": _WORD, "s": _SPACE,
+                "D": _ALL - _DIGIT, "W": _ALL - _WORD, "S": _ALL - _SPACE,
+                "n": frozenset(b"\n"), "r": frozenset(b"\r"),
+                "t": frozenset(b"\t"), "f": frozenset(b"\f"),
+                "v": frozenset(b"\v")}
 
 
 class _Parser:
@@ -163,6 +179,20 @@ class _Parser:
             return self._escape()
         if ch in "*+?{":
             raise RegexError("dangling quantifier at {}".format(self.i))
+        if ch == "^":
+            if self.i == 0:  # leading anchor: no-op, matching is anchored
+                self.i += 1
+                return _Concat([])
+            raise RegexError(
+                "'^' mid-pattern unsupported (matching is whole-string)"
+            )
+        if ch == "$":
+            if self.i == self.n - 1:  # trailing anchor: no-op
+                self.i += 1
+                return _Concat([])
+            raise RegexError(
+                "'$' mid-pattern unsupported (matching is whole-string)"
+            )
         self.i += 1
         data = ch.encode("utf-8")
         if len(data) == 1:
@@ -175,22 +205,20 @@ class _Parser:
             raise RegexError("trailing backslash")
         ch = self.p[self.i]
         self.i += 1
-        table = {"d": _DIGIT, "w": _WORD, "s": _SPACE,
-                 "n": frozenset(b"\n"), "r": frozenset(b"\r"),
-                 "t": frozenset(b"\t")}
-        if ch in table:
-            return _Lit(table[ch])
+        if ch in _ESCAPE_SETS:
+            return _Lit(_ESCAPE_SETS[ch])
         if ch == "x":  # \xNN byte escape
             hexpair = self.p[self.i : self.i + 2]
             if len(hexpair) != 2:
                 raise RegexError("truncated \\x escape")
             self.i += 2
             return _Lit(frozenset([int(hexpair, 16)]))
+        if ch.isalnum():  # \b \B \A \Z, backrefs, \p{..}: NOT literals
+            raise RegexError(
+                "unsupported escape \\{} (outside the guided-regex "
+                "subset)".format(ch)
+            )
         return _Lit(frozenset(ch.encode("utf-8")[:1]))
-
-    _CLASS_SETS = {"d": _DIGIT, "w": _WORD, "s": _SPACE,
-                   "n": frozenset(b"\n"), "r": frozenset(b"\r"),
-                   "t": frozenset(b"\t")}
 
     def _class_atom(self):
         """One class member: a byte value, or a named set (returns a set)."""
@@ -198,14 +226,18 @@ class _Parser:
             self.i += 1
             ch = self.p[self.i]
             self.i += 1
-            if ch in self._CLASS_SETS:
-                return self._CLASS_SETS[ch]
+            if ch in _ESCAPE_SETS:
+                return _ESCAPE_SETS[ch]
             if ch == "x":
                 hexpair = self.p[self.i : self.i + 2]
                 if len(hexpair) != 2:
                     raise RegexError("truncated \\x escape in class")
                 self.i += 2
                 return int(hexpair, 16)
+            if ch.isalnum():
+                raise RegexError(
+                    "unsupported escape \\{} in character class".format(ch)
+                )
             return ch.encode("utf-8")[0]
         enc = self.p[self.i].encode("utf-8")
         if len(enc) != 1:
@@ -328,8 +360,19 @@ class ByteDFA:
         return self.trans.shape[0]
 
     @classmethod
-    def from_regex(cls, pattern: str, max_states: int = 4096) -> "ByteDFA":
+    def from_regex(
+        cls,
+        pattern: str,
+        max_states: int = 4096,
+        allow_leading_space: bool = False,
+    ) -> "ByteDFA":
+        """``allow_leading_space`` prepends an optional ' ' at the AST
+        level (SPM detokenization strips it) — string-level wrapping would
+        push a user's no-op leading '^' / trailing '$' into mid-pattern
+        position and fail patterns the pre-flight already accepted."""
         ast = _Parser(pattern).parse()
+        if allow_leading_space:
+            ast = _Concat([_Repeat(_Lit(frozenset([0x20])), 0, 1), ast])
         nfa = _NFA()
         start, accept = nfa.build(ast)
 
@@ -519,6 +562,10 @@ def token_byte_table(tokenizer, vocab_size: int) -> List[Optional[bytes]]:
     specials |= set(getattr(hf, "all_special_ids", None) or [])
     pieces = hf.convert_ids_to_tokens(list(range(vocab_size)))
     spm = any(p is not None and "▁" in p for p in pieces)
+    try:  # share the probe with _is_spm_tokenizer: one O(V) walk, one truth
+        tokenizer._spm_convention = spm
+    except Exception:
+        pass
     bd = _gpt2_byte_decoder()
     for i, p in enumerate(pieces):
         if i in specials or p is None:
@@ -606,7 +653,9 @@ def json_schema_to_regex(schema: dict, depth: int = 4) -> str:
         return r"\[" + _WS + body + _WS + r"\]"
     if t == "object" or "properties" in schema:
         props = schema.get("properties", {})
-        required = set(schema.get("required", list(props)))
+        # JSON Schema semantics (and Outlines): absent `required` means NO
+        # property is required, not all of them (ADVICE r3)
+        required = set(schema.get("required") or [])
         pieces = [
             (
                 '"{}":{}{}'.format(
@@ -636,13 +685,19 @@ def json_schema_to_regex(schema: dict, depth: int = 4) -> str:
                     out.append("({}{})?".format(comma, p))
             body = "".join(out)
         elif pieces:
-            # all optional: suffix alternation — tail_i = "a member list
-            # starting at property i"; each p_i may be followed by any
-            # later-starting tail, commas always between members
-            tail = pieces[-1][0]
-            for p, _r in reversed(pieces[:-1]):
-                tail = "({}({}({}))?|{})".format(p, comma, tail, tail)
-            body = "({})?".format(tail)
+            # all optional: alternation over the FIRST present property;
+            # every later property then optionally follows with a leading
+            # comma. Quadratic pattern size (sum of suffix lengths) — the
+            # previous suffix-recursion duplicated the tail twice per
+            # property, i.e. exponential, and a ~28-optional-property
+            # schema could OOM the pre-flight (r4 code review)
+            alts = []
+            for i, (p, _r) in enumerate(pieces):
+                rest = "".join(
+                    "({}{})?".format(comma, q) for q, _r2 in pieces[i + 1 :]
+                )
+                alts.append(p + rest)
+            body = "({})?".format("|".join(alts))
         else:
             body = ""
         return r"\{" + _WS + body + _WS + r"\}"
@@ -747,6 +802,32 @@ def build_token_byte_arrays(
     return tb, tl
 
 
+def _is_spm_tokenizer(tokenizer, vocab_size: int) -> bool:
+    """True for SentencePiece-convention tokenizers (pieces use '▁' word
+    markers and decode strips the sequence-leading space). Byte-level BPE
+    (GPT-2/Llama-3 alphabet) returns False: there decode PRESERVES the
+    leading space, so the grammar must not admit one.
+
+    Uses the SAME vocab probe as token_byte_table (any '▁' piece) so the
+    grammar's leading-space branch and the byte table can never disagree;
+    the O(V) walk is cached on the tokenizer wrapper."""
+    hf = getattr(tokenizer, "_tok", None)
+    if hf is None:
+        return False
+    flag = getattr(tokenizer, "_spm_convention", None)
+    if flag is None:
+        try:
+            pieces = hf.convert_ids_to_tokens(list(range(vocab_size)))
+            flag = any(p is not None and "▁" in p for p in pieces)
+        except Exception:
+            flag = False
+        try:
+            tokenizer._spm_convention = flag
+        except Exception:
+            pass
+    return flag
+
+
 def compile_guided(
     spec: GuidedSpec, tokenizer, vocab_size: int, eos_id: int,
     max_states: int = 8192, max_token_bytes: int = 16,
@@ -762,7 +843,17 @@ def compile_guided(
         pattern = json_object_regex(3)
     else:
         raise RegexError("unknown guided kind {!r}".format(spec.kind))
-    dfa = ByteDFA.from_regex(pattern, max_states=max_states)
+    # SentencePiece detokenization strips one leading space ('▁word' at
+    # sequence start decodes to "word"), so the natural word-start pieces
+    # contribute " word" bytes and a grammar anchored at string start
+    # would steer the model away from its highest-probability tokenization
+    # (ADVICE r3). Allow exactly one optional leading space: it vanishes
+    # in decode, so emitted text still matches the original pattern.
+    dfa = ByteDFA.from_regex(
+        pattern,
+        max_states=max_states,
+        allow_leading_space=_is_spm_tokenizer(tokenizer, vocab_size),
+    )
     if token_bytes is None:
         token_bytes = token_byte_table(tokenizer, vocab_size)
     tokens = list(token_bytes)

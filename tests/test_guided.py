@@ -54,6 +54,55 @@ def test_regex_errors():
             ByteDFA.from_regex(bad)
 
 
+def test_regex_anchors_and_complement_escapes():
+    """ADVICE r3: a leading '^' / trailing '$' are no-ops under implicit
+    whole-string anchoring (vLLM users write r'\\d+$'); everything else
+    outside the subset must fail pre-flight instead of mis-compiling into
+    literal characters."""
+    dfa = ByteDFA.from_regex(r"^\d+$")
+    assert dfa.matches(b"42")
+    assert not dfa.matches(b"42$")  # '$' is NOT forced into the output
+    assert not dfa.matches(b"^42")
+    dfa = ByteDFA.from_regex(r"\D+")
+    assert dfa.matches(b"ab!")
+    assert not dfa.matches(b"a1")
+    assert ByteDFA.from_regex(r"[\S]+").matches(b"x.y")
+    assert not ByteDFA.from_regex(r"\W").matches(b"a")
+    for bad in [r"\bword\b", r"a\Z", r"\Aa", r"(a)\1", "a$b", "a^b",
+                r"[\b]", r"\p{L}"]:
+        with pytest.raises(RegexError):
+            ByteDFA.from_regex(bad)
+
+
+def test_json_schema_absent_required_means_all_optional():
+    """ADVICE r3: JSON Schema semantics — absent `required` requires
+    nothing (was: everything)."""
+    schema = {
+        "type": "object",
+        "properties": {"x": {"type": "integer"}, "y": {"type": "integer"}},
+    }
+    dfa = ByteDFA.from_regex(json_schema_to_regex(schema))
+    for ok in [{}, {"x": 1}, {"y": 2}, {"x": 1, "y": 2}]:
+        assert dfa.matches(json.dumps(ok, separators=(",", ":")).encode()), ok
+
+
+def test_json_schema_many_optional_properties_stays_polynomial():
+    """r4 code review: the all-optional encoding must not be exponential —
+    a ~28-property schema used to build a multi-GB regex in pre-flight."""
+    n = 24
+    schema = {
+        "type": "object",
+        "properties": {"p{}".format(i): {"type": "integer"} for i in range(n)},
+    }
+    pattern = json_schema_to_regex(schema)
+    assert len(pattern) < 200_000
+    dfa = ByteDFA.from_regex(pattern, max_states=16384)
+    for ok in [{}, {"p0": 1}, {"p3": 1, "p17": 2}, {"p23": 9}]:
+        assert dfa.matches(json.dumps(ok, separators=(",", ":")).encode()), ok
+    assert not dfa.matches(b'{"p1":1"p2":2}')   # missing comma
+    assert not dfa.matches(b'{"p2":2,"p1":1}')  # out of declaration order
+
+
 def test_json_schema_regex_roundtrip():
     schema = {
         "type": "object",
@@ -155,6 +204,39 @@ def test_token_byte_table_sentencepiece_convention():
     assert table[2] == b" world"
     assert table[3] == b"\n"
     assert table[4] == b"ab"
+
+
+def test_spm_grammar_admits_word_start_piece():
+    """ADVICE r3: on SentencePiece tokenizers the natural word-start piece
+    ('▁north' -> b' north') must satisfy a grammar anchored at string start
+    (decode strips the sequence-leading space), so compile_guided adds an
+    optional leading-space branch — for SPM only."""
+    pieces = ["<s>", "</s>", "▁north", "north", "n", "orth", "▁"]
+    tok = _StubTokenizer(pieces, [0, 1])
+    g = compile_guided(
+        GuidedSpec(kind="regex", payload="north"), tok, len(pieces), eos_id=1
+    )
+    def allowed(gram, tid):
+        return bool(gram.mask_bits[0, tid // 8] >> (tid % 8) & 1)
+    assert allowed(g, 2)   # '▁north' (" north") admitted at start
+    assert allowed(g, 3)   # plain 'north' still admitted
+    assert not allowed(g, 5)  # 'orth' still not a valid start
+
+    # the space branch is added at the AST level, so a user's no-op
+    # anchors survive SPM wrapping (r4 code review)
+    g_anchored = compile_guided(
+        GuidedSpec(kind="regex", payload=r"^north$"), tok, len(pieces),
+        eos_id=1,
+    )
+    assert allowed(g_anchored, 2)
+
+    # byte-level BPE decode PRESERVES a leading space: no branch added
+    bpe = _StubTokenizer(["<s>", "</s>", "Ġnorth", "north"], [0, 1])
+    g2 = compile_guided(
+        GuidedSpec(kind="regex", payload="north"), bpe, 4, eos_id=1
+    )
+    assert not allowed(g2, 2)  # ' north' would corrupt byte-level output
+    assert allowed(g2, 3)
 
 
 def test_token_byte_table_byte_level_convention():

@@ -308,6 +308,27 @@ def test_min_tokens_exceeding_max_tokens_rejected(parts):
     engine.stop()
 
 
+def test_min_tokens_with_too_many_stop_ids_rejected(parts):
+    """ADVICE r3: suppression rows hold _STOP_SLOTS ids; rather than
+    silently under-enforcing the floor on the overflow ids, validate()
+    rejects the combination up front."""
+    bundle, params = parts
+    engine = _engine(bundle, params, eos_token_id=257)
+    many = list(range(100, 109))  # 9 > _STOP_SLOTS (8)
+    with pytest.raises(ValueError):
+        engine.validate(
+            GenRequest(
+                prompt_ids=[1], max_new_tokens=8, min_tokens=2,
+                stop_token_ids=many,
+            )
+        )
+    # without a floor the same stop set remains fine
+    engine.validate(
+        GenRequest(prompt_ids=[1], max_new_tokens=8, stop_token_ids=many)
+    )
+    engine.stop()
+
+
 def test_paged_cache_with_penalties(parts):
     bundle, params = parts
     engine = _engine(bundle, params, cache_mode="paged", page_size=16)
