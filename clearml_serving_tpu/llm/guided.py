@@ -651,6 +651,11 @@ def json_schema_to_regex(schema: dict, depth: int = 4) -> str:
                 i=item, w=_WS, m=lo - 1, n=max(hi - 1, lo - 1)
             )
         return r"\[" + _WS + body + _WS + r"\]"
+    if t == "object" and "properties" not in schema:
+        # no declared properties = ANY object (JSON Schema), not the empty
+        # object: lower to a bounded any-object like json_object mode
+        _arr, obj = _json_container_regexes(json_value_regex(min(depth, 2)))
+        return obj
     if t == "object" or "properties" in schema:
         props = schema.get("properties", {})
         # JSON Schema semantics (and Outlines): absent `required` means NO

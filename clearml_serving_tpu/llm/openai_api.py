@@ -19,7 +19,7 @@ import asyncio
 import json
 import time
 import uuid
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from ..engines.base import BaseEngineRequest, EndpointModelError, register_engine
 from ..serving.responses import StreamingOutput
@@ -53,6 +53,9 @@ class LLMEngineRequest(BaseEngineRequest):
         self.audio = None
         self.tokenizer = None
         self._model_name = "model"
+        # aux engine.chat block (reference vLLM chat_settings:
+        # examples/vllm/preprocess.py:14-33): response_role etc.
+        self._chat_cfg: Dict[str, Any] = {}
         super().__init__(*args, **kwargs)
 
     # -- loading --------------------------------------------------------------
@@ -67,6 +70,7 @@ class LLMEngineRequest(BaseEngineRequest):
         enable_persistent_compilation_cache()
         aux = self.endpoint.auxiliary_cfg if isinstance(self.endpoint.auxiliary_cfg, dict) else {}
         engine_cfg = dict(aux.get("engine") or {})
+        self._chat_cfg = dict(engine_cfg.get("chat") or {})
 
         # multi-LoRA (reference vLLM knob `lora_modules`,
         # preprocess_service.py:740-767): aux engine.lora = {"modules":
@@ -272,7 +276,10 @@ class LLMEngineRequest(BaseEngineRequest):
         return None
 
     def _gen_request_from_body(self, body: Dict[str, Any], prompt_ids: List[int],
-                               chat: bool = True):
+                               chat: bool = True, guided_override=None):
+        """``guided_override``: a GuidedSpec that supersedes the body's own
+        response_format/guided_* (tool_choice required/forced compiles the
+        tool-call JSON into the grammar)."""
         from .engine import GenRequest
 
         logit_bias = body.get("logit_bias") or None
@@ -289,7 +296,7 @@ class LLMEngineRequest(BaseEngineRequest):
         else:
             raw_lp = body.get("logprobs")
             logprobs = int(raw_lp) if raw_lp is not None and raw_lp is not False else None
-        return GenRequest(
+        request = GenRequest(
             prompt_ids=prompt_ids,
             max_new_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or 128),
             temperature=float(body.get("temperature", 0.0) or 0.0),
@@ -303,8 +310,12 @@ class LLMEngineRequest(BaseEngineRequest):
             logprobs=logprobs,
             adapter=self._adapter_for(body),
             min_tokens=int(body.get("min_tokens", 0) or 0),
-            guided=self._guided_spec(body),
+            guided=guided_override or self._guided_spec(body),
         )
+        # vLLM `return_tokens_as_token_ids`: logprob token strings become
+        # "token_id:<id>" (API-layer formatting, so not a GenRequest field)
+        request.tokens_as_ids = bool(body.get("return_tokens_as_token_ids"))
+        return request
 
     @staticmethod
     def _guided_spec(body: Dict[str, Any]):
@@ -334,7 +345,10 @@ class LLMEngineRequest(BaseEngineRequest):
             schema = body["guided_json"]
             if isinstance(schema, str):
                 schema = _json.loads(schema)
-            return GuidedSpec("json_schema", _json.dumps(schema, sort_keys=True))
+            # NO sort_keys: property DECLARATION order is part of the
+            # grammar (json_schema_to_regex emits members in order);
+            # sorting would reorder the forced output's keys
+            return GuidedSpec("json_schema", _json.dumps(schema))
         rf = body.get("response_format")
         if not rf:
             return None
@@ -347,13 +361,13 @@ class LLMEngineRequest(BaseEngineRequest):
             schema = (rf.get("json_schema") or {}).get("schema")
             if schema is None:
                 raise ValueError("response_format.json_schema.schema missing")
-            return GuidedSpec("json_schema", _json.dumps(schema, sort_keys=True))
+            return GuidedSpec("json_schema", _json.dumps(schema))
         if kind in (None, "text"):
             return None
         raise ValueError("unsupported response_format type {!r}".format(kind))
 
     def _n_requests(self, body: Dict[str, Any], prompt_ids: List[int],
-                    chat: bool = True):
+                    chat: bool = True, guided_override=None):
         """OpenAI `n` choices: n independent requests through the continuous
         batch; seeded requests offset the seed per choice so choices differ."""
         n = int(body.get("n", 1) or 1)
@@ -361,7 +375,10 @@ class LLMEngineRequest(BaseEngineRequest):
             raise ValueError("n must be >= 1")
         requests = []
         for i in range(n):
-            r = self._gen_request_from_body(body, list(prompt_ids), chat=chat)
+            r = self._gen_request_from_body(
+                body, list(prompt_ids), chat=chat,
+                guided_override=guided_override,
+            )
             if r.seed is not None and i:
                 r.seed = r.seed + i
             requests.append(r)
@@ -540,16 +557,22 @@ class LLMEngineRequest(BaseEngineRequest):
     def _token_str(self, tid: int) -> str:
         return self.tokenizer.decode([int(tid)])
 
-    def _chat_lp_entries(self, entries: List[dict], k: int) -> List[dict]:
+    def _token_repr(self, tid: int, as_ids: bool) -> str:
+        """vLLM return_tokens_as_token_ids: "token_id:<id>" instead of the
+        decoded piece (lets callers distinguish tokens that decode alike)."""
+        return "token_id:{}".format(int(tid)) if as_ids else self._token_str(tid)
+
+    def _chat_lp_entries(self, entries: List[dict], k: int,
+                         as_ids: bool = False) -> List[dict]:
         """Chat-shape logprob items from engine entries ({"id", "logprob",
         "top_ids", "top_logprobs"}); shared by the streaming chunks and the
         final response."""
         content = []
         for entry in entries:
-            tok = self._token_str(entry["id"])
+            tok = self._token_repr(entry["id"], as_ids)
             tops = []
             for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k]):
-                ts = self._token_str(t)
+                ts = self._token_repr(t, as_ids)
                 tops.append(
                     {"token": ts, "logprob": lp, "bytes": list(ts.encode("utf-8"))}
                 )
@@ -566,34 +589,43 @@ class LLMEngineRequest(BaseEngineRequest):
     def _chat_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
         return {
             "content": self._chat_lp_entries(
-                request.logprob_entries[: len(ids)], int(request.logprobs or 0)
+                request.logprob_entries[: len(ids)], int(request.logprobs or 0),
+                as_ids=getattr(request, "tokens_as_ids", False),
             )
         }
 
-    def _completion_lp_entries(self, entries: List[dict], k: int,
-                               offset: int = 0) -> Dict[str, Any]:
+    def _completion_lp_entries(
+        self, entries: List[dict], k: int, offset: int = 0,
+        as_ids: bool = False,
+    ) -> Tuple[Dict[str, Any], int]:
+        """-> (logprobs dict, next text offset). text_offset tracks the
+        EMITTED text even in token_id mode, so each token decodes once."""
         tokens, token_logprobs, top_logprobs, offsets = [], [], [], []
         for entry in entries:
-            tok = self._token_str(entry["id"])
-            tokens.append(tok)
+            decoded = self._token_str(entry["id"])
+            tokens.append(
+                "token_id:{}".format(int(entry["id"])) if as_ids else decoded
+            )
             token_logprobs.append(entry["logprob"])
             tops = {}
             for t, lp in zip(entry["top_ids"][:k], entry["top_logprobs"][:k]):
-                tops[self._token_str(t)] = lp
+                tops[self._token_repr(t, as_ids)] = lp
             top_logprobs.append(tops)
             offsets.append(offset)
-            offset += len(tok)
+            offset += len(decoded)
         return {
             "tokens": tokens,
             "token_logprobs": token_logprobs,
             "top_logprobs": top_logprobs,
             "text_offset": offsets,
-        }
+        }, offset
 
     def _completion_logprobs(self, request, ids: List[int]) -> Dict[str, Any]:
-        return self._completion_lp_entries(
-            request.logprob_entries[: len(ids)], int(request.logprobs or 0)
+        lp, _ = self._completion_lp_entries(
+            request.logprob_entries[: len(ids)], int(request.logprobs or 0),
+            as_ids=getattr(request, "tokens_as_ids", False),
         )
+        return lp
 
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
@@ -616,9 +648,41 @@ class LLMEngineRequest(BaseEngineRequest):
             )
 
     async def v1_chat_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
+        from .tools import (
+            TOOL_TAG,
+            parse_tool_calls,
+            render_chat_with_tools,
+            resolve_tool_choice,
+            split_tag_holdback,
+            strip_tool_blocks,
+            tool_call_objects,
+            tool_call_schema,
+            validate_tools,
+        )
+
         self._require_engine("v1/chat/completions")
         messages = body.get("messages") or []
-        prompt = self.tokenizer.apply_chat_template(messages)
+        tool_mode, forced_tool = resolve_tool_choice(body)
+        # OpenAI semantics: tool_choice "none" only prevents CALLING — the
+        # definitions stay visible in the prompt (multi-turn histories
+        # reference them); only parsing/constraint is disabled
+        tools_render = validate_tools(body["tools"]) if body.get("tools") else []
+        tools = tools_render if tool_mode != "none" else []
+        tool_names = [t["name"] for t in tools]
+        guided_override = None
+        if tool_mode in ("required", "forced"):
+            # arguments enforced BY CONSTRUCTION: the tool-call JSON
+            # compiles into the on-device decode grammar (llm/guided.py)
+            from .guided import GuidedSpec
+
+            # no sort_keys: the grammar must force name BEFORE arguments
+            # (sorting would make the model commit arguments first — in
+            # multi-tool required mode, before the tool is even pinned)
+            guided_override = GuidedSpec(
+                "json_schema",
+                json.dumps(tool_call_schema(tools, forced_tool)),
+            )
+        prompt = render_chat_with_tools(self.tokenizer, messages, tools_render)
         # encode_chat: no special-token re-add — HF chat templates already
         # emit BOS in the template text (double-BOS degrades fidelity)
         prompt_ids = self.tokenizer.encode_chat(prompt)
@@ -626,55 +690,194 @@ class LLMEngineRequest(BaseEngineRequest):
         model = body.get("model", self._model_name)
         completion_id = _gen_id("chatcmpl")
         created = _now()
+        # vLLM `response_role`: request body overrides the endpoint's
+        # aux-config chat block; default matches OpenAI ("assistant")
+        role = str(
+            body.get("response_role")
+            or self._chat_cfg.get("response_role")
+            or "assistant"
+        )
+        include_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage")
+        )
+
+        def chat_chunk(choice, usage="omit"):
+            chunk = {
+                "id": completion_id, "object": "chat.completion.chunk",
+                "created": created, "model": model,
+                "choices": [choice] if choice is not None else [],
+            }
+            if include_usage:
+                # OpenAI stream_options semantics: every chunk carries
+                # usage: null; one final choices-less chunk carries totals
+                chunk["usage"] = None if usage == "omit" else usage
+            return "data: {}\n\n".format(json.dumps(chunk))
 
         if body.get("stream"):
             if int(body.get("n", 1) or 1) != 1:
                 raise EndpointModelError("streaming supports a single choice (n=1)")
-            request = self._gen_request_from_body(body, prompt_ids)
+            request = self._gen_request_from_body(
+                body, prompt_ids, guided_override=guided_override
+            )
             # validate BEFORE returning the stream — a late ValueError would
             # abort mid-SSE after the 200 headers are already sent
             self.engine.validate(request)
+            # required/forced always buffers (output IS a tool call); auto
+            # sniffs the first text for a call-shaped prefix and buffers
+            # only then, so plain answers still stream token by token
+            buffer_all = tool_mode in ("required", "forced")
+            sniffing = tool_mode == "auto" and bool(tools)
+
+            def call_prefix(text):
+                """Could `text` still grow into a tool call? -> 'yes'
+                (buffer to end), 'maybe' (keep sniffing), 'no' (flush)."""
+                s = text.lstrip()
+                if not s:
+                    return "maybe"
+                if s.startswith(("{", "[", "<tool_call>")):
+                    return "yes"
+                if "<tool_call>".startswith(s):
+                    return "maybe"
+                return "no"
 
             async def sse():
+                # mode machine: "buffer" = withholding a (suspected or
+                # certain) tool call to stream end; "sniff" = deciding from
+                # the first text; "watch" = streaming live but holding back
+                # a potential <tool_call> tag (hermes models narrate BEFORE
+                # calling, so tags can appear mid-answer); "stream" = plain.
+                mode = "buffer" if buffer_all else (
+                    "sniff" if sniffing else "stream"
+                )
+                held: List[str] = []      # text awaiting the decision
+                stashed: List[dict] = []  # logprob entries withheld with it
+                watch_pending = ""        # tag holdback in watch mode
+
+                def lp(entries):
+                    return {"content": self._chat_lp_entries(
+                        entries, int(request.logprobs or 0),
+                        as_ids=getattr(request, "tokens_as_ids", False),
+                    )}
+
+                def content_chunk(text, entries):
+                    choice = {"index": 0, "delta": {"content": text},
+                              "finish_reason": None}
+                    if entries:
+                        # withheld entries attach to the chunk that finally
+                        # emits their text — every entry is delivered once
+                        choice["logprobs"] = lp(entries)
+                    return chat_chunk(choice)
+
+                def watch_emit(text):
+                    """Emittable prefix of `text`; switches to buffer mode
+                    when a full tool tag appears, holds back partial tags."""
+                    nonlocal mode, watch_pending, held
+                    watch_pending += text
+                    idx = watch_pending.find(TOOL_TAG)
+                    if idx >= 0:
+                        emit = watch_pending[:idx]
+                        held = [watch_pending[idx:]]
+                        watch_pending = ""
+                        mode = "buffer"
+                        return emit
+                    emit, watch_pending = split_tag_holdback(watch_pending)
+                    return emit
+
                 try:
-                    first = {
-                        "id": completion_id, "object": "chat.completion.chunk",
-                        "created": created, "model": model,
-                        "choices": [{"index": 0, "delta": {"role": "assistant"},
-                                     "finish_reason": None}],
-                    }
-                    yield "data: {}\n\n".format(json.dumps(first))
+                    yield chat_chunk({"index": 0,
+                                      "delta": {"role": role},
+                                      "finish_reason": None})
                     try:
                         async for piece in self._stream_deltas(request, stops):
+                            entries = piece.get("entries") or []
+                            if mode in ("buffer", "sniff"):
+                                held.append(piece["delta"])
+                                stashed.extend(entries)
+                                if mode == "sniff":
+                                    verdict = call_prefix("".join(held))
+                                    if verdict == "no":
+                                        mode = "watch"
+                                        text, held = "".join(held), []
+                                        emit = watch_emit(text)
+                                        if emit:
+                                            yield content_chunk(emit, stashed)
+                                            stashed = []
+                                continue
+                            if mode == "watch":
+                                emit = watch_emit(piece["delta"])
+                                stashed.extend(entries)
+                                if emit:
+                                    yield content_chunk(emit, stashed)
+                                    stashed = []
+                                continue
                             choice = {"index": 0,
                                       "delta": {"content": piece["delta"]},
                                       "finish_reason": None}
                             if piece.get("entries") is not None:
-                                choice["logprobs"] = {
-                                    "content": self._chat_lp_entries(
-                                        piece["entries"],
-                                        int(request.logprobs or 0),
-                                    )
-                                }
-                            chunk = {
-                                "id": completion_id, "object": "chat.completion.chunk",
-                                "created": created, "model": model,
-                                "choices": [choice],
-                            }
-                            yield "data: {}\n\n".format(json.dumps(chunk))
+                                choice["logprobs"] = lp(piece["entries"])
+                            yield chat_chunk(choice)
                     except Exception as ex:
                         yield "data: {}\n\n".format(json.dumps(
                             {"error": {"message": str(ex), "type": type(ex).__name__}}
                         ))
                         yield "data: [DONE]\n\n"
                         return
-                    done = {
-                        "id": completion_id, "object": "chat.completion.chunk",
-                        "created": created, "model": model,
-                        "choices": [{"index": 0, "delta": {},
-                                     "finish_reason": self._finish_reason(request)}],
-                    }
-                    yield "data: {}\n\n".format(json.dumps(done))
+                    finish = self._finish_reason(request)
+                    text = "".join(held) + watch_pending
+                    calls = (
+                        parse_tool_calls(text, tool_names)
+                        if text and tools and finish != "length"
+                        else None
+                    )
+                    if calls:
+                        # prose around <tool_call> blocks still streams as
+                        # content (OpenAI allows content + tool_calls)
+                        prose = (
+                            strip_tool_blocks(text)
+                            if TOOL_TAG in text else ""
+                        )
+                        if prose:
+                            yield content_chunk(prose, stashed)
+                            stashed = []
+                        for ci, tc in enumerate(tool_call_objects(calls)):
+                            first = {
+                                "index": 0,
+                                "delta": {"tool_calls": [{
+                                    "index": ci, "id": tc["id"],
+                                    "type": "function",
+                                    "function": {
+                                        "name": tc["function"]["name"],
+                                        "arguments": "",
+                                    },
+                                }]},
+                                "finish_reason": None,
+                            }
+                            if ci == 0 and stashed:
+                                first["logprobs"] = lp(stashed)
+                                stashed = []
+                            yield chat_chunk(first)
+                            yield chat_chunk({
+                                "index": 0,
+                                "delta": {"tool_calls": [{
+                                    "index": ci,
+                                    "function": {"arguments":
+                                                 tc["function"]["arguments"]},
+                                }]},
+                                "finish_reason": None,
+                            })
+                        finish = "tool_calls"
+                    elif text:
+                        yield content_chunk(text, stashed)
+                        stashed = []
+                    yield chat_chunk({"index": 0, "delta": {},
+                                      "finish_reason": finish})
+                    if include_usage:
+                        yield chat_chunk(None, usage={
+                            "prompt_tokens": request.prompt_len,
+                            "completion_tokens": request.produced,
+                            "total_tokens": request.prompt_len
+                            + request.produced,
+                        })
                     yield "data: [DONE]\n\n"
                 finally:
                     # runs on normal completion AND on client disconnect
@@ -685,7 +888,8 @@ class LLMEngineRequest(BaseEngineRequest):
 
             return StreamingOutput(sse())
 
-        requests = self._n_requests(body, prompt_ids)
+        requests = self._n_requests(body, prompt_ids,
+                                    guided_override=guided_override)
         results = await asyncio.gather(
             *[self._collect_text(r, stops) for r in requests]
         )
@@ -695,7 +899,7 @@ class LLMEngineRequest(BaseEngineRequest):
         for i, (r, res) in enumerate(zip(requests, results)):
             choice = {
                 "index": i,
-                "message": {"role": "assistant", "content": res["text"]},
+                "message": {"role": role, "content": res["text"]},
                 "finish_reason": res["finish_reason"],
                 "logprobs": (
                     self._chat_logprobs(r, res["ids"])
@@ -703,6 +907,21 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
+            if tools and res["finish_reason"] != "length":
+                calls = parse_tool_calls(res["text"], tool_names)
+                if calls:
+                    # hermes-style prose around the <tool_call> blocks is
+                    # kept as content (OpenAI allows content + tool_calls)
+                    prose = (
+                        strip_tool_blocks(res["text"])
+                        if TOOL_TAG in res["text"] else ""
+                    )
+                    choice["message"] = {
+                        "role": role,
+                        "content": prose or None,
+                        "tool_calls": tool_call_objects(calls),
+                    }
+                    choice["finish_reason"] = "tool_calls"
             choices.append(choice)
         return {
             "id": completion_id,
@@ -764,43 +983,53 @@ class LLMEngineRequest(BaseEngineRequest):
             )
             self.engine.validate(request)
 
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage")
+            )
+
+            def cmpl_chunk(choices, usage="omit"):
+                chunk = {
+                    "id": completion_id, "object": "text_completion",
+                    "created": created, "model": model, "choices": choices,
+                }
+                if include_usage:
+                    chunk["usage"] = None if usage == "omit" else usage
+                return "data: {}\n\n".format(json.dumps(chunk))
+
             async def sse():
                 lp_offset = 0
+                as_ids = getattr(request, "tokens_as_ids", False)
                 try:
                     try:
                         async for piece in self._stream_deltas(request, stops):
                             choice = {"index": 0, "text": piece["delta"],
                                       "finish_reason": None}
                             if piece.get("entries") is not None:
-                                lp = self._completion_lp_entries(
+                                lp, lp_offset = self._completion_lp_entries(
                                     piece["entries"],
                                     int(request.logprobs or 0),
                                     offset=lp_offset,
-                                )
-                                lp_offset = (
-                                    lp["text_offset"][-1] + len(lp["tokens"][-1])
-                                    if lp["tokens"] else lp_offset
+                                    as_ids=as_ids,
                                 )
                                 choice["logprobs"] = lp
-                            chunk = {
-                                "id": completion_id, "object": "text_completion",
-                                "created": created, "model": model,
-                                "choices": [choice],
-                            }
-                            yield "data: {}\n\n".format(json.dumps(chunk))
+                            yield cmpl_chunk([choice])
                     except Exception as ex:
                         yield "data: {}\n\n".format(json.dumps(
                             {"error": {"message": str(ex), "type": type(ex).__name__}}
                         ))
                         yield "data: [DONE]\n\n"
                         return
-                    final = {
-                        "id": completion_id, "object": "text_completion",
-                        "created": created, "model": model,
-                        "choices": [{"index": 0, "text": "",
-                                     "finish_reason": self._finish_reason(request)}],
-                    }
-                    yield "data: {}\n\n".format(json.dumps(final))
+                    yield cmpl_chunk(
+                        [{"index": 0, "text": "",
+                          "finish_reason": self._finish_reason(request)}]
+                    )
+                    if include_usage:
+                        yield cmpl_chunk([], usage={
+                            "prompt_tokens": request.prompt_len,
+                            "completion_tokens": request.produced,
+                            "total_tokens": request.prompt_len
+                            + request.produced,
+                        })
                     yield "data: [DONE]\n\n"
                 finally:
                     # normal completion AND client disconnect (GeneratorExit):

@@ -75,8 +75,17 @@ class HFTokenizer:
     def decode(self, ids: Iterable[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: List[dict]) -> str:
+    def apply_chat_template(self, messages: List[dict], tools=None) -> str:
+        """``tools``: OpenAI-shape tool definitions forwarded to the HF
+        template. Templates without a ``tools`` variable silently ignore
+        them — llm/tools.py detects that by comparing against the
+        tool-less render and falls back to a system preamble."""
         try:
+            if tools:
+                return self._tok.apply_chat_template(
+                    messages, tokenize=False, add_generation_prompt=True,
+                    tools=list(tools),
+                )
             return self._tok.apply_chat_template(
                 messages, tokenize=False, add_generation_prompt=True
             )

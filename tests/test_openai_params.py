@@ -276,3 +276,74 @@ def test_bad_logit_bias_is_422(llm_served):
         return r.status
 
     assert _run(llm_served, fn) == 422
+
+def test_response_role_and_usage_stream_options(llm_served):
+    """vLLM chat knobs: response_role renames the assistant role;
+    stream_options.include_usage adds usage:null chunks + a final
+    choices-less usage chunk (OpenAI stream_options semantics)."""
+    import json as _json
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(response_role="bot"),
+        )
+        non_stream = await r.json()
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(
+                stream=True,
+                response_role="bot",
+                stream_options={"include_usage": True},
+            ),
+        )
+        return non_stream, await r.text()
+
+    non_stream, text = _run(llm_served, fn)
+    assert non_stream["choices"][0]["message"]["role"] == "bot"
+    lines = [l for l in text.split("\n\n") if l.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [_json.loads(l[len("data: "):]) for l in lines[:-1]]
+    assert chunks[0]["choices"][0]["delta"]["role"] == "bot"
+    # every non-final chunk: usage null; final chunk: no choices, real usage
+    for c in chunks[:-1]:
+        assert c["usage"] is None
+    final = chunks[-1]
+    assert final["choices"] == []
+    assert final["usage"]["completion_tokens"] >= 1
+    assert final["usage"]["total_tokens"] == (
+        final["usage"]["prompt_tokens"] + final["usage"]["completion_tokens"]
+    )
+
+
+def test_return_tokens_as_token_ids(llm_served):
+    """vLLM return_tokens_as_token_ids: logprob token strings become
+    "token_id:<id>" in chat and completions shapes."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(logprobs=True, top_logprobs=2,
+                            return_tokens_as_token_ids=True, max_tokens=4),
+        )
+        chat = await r.json()
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "ab", "max_tokens": 4,
+                  "logprobs": 2, "return_tokens_as_token_ids": True},
+        )
+        return chat, await r.json()
+
+    chat, comp = _run(llm_served, fn)
+    for item in chat["choices"][0]["logprobs"]["content"]:
+        assert item["token"].startswith("token_id:")
+        int(item["token"].split(":", 1)[1])
+        for top in item["top_logprobs"]:
+            assert top["token"].startswith("token_id:")
+    lp = comp["choices"][0]["logprobs"]
+    assert all(t.startswith("token_id:") for t in lp["tokens"])
+    assert all(
+        k.startswith("token_id:") for d in lp["top_logprobs"] for k in d
+    )
+    # offsets still track emitted TEXT, not the token_id strings
+    assert lp["text_offset"][0] == 0

@@ -1,0 +1,388 @@
+"""OpenAI tool / function calling on v1/chat/completions.
+
+Reference surface: vLLM tool parsing enabled via chat_settings
+(/root/reference/clearml_serving/serving/preprocess_service.py:792-808,
+/root/reference/examples/vllm/preprocess.py:25-33). Here arguments for
+forced/required calls are enforced by the on-device guided-decoding DFA."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from clearml_serving_tpu.llm.tools import (
+    messages_with_tool_results,
+    parse_tool_calls,
+    render_chat_with_tools,
+    resolve_tool_choice,
+    tool_call_schema,
+    tools_preamble,
+    validate_tools,
+)
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.main import build_app
+from clearml_serving_tpu.serving.model_request_processor import ModelRequestProcessor
+
+WEATHER = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Look up the weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"location": {"enum": ["paris", "tokyo"]}},
+            "required": ["location"],
+        },
+    },
+}
+CLOCK = {
+    "type": "function",
+    "function": {"name": "get_time", "parameters": {"type": "object",
+                                                    "properties": {}}},
+}
+
+
+# ------------------------------------------------------------------ unit
+
+def test_validate_tools_normalizes_and_rejects():
+    out = validate_tools([WEATHER, CLOCK])
+    assert [t["name"] for t in out] == ["get_weather", "get_time"]
+    assert out[0]["parameters"]["required"] == ["location"]
+    for bad in [
+        [],
+        [{"type": "retrieval"}],
+        [{"type": "function", "function": {}}],
+        [{"type": "function", "function": {"name": "x", "parameters": 3}}],
+        [WEATHER, WEATHER],  # duplicate names
+    ]:
+        with pytest.raises(ValueError):
+            validate_tools(bad)
+
+
+def test_resolve_tool_choice_modes():
+    assert resolve_tool_choice({}) == ("none", None)
+    assert resolve_tool_choice({"tools": [WEATHER]}) == ("auto", None)
+    assert resolve_tool_choice({"tools": [WEATHER], "tool_choice": "none"}) == ("none", None)
+    assert resolve_tool_choice({"tools": [WEATHER], "tool_choice": "required"}) == ("required", None)
+    assert resolve_tool_choice(
+        {"tools": [WEATHER],
+         "tool_choice": {"type": "function", "function": {"name": "get_weather"}}}
+    ) == ("forced", "get_weather")
+    with pytest.raises(ValueError):
+        resolve_tool_choice({"tool_choice": "required"})  # tools absent
+    with pytest.raises(ValueError):
+        resolve_tool_choice({"tools": [WEATHER], "tool_choice": {"type": "function"}})
+
+
+def test_tool_call_schema_shapes():
+    tools = validate_tools([WEATHER, CLOCK])
+    one = tool_call_schema(tools, "get_weather")
+    assert one["properties"]["name"] == {"const": "get_weather"}
+    assert one["required"] == ["name", "arguments"]
+    both = tool_call_schema(tools, None)
+    assert {v["properties"]["name"]["const"] for v in both["anyOf"]} == {
+        "get_weather", "get_time"
+    }
+    with pytest.raises(ValueError):
+        tool_call_schema(tools, "nope")
+
+
+def test_parse_tool_calls_formats():
+    names = ["get_weather", "get_time"]
+    # bare llama-3-style JSON, `arguments` or `parameters`
+    got = parse_tool_calls('{"name": "get_weather", "arguments": {"location": "paris"}}', names)
+    assert got == [{"name": "get_weather", "arguments": '{"location": "paris"}'}]
+    got = parse_tool_calls('{"name": "get_time", "parameters": {}}', names)
+    assert got == [{"name": "get_time", "arguments": "{}"}]
+    # arguments already a JSON string
+    got = parse_tool_calls('{"name": "get_time", "arguments": "{}"}', names)
+    assert got == [{"name": "get_time", "arguments": "{}"}]
+    # hermes/qwen <tool_call> blocks, multiple = parallel calls
+    text = ('<tool_call>{"name": "get_weather", "arguments": {"location": "tokyo"}}</tool_call>\n'
+            '<tool_call>{"name": "get_time", "arguments": {}}</tool_call>')
+    got = parse_tool_calls(text, names)
+    assert [c["name"] for c in got] == ["get_weather", "get_time"]
+    # JSON array of calls
+    got = parse_tool_calls('[{"name": "get_time", "arguments": {}}]', names)
+    assert [c["name"] for c in got] == ["get_time"]
+    # NOT tool calls: prose, unknown name, JSON without a name
+    assert parse_tool_calls("the weather is nice", names) is None
+    assert parse_tool_calls('{"name": "other_fn", "arguments": {}}', names) is None
+    assert parse_tool_calls('{"answer": 42}', names) is None
+    assert parse_tool_calls('{"name": "get_time"', names) is None  # truncated
+
+
+def test_messages_with_tool_results_rewrite():
+    msgs = [
+        {"role": "user", "content": "weather?"},
+        {"role": "assistant", "tool_calls": [
+            {"id": "call_1", "type": "function",
+             "function": {"name": "get_weather", "arguments": '{"location": "paris"}'}}]},
+        {"role": "tool", "tool_call_id": "call_1", "content": "sunny"},
+    ]
+    out = messages_with_tool_results(msgs)
+    assert out[0] == msgs[0]
+    assert out[1]["role"] == "assistant" and "get_weather" in out[1]["content"]
+    assert out[2]["role"] == "user" and "sunny" in out[2]["content"]
+
+
+def test_render_falls_back_to_preamble():
+    from clearml_serving_tpu.llm.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(512)
+    tools = validate_tools([WEATHER])
+    text = render_chat_with_tools(tok, [{"role": "user", "content": "hi"}], tools)
+    assert "get_weather" in text and "respond ONLY with a JSON object" in text
+    pre = tools_preamble(tools)
+    assert "get_weather" in pre and "location" in pre
+
+
+# ------------------------------------------------------------------ HTTP
+
+@pytest.fixture(scope="module")
+def tool_served(tmp_path_factory):
+    import os
+
+    root = tmp_path_factory.mktemp("state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    mrp = ModelRequestProcessor(state_root=str(root), force_create=True, name="llm")
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 1024,
+                    "prefill_buckets": [128],
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def _run(mrp, fn):
+    async def runner():
+        client = TestClient(TestServer(build_app(mrp)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def _chat_body(**extra):
+    body = {
+        "model": "tiny_llm",
+        "messages": [{"role": "user", "content": "weather in paris?"}],
+        "max_tokens": 96,
+        "temperature": 0.9,
+        "seed": 7,
+        "tools": [WEATHER, CLOCK],
+    }
+    body.update(extra)
+    return body
+
+
+def test_forced_tool_call_http(tool_served):
+    """tool_choice forcing one function: the guided DFA makes the call and
+    its arguments schema-valid by construction (OpenAI SDK wire shape)."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(tool_choice={"type": "function",
+                                         "function": {"name": "get_weather"}}),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(tool_served, fn)
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    msg = choice["message"]
+    assert msg["content"] is None
+    (call,) = msg["tool_calls"]
+    assert call["id"].startswith("call_") and call["type"] == "function"
+    assert call["function"]["name"] == "get_weather"
+    args = json.loads(call["function"]["arguments"])
+    assert args["location"] in ("paris", "tokyo")
+
+
+def test_required_tool_call_http(tool_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(tool_choice="required", seed=11),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(tool_served, fn)
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    (call,) = choice["message"]["tool_calls"]
+    assert call["function"]["name"] in ("get_weather", "get_time")
+    json.loads(call["function"]["arguments"])
+
+
+def test_forced_tool_call_streaming(tool_served):
+    """SSE shape: role chunk, tool_calls deltas accumulating by index,
+    finish_reason tool_calls."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(stream=True,
+                            tool_choice={"type": "function",
+                                         "function": {"name": "get_weather"}}),
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        return await r.text()
+
+    text = _run(tool_served, fn)
+    lines = [l for l in text.split("\n\n") if l.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(l[len("data: "):]) for l in lines[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    # accumulate tool_call deltas the way the OpenAI SDK does
+    acc = {}
+    finish = None
+    for c in chunks:
+        ch = c["choices"][0]
+        finish = ch.get("finish_reason") or finish
+        for tc in ch["delta"].get("tool_calls") or []:
+            slot = acc.setdefault(tc["index"], {"id": None, "name": "", "arguments": ""})
+            if tc.get("id"):
+                slot["id"] = tc["id"]
+            fn_part = tc.get("function") or {}
+            if fn_part.get("name"):
+                slot["name"] = fn_part["name"]
+            slot["arguments"] += fn_part.get("arguments", "")
+    assert finish == "tool_calls"
+    assert acc[0]["name"] == "get_weather" and acc[0]["id"].startswith("call_")
+    args = json.loads(acc[0]["arguments"])
+    assert args["location"] in ("paris", "tokyo")
+
+
+def test_auto_mode_plain_answer_http(tool_served):
+    """auto + a model that answers in prose: normal content response, no
+    tool_calls fabricated."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(temperature=0.0, max_tokens=8),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(tool_served, fn)
+    choice = out["choices"][0]
+    assert choice["finish_reason"] != "tool_calls"
+    assert "tool_calls" not in choice["message"]
+    assert isinstance(choice["message"]["content"], str)
+
+
+def test_tool_errors_http(tool_served):
+    async def fn(client):
+        # tool_choice without tools
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json={"model": "tiny_llm",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "tool_choice": "required"},
+        )
+        assert r.status == 422, await r.text()
+        # malformed tool entry
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(tools=[{"type": "function", "function": {}}]),
+        )
+        assert r.status == 422, await r.text()
+        # forcing an unknown tool
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(tool_choice={"type": "function",
+                                         "function": {"name": "nope"}}),
+        )
+        assert r.status == 422, await r.text()
+
+    _run(tool_served, fn)
+
+
+def test_parse_tool_calls_with_surrounding_prose():
+    """Hermes models narrate before calling: prose + <tool_call> blocks
+    must yield calls AND preserve the prose (r4 code review)."""
+    from clearml_serving_tpu.llm.tools import strip_tool_blocks
+
+    text = ('Let me check that for you.\n'
+            '<tool_call>{"name": "get_weather", "arguments": {"location": "paris"}}</tool_call>')
+    calls = parse_tool_calls(text, ["get_weather"])
+    assert calls and calls[0]["name"] == "get_weather"
+    assert strip_tool_blocks(text) == "Let me check that for you."
+
+
+def test_split_tag_holdback():
+    from clearml_serving_tpu.llm.tools import split_tag_holdback
+
+    assert split_tag_holdback("hello ") == ("hello ", "")
+    assert split_tag_holdback("hello <tool") == ("hello ", "<tool")
+    assert split_tag_holdback("<") == ("", "<")
+    # a '<' that can't start the tag is emitted
+    assert split_tag_holdback("a < b") == ("a < b", "")
+
+
+def test_tool_grammar_forces_name_before_arguments():
+    """r4 code review: the serialized grammar schema must keep declaration
+    order (name first) — sort_keys would make the model commit arguments
+    before the tool name is pinned."""
+    tools = validate_tools([WEATHER, CLOCK])
+    payload = json.dumps(tool_call_schema(tools, None))
+    for variant in json.loads(payload)["anyOf"]:
+        keys = list(variant["properties"].keys())
+        assert keys == ["name", "arguments"]
+    assert payload.index('"name"') < payload.index('"arguments"')
+
+
+def test_prose_then_tool_call_streaming(tool_served):
+    """Streaming auto mode must detect a <tool_call> tag arriving AFTER
+    prose (r4 code review): the tag text never streams as content and the
+    stream finishes with tool_calls.
+
+    The tiny random model can't emit the tag itself, so this drives the
+    SSE state machine through the route with a stop-gated two-phase hack:
+    instead we test the watcher pieces directly."""
+    from clearml_serving_tpu.llm.tools import split_tag_holdback
+
+    # simulate the sse watcher: prose streams, tag switches to buffering
+    pending = ""
+    emitted = []
+    deltas = ["Sure, ", "let me <to", "ol_call>{\"name\": \"get_time\"", ", \"arguments\": {}}</tool_call>"]
+    buffered = None
+    for d in deltas:
+        if buffered is not None:
+            buffered += d
+            continue
+        pending += d
+        idx = pending.find("<tool_call>")
+        if idx >= 0:
+            emitted.append(pending[:idx])
+            buffered = pending[idx:]
+            pending = ""
+        else:
+            emit, pending = split_tag_holdback(pending)
+            if emit:
+                emitted.append(emit)
+    assert "".join(emitted) == "Sure, let me "
+    calls = parse_tool_calls(buffered, ["get_time"])
+    assert calls == [{"name": "get_time", "arguments": "{}"}]
