@@ -651,13 +651,23 @@ def json_schema_to_regex(schema: dict, depth: int = 4) -> str:
                 i=item, w=_WS, m=lo - 1, n=max(hi - 1, lo - 1)
             )
         return r"\[" + _WS + body + _WS + r"\]"
-    if t == "object" and "properties" not in schema:
+    if (
+        t == "object"
+        and not schema.get("properties")
+        and not schema.get("required")
+    ):
         # no declared properties = ANY object (JSON Schema), not the empty
-        # object: lower to a bounded any-object like json_object mode
+        # object: lower to a bounded any-object like json_object mode.
+        # (additionalProperties constraints are not modeled — documented
+        # subset limitation.)
         _arr, obj = _json_container_regexes(json_value_regex(min(depth, 2)))
         return obj
     if t == "object" or "properties" in schema:
-        props = schema.get("properties", {})
+        props = schema.get("properties") or {}
+        if not props and schema.get("required"):
+            # required-only object: presence of the required members IS the
+            # constraint — enforce them (any JSON value), declaration order
+            props = {str(r): {} for r in schema["required"]}
         # JSON Schema semantics (and Outlines): absent `required` means NO
         # property is required, not all of them (ADVICE r3)
         required = set(schema.get("required") or [])

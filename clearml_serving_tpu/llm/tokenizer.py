@@ -80,12 +80,21 @@ class HFTokenizer:
         template. Templates without a ``tools`` variable silently ignore
         them — llm/tools.py detects that by comparing against the
         tool-less render and falls back to a system preamble."""
-        try:
-            if tools:
+        if tools:
+            try:
                 return self._tok.apply_chat_template(
                     messages, tokenize=False, add_generation_prompt=True,
                     tools=list(tools),
                 )
+            except Exception:
+                # a failed tools= render (old transformers without the
+                # kwarg, or a template choking on the tools variable) must
+                # fall back to the TOOL-LESS render, not the byte-level
+                # fallback text: returning different text here would make
+                # the native-support probe read "template consumed tools"
+                # and permanently serve degraded prompts (r4 code review)
+                return self.apply_chat_template(messages)
+        try:
             return self._tok.apply_chat_template(
                 messages, tokenize=False, add_generation_prompt=True
             )

@@ -297,4 +297,8 @@ def render_chat_with_tools(
         msgs = [{"role": "system", "content": tools_preamble(tools)}]
         msgs.extend(messages_with_tool_results(messages))
         return tokenizer.apply_chat_template(msgs)
-    return tokenizer.apply_chat_template(messages_with_tool_results(messages))
+    # no tools in the request: pass messages through untouched — chat
+    # templates that natively render `tool` turns (Hermes/Qwen/Llama-3.1)
+    # must see the real role structure, not the textual rewrite (which is
+    # only for the preamble fallback path)
+    return tokenizer.apply_chat_template(messages)

@@ -429,3 +429,19 @@ def test_engine_without_tokenizer_rejects_guided():
         engine.validate(GenRequest(
             prompt_ids=[256], guided=GuidedSpec("regex", "ab")
         ))
+
+
+def test_json_schema_required_only_object_enforced():
+    """r4 code review: {"type":"object","required":[...]} without
+    `properties` must still enforce the required members, not widen to
+    any-object."""
+    schema = {"type": "object", "required": ["id"]}
+    dfa = ByteDFA.from_regex(json_schema_to_regex(schema))
+    assert dfa.matches(b'{"id":7}')
+    assert dfa.matches(b'{"id":"x"}')
+    assert not dfa.matches(b"{}")
+    assert not dfa.matches(b'{"x":1}')
+    # truly unconstrained object stays any-object
+    dfa = ByteDFA.from_regex(json_schema_to_regex({"type": "object"}))
+    assert dfa.matches(b"{}")
+    assert dfa.matches(b'{"x": 1}')

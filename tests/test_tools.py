@@ -386,3 +386,45 @@ def test_prose_then_tool_call_streaming(tool_served):
     assert "".join(emitted) == "Sure, let me "
     calls = parse_tool_calls(buffered, ["get_time"])
     assert calls == [{"name": "get_time", "arguments": "{}"}]
+
+
+def test_no_tools_history_passes_messages_untouched():
+    """r4 code review: without a `tools` field the messages must reach the
+    template unrewritten so tool-native templates render real tool turns."""
+
+    class _Spy:
+        def __init__(self):
+            self.seen = None
+
+        def apply_chat_template(self, messages, tools=None):
+            self.seen = messages
+            return "x"
+
+    spy = _Spy()
+    msgs = [
+        {"role": "user", "content": "hi"},
+        {"role": "tool", "tool_call_id": "c1", "content": "sunny"},
+    ]
+    render_chat_with_tools(spy, msgs, [])
+    assert spy.seen is msgs  # untouched, not rewritten
+
+
+def test_failed_tools_render_falls_back_to_preamble():
+    """r4 code review: a tokenizer whose tools= render fails must yield
+    the PREAMBLE path, never a degraded non-template render."""
+
+    class _FakeHF:
+        def apply_chat_template(self, messages, tokenize=False,
+                                add_generation_prompt=True, tools=None):
+            if tools is not None:
+                raise TypeError("no tools kwarg")  # old transformers
+            return "<T>" + " ".join(m.get("content") or "" for m in messages)
+
+    from clearml_serving_tpu.llm.tokenizer import HFTokenizer
+
+    tok = HFTokenizer.__new__(HFTokenizer)
+    tok._tok = _FakeHF()
+    tools = validate_tools([WEATHER])
+    text = render_chat_with_tools(tok, [{"role": "user", "content": "hi"}], tools)
+    assert "get_weather" in text  # preamble injected
+    assert tok._tools_template_native is False
