@@ -67,6 +67,22 @@ class PagePool:
         self._slot_pages[slot] = []
         self._slot_len[slot] = 0
 
+    def truncate(self, slot: int, tokens: int) -> None:
+        """Shrink a sequence to `tokens`, returning surplus pages to the
+        pool (speculative chunks over-allocate for the worst-case accepted
+        length, then roll back to what was actually emitted)."""
+        if tokens > self._slot_len[slot]:
+            raise ValueError(
+                "truncate({}) past current length {}".format(
+                    tokens, self._slot_len[slot]
+                )
+            )
+        keep = self.pages_needed(tokens)
+        surplus = self._slot_pages[slot][keep:]
+        self._slot_pages[slot] = self._slot_pages[slot][:keep]
+        self._free.extend(reversed(surplus))
+        self._slot_len[slot] = tokens
+
     def slot_length(self, slot: int) -> int:
         return self._slot_len[slot]
 

@@ -1123,6 +1123,94 @@ def build(config: dict) -> SimpleNamespace:
             v_pools = jnp.stack(new_v)
         return _logits(params, x)[:, 0], k_pools, v_pools
 
+    def verify_paged(
+        params,
+        tokens,        # [B, S] int32: pending token + S-1 drafts
+        k_pools,       # [L, Hkv, N, P, D]
+        v_pools,       # [L, Hkv, N, P, D]
+        page_table,    # [B, PP] int32
+        lengths,       # [B] int32 tokens present BEFORE this chunk
+        lora_idx=None,
+    ):
+        """Speculative verification over paged KV (vLLM spec-decode on a
+        paged cache). Same contract as :func:`verify`: logits at ALL S
+        positions, lengths NOT advanced — the caller accepts a draft
+        prefix and sets pool lengths itself; K/V written past the accepted
+        point sit beyond ``lengths`` and are overwritten by later writes
+        at the same positions.
+
+        The chunk's K/V scatter into the pools at coords derived from the
+        page table (position p -> table[b, p // P], p % P), so the caller
+        only pre-allocates pages; write coordinates stay dynamic, which a
+        host-precomputed coord list could not be (accepted counts are a
+        device-side value). Attention gathers each sequence's table to a
+        dense [cap] run — capacity bandwidth, like the XLA-gather decode
+        fallback — and reuses ``_attend`` so query_scale/softcap families
+        verify exactly like they decode."""
+        b, s = tokens.shape
+        pp = page_table.shape[1]
+        page = k_pools.shape[3]
+        cap = pp * page
+        positions = lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+        x = _embed(params, tokens)                                 # [B, S, dim]
+        wp = jnp.take_along_axis(page_table, positions // page, axis=1)
+        wo = positions % page                                      # [B, S]
+        # causal bound per query position; table slots past each row's
+        # allocation hold page 0 (garbage) but always sit beyond the bound
+        t_idx = jnp.arange(cap, dtype=jnp.int32)[None, None]       # [1,1,cap]
+        mask = jnp.where(
+            t_idx < (positions[:, :, None] + 1), 0.0, -jnp.inf
+        ).astype(jnp.float32)[:, None]                             # [B,1,S,cap]
+
+        def layer_body(x, layer, k_pool_l, v_pool_l):
+            stash = []
+
+            def attn_fn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # k,v [B,S,Hkv,D]
+                k_hm = k.transpose(2, 0, 1, 3).astype(k_pool_l.dtype)
+                v_hm = v.transpose(2, 0, 1, 3).astype(v_pool_l.dtype)
+                k_p = k_pool_l.at[:, wp, wo].set(k_hm)
+                v_p = v_pool_l.at[:, wp, wo].set(v_hm)
+                stash.append((k_p, v_p))
+                # [Hkv, B, PP, P, D] -> [B, cap, Hkv, D] (table order IS
+                # sequence-position order)
+                kg = k_p[:, page_table].transpose(1, 2, 3, 0, 4).reshape(
+                    b, cap, n_kv, head_dim
+                )
+                vg = v_p[:, page_table].transpose(1, 2, 3, 0, 4).reshape(
+                    b, cap, n_kv, head_dim
+                )
+                return _attend(q, kg.astype(q.dtype), vg.astype(q.dtype), mask)
+
+            # dropless MoE like verify(): capacity dropping would make the
+            # accept chain depend on batch occupancy
+            x = _block(layer, x, attn_fn, lora_idx,
+                       ffn_kwargs={"dropless": True})
+            k_pool_l, v_pool_l = stash[0]
+            return x, k_pool_l, v_pool_l
+
+        if scan_layers:
+            def scan_body(x, xs):
+                layer, k_pool_l, v_pool_l = xs
+                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pool_l, v_pool_l)
+                return x, (k_pool_l, v_pool_l)
+
+            x, (k_pools, v_pools) = jax.lax.scan(
+                scan_body, x, (params["layers"], k_pools, v_pools)
+            )
+        else:
+            new_k, new_v = [], []
+            for li, layer in enumerate(params["layers"]):
+                x, k_pool_l, v_pool_l = layer_body(
+                    x, layer, k_pools[li], v_pools[li]
+                )
+                new_k.append(k_pool_l)
+                new_v.append(v_pool_l)
+            k_pools = jnp.stack(new_k)
+            v_pools = jnp.stack(new_v)
+        return _logits(params, x), k_pools, v_pools
+
     def prepare_params(params):
         """Adapt a loaded param pytree to this build's layout: under
         scan_layers, a list/tuple of per-layer dicts (e.g. from a checkpoint
@@ -1196,6 +1284,7 @@ def build(config: dict) -> SimpleNamespace:
         decode=decode,
         verify=verify,
         decode_paged=decode_paged,
+        verify_paged=verify_paged,
         # pipeline-parallel prefill: gated to configs whose forward the
         # pipeline stage body reproduces exactly (see prefill_pipeline doc)
         prefill_pipeline=(
