@@ -724,9 +724,15 @@ class LLMEngineRequest(BaseEngineRequest):
             self.engine.validate(request)
             # required/forced always buffers (output IS a tool call); auto
             # sniffs the first text for a call-shaped prefix and buffers
-            # only then, so plain answers still stream token by token
+            # only then, so plain answers still stream token by token. A
+            # guided response_format (json_object/json_schema) forces the
+            # output to start with '{'/'[' without it being a tool call, so
+            # sniffing would buffer the whole response — stream normally.
             buffer_all = tool_mode in ("required", "forced")
-            sniffing = tool_mode == "auto" and bool(tools)
+            sniffing = (
+                tool_mode == "auto" and bool(tools)
+                and request.guided is None
+            )
 
             def call_prefix(text):
                 """Could `text` still grow into a tool call? -> 'yes'
@@ -794,8 +800,14 @@ class LLMEngineRequest(BaseEngineRequest):
                                 held.append(piece["delta"])
                                 stashed.extend(entries)
                                 if mode == "sniff":
+                                    # verdict settles within the first few
+                                    # non-space chars; 'yes' locks buffer
+                                    # mode so long buffered outputs don't
+                                    # re-join `held` on every delta
                                     verdict = call_prefix("".join(held))
-                                    if verdict == "no":
+                                    if verdict == "yes":
+                                        mode = "buffer"
+                                    elif verdict == "no":
                                         mode = "watch"
                                         text, held = "".join(held), []
                                         emit = watch_emit(text)

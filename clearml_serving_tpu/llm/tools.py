@@ -85,6 +85,12 @@ def resolve_tool_choice(body: Dict[str, Any]) -> Tuple[str, Optional[str]]:
     if choice == "required":
         return "required", None
     if isinstance(choice, dict):
+        if choice.get("type", "function") != "function":
+            raise ValueError(
+                "tool_choice.type {!r} unsupported (only 'function')".format(
+                    choice.get("type")
+                )
+            )
         name = (choice.get("function") or {}).get("name")
         if not name:
             raise ValueError("tool_choice.function.name missing")
@@ -152,13 +158,17 @@ def _normalize_call(
     if args is None:
         args = {}
     if isinstance(args, str):
-        try:  # already a JSON-encoded argument object
-            json.loads(args)
-            arg_str = args
+        try:  # already JSON-encoded; OpenAI clients require an object
+            parsed = json.loads(args)
         except ValueError:
-            arg_str = json.dumps(args)
-    else:
+            return None
+        if not isinstance(parsed, dict):
+            return None
+        arg_str = args
+    elif isinstance(args, dict):
         arg_str = json.dumps(args)
+    else:  # list / scalar arguments are not a valid call shape
+        return None
     return {"name": name, "arguments": arg_str}
 
 

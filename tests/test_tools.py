@@ -73,6 +73,12 @@ def test_resolve_tool_choice_modes():
         resolve_tool_choice({"tool_choice": "required"})  # tools absent
     with pytest.raises(ValueError):
         resolve_tool_choice({"tools": [WEATHER], "tool_choice": {"type": "function"}})
+    with pytest.raises(ValueError):  # unknown object shape must 422, not force
+        resolve_tool_choice(
+            {"tools": [WEATHER],
+             "tool_choice": {"type": "retrieval",
+                             "function": {"name": "get_weather"}}}
+        )
 
 
 def test_tool_call_schema_shapes():
@@ -111,6 +117,13 @@ def test_parse_tool_calls_formats():
     assert parse_tool_calls('{"name": "other_fn", "arguments": {}}', names) is None
     assert parse_tool_calls('{"answer": 42}', names) is None
     assert parse_tool_calls('{"name": "get_time"', names) is None  # truncated
+    # arguments must be a JSON OBJECT — scalars/arrays (raw or encoded)
+    # would hand OpenAI clients a non-object payload
+    assert parse_tool_calls('{"name": "get_time", "arguments": "5"}', names) is None
+    assert parse_tool_calls('{"name": "get_time", "arguments": "[1]"}', names) is None
+    assert parse_tool_calls('{"name": "get_time", "arguments": 5}', names) is None
+    assert parse_tool_calls('{"name": "get_time", "arguments": [1]}', names) is None
+    assert parse_tool_calls('{"name": "get_time", "arguments": "not json"}', names) is None
 
 
 def test_messages_with_tool_results_rewrite():
@@ -291,6 +304,40 @@ def test_auto_mode_plain_answer_http(tool_served):
     assert choice["finish_reason"] != "tool_calls"
     assert "tool_calls" not in choice["message"]
     assert isinstance(choice["message"]["content"], str)
+
+
+def test_auto_tools_with_guided_json_streams_incrementally(tool_served):
+    """tools auto + response_format json_object: the output is guaranteed
+    to start with '{' WITHOUT being a tool call, so the call-prefix sniff
+    must be disabled and content must stream as it decodes — not buffer to
+    a single end-of-stream chunk (r4 advisor finding)."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(stream=True, max_tokens=48,
+                            response_format={"type": "json_object"}),
+        )
+        assert r.status == 200, await r.text()
+        return await r.text()
+
+    text = _run(tool_served, fn)
+    lines = [l for l in text.split("\n\n") if l.startswith("data: ")]
+    chunks = [json.loads(l[len("data: "):]) for l in lines[:-1]]
+    content_chunks = [
+        c for c in chunks
+        if c["choices"] and c["choices"][0]["delta"].get("content")
+    ]
+    # incremental streaming: content arrives across multiple deltas
+    assert len(content_chunks) >= 2, [c["choices"][0]["delta"] for c in chunks]
+    assert not any(
+        c["choices"][0]["delta"].get("tool_calls")
+        for c in chunks if c["choices"]
+    )
+    body = "".join(
+        c["choices"][0]["delta"]["content"] for c in content_chunks
+    )
+    assert body.lstrip().startswith("{")
 
 
 def test_tool_errors_http(tool_served):
