@@ -919,7 +919,12 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
-            if tools and res["finish_reason"] != "length":
+            # a body-supplied guided response_format pins the OUTPUT shape —
+            # the JSON answer is the deliverable, not a tool call; skipping
+            # the parse keeps stream and non-stream responses identical
+            # (streaming disables its call sniff under the same condition)
+            parse_ok = tool_mode in ("required", "forced") or r.guided is None
+            if tools and parse_ok and res["finish_reason"] != "length":
                 calls = parse_tool_calls(res["text"], tool_names)
                 if calls:
                     # hermes-style prose around the <tool_call> blocks is

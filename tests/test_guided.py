@@ -445,3 +445,56 @@ def test_json_schema_required_only_object_enforced():
     dfa = ByteDFA.from_regex(json_schema_to_regex({"type": "object"}))
     assert dfa.matches(b"{}")
     assert dfa.matches(b'{"x": 1}')
+
+
+def test_guided_slot_in_speculating_batch():
+    """Per-slot spec gating: a grammar-constrained request sharing the
+    engine with a greedy (speculating) request must still produce
+    grammar-valid output — the guided mask + DFA advance run on the verify
+    dispatch's position-0 path — and the greedy slot stays exact."""
+    tok = ByteTokenizer(512)
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    common = dict(max_batch=2, max_seq_len=128, prefill_buckets=[16, 32],
+                  eos_token_id=tok.eos_token_id, tokenizer=tok,
+                  decode_steps=2)
+    greedy_p = [256, 1, 2, 1, 2, 1, 2]
+
+    plain_engine = LLMEngineCore(bundle, params, **common)
+    want_greedy = _gen(plain_engine, GenRequest(
+        prompt_ids=greedy_p, max_new_tokens=12))
+
+    engine = LLMEngineCore(
+        bundle, params, speculation="ngram", spec_k=3, **common
+    )
+    dispatches = [0]
+    orig = engine._spec_chunk_jit
+
+    def counting(*a, **k):
+        dispatches[0] += 1
+        return orig(*a, **k)
+
+    engine._spec_chunk_jit = counting
+
+    async def run():
+        greedy = GenRequest(prompt_ids=greedy_p, max_new_tokens=12)
+        guided = GenRequest(
+            prompt_ids=tok.encode("Q:"), max_new_tokens=24, temperature=0.9,
+            guided=GuidedSpec("regex", "(yes|no|maybe)"),
+        )
+
+        async def col(r):
+            out = []
+            async for t in engine.generate(r):
+                out.append(t)
+            return out
+
+        return await asyncio.gather(col(greedy), col(guided))
+
+    out_greedy, out_guided = asyncio.run(run())
+    assert out_greedy == want_greedy
+    assert _text(tok, out_guided) in ("yes", "no", "maybe")
+    assert dispatches[0] > 0, "guided slot knocked the batch off spec path"
+    assert all(e["refs"] == 0 for e in engine._grammars.values())

@@ -156,6 +156,116 @@ def test_paged_engine_concurrent(tiny):
     assert engine.paged_cache.pool.free_pages == engine.paged_cache.pool.num_pages - 1
 
 
+def test_paged_speculative_matches_plain_paged(tiny):
+    """Speculation over the paged cache (verify_paged + over-allocate /
+    truncate) is greedy-EXACT: outputs are token-identical to the plain
+    paged engine — drafts hitting (repetitive prompt) and missing alike —
+    and every over-allocated page rolls back to the pool."""
+    bundle, params = tiny
+    prompts = [
+        [256] + [10, 20, 30, 10, 20, 30, 10, 20],   # repetitive: drafts hit
+        [256] + list(range(40, 52)),                # no repeats: drafts miss
+        [256, 99],                                  # tiny prompt
+    ]
+    common = dict(max_batch=2, max_seq_len=64, prefill_buckets=[16, 32],
+                  eos_token_id=257, decode_steps=3)
+
+    plain = LLMEngineCore(bundle, params, cache_mode="paged", page_size=4,
+                          **common)
+    spec = LLMEngineCore(
+        bundle, params, cache_mode="paged", page_size=4,
+        speculation="ngram", spec_k=3, spec_ngram=2, **common,
+    )
+    dispatches = [0]
+    orig = spec._spec_paged_jit
+
+    def counting(*a, **k):
+        dispatches[0] += 1
+        return orig(*a, **k)
+
+    spec._spec_paged_jit = counting
+    for p in prompts:
+        r_plain = _collect(plain, GenRequest(prompt_ids=p, max_new_tokens=24))
+        r_spec = _collect(spec, GenRequest(prompt_ids=p, max_new_tokens=24))
+        assert r_plain == r_spec, (p, r_plain, r_spec)
+    assert dispatches[0] > 0, "paged speculative path never dispatched"
+    # truncate + finish-free bookkeeping: no page leaked
+    assert spec.paged_cache.pool.free_pages == spec.paged_cache.pool.num_pages - 1
+
+
+def test_paged_speculative_mixed_batch(tiny):
+    """Concurrent greedy + seeded-sampled requests on the paged spec engine:
+    per-slot gating keeps speculation active and both outputs match the
+    plain paged engine token-for-token."""
+    bundle, params = tiny
+    reqs = [
+        dict(prompt_ids=[256, 1, 2, 1, 2, 1, 2], max_new_tokens=10),
+        dict(prompt_ids=[256, 5], max_new_tokens=10, temperature=0.9, seed=42),
+    ]
+    common = dict(max_batch=2, max_seq_len=64, prefill_buckets=[16],
+                  eos_token_id=257, decode_steps=2)
+
+    async def run(engine):
+        return await asyncio.gather(*[
+            _gather_one(engine, GenRequest(**r)) for r in reqs
+        ])
+
+    async def _gather_one(engine, req):
+        out = []
+        async for t in engine.generate(req):
+            out.append(t)
+        return out
+
+    plain = asyncio.run(run(LLMEngineCore(
+        bundle, params, cache_mode="paged", page_size=4, **common)))
+    spec_engine = LLMEngineCore(
+        bundle, params, cache_mode="paged", page_size=4,
+        speculation="ngram", spec_k=3, **common,
+    )
+    spec = asyncio.run(run(spec_engine))
+    assert spec == plain
+    assert spec_engine.paged_cache.pool.free_pages == (
+        spec_engine.paged_cache.pool.num_pages - 1
+    )
+
+
+def test_paged_speculative_pool_slack_fallback(tiny):
+    """When the pool cannot hold the speculative over-allocation, the
+    dispatch declines (returns None) and the iteration falls back to the
+    plain paged chunk — requests still complete with exact greedy output."""
+    bundle, params = tiny
+    common = dict(max_batch=1, max_seq_len=64, prefill_buckets=[16],
+                  eos_token_id=257, decode_steps=3)
+    p = [256, 1, 2, 1, 2, 1]
+
+    plain = LLMEngineCore(bundle, params, cache_mode="paged", page_size=4,
+                          **common)
+    want = _collect(plain, GenRequest(prompt_ids=p, max_new_tokens=8))
+
+    # pool: 5 usable pages = 20 tokens — enough for the 6-token prompt plus
+    # every plain chunk (max length 6+3*3=15 => 4 pages), but NOT for the
+    # spec slack (6 + decode_steps*(k+1)=18 => 24 tokens => 6 pages)
+    spec = LLMEngineCore(
+        bundle, params, cache_mode="paged", page_size=4,
+        speculation="ngram", spec_k=5,
+        num_pages=6,
+        **common,
+    )
+    declines = [0]
+    orig = spec._dispatch_spec_paged_chunk
+
+    def counting(*a, **k):
+        res = orig(*a, **k)
+        if res is None:
+            declines[0] += 1
+        return res
+
+    spec._dispatch_spec_paged_chunk = counting
+    got = _collect(spec, GenRequest(prompt_ids=p, max_new_tokens=8))
+    assert got == want
+    assert declines[0] > 0, "undersized pool never triggered the fallback"
+
+
 def test_paged_pool_exhaustion_fails_only_that_request(tiny):
     """An undersized pool (oversubscription) must fail only the sequence that
     hits capacity, not the whole engine."""

@@ -340,6 +340,28 @@ def test_auto_tools_with_guided_json_streams_incrementally(tool_served):
     assert body.lstrip().startswith("{")
 
 
+def test_auto_tools_with_guided_json_nonstreaming_stays_content(tool_served):
+    """Non-streaming twin of the streaming test above: with a body-supplied
+    guided response_format, the JSON answer is the deliverable — it must not
+    be re-parsed into tool_calls (stream and non-stream responses stay
+    structurally identical)."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(max_tokens=48,
+                            response_format={"type": "json_object"}),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(tool_served, fn)
+    choice = out["choices"][0]
+    assert choice["finish_reason"] != "tool_calls"
+    assert "tool_calls" not in choice["message"]
+    assert choice["message"]["content"].lstrip().startswith("{")
+
+
 def test_tool_errors_http(tool_served):
     async def fn(client):
         # tool_choice without tools
