@@ -156,6 +156,15 @@ def convert(model_dir: str, out_dir: str, language: Optional[str] = None) -> Non
     tok = transformers.WhisperTokenizer.from_pretrained(model_dir, local_files_only=True)
     cfg.update(prompt_ids_from_tokenizer(tok, language=language))
 
+    # curated word-alignment heads (openai ships them per released model;
+    # HF stores them on generation_config). Without them the word-timestamp
+    # DTW falls back to the noisier top-half-of-decoder heuristic
+    # (llm/audio.py _alignment_heads).
+    gen_cfg = getattr(hf, "generation_config", None)
+    heads = getattr(gen_cfg, "alignment_heads", None)
+    if heads:
+        cfg["alignment_heads"] = [[int(l), int(h)] for l, h in heads]
+
     save_bundle(out_dir, "whisper", cfg, params)
     for f in Path(model_dir).glob("*token*"):
         shutil.copy(f, Path(out_dir) / f.name)

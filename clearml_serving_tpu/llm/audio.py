@@ -24,6 +24,63 @@ import jax
 import jax.numpy as jnp
 
 
+def _median_filter_time(x: np.ndarray, width: int = 7) -> np.ndarray:
+    """Median filter along the LAST axis (edge-padded), openai-whisper's
+    timing smoothing (medfilt_width=7)."""
+    if width <= 1 or x.shape[-1] == 0:
+        return x
+    pad = width // 2
+    padded = np.concatenate(
+        [np.repeat(x[..., :1], pad, axis=-1), x,
+         np.repeat(x[..., -1:], pad, axis=-1)],
+        axis=-1,
+    )
+    windows = np.lib.stride_tricks.sliding_window_view(padded, width, axis=-1)
+    return np.median(windows, axis=-1)
+
+
+def _dtw_path(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Monotonic alignment through a [N_tokens, M_frames] cost matrix
+    (openai-whisper's dtw over -attention): returns (token_idx, frame_idx)
+    index arrays of the optimal path."""
+    n, m = cost.shape
+    acc = np.full((n + 1, m + 1), np.inf, np.float64)
+    trace = np.zeros((n + 1, m + 1), np.int8)
+    acc[0, 0] = 0.0
+    for i in range(1, n + 1):
+        row = cost[i - 1]
+        prev = acc[i - 1]
+        cur = acc[i]
+        # cur[j] depends on cur[j-1] (insertion) — sequential in j
+        for j in range(1, m + 1):
+            c0 = prev[j - 1]   # match (diagonal)
+            c1 = prev[j]       # token advances, frame repeats
+            c2 = cur[j - 1]    # frame advances, token repeats
+            if c0 <= c1 and c0 <= c2:
+                cur[j] = c0 + row[j - 1]
+                trace[i, j] = 0
+            elif c1 <= c2:
+                cur[j] = c1 + row[j - 1]
+                trace[i, j] = 1
+            else:
+                cur[j] = c2 + row[j - 1]
+                trace[i, j] = 2
+    i, j = n, m
+    ti: List[int] = []
+    fi: List[int] = []
+    while i > 0 and j > 0:
+        ti.append(i - 1)
+        fi.append(j - 1)
+        step = trace[i, j]
+        if step == 0:
+            i, j = i - 1, j - 1
+        elif step == 1:
+            i -= 1
+        else:
+            j -= 1
+    return np.array(ti[::-1]), np.array(fi[::-1])
+
+
 class AudioCore:
     def __init__(
         self,
@@ -82,6 +139,7 @@ class AudioCore:
         self._carry = None  # deferred different-task item (runs first next round)
 
         self._encode_jit = jax.jit(bundle.encode)
+        self._align_jit = None  # word-timestamp DTW pass; built on first use
 
         def _decode_chunk_batch(params, token, cache):
             def body(carry, _):
@@ -304,6 +362,172 @@ class AudioCore:
                     }
                 )
                 cursor += dur
+        return words
+
+    # -- word timestamps: cross-attention DTW ------------------------------
+
+    def _alignment_heads(self) -> tuple:
+        """Per-model alignment heads (config "alignment_heads" as [layer,
+        head] pairs, recorded by the HF converter when the checkpoint ships
+        them), else openai-whisper's generic fallback: every head of the
+        top half of the decoder."""
+        cfg = self.bundle.config
+        heads = cfg.get("alignment_heads")
+        if heads:
+            return tuple((int(l), int(h)) for l, h in heads)
+        n_layers = int(cfg["n_text_layers"])
+        n_heads = int(cfg["n_heads"])
+        return tuple(
+            (l, h) for l in range(n_layers // 2, n_layers)
+            for h in range(n_heads)
+        )
+
+    def words_dtw(
+        self, pcm: np.ndarray, windows: List[List[int]], tokenizer,
+        task: str = "transcribe",
+    ) -> Optional[List[dict]]:
+        """Whisper-faithful word timestamps: one teacher-forced decoder pass
+        per 30s window emitting the alignment heads' cross-attention maps
+        (models/whisper.py cross_attention_alignment; padding frames masked
+        pre-softmax), then openai-whisper's timing pipeline — per-head
+        z-norm over tokens, median filter over time, head average, DTW over
+        the negative map — and token->word grouping. Grouping is
+        unicode-safe: consecutive tokens accumulate until they decode
+        without a trailing replacement char (byte-level BPE splits non-ASCII
+        codepoints across tokens), and words break at whitespace AND at
+        timestamp markers (segment boundaries — bounds word length for
+        unspaced scripts). Returns None when the bundle has no alignment
+        surface (caller falls back to proportional interpolation).
+        Reference surface: preprocess_service.py:1031-1075 (vLLM whisper
+        verbose_json)."""
+        align_fn = getattr(self.bundle, "cross_attention_alignment", None)
+        if align_fn is None or self.timestamp_begin is None:
+            return None
+        from ..ops.audio import log_mel_spectrogram
+
+        heads = self._alignment_heads()
+        if self._align_jit is None:
+            self._align_jit = jax.jit(
+                lambda p, tok, enc, nf: align_fn(p, tok, enc, heads, nf)
+            )
+        prompt = self.prompt_ids(task, timestamps=True)
+        ts_begin = self.timestamp_begin
+        frame_s = 2.0 * self.hop_length / self.sampling_rate  # enc position
+        pcm = np.asarray(pcm, np.float32).reshape(-1)
+        # phase 1 — device passes only (the lock serializes against the
+        # decode micro-batcher; the O(tokens*frames) DTW must not hold it)
+        pending = []  # (w, ids, text_pos, mat [N, S_text, T], dur_w)
+        with self._lock:
+            for w, ids in enumerate(windows):
+                text_pos = [
+                    k for k, t in enumerate(ids)
+                    if t < ts_begin and t != self.eos_token_id
+                ]
+                if not text_pos:
+                    continue
+                chunk = pcm[w * self.n_samples : (w + 1) * self.n_samples]
+                dur_w = len(chunk) / self.sampling_rate
+                mel = log_mel_spectrogram(
+                    chunk, self.mel_filters, n_fft=self.n_fft,
+                    hop_length=self.hop_length, n_samples=self.n_samples,
+                )[None, :, : self._frames]
+                enc = self._encode_jit(self.params, jnp.asarray(mel))
+                seq = prompt + list(ids) + [self.eos_token_id]
+                bucket = 1
+                while bucket < len(seq):
+                    bucket *= 2
+                bucket = min(bucket, self.max_target)
+                toks = np.full((1, bucket), self.eos_token_id, np.int32)
+                toks[0, : len(seq)] = seq[:bucket]
+                n_frames = max(
+                    1,
+                    min(self._frames // 2, int(round(dur_w / frame_s))),
+                )
+                attn = np.asarray(
+                    self._align_jit(
+                        self.params, jnp.asarray(toks), enc,
+                        jnp.asarray(n_frames, jnp.int32),
+                    ),
+                    np.float64,
+                )                                       # [N, 1, S, T]
+                n_frames = min(n_frames, attn.shape[-1])
+                text_pos = [
+                    k for k in text_pos if len(prompt) + k < bucket
+                ]
+                if not text_pos:
+                    continue
+                rows = [len(prompt) + k for k in text_pos]
+                pending.append(
+                    (w, ids, text_pos, attn[:, 0, rows, :n_frames], dur_w)
+                )
+        # phase 2 — host-only timing + word grouping
+        words: List[dict] = []
+        for w, ids, text_pos, mat, dur_w in pending:
+            offset = w * float(self.chunk_length)
+            std = mat.std(axis=-2, keepdims=True)
+            mean = mat.mean(axis=-2, keepdims=True)
+            mat = (mat - mean) / np.maximum(std, 1e-8)
+            mat = _median_filter_time(mat)
+            mat = mat.mean(axis=0)                      # [S_text, T]
+            ti, fi = _dtw_path(-mat)
+            # first frame of each token's run on the path = its onset
+            jumps = np.diff(ti, prepend=-1) > 0
+            starts = fi[jumps] * frame_s
+            bounds = np.concatenate([starts, [dur_w]])
+            span = {
+                k: (float(bounds[i]), float(min(bounds[i + 1], dur_w)))
+                for i, k in enumerate(text_pos)
+            }
+            cur_text, cur_start, cur_end = "", None, None
+            unit: List[int] = []  # token positions of a pending decode unit
+
+            def flush_word():
+                nonlocal cur_text, cur_start, cur_end
+                if cur_text.strip():
+                    words.append({
+                        "word": cur_text.strip(),
+                        "start": round(cur_start, 2),
+                        "end": round(cur_end, 2),
+                    })
+                cur_text, cur_start, cur_end = "", None, None
+
+            def emit_unit(text: str, toks: List[int]):
+                nonlocal cur_text, cur_start, cur_end
+                st = span[toks[0]][0] + offset
+                en = span[toks[-1]][1] + offset
+                if text[:1].isspace() and cur_text.strip():
+                    flush_word()
+                if not text.strip():
+                    if cur_text.strip():
+                        flush_word()
+                    return
+                if cur_start is None:
+                    cur_start = st
+                cur_text += text
+                cur_end = en
+
+            for k, t in enumerate(ids):
+                if t >= ts_begin or t == self.eos_token_id:
+                    # segment boundary: close the open unit and word
+                    if unit:
+                        emit_unit(tokenizer.decode([ids[i] for i in unit]), unit)
+                        unit = []
+                    flush_word()
+                    continue
+                if k not in span:
+                    continue
+                unit.append(k)
+                text = tokenizer.decode([ids[i] for i in unit])
+                if text.endswith("�"):
+                    continue  # split codepoint: extend the unit
+                if not text:
+                    unit = []  # special token: contributes no text or break
+                    continue
+                emit_unit(text, unit)
+                unit = []
+            if unit:
+                emit_unit(tokenizer.decode([ids[i] for i in unit]), unit)
+            flush_word()
         return words
 
     def _encode_and_prime(self, pcms: List[np.ndarray], prompt: List[int]):

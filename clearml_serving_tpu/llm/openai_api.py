@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import time
 import uuid
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
@@ -1402,7 +1403,30 @@ class LLMEngineRequest(BaseEngineRequest):
                 if "segment" in granularities:
                     out["segments"] = segments
                 if "word" in granularities:
-                    out["words"] = self.audio.words_from_segments(segments)
+                    # whisper-faithful word timing: DTW over cross-attention
+                    # alignment heads; proportional interpolation only when
+                    # the bundle lacks the alignment surface or the DTW
+                    # pass fails (docs/parity.md Whisper row)
+                    words = None
+                    try:
+                        words = await asyncio.to_thread(
+                            self.audio.words_dtw, pcm, windows,
+                            self.tokenizer, task,
+                        )
+                    except Exception:
+                        # degraded word timing must leave a signal — a
+                        # silent fall-back would hide a persistently
+                        # failing DTW pass that still pays encode+align
+                        logging.getLogger(__name__).warning(
+                            "word-timestamp DTW failed; falling back to "
+                            "proportional interpolation",
+                            exc_info=True,
+                        )
+                    out["words"] = (
+                        words
+                        if words is not None
+                        else self.audio.words_from_segments(segments)
+                    )
         return out
 
     async def v1_audio_transcriptions(self, body, state, collect_fn=None):

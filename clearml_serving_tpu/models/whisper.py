@@ -267,6 +267,65 @@ def build(config: dict) -> SimpleNamespace:
         }
         return logits, cache
 
+    def cross_attention_alignment(
+        params, tokens: jnp.ndarray, enc_out: jnp.ndarray, heads: tuple,
+        n_frames=None,
+    ):
+        """Teacher-forced decoder pass that returns the cross-attention
+        PROBABILITIES of the selected alignment heads: tokens [B, S] ->
+        [N, B, S, T] float32, N = len(heads), heads a static tuple of
+        (layer, head) pairs (per-model alignment heads, or the generic
+        top-half-of-decoder default — openai-whisper's fallback).
+
+        ``n_frames`` (scalar, dynamic): encoder positions covering the REAL
+        audio; the alignment softmax masks positions beyond it BEFORE
+        normalizing (openai-whisper crops QK to num_frames//2 pre-softmax —
+        window padding would otherwise siphon row mass non-uniformly and
+        skew the DTW path for short audio). The decoder's own residual
+        stream keeps the full-window attention the serving decode uses.
+
+        Word-level timestamps DTW over these maps (reference delegates word
+        timing to whisper's cross-attention DTW; preprocess_service.py
+        verbose_json surface). Only the selected heads' probabilities leave
+        the graph, so HBM cost stays ~N*S*T instead of L*H*S*T."""
+        b, s = tokens.shape
+        x = params["embed"][tokens] + params["dec_pos"][:s][None]
+        causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+        mask = jnp.where(causal, 0.0, -jnp.inf).astype(jnp.float32)[None, None]
+        t = enc_out.shape[1]
+        frame_ok = None
+        if n_frames is not None:
+            frame_ok = (jnp.arange(t) < n_frames)[None, None, None, :]
+        by_layer: Dict[int, list] = {}
+        for li, hi in heads:
+            by_layer.setdefault(int(li), []).append(int(hi))
+        picked = []
+        for i, layer in enumerate(params["dec_layers"]):
+            h = _layer_norm(x, layer["attn_norm"])
+            x = x + _self_attn(layer["attn"], h, mask)
+            h = _layer_norm(x, layer["cross_norm"])
+            qc = _heads(_proj(layer["cross"]["q"], h), b, s)
+            kc = _heads(_proj(layer["cross"]["k"], enc_out), b, t)
+            vc = _heads(_proj(layer["cross"]["v"], enc_out), b, t)
+            scores = jnp.einsum(
+                "bshd,bthd->bhst", qc, kc, preferred_element_type=jnp.float32
+            ) * (head_dim ** -0.5)
+            probs = jax.nn.softmax(scores, axis=-1)             # [B, H, S, T]
+            if by_layer.get(i):
+                a_scores = scores
+                if frame_ok is not None:
+                    a_scores = jnp.where(frame_ok, scores, -jnp.inf)
+                a_probs = jax.nn.softmax(a_scores, axis=-1)
+                for hi in by_layer[i]:
+                    picked.append(a_probs[:, hi])
+            cross = jnp.einsum(
+                "bhst,bthd->bshd", probs.astype(vc.dtype), vc
+            ).reshape(b, s, d)
+            x = x + _proj(layer["cross"]["o"], cross)
+            h = _layer_norm(x, layer["ffn_norm"])
+            x = x + _ffn_block(layer, h)
+        return jnp.stack(picked)                                 # [N, B, S, T]
+
     def decoder_forward(params, tokens: jnp.ndarray, enc_out: jnp.ndarray):
         """Full teacher-forced decoder pass: tokens [B, S] -> logits
         [B, S, vocab] (fidelity tests / scoring)."""
@@ -295,6 +354,7 @@ def build(config: dict) -> SimpleNamespace:
         init_cache=init_cache,
         decode=decode,
         decoder_forward=decoder_forward,
+        cross_attention_alignment=cross_attention_alignment,
         apply=decoder_forward,  # generic-bundle surface (unused for serving)
         config=cfg,
         n_heads=n_heads,

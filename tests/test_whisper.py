@@ -537,3 +537,131 @@ def test_word_granularity_route(ts_audio_served):
 
     out2 = asyncio.run(fn2())
     assert "words" in out2 and "segments" not in out2
+
+
+# -- word timestamps: cross-attention DTW -----------------------------------
+
+def test_dtw_path_diagonal_and_monotone():
+    from clearml_serving_tpu.llm.audio import _dtw_path
+
+    # strong diagonal: the path must follow it
+    n, m = 4, 8
+    cost = np.ones((n, m))
+    for i in range(n):
+        cost[i, 2 * i : 2 * i + 2] = 0.0
+    ti, fi = _dtw_path(cost)
+    assert ti[0] == 0 and fi[0] == 0
+    assert ti[-1] == n - 1 and fi[-1] == m - 1
+    # monotone non-decreasing, single steps
+    assert (np.diff(ti) >= 0).all() and (np.diff(fi) >= 0).all()
+    assert ((np.diff(ti) + np.diff(fi)) >= 1).all()
+    # each token's run sits on its low-cost band
+    for k in range(n):
+        frames = fi[ti == k]
+        assert cost[k, frames].mean() <= 0.5
+
+
+def test_median_filter_time():
+    from clearml_serving_tpu.llm.audio import _median_filter_time
+
+    x = np.zeros((2, 3, 9))
+    x[..., 4] = 100.0  # lone spike is removed by a width-7 median
+    out = _median_filter_time(x, 7)
+    assert out.shape == x.shape
+    assert np.abs(out).max() == 0.0
+    ramp = np.arange(9, dtype=float)[None, None]
+    out = _median_filter_time(ramp, 7)
+    assert out[0, 0, 4] == pytest.approx(4.0)  # interior preserved
+
+
+class _StubTok:
+    """Maps text ids to letters; id 341 decodes with a LEADING SPACE so the
+    word grouper splits there."""
+
+    def decode(self, ids):
+        out = []
+        for t in ids:
+            if t == 341:
+                out.append(" b")
+            else:
+                out.append(chr(ord("a") + (t - 330) % 26))
+        return "".join(out)
+
+
+def test_words_dtw_monotone_and_grouped(ts_audio_core):
+    core = ts_audio_core
+    rng = np.random.RandomState(0)
+    pcm = (0.1 * rng.randn(16000)).astype(np.float32)  # one 1s window
+    # window ids: <|t0.1|> text text text <|t0.4|>
+    windows = [[355, 334, 341, 335, 370]]
+    words = core.words_dtw(pcm, windows, _StubTok())
+    assert words is not None and len(words) == 2
+    # grouping: "e" then " bf" -> words "e", "bf"
+    assert [w["word"] for w in words] == ["e", "bf"]
+    dur = len(pcm) / core.sampling_rate
+    prev_end = 0.0
+    for w in words:
+        assert 0.0 <= w["start"] <= w["end"] <= dur + 1e-6
+        assert w["start"] >= prev_end - 0.3  # near-monotone across words
+        prev_end = w["end"]
+
+
+def test_verbose_json_word_granularity_route(ts_audio_served):
+    import asyncio
+    import base64
+
+    async def fn():
+        return await ts_audio_served.process_request(
+            "ts_whisper",
+            None,
+            {
+                "file": base64.b64encode(_tone_wav(0.6)).decode(),
+                "response_format": "verbose_json",
+                "timestamp_granularities": ["word", "segment"],
+            },
+            serve_type="v1/audio/transcriptions",
+        )
+
+    out = asyncio.run(fn())
+    assert "segments" in out and "words" in out
+    for w in out["words"]:
+        assert set(w) == {"word", "start", "end"}
+        assert 0.0 <= w["start"] <= w["end"] <= out["duration"] + 1e-6
+        assert w["word"].strip() == w["word"] != ""
+
+
+class _ByteStubTok:
+    """Byte-level BPE stand-in: 'ü' (0xC3 0xBC) split across two tokens."""
+
+    TABLE = {334: b"\xc3", 335: b"\xbc", 336: b"ber", 337: b" x"}
+
+    def decode(self, ids):
+        return b"".join(
+            self.TABLE.get(t, b"") for t in ids
+        ).decode("utf-8", errors="replace")
+
+
+def test_words_dtw_utf8_safe_units(ts_audio_core):
+    """Tokens splitting a multi-byte codepoint must accumulate until they
+    decode cleanly — never emit U+FFFD mojibake (r5 code review)."""
+    core = ts_audio_core
+    rng = np.random.RandomState(0)
+    pcm = (0.1 * rng.randn(16000)).astype(np.float32)
+    # <|t|> 0xC3 0xBC "ber" " x" <|t|>
+    windows = [[355, 334, 335, 336, 337, 370]]
+    words = core.words_dtw(pcm, windows, _ByteStubTok())
+    assert [w["word"] for w in words] == ["über", "x"]
+    assert all("�" not in w["word"] for w in words)
+
+
+def test_words_dtw_breaks_at_segment_boundaries(ts_audio_core):
+    """Timestamp markers break words even without whitespace — bounds word
+    length for unspaced scripts (r5 code review)."""
+    core = ts_audio_core
+    rng = np.random.RandomState(0)
+    pcm = (0.1 * rng.randn(16000)).astype(np.float32)
+    # two segments, no whitespace anywhere: <|t|> ber <|t|><|t|> ber <|t|>
+    windows = [[355, 336, 365, 365, 336, 375]]
+    words = core.words_dtw(pcm, windows, _ByteStubTok())
+    assert [w["word"] for w in words] == ["ber", "ber"]
+    assert words[0]["end"] <= words[1]["start"] + 0.3
