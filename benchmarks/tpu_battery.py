@@ -124,9 +124,30 @@ def main() -> int:
             emit(json.loads(line))
         except Exception:
             print(line, flush=True)
+    paged_wall_s = round(time.time() - t0, 1)
+
+    # -- phase 3: SERVING-ENGINE speculative A/B (VERDICT r3 #5) ------------
+    # LLMEngineCore end to end (admission/emission included), 8B int8,
+    # speculation off vs ngram on draft-friendly and draft-hostile traffic.
+    from benchmarks import spec_ab
+
+    t1 = time.time()
+    try:
+        for row in spec_ab.run_ab(
+            preset="llama3-8b", batch=16, prompt_len=256, new_tokens=256,
+            decode_steps=25, quantize="int8", dtype="bfloat16",
+            scan_layers=True, kv_quant="int8",
+        ):
+            emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_engine_spec_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t1, 1)})
+
     emit({
         "event": "battery_done",
-        "paged_wall_s": round(time.time() - t0, 1),
+        "paged_wall_s": paged_wall_s,
+        "spec_ab_wall_s": round(time.time() - t1, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

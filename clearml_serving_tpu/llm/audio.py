@@ -42,29 +42,37 @@ def _median_filter_time(x: np.ndarray, width: int = 7) -> np.ndarray:
 def _dtw_path(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Monotonic alignment through a [N_tokens, M_frames] cost matrix
     (openai-whisper's dtw over -attention): returns (token_idx, frame_idx)
-    index arrays of the optimal path."""
+    index arrays of the optimal path.
+
+    The DP runs over ANTI-DIAGONALS: every predecessor of a cell on
+    diagonal d (match d-2, deletion d-1, insertion d-1) lies on an earlier
+    diagonal, so each diagonal is one vectorized numpy step — a naive
+    cell-by-cell Python loop is ~660k iterations for a full 30s window
+    (~440 tokens x 1500 frames) of GIL-bound time per window
+    (openai-whisper jits this same kernel with numba/triton)."""
     n, m = cost.shape
-    acc = np.full((n + 1, m + 1), np.inf, np.float64)
     trace = np.zeros((n + 1, m + 1), np.int8)
-    acc[0, 0] = 0.0
-    for i in range(1, n + 1):
-        row = cost[i - 1]
-        prev = acc[i - 1]
-        cur = acc[i]
-        # cur[j] depends on cur[j-1] (insertion) — sequential in j
-        for j in range(1, m + 1):
-            c0 = prev[j - 1]   # match (diagonal)
-            c1 = prev[j]       # token advances, frame repeats
-            c2 = cur[j - 1]    # frame advances, token repeats
-            if c0 <= c1 and c0 <= c2:
-                cur[j] = c0 + row[j - 1]
-                trace[i, j] = 0
-            elif c1 <= c2:
-                cur[j] = c1 + row[j - 1]
-                trace[i, j] = 1
-            else:
-                cur[j] = c2 + row[j - 1]
-                trace[i, j] = 2
+    # diag arrays indexed by i: entry i holds acc[i, d - i] (inf off-band)
+    prev2 = np.full(n + 1, np.inf)   # diagonal d-2
+    prev1 = np.full(n + 1, np.inf)   # diagonal d-1
+    prev2[0] = 0.0                   # acc[0, 0]
+    for d in range(2, n + m + 1):
+        lo = max(1, d - m)   # never > hi for 2 <= d <= n+m with n,m >= 1
+        hi = min(n, d - 1)
+        i_arr = np.arange(lo, hi + 1)
+        j_arr = d - i_arr
+        c0 = prev2[i_arr - 1]        # match: acc[i-1, j-1]
+        c1 = prev1[i_arr - 1]        # token advances: acc[i-1, j]
+        c2 = prev1[i_arr]            # frame advances: acc[i, j-1]
+        # tie-break priority matches the scalar formulation: 0, then 1
+        choice = np.where(
+            (c0 <= c1) & (c0 <= c2), 0, np.where(c1 <= c2, 1, 2)
+        ).astype(np.int8)
+        best = np.where(choice == 0, c0, np.where(choice == 1, c1, c2))
+        cur = np.full(n + 1, np.inf)
+        cur[i_arr] = best + cost[i_arr - 1, j_arr - 1]
+        trace[i_arr, j_arr] = choice
+        prev2, prev1 = prev1, cur
     i, j = n, m
     ti: List[int] = []
     fi: List[int] = []
@@ -506,11 +514,20 @@ class AudioCore:
                 cur_text += text
                 cur_end = en
 
+            def force_unit(toks: List[int]):
+                # a unit cut off mid-codepoint (segment boundary / window
+                # end): drop the incomplete bytes' replacement chars rather
+                # than hand clients mojibake
+                text = tokenizer.decode([ids[i] for i in toks])
+                text = text.replace("�", "")
+                if text:
+                    emit_unit(text, toks)
+
             for k, t in enumerate(ids):
                 if t >= ts_begin or t == self.eos_token_id:
                     # segment boundary: close the open unit and word
                     if unit:
-                        emit_unit(tokenizer.decode([ids[i] for i in unit]), unit)
+                        force_unit(unit)
                         unit = []
                     flush_word()
                     continue
@@ -526,7 +543,7 @@ class AudioCore:
                 emit_unit(text, unit)
                 unit = []
             if unit:
-                emit_unit(tokenizer.decode([ids[i] for i in unit]), unit)
+                force_unit(unit)
             flush_word()
         return words
 

@@ -665,3 +665,55 @@ def test_words_dtw_breaks_at_segment_boundaries(ts_audio_core):
     words = core.words_dtw(pcm, windows, _ByteStubTok())
     assert [w["word"] for w in words] == ["ber", "ber"]
     assert words[0]["end"] <= words[1]["start"] + 0.3
+
+
+def test_cross_attention_alignment_matches_hf(tiny_hf_whisper):
+    """The DTW timing source — per-head cross-attention probabilities from
+    the teacher-forced pass — must match transformers' cross_attentions
+    exactly (same checkpoint, same tokens). This pins the word-timestamp
+    pipeline's input to the reference implementation."""
+    hf, bundle, params = tiny_hf_whisper
+    mel = np.random.RandomState(2).rand(1, 16, 128).astype(np.float32)
+    tokens = np.array([[50258, 50359, 50363, 11, 23, 42]], np.int64)
+    enc = bundle.encode(params, mel)
+    heads = ((0, 0), (0, 1), (1, 0), (1, 1))
+    ours = np.asarray(bundle.cross_attention_alignment(
+        params, tokens.astype(np.int32), enc, heads
+    ))                                                 # [N, 1, S, T]
+    # SDPA attention returns no attention maps; rebuild eager with the
+    # same weights
+    eager = transformers.WhisperForConditionalGeneration._from_config(
+        hf.config, attn_implementation="eager"
+    )
+    eager.load_state_dict(hf.state_dict())
+    eager.eval()
+    with torch.no_grad():
+        out = eager(
+            input_features=torch.from_numpy(mel),
+            decoder_input_ids=torch.from_numpy(tokens),
+            output_attentions=True,
+        )
+    for n, (l, h) in enumerate(heads):
+        theirs = out.cross_attentions[l][0, h].numpy()  # [S, T]
+        np.testing.assert_allclose(ours[n, 0], theirs, rtol=2e-3, atol=2e-3)
+    # frame masking: probs beyond n_frames are exactly zero and rows
+    # renormalize over the kept frames
+    masked = np.asarray(bundle.cross_attention_alignment(
+        params, tokens.astype(np.int32), enc, heads, n_frames=10
+    ))
+    assert np.abs(masked[..., 10:]).max() == 0.0
+    np.testing.assert_allclose(masked.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_words_dtw_forced_flush_never_emits_mojibake(ts_audio_core):
+    """A unit cut off mid-codepoint by a segment boundary or window end
+    drops the incomplete bytes instead of emitting U+FFFD (r5 review)."""
+    core = ts_audio_core
+    rng = np.random.RandomState(0)
+    pcm = (0.1 * rng.randn(16000)).astype(np.float32)
+    # segment boundary right after a lone continuation byte; then a clean
+    # token; window ends with another dangling partial codepoint
+    windows = [[355, 334, 365, 365, 336, 375, 334]]
+    words = core.words_dtw(pcm, windows, _ByteStubTok())
+    assert [w["word"] for w in words] == ["ber"]
+    assert all("�" not in w["word"] for w in words)
