@@ -48,6 +48,7 @@ from .sampling import (
     SamplingExtras,
     SamplingParams,
     penalize_logits,
+    speculative_sample_chain,
     sample_tokens,
 )
 
@@ -202,6 +203,7 @@ class LLMEngineCore:
         speculation: Optional[str] = None,
         spec_k: int = 4,
         spec_ngram: int = 2,
+        spec_sampling: bool = True,
         pipeline_chunk: int = 512,
         lora_adapters: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[int] = None,
@@ -700,14 +702,17 @@ class LLMEngineCore:
         # (repetitive spans: summarization, extraction, code).
         #
         # Per-slot gating (VERDICT r3 #5): only greedy unconstrained slots
-        # accept drafts (spec_mask). Slots with temperature>0, sampling
-        # extras, grammar constraints, or logprob tracking ride the SAME
-        # verify dispatch but take exactly one token per round, fully
-        # sampled from position 0's logits with the plain chunk's semantics
-        # (penalties/bias/seeds, guided masks + DFA advance, logprobs).
-        # On a weight-read-bound decode their k extra verify positions are
-        # nearly free, so a mixed batch never forces the engine off the
-        # speculative path.
+        # accept drafts (spec_mask). Plain temperature>0 slots speculate
+        # too (sspec_mask) via REJECTION SAMPLING over the draft chain
+        # (sampling.speculative_sample_chain — vLLM spec-sampling
+        # semantics; distribution-exact, gated by engine.spec_sampling).
+        # Slots with sampling extras, grammar constraints, or logprob
+        # tracking ride the SAME verify dispatch but take exactly one token
+        # per round, fully sampled from position 0's logits with the plain
+        # chunk's semantics (penalties/bias/seeds, guided masks + DFA
+        # advance, logprobs). On a weight-read-bound decode the k extra
+        # verify positions are nearly free, so a mixed batch never forces
+        # the engine off the speculative path.
         self._speculation = None
         if speculation:
             if speculation != "ngram":
@@ -719,6 +724,7 @@ class LLMEngineCore:
                     "decoder with multi-position verification".format(need)
                 )
             self._speculation = speculation
+        self._spec_sampling = bool(spec_sampling)
         self._spec_k = max(1, int(spec_k))
         self._spec_ngram = max(1, int(spec_ngram))
         self._spec_slack = self.decode_steps * (self._spec_k + 1)
@@ -729,12 +735,17 @@ class LLMEngineCore:
 
             def _make_spec_chunk(paged: bool):
                 def _spec_chunk(params, tokbuf, pending, cachelike, active,
-                                spec_mask, sampling, rng, lora_idx=None,
+                                spec_mask, sspec_mask, sampling, rng,
+                                lora_idx=None,
                                 extras=None, counts=None, pmask=None,
-                                guided=None, gstate=None, want_lp=False):
+                                guided=None, gstate=None, want_lp=False,
+                                with_sspec=False):
                     t_idx = jnp.arange(buf_len, dtype=jnp.int32)
                     nb = pending.shape[0]
-                    ns_mask = active & ~spec_mask  # sampled-path slots
+                    # position-0 plain-path slots (extras/guided/logprobs)
+                    ns_mask = active & ~spec_mask
+                    if with_sspec:
+                        ns_mask = ns_mask & ~sspec_mask
                     if gstate is None:
                         gstate = jnp.full((nb,), -1, jnp.int32)
                     if paged:
@@ -799,7 +810,11 @@ class LLMEngineCore:
                             jnp.cumprod((drafts == g[:, :k_]).astype(jnp.int32), axis=1),
                             axis=1,
                         )                                            # [B] 0..k
-                        # ---- sampled-path slots: one token from position 0,
+                        if with_sspec:
+                            # rejection-sampled draft chain for plain
+                            # temperature>0 slots (distribution-exact)
+                            step_rng, chain_rng = jax.random.split(step_rng)
+                        # ---- plain-path slots: one token from position 0,
                         # plain-chunk semantics (mask -> penalize -> sample ->
                         # count -> DFA advance) -------------------------------
                         l0 = logits[:, 0, :]
@@ -824,7 +839,16 @@ class LLMEngineCore:
                         if guided is not None:
                             gstate = _guided_advance(gstate, sampled, ns_mask, guided)
                         acc = jnp.where(spec_mask, acc, 0)
-                        g = g.at[:, 0].set(jnp.where(spec_mask, g[:, 0], sampled))
+                        if with_sspec:
+                            g_s, acc_s = speculative_sample_chain(
+                                logits, drafts, sampling, chain_rng
+                            )
+                            acc = jnp.where(sspec_mask, acc_s, acc)
+                            g = jnp.where(sspec_mask[:, None], g_s, g)
+                            keep = spec_mask | sspec_mask
+                        else:
+                            keep = spec_mask
+                        g = g.at[:, 0].set(jnp.where(keep, g[:, 0], sampled))
                         new_pending = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
                         new_len = jnp.where(active, length + 1 + acc, length)
                         # append the emitted tokens to the history buffer
@@ -875,12 +899,12 @@ class LLMEngineCore:
                 self._spec_chunk_jit = None
                 self._spec_paged_jit = jax.jit(
                     _make_spec_chunk(True), donate_argnums=(3,),
-                    static_argnames=("want_lp",),
+                    static_argnames=("want_lp", "with_sspec"),
                 )
             else:
                 self._spec_chunk_jit = jax.jit(
                     _make_spec_chunk(False), donate_argnums=(3,),
-                    static_argnames=("want_lp",),
+                    static_argnames=("want_lp", "with_sspec"),
                 )
                 self._spec_paged_jit = None
         else:
@@ -1753,23 +1777,31 @@ class LLMEngineCore:
                 self._slot_req[slot] = None
                 self._release_guided(slot)
 
-    def _spec_eligible_mask(self, active_mask: np.ndarray) -> np.ndarray:
-        """Slots whose emissions the greedy verify chain reproduces exactly:
-        temperature 0, no sampling extras, no grammar constraint, no logprob
-        tracking. Everything else takes the sampled position-0 path inside
-        the same speculative dispatch."""
+    def _spec_eligible_mask(self, active_mask: np.ndarray):
+        """(greedy_mask, sampled_mask): greedy_mask — slots the greedy
+        verify chain reproduces exactly (temperature 0, no sampling extras,
+        no grammar constraint, no logprob tracking); sampled_mask — plain
+        temperature>0 slots eligible for rejection-sampled speculation
+        (same exclusions; gated by engine.spec_sampling). Everything else
+        takes the sampled position-0 path inside the same dispatch."""
         lp_free = np.array(
             [r is None or r.logprobs is None for r in self._slot_req]
         )
-        return (
+        clean = (
             active_mask
-            & (self._temperature == 0.0)
             & ~self._slot_extra
             & (self._gstate < 0)
             & lp_free
         )
+        greedy = clean & (self._temperature == 0.0)
+        sampled = (
+            clean & (self._temperature > 0.0)
+            if self._spec_sampling
+            else np.zeros_like(greedy)
+        )
+        return greedy, sampled
 
-    def _spec_common_args(self, active_mask, spec_mask, sampling):
+    def _spec_common_args(self, active_mask, spec_mask, sspec_mask, sampling):
         """Argument tail shared by the dense and paged spec dispatches."""
         use_extras = self._extras_active(active_mask)
         use_guided = bool(np.any(self._gstate[active_mask] >= 0))
@@ -1777,6 +1809,7 @@ class LLMEngineCore:
         args = (
             jnp.asarray(active_mask),
             jnp.asarray(spec_mask),
+            jnp.asarray(sspec_mask),
             sampling,
             self._next_rng(),
             jnp.asarray(self._lora_slots) if self._lora_enabled else None,
@@ -1801,14 +1834,14 @@ class LLMEngineCore:
         return tuple(np.asarray(a) for a in lp) if lp is not None else None
 
     def _dispatch_spec_chunk(self, active_mask: np.ndarray, spec_mask,
-                             sampling, want_lp: bool = False):
+                             sspec_mask, sampling, want_lp: bool = False):
         """Worker-thread side of a dense speculative dispatch: run the fused
         draft-verify rounds and read back (gs [R,B,k+1], accs [R,B],
         pending [B], lp). The host token buffer round-trips through the
         executable so the on-device n-gram proposer sees each slot's full
         history."""
         tail, use_extras, gtables = self._spec_common_args(
-            active_mask, spec_mask, sampling
+            active_mask, spec_mask, sspec_mask, sampling
         )
         (tokbuf, pending, self.cache, gs, accs, new_counts, gstate_out,
          lp) = self._spec_chunk_jit(
@@ -1818,6 +1851,7 @@ class LLMEngineCore:
             self.cache,
             *tail,
             want_lp=want_lp,
+            with_sspec=bool(sspec_mask.any()),
         )
         lp_np = self._spec_commit_state(
             tokbuf, new_counts, gstate_out, lp, use_extras, gtables
@@ -1825,7 +1859,8 @@ class LLMEngineCore:
         return np.asarray(gs), np.asarray(accs), np.asarray(pending), lp_np
 
     def _dispatch_spec_paged_chunk(self, active_mask: np.ndarray, spec_mask,
-                                   sampling, want_lp: bool = False):
+                                   sspec_mask, sampling,
+                                   want_lp: bool = False):
         """Paged-cache speculative dispatch. Pages for the worst-case chunk
         growth (decode_steps*(k+1) tokens per slot) are allocated up front —
         accepted counts are a device-side value, so write coordinates must
@@ -1840,13 +1875,15 @@ class LLMEngineCore:
         extended: List[int] = []
         for slot in np.nonzero(active_mask)[0]:
             slot = int(slot)
-            # sampled-path slots keep 1 token/round and only the last
-            # round's draft writes can land past the kept run — they need
-            # rounds+k tokens of headroom, not rounds*(k+1); the smaller
-            # ask avoids whole-batch fallback near pool capacity
+            # position-0 plain-path slots keep 1 token/round and only the
+            # last round's draft writes can land past the kept run — they
+            # need rounds+k tokens of headroom, not rounds*(k+1); the
+            # smaller ask avoids whole-batch fallback near pool capacity.
+            # Both speculating classes (greedy chain AND rejection-sampled
+            # chain) can accept drafts, so they take the full slack.
             slack = (
                 self._spec_slack
-                if spec_mask[slot]
+                if (spec_mask[slot] or sspec_mask[slot])
                 else self.decode_steps + self._spec_k
             )
             try:
@@ -1858,7 +1895,7 @@ class LLMEngineCore:
             extended.append(slot)
         page_table = pool.page_table(self._pages_per_seq)
         tail, use_extras, gtables = self._spec_common_args(
-            active_mask, spec_mask, sampling
+            active_mask, spec_mask, sspec_mask, sampling
         )
         (tokbuf, pending, (k_pools, v_pools), gs, accs, new_counts,
          gstate_out, lp) = self._spec_paged_jit(
@@ -1873,6 +1910,7 @@ class LLMEngineCore:
             ),
             *tail,
             want_lp=want_lp,
+            with_sspec=bool(sspec_mask.any()),
         )
         self.paged_cache.k = k_pools
         self.paged_cache.v = v_pools
@@ -2046,26 +2084,32 @@ class LLMEngineCore:
                 top_k=jnp.asarray(self._top_k),
                 top_p=jnp.asarray(self._top_p),
             )
-            # speculate when at least one active slot is spec-eligible;
-            # ineligible slots ride the same dispatch on the sampled
+            # speculate when at least one active slot is spec-eligible —
+            # greedy (exact argmax chain) or plain-sampled (rejection
+            # chain); remaining slots ride the same dispatch on the
             # position-0 path (per-slot gating, VERDICT r3 #5)
-            spec_mask = (
+            spec_masks = (
                 self._spec_eligible_mask(active_mask)
                 if self._speculation
                 else None
             )
-            if spec_mask is not None and bool(spec_mask.any()):
+            if spec_masks is not None and bool(
+                spec_masks[0].any() or spec_masks[1].any()
+            ):
+                spec_mask, sspec_mask = spec_masks
                 # draft-and-verify rounds: device work off-loop, emission on
                 # the loop thread like the plain path
                 if self.cache_mode == "paged":
                     res = await asyncio.to_thread(
                         self._dispatch_spec_paged_chunk,
-                        active_mask, spec_mask, sampling, want_lp,
+                        active_mask, spec_mask, sspec_mask, sampling,
+                        want_lp,
                     )
                 else:
                     res = await asyncio.to_thread(
                         self._dispatch_spec_chunk,
-                        active_mask, spec_mask, sampling, want_lp,
+                        active_mask, spec_mask, sspec_mask, sampling,
+                        want_lp,
                     )
                 if res is not None:
                     gs, accs, pending, lp_np = res
@@ -2078,6 +2122,7 @@ class LLMEngineCore:
                                     lp_np is not None
                                     and i == 0
                                     and not spec_mask[slot]
+                                    and not sspec_mask[slot]
                                 ):
                                     chosen, top_id, top_lp = lp_np
                                     entry = {

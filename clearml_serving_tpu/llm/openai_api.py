@@ -194,6 +194,7 @@ class LLMEngineRequest(BaseEngineRequest):
             speculation=engine_cfg.get("speculation"),
             spec_k=int(engine_cfg.get("spec_k", 4)),
             spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
+            spec_sampling=bool(engine_cfg.get("spec_sampling", True)),
             pipeline_chunk=int(engine_cfg.get("pipeline_chunk", 512)),
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),

@@ -122,6 +122,40 @@ def _row_keys(rng: jax.Array, extras: SamplingExtras, batch: int):
     return jnp.where(use_seed, seeded, shared)
 
 
+def warp_logits(
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Temperature-scale + top-k + top-p mask: [N, V] logits with per-row
+    params [N] -> masked scaled logits (softmax of the result IS the
+    sampling distribution). Shared by sample_tokens and the speculative
+    rejection sampler so both sample from the identical law."""
+    n, v = logits.shape
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k mask (k == 0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]              # [N, V]
+    k = jnp.where(top_k > 0, top_k, v)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.minimum(k - 1, v - 1)[:, None], axis=-1
+    )                                                              # [N, 1]
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus) mask over the sorted distribution
+    sorted_scaled = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
+    cumulative = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens while cumulative(prev) < top_p  (always keep the first)
+    keep_sorted = (cumulative - probs_sorted) < top_p[:, None]
+    cutoff = jnp.where(
+        keep_sorted, sorted_scaled, jnp.inf
+    ).min(axis=-1, keepdims=True)                                  # lowest kept logit
+    return jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+
 @partial(jax.jit, donate_argnums=())
 def sample_tokens(
     logits: jnp.ndarray,
@@ -141,28 +175,7 @@ def sample_tokens(
     if extras is not None:
         logits = penalize_logits(logits, extras, counts, prompt_mask)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
-    scaled = logits / temp
-
-    # top-k mask (k == 0 disables)
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]              # [B, V]
-    k = jnp.where(params.top_k > 0, params.top_k, v)
-    kth = jnp.take_along_axis(
-        sorted_desc, jnp.minimum(k - 1, v - 1)[:, None], axis=-1
-    )                                                              # [B, 1]
-    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-
-    # top-p (nucleus) mask over the sorted distribution
-    sorted_scaled = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs_sorted = jax.nn.softmax(sorted_scaled, axis=-1)
-    cumulative = jnp.cumsum(probs_sorted, axis=-1)
-    # keep tokens while cumulative(prev) < top_p  (always keep the first)
-    keep_sorted = (cumulative - probs_sorted) < params.top_p[:, None]
-    cutoff = jnp.where(
-        keep_sorted, sorted_scaled, jnp.inf
-    ).min(axis=-1, keepdims=True)                                  # lowest kept logit
-    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    scaled = warp_logits(logits, params.temperature, params.top_k, params.top_p)
 
     if extras is None:
         sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
@@ -172,3 +185,58 @@ def sample_tokens(
             lambda key, row: jax.random.categorical(key, row)
         )(keys, scaled).astype(jnp.int32)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
+
+
+def speculative_sample_chain(
+    logits: jnp.ndarray,   # [B, K+1, V] verify-pass logits (float32)
+    drafts: jnp.ndarray,   # [B, K] int32 proposed draft tokens
+    params: SamplingParams,
+    rng: jax.Array,
+):
+    """Rejection-based speculative SAMPLING over a deterministic draft
+    chain (vLLM spec-decode semantics for temperature > 0).
+
+    The n-gram proposer is a point mass q = delta(d_i), so the standard
+    accept rule collapses to: accept draft d_i with probability P_i(d_i);
+    at the first rejection emit one sample from the residual (P_i with the
+    draft removed, renormalized); if all K drafts are accepted emit a
+    bonus sample from P_K. The marginal law of the emitted prefix is
+    EXACTLY autoregressive sampling from the warped per-position
+    distributions P_i = softmax(warp(logits_i)) — same warp (temperature /
+    top-k / top-p) sample_tokens uses, so speculated and plain slots draw
+    from an identical law.
+
+    Returns (tokens [B, K+1], acc [B]): tokens[b, :acc[b]] are the accepted
+    drafts and tokens[b, acc[b]] is the residual/bonus sample; entries past
+    acc[b] are meaningless (the engine emits acc+1 per round).
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    rep = lambda x: jnp.repeat(x, k1)
+    warped = warp_logits(
+        logits.reshape(b * k1, v),
+        rep(params.temperature), rep(params.top_k), rep(params.top_p),
+    ).reshape(b, k1, v)
+    probs = jax.nn.softmax(warped, axis=-1)
+    r_acc, r_gum = jax.random.split(rng)
+    u = jax.random.uniform(r_acc, (b, k))
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], drafts[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]                                                      # [B, K]
+    accept = u < p_draft
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # fallback samples per position: residual (draft masked out) for the
+    # K draft positions, plain bonus for position K. A row whose residual
+    # is empty (P(d) == 1) is unreachable: u < 1 always accepts it.
+    draft_hot = jax.nn.one_hot(drafts, v, dtype=bool)              # [B, K, V]
+    w_resid = jnp.where(draft_hot, -jnp.inf, warped[:, :k])
+    w_all = jnp.concatenate([w_resid, warped[:, k:]], axis=1)      # [B, K+1, V]
+    fallback = jax.random.categorical(
+        r_gum, w_all, axis=-1
+    ).astype(jnp.int32)                                            # [B, K+1]
+    f_at = jnp.take_along_axis(fallback, acc[:, None], axis=1)[:, 0]
+    tokens = jnp.concatenate(
+        [drafts.astype(jnp.int32), fallback[:, k:]], axis=1
+    )
+    tokens = tokens.at[jnp.arange(b), acc].set(f_at)
+    return tokens, acc

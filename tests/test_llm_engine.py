@@ -604,3 +604,145 @@ def test_speculative_moe_greedy_exact():
         bundle, params, decode_steps=2, speculation="ngram", spec_k=3,
     )))
     assert spec == plain
+
+
+def test_speculative_sample_chain_preserves_distribution():
+    """Rejection-based speculative sampling with a point-mass draft must
+    leave the emitted-token law EXACTLY the target distribution: empirical
+    first-token frequencies over many keys match P0, both when the draft is
+    likely (often accepted) and when it is unlikely (mostly resampled)."""
+    import jax
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu.llm.sampling import (
+        make_sampling_params,
+        speculative_sample_chain,
+    )
+
+    v = 8
+    p0 = np.array([0.4, 0.3, 0.1, 0.1, 0.05, 0.03, 0.01, 0.01])
+    p1 = np.array([0.05, 0.05, 0.5, 0.2, 0.1, 0.05, 0.03, 0.02])
+    logits = jnp.log(jnp.asarray(
+        np.stack([p0, p1, p0]), jnp.float32
+    ))[None]                                           # [1, 3, V] (k=2)
+    params = make_sampling_params(1, temperature=1.0)
+
+    def run_many(draft0, n=20000):
+        drafts = jnp.asarray([[draft0, 2]], jnp.int32)
+        toks, accs = jax.jit(jax.vmap(
+            lambda key: speculative_sample_chain(logits, drafts, params, key)
+        ))(jax.random.split(jax.random.PRNGKey(0), n))
+        return np.asarray(toks)[:, 0], np.asarray(accs)[:, 0]
+
+    for draft0 in (0, 6):  # likely draft (p=0.4) and unlikely draft (p=0.01)
+        toks, accs = run_many(draft0)
+        first = toks[:, 0]
+        emp = np.bincount(first, minlength=v) / len(first)
+        tv = 0.5 * np.abs(emp - p0).sum()
+        assert tv < 0.02, (draft0, emp, p0)
+        # second token, conditioned on the first draft being accepted,
+        # must follow P1 (the chain continues autoregressively)
+        cont = toks[accs >= 1]
+        if len(cont) > 2000:
+            emp1 = np.bincount(cont[:, 1], minlength=v) / len(cont)
+            tv1 = 0.5 * np.abs(emp1 - p1).sum()
+            assert tv1 < 0.03, (draft0, emp1, p1)
+    # accept rate tracks the draft probability
+    _, acc_hi = run_many(0)
+    _, acc_lo = run_many(6)
+    assert (acc_hi >= 1).mean() == pytest.approx(0.4, abs=0.03)
+    assert (acc_lo >= 1).mean() == pytest.approx(0.01, abs=0.01)
+
+
+def test_speculative_sample_chain_respects_top_k():
+    """The chain samples from the SAME warped law as sample_tokens: with
+    top_k=2 every emitted token is in the per-position top-2."""
+    import jax
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu.llm.sampling import (
+        make_sampling_params,
+        speculative_sample_chain,
+    )
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(2, 4, 16), jnp.float32)  # [B=2, k+1=4, V]
+    top2 = np.argsort(np.asarray(logits), axis=-1)[..., -2:]
+    params = make_sampling_params(2, temperature=0.8, top_k=2)
+    drafts = jnp.asarray(rng.randint(0, 16, size=(2, 3)), jnp.int32)
+    for trial in range(50):
+        toks, accs = speculative_sample_chain(
+            logits, drafts, params, jax.random.PRNGKey(trial)
+        )
+        toks, accs = np.asarray(toks), np.asarray(accs)
+        for b in range(2):
+            # every EMITTED token (accepted prefix + fallback) is top-2
+            for i in range(int(accs[b]) + 1):
+                assert toks[b, i] in top2[b, i], (trial, b, i)
+
+
+def test_sampled_speculation_in_engine(tiny_engine_parts):
+    """temperature>0 slots speculate via the rejection chain: the spec path
+    dispatches for a mixed greedy+sampled batch, the greedy co-resident
+    stays exact, and a sampled request submitted ALONE (deterministic rng
+    stream — concurrent admissions race on the shared stream by design) is
+    repeatable across engines with the same seed."""
+    bundle, params = tiny_engine_parts
+    hot_req = dict(prompt_ids=[256, 5, 6, 5, 6], max_new_tokens=10,
+                   temperature=0.9)
+
+    def build(**kw):
+        return _make_engine(
+            bundle, params, decode_steps=2, speculation="ngram", spec_k=3,
+            rng_seed=42, **kw,
+        )
+
+    async def run_mixed(engine):
+        greedy = GenRequest(prompt_ids=[256, 1, 2, 1, 2, 1], max_new_tokens=10)
+        hot = GenRequest(**hot_req)
+        return await asyncio.gather(
+            _collect(engine, greedy), _collect(engine, hot))
+
+    e1 = build()
+    dispatches = [0]
+    orig = e1._spec_chunk_jit
+
+    def counting(*a, **k):
+        dispatches[0] += 1
+        return orig(*a, **k)
+
+    e1._spec_chunk_jit = counting
+    g1, s1 = asyncio.run(run_mixed(e1))
+    assert dispatches[0] > 0 and len(s1) >= 1
+    # greedy slot remains exact vs plain engine
+    plain = _make_engine(bundle, params, decode_steps=2, rng_seed=42)
+
+    async def run_greedy():
+        return await _collect(plain, GenRequest(
+            prompt_ids=[256, 1, 2, 1, 2, 1], max_new_tokens=10))
+
+    assert g1 == asyncio.run(run_greedy())
+
+    # sampled request alone: the rejection chain IS the only decode path
+    # (sspec-only batch) and the rng stream is deterministic
+    async def run_alone(engine):
+        return await _collect(engine, GenRequest(**hot_req))
+
+    e2 = build()
+    chain_dispatches = [0]
+    orig2 = e2._spec_chunk_jit
+
+    def counting2(*a, **k):
+        chain_dispatches[0] += 1
+        return orig2(*a, **k)
+
+    e2._spec_chunk_jit = counting2
+    a1 = asyncio.run(run_alone(e2))
+    assert chain_dispatches[0] > 0, "sampled-only batch skipped the chain"
+    a2 = asyncio.run(run_alone(build()))
+    assert a1 == a2 and len(a1) >= 1
+    # spec_sampling=False: the sampled-only batch takes the PLAIN chunk
+    # (no spec-eligible slot at all) and still completes
+    off = build(spec_sampling=False)
+    a3 = asyncio.run(run_alone(off))
+    assert len(a3) >= 1

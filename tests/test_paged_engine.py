@@ -298,3 +298,31 @@ def test_paged_pool_exhaustion_fails_only_that_request(tiny):
     ok, err, n = asyncio.run(run())
     assert err >= 1, "long generation should exhaust the tiny pool"
     assert n >= 1, "engine must keep serving after a capacity failure"
+
+
+def test_paged_sampled_speculation(tiny):
+    """Rejection-sampled speculation over the paged cache: a temperature>0
+    request alone drives the spec dispatch, completes the full budget, and
+    the over-allocated pages roll back (pool fully free afterwards)."""
+    bundle, params = tiny
+    engine = LLMEngineCore(
+        bundle, params, cache_mode="paged", page_size=4,
+        speculation="ngram", spec_k=3,
+        max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, decode_steps=2,
+    )
+    dispatches = [0]
+    orig = engine._spec_paged_jit
+
+    def counting(*a, **k):
+        dispatches[0] += 1
+        return orig(*a, **k)
+
+    engine._spec_paged_jit = counting
+    out = _collect(engine, GenRequest(
+        prompt_ids=[256, 5, 6, 5, 6], max_new_tokens=12, temperature=0.9))
+    assert len(out) == 12
+    assert dispatches[0] > 0, "sampled-only paged batch skipped the chain"
+    assert engine.paged_cache.pool.free_pages == (
+        engine.paged_cache.pool.num_pages - 1
+    )
