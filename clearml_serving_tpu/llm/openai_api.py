@@ -997,6 +997,8 @@ class LLMEngineRequest(BaseEngineRequest):
                 )
             if int(body.get("n", 1) or 1) != 1:
                 raise EndpointModelError("streaming supports a single choice (n=1)")
+            if body.get("best_of") not in (None, 1):
+                raise EndpointModelError("best_of cannot be used with streaming")
             request = self._gen_request_from_body(
                 body, prompt_id_lists[0], chat=False
             )
@@ -1060,24 +1062,56 @@ class LLMEngineRequest(BaseEngineRequest):
 
         # n choices per prompt, all generated concurrently through the
         # continuous batch (OpenAI batched-prompt semantics: choice index is
-        # prompt-major, prompt_idx * n + choice_idx)
+        # prompt-major, prompt_idx * n + choice_idx). vLLM `best_of`:
+        # generate best_of candidates per prompt server-side, return the
+        # top n ranked by cumulative logprob; every candidate's tokens
+        # count toward usage (OpenAI billing semantics).
+        n = int(body.get("n", 1) or 1)
+        best_of = int(body.get("best_of") or n)
+        if best_of < n:
+            raise ValueError("best_of must be >= n")
+        cand_body = dict(body, n=best_of) if best_of != n else body
         requests: List[Any] = []
         for ids in prompt_id_lists:
-            requests.extend(self._n_requests(body, ids, chat=False))
+            requests.extend(self._n_requests(cand_body, ids, chat=False))
+        # ranking needs per-token chosen logprobs; when the user did not ask
+        # for them (None OR false — the request parser treats both as off),
+        # collect them internally and omit them from the reply
+        lp_internal = best_of != n and requests[0].logprobs is None
+        if lp_internal:
+            for r in requests:
+                r.logprobs = 0
         results = await asyncio.gather(
             *[self._collect_text(r, stops) for r in requests]
         )
         for r in requests:
             self._report_gen_stats(r, collect_fn)
+        if best_of != n:
+            def cumulative_lp(i: int) -> float:
+                # +1 keeps the finishing token's entry (EOS is stripped
+                # from ids): vLLM's cumulative_logprob includes it, and
+                # without it an immediate-EOS candidate would sum an empty
+                # slice to 0.0 and outrank every real completion
+                ents = requests[i].logprob_entries[: len(results[i]["ids"]) + 1]
+                return sum(e["logprob"] for e in ents)
+
+            sel: List[int] = []
+            for p in range(len(prompt_id_lists)):
+                grp = list(range(p * best_of, (p + 1) * best_of))
+                grp.sort(key=cumulative_lp, reverse=True)
+                sel.extend(grp[:n])
+        else:
+            sel = list(range(len(requests)))
         choices = []
-        for i, (r, res) in enumerate(zip(requests, results)):
+        for i, idx in enumerate(sel):
+            r, res = requests[idx], results[idx]
             choice = {
                 "index": i,
                 "text": res["text"],
                 "finish_reason": res["finish_reason"],
                 "logprobs": (
                     self._completion_logprobs(r, res["ids"])
-                    if r.logprobs is not None
+                    if r.logprobs is not None and not lp_internal
                     else None
                 ),
             }
