@@ -18,6 +18,7 @@ the OpenAI-compatible route surface (reference preprocess_service.py:619-1348).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from types import SimpleNamespace
 from typing import Any, Dict, Optional, Tuple
@@ -75,9 +76,30 @@ def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
     if not rope_scaling:
         return freqs
     rope_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
+    if rope_type == "linear":
+        # position-interpolation (Chen et al.): every frequency shrinks by
+        # 1/factor, equivalent to scaling positions down
+        return freqs / float(rope_scaling["factor"])
+    if rope_type == "longrope":
+        # position-dependent; applied in _rope — validate here (fail fast
+        # at build instead of inside the first traced forward)
+        hd2 = head_dim // 2
+        for key in ("short_factor", "long_factor"):
+            fac = rope_scaling.get(key)
+            if fac is None or len(fac) != hd2:
+                raise ValueError(
+                    "rope_scaling.{} must list head_dim/2 = {} per-dim "
+                    "factors".format(key, hd2)
+                )
+        if not rope_scaling.get("original_max_position_embeddings"):
+            raise ValueError(
+                "longrope rope_scaling needs original_max_position_embeddings"
+            )
+        return freqs
     if rope_type != "llama3":
         raise ValueError(
-            "unsupported rope_scaling type {!r} (supported: llama3)".format(rope_type)
+            "unsupported rope_scaling type {!r} (supported: llama3, "
+            "linear, longrope)".format(rope_type)
         )
     # Llama-3.1 frequency-dependent scaling: long wavelengths scale by
     # 1/factor, short ones stay, the middle band interpolates smoothly.
@@ -100,6 +122,41 @@ def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
 def _rope(positions: jnp.ndarray, head_dim: int, theta: float,
           rope_scaling: Optional[dict] = None):
     """cos/sin tables for given positions: [..., head_dim//2]."""
+    rope_type = (
+        (rope_scaling.get("rope_type") or rope_scaling.get("type"))
+        if rope_scaling
+        else None
+    )
+    if rope_type == "longrope":
+        # Phi-3 LongRoPE (vLLM Phi3LongRoPEScaledRotaryEmbedding layout):
+        # per-dim rescale factors — SHORT factors for positions inside the
+        # original training window, LONG factors beyond it (a per-position
+        # selection, so one table serves any mix of contexts) — plus a
+        # global attention scale on cos/sin:
+        # sqrt(1 + ln(max/orig)/ln(orig)) unless the checkpoint pins one.
+        base = _rope_freqs(head_dim, theta, None)
+        short = jnp.asarray(rope_scaling["short_factor"], jnp.float32)
+        long = jnp.asarray(rope_scaling["long_factor"], jnp.float32)
+        orig = float(rope_scaling["original_max_position_embeddings"])
+        max_pos = float(
+            rope_scaling.get("max_position_embeddings")
+            or rope_scaling.get("max_seq_len")
+            or orig
+        )
+        att = rope_scaling.get("attention_factor")
+        if att is None:
+            # plain-python math: this is a config constant, and _rope runs
+            # under jit (jnp here would try to concretize a tracer)
+            scale = max(max_pos / orig, 1.0)
+            att = (
+                1.0
+                if scale <= 1.0
+                else math.sqrt(1.0 + math.log(scale) / math.log(orig))
+            )
+        pos = positions.astype(jnp.float32)[..., None]            # [..., 1]
+        freqs = jnp.where(pos < orig, base / short, base / long)  # [..., hd/2]
+        angles = pos * freqs
+        return jnp.cos(angles) * att, jnp.sin(angles) * att
     freqs = _rope_freqs(head_dim, theta, rope_scaling)
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., hd/2]
     return jnp.cos(angles), jnp.sin(angles)
@@ -126,11 +183,21 @@ def build(config: dict) -> SimpleNamespace:
     ffn_dim = int(cfg["ffn_dim"])
     theta = float(cfg["rope_theta"])
     rope_scaling = cfg.get("rope_scaling") or None
-    _rope_freqs(dim // int(cfg["n_heads"]), theta, rope_scaling)  # fail fast on bad cfg
     eps = float(cfg["norm_eps"])
     dtype = jnp.dtype(cfg["dtype"])
     # head_dim may be decoupled from dim (Gemma-2: 16 heads x 256 > dim)
     head_dim = int(cfg.get("head_dim") or dim // n_heads)
+    if rope_scaling and (
+        rope_scaling.get("rope_type") or rope_scaling.get("type")
+    ) == "longrope":
+        # the attention scale needs the DEPLOYED context length; HF keeps it
+        # outside the rope_scaling dict, so default it from the model's own
+        # max_seq_len rather than silently degrading to scale 1.0
+        rope_scaling = dict(rope_scaling)
+        rope_scaling.setdefault(
+            "max_position_embeddings", int(cfg.get("max_seq_len") or 0) or None
+        )
+    _rope_freqs(head_dim, theta, rope_scaling)  # fail fast on bad cfg
     assert n_heads % n_kv == 0, "n_heads must be divisible by n_kv_heads"
     group = n_heads // n_kv
 

@@ -66,8 +66,17 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
     if attn_bias:
         config["attn_bias"] = True
     if rope_scaling:
-        # validated by the model build (llama3 scaling supported; others raise)
+        # validated by the model build (llama3/linear/longrope supported;
+        # others raise)
         config["rope_scaling"] = dict(rope_scaling)
+        rtype = rope_scaling.get("rope_type") or rope_scaling.get("type")
+        if rtype == "longrope":
+            # the attention scale needs the deployed context length, which
+            # HF keeps OUTSIDE the rope_scaling dict
+            config["rope_scaling"].setdefault(
+                "max_position_embeddings",
+                int(getattr(hf_cfg, "max_position_embeddings", 4096)),
+            )
     if gemma:
         # Gemma family deltas: zero-init (1+w) norms, GeGLU, sqrt(dim) embed
         # scaling, head_dim decoupled from dim

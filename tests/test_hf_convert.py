@@ -347,3 +347,163 @@ def test_gemma2_scan_layers_matches_unscanned():
     )
     b = scan_bundle.apply(scan_params, jnp.asarray(tokens, jnp.int32))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_rope_scaling_linear_matches_hf():
+    """Position-interpolation (linear) rope_scaling: full-logits fidelity
+    against transformers with the same random weights."""
+    from convert_model import convert_hf_llama
+
+    import jax.numpy as jnp
+
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from clearml_serving_tpu import models
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+    )
+    torch.manual_seed(2)
+    hf = LlamaForCausalLM(config)
+    hf.eval()
+    cfg, params = convert_hf_llama(hf)
+    cfg["dtype"] = "float32"
+    bundle = models.build_model("llama", cfg)
+    params = {
+        k: (jnp.asarray(v) if not isinstance(v, list)
+            else [{kk: jnp.asarray(vv) for kk, vv in layer.items()} for layer in v])
+        for k, v in params.items()
+    }
+    tokens = np.array([[1, 5, 9, 77, 3, 42, 8, 11, 64, 100]], np.int32)
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    ours = np.asarray(bundle.apply(params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_rope_longrope_matches_hf_tables():
+    """Phi-3 LongRoPE: our per-position cos/sin (short factors inside the
+    original window, long factors beyond, attention scale applied) must
+    match transformers' longrope rope-init in both regions."""
+    import jax.numpy as jnp
+
+    from transformers import Phi3Config
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from clearml_serving_tpu.models.llama import _rope
+
+    head_dim = 16
+    orig, deployed = 64, 256
+    short = [1.0 + 0.05 * i for i in range(head_dim // 2)]
+    long = [2.0 + 0.1 * i for i in range(head_dim // 2)]
+    cfg = Phi3Config(
+        hidden_size=64, num_attention_heads=4, num_hidden_layers=1,
+        max_position_embeddings=deployed, rope_theta=10000.0,
+        original_max_position_embeddings=orig,
+        rope_scaling={"type": "longrope", "short_factor": short,
+                      "long_factor": long},
+    )
+    scaling = {
+        "rope_type": "longrope", "short_factor": short, "long_factor": long,
+        "original_max_position_embeddings": orig,
+        "max_position_embeddings": deployed,
+    }
+    positions = jnp.asarray([[2, 10, 63, 64, 100, 200]], jnp.int32)
+    cos, sin = _rope(positions, head_dim, 10000.0, scaling)
+    cos, sin = np.asarray(cos)[0], np.asarray(sin)[0]
+
+    fn = ROPE_INIT_FUNCTIONS["longrope"]
+    # HF picks the factor set by the FORWARD length; our table is
+    # per-position — compare the short region against a short-run init and
+    # the long region against a long-run init
+    inv_short, att_short = fn(cfg, device=None, seq_len=orig)
+    inv_long, att_long = fn(cfg, device=None, seq_len=deployed)
+    assert att_short == pytest.approx(att_long)  # one global scale
+    for row, p in enumerate([2, 10, 63, 64, 100, 200]):
+        inv = inv_short if p < orig else inv_long
+        angles = p * inv.numpy()
+        np.testing.assert_allclose(
+            cos[row], np.cos(angles) * float(att_short), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            sin[row], np.sin(angles) * float(att_short), rtol=1e-5, atol=1e-5
+        )
+
+
+def test_rope_longrope_validation():
+    from clearml_serving_tpu import models
+
+    with pytest.raises(ValueError):
+        models.build_model("llama", {
+            "preset": "llama-tiny", "dtype": "float32",
+            "rope_scaling": {"rope_type": "longrope",
+                             "short_factor": [1.0],  # wrong length
+                             "long_factor": [1.0],
+                             "original_max_position_embeddings": 64},
+        })
+    with pytest.raises(ValueError):
+        models.build_model("llama", {
+            "preset": "llama-tiny", "dtype": "float32",
+            "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
+        })
+
+
+def test_rope_longrope_defaults_deployed_length_from_max_seq_len():
+    """When rope_scaling omits max_position_embeddings (HF keeps it outside
+    the dict), the build must default it from the model's max_seq_len so the
+    attention scale applies — NOT silently degrade to 1.0 (r5 review)."""
+    import jax
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu import models
+
+    short = [1.0] * 8
+    long = [2.0] * 8
+    base_cfg = {
+        "preset": "llama-tiny", "dtype": "float32", "max_seq_len": 512,
+    }
+    implicit = models.build_model("llama", dict(base_cfg, rope_scaling={
+        "rope_type": "longrope", "short_factor": short, "long_factor": long,
+        "original_max_position_embeddings": 64}))
+    explicit = models.build_model("llama", dict(base_cfg, rope_scaling={
+        "rope_type": "longrope", "short_factor": short, "long_factor": long,
+        "original_max_position_embeddings": 64,
+        "max_position_embeddings": 512}))
+    p = jax.random.PRNGKey(0)
+    params = implicit.init(p)
+    toks = np.array([[1, 2, 3]], np.int32)
+    a = np.asarray(implicit.apply(params, jnp.asarray(toks)))
+    b = np.asarray(explicit.apply(params, jnp.asarray(toks)))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+    # and the scale genuinely differs from the unscaled (orig-only) model
+    unscaled = models.build_model("llama", dict(base_cfg, rope_scaling={
+        "rope_type": "longrope", "short_factor": short, "long_factor": long,
+        "original_max_position_embeddings": 64,
+        "max_position_embeddings": 64}))
+    c = np.asarray(unscaled.apply(params, jnp.asarray(toks)))
+    assert not np.allclose(a, c, rtol=1e-4, atol=1e-4)
+
+
+def test_rope_longrope_decoupled_head_dim_validation():
+    """Factor-length validation must use the RESOLVED head_dim (decoupled
+    via cfg['head_dim']), not dim // n_heads (r5 review)."""
+    from clearml_serving_tpu import models
+
+    # llama-tiny dim=64 n_heads=4 -> dim//n_heads = 16, but head_dim=8:
+    # 4 factors must validate; 8 must be rejected
+    cfg = {"preset": "llama-tiny", "dtype": "float32", "head_dim": 8,
+           "max_seq_len": 128}
+    models.build_model("llama", dict(cfg, rope_scaling={
+        "rope_type": "longrope", "short_factor": [1.0] * 4,
+        "long_factor": [2.0] * 4,
+        "original_max_position_embeddings": 64}))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        models.build_model("llama", dict(cfg, rope_scaling={
+            "rope_type": "longrope", "short_factor": [1.0] * 8,
+            "long_factor": [2.0] * 8,
+            "original_max_position_embeddings": 64}))
