@@ -18,9 +18,10 @@ import numpy as np
 def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
     """(config_dict, params) from a transformers llama-family CausalLM:
     LlamaForCausalLM, Qwen2ForCausalLM (QKV biases), MistralForCausalLM
-    (sliding-window attention) — same tensor naming, two config deltas.
-    `dtype` sets both the stored weight dtype and the bundle's compute dtype
-    (serving default: pass "bfloat16")."""
+    (sliding-window attention), Phi3ForCausalLM (fused qkv/gate_up
+    projections split here; LongRoPE rides rope_scaling) — same skeleton,
+    small config/tensor deltas. `dtype` sets both the stored weight dtype
+    and the bundle's compute dtype (serving default: pass "bfloat16")."""
     hf_cfg = hf_model.config
     sd_keys = hf_model.state_dict().keys()
     # Qwen2 sets no attention_bias flag pre-4.37-config models; detect from
@@ -71,12 +72,19 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
         config["rope_scaling"] = dict(rope_scaling)
         rtype = rope_scaling.get("rope_type") or rope_scaling.get("type")
         if rtype == "longrope":
-            # the attention scale needs the deployed context length, which
-            # HF keeps OUTSIDE the rope_scaling dict
+            # the attention scale needs the deployed AND original context
+            # lengths; HF (Phi-3) keeps both OUTSIDE the rope_scaling dict
             config["rope_scaling"].setdefault(
                 "max_position_embeddings",
                 int(getattr(hf_cfg, "max_position_embeddings", 4096)),
             )
+            orig = getattr(
+                hf_cfg, "original_max_position_embeddings", None
+            )
+            if orig:
+                config["rope_scaling"].setdefault(
+                    "original_max_position_embeddings", int(orig)
+                )
     if gemma:
         # Gemma family deltas: zero-init (1+w) norms, GeGLU, sqrt(dim) embed
         # scaling, head_dim decoupled from dim
@@ -134,13 +142,52 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
     if not config["tie_embeddings"]:
         params["lm_head"] = t("lm_head.weight").T
     gemma2 = model_type == "gemma2"
+    phi3 = model_type == "phi3"
+    prf = getattr(hf_cfg, "partial_rotary_factor", None)
+    if prf not in (None, 1, 1.0):
+        # e.g. Phi-4-mini (model_type phi3, partial_rotary_factor 0.75):
+        # our rope applies to the full head_dim, so converting would serve
+        # silently wrong logits (or fail with a misleading factor-length
+        # error under longrope) — refuse loudly
+        raise ValueError(
+            "partial_rotary_factor={} is not supported (RoPE applies to "
+            "the full head_dim)".format(prf)
+        )
+    head_dim_ = int(
+        getattr(hf_cfg, "head_dim", None)
+        or config["dim"] // config["n_heads"]
+    )
+    if head_dim_ != config["dim"] // config["n_heads"]:
+        # decoupled head_dim must reach the bundle, or build_model's
+        # dim//n_heads fallback reshapes the split projections wrongly
+        config["head_dim"] = head_dim_
+    q_rows = config["n_heads"] * head_dim_
+    kv_rows = config["n_kv_heads"] * head_dim_
     for i in range(config["n_layers"]):
         pre = "model.layers.{}.".format(i)
+        if phi3:
+            # Phi-3 fuses the attention projections into qkv_proj
+            # ([q+2kv rows, dim]) and the GLU input into gate_up_proj
+            # ([2*ffn, dim]); split them into the separate factors the
+            # bundle stores
+            qkv = t(pre + "self_attn.qkv_proj.weight")
+            gate_up = t(pre + "mlp.gate_up_proj.weight")
+            wq = qkv[:q_rows].T
+            wk = qkv[q_rows : q_rows + kv_rows].T
+            wv = qkv[q_rows + kv_rows :].T
+            w_gate = gate_up[: config["ffn_dim"]].T
+            w_up = gate_up[config["ffn_dim"] :].T
+        else:
+            wq = t(pre + "self_attn.q_proj.weight").T
+            wk = t(pre + "self_attn.k_proj.weight").T
+            wv = t(pre + "self_attn.v_proj.weight").T
+            w_gate = t(pre + "mlp.gate_proj.weight").T
+            w_up = t(pre + "mlp.up_proj.weight").T
         layer = {
             "attn_norm": t(pre + "input_layernorm.weight"),
-            "wq": t(pre + "self_attn.q_proj.weight").T,
-            "wk": t(pre + "self_attn.k_proj.weight").T,
-            "wv": t(pre + "self_attn.v_proj.weight").T,
+            "wq": wq,
+            "wk": wk,
+            "wv": wv,
             "wo": t(pre + "self_attn.o_proj.weight").T,
             # Gemma-2 renames: its pre_feedforward_layernorm plays the
             # standard pre-FFN role; post_attention_layernorm becomes the
@@ -149,8 +196,8 @@ def convert_hf_llama(hf_model, dtype: str = "float32") -> tuple:
                 pre + ("pre_feedforward_layernorm.weight" if gemma2
                        else "post_attention_layernorm.weight")
             ),
-            "w_gate": t(pre + "mlp.gate_proj.weight").T,
-            "w_up": t(pre + "mlp.up_proj.weight").T,
+            "w_gate": w_gate,
+            "w_up": w_up,
             "w_down": t(pre + "mlp.down_proj.weight").T,
         }
         if gemma2:

@@ -352,13 +352,7 @@ def test_gemma2_scan_layers_matches_unscanned():
 def test_rope_scaling_linear_matches_hf():
     """Position-interpolation (linear) rope_scaling: full-logits fidelity
     against transformers with the same random weights."""
-    from convert_model import convert_hf_llama
-
-    import jax.numpy as jnp
-
     from transformers import LlamaConfig, LlamaForCausalLM
-
-    from clearml_serving_tpu import models
 
     config = LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
@@ -369,19 +363,8 @@ def test_rope_scaling_linear_matches_hf():
     torch.manual_seed(2)
     hf = LlamaForCausalLM(config)
     hf.eval()
-    cfg, params = convert_hf_llama(hf)
-    cfg["dtype"] = "float32"
-    bundle = models.build_model("llama", cfg)
-    params = {
-        k: (jnp.asarray(v) if not isinstance(v, list)
-            else [{kk: jnp.asarray(vv) for kk, vv in layer.items()} for layer in v])
-        for k, v in params.items()
-    }
-    tokens = np.array([[1, 5, 9, 77, 3, 42, 8, 11, 64, 100]], np.int32)
-    with torch.no_grad():
-        hf_logits = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
-    ours = np.asarray(bundle.apply(params, jnp.asarray(tokens)))
-    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+    cfg = _convert_and_compare(hf, atol=3e-4)
+    assert cfg["rope_scaling"]["rope_type"] == "linear"
 
 
 def test_rope_longrope_matches_hf_tables():
@@ -507,3 +490,71 @@ def test_rope_longrope_decoupled_head_dim_validation():
             "rope_type": "longrope", "short_factor": [1.0] * 8,
             "long_factor": [2.0] * 8,
             "original_max_position_embeddings": 64}))
+
+
+def test_converted_phi3_matches_hf_logits():
+    """Phi-3 = llama skeleton + fused qkv/gate_up projections (split in the
+    converter): full-logits fidelity against transformers."""
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    config = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=128,
+        tie_word_embeddings=False, sliding_window=None, pad_token_id=0,
+    )
+    torch.manual_seed(4)
+    hf = Phi3ForCausalLM(config)
+    hf.eval()
+    _convert_and_compare(hf)
+
+
+def test_converted_phi3_longrope_matches_hf_inside_window():
+    """Phi-3 with LongRoPE: inside the original window the short factors
+    apply uniformly, so full logits must match HF exactly. (Past the window
+    HF re-encodes the WHOLE sequence with long factors while the serving
+    convention — vLLM's — is per-position selection, KV-cache-compatible
+    by construction; pinned at the table level in
+    test_rope_longrope_matches_hf_tables.)"""
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    hd2 = (64 // 4) // 2
+    config = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        max_position_embeddings=256,
+        original_max_position_embeddings=64,
+        tie_word_embeddings=False, sliding_window=None, pad_token_id=0,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0 + 0.1 * i for i in range(hd2)],
+                      "long_factor": [2.0 + 0.2 * i for i in range(hd2)]},
+    )
+    torch.manual_seed(5)
+    hf = Phi3ForCausalLM(config)
+    hf.eval()
+    cfg = _convert_and_compare(hf, seq_len=24)  # 24 < 64: short region
+    assert (cfg["rope_scaling"].get("rope_type")
+            or cfg["rope_scaling"].get("type")) == "longrope"
+    assert cfg["rope_scaling"]["max_position_embeddings"] == 256
+
+
+def test_partial_rotary_factor_is_rejected():
+    """Phi-4-mini-style partial rotary (model_type phi3,
+    partial_rotary_factor<1) must refuse to convert instead of serving
+    silently wrong logits (r5 review)."""
+    from convert_model import convert_hf_llama
+
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    config = Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        sliding_window=None, pad_token_id=0,
+    )
+    config.partial_rotary_factor = 0.75
+    torch.manual_seed(6)
+    hf = Phi3ForCausalLM(config)
+    with pytest.raises(ValueError, match="partial_rotary_factor"):
+        convert_hf_llama(hf)
