@@ -687,6 +687,22 @@ class LLMEngineCore:
             _decode_chunk, donate_argnums=(2,), static_argnames=("want_lp",)
         )
         # first-token (admission) logprobs from the prefill logits
+        def _score_prompt(params, tokens, lora_idx=None):
+            """Teacher-forced scoring: tokens [1, S] -> (chosen [S-1],
+            top_ids [S-1, K], top_lp [S-1, K]) for positions 1..S-1 (the
+            first token has no conditional). OpenAI completions
+            `echo` + `logprobs` needs per-prompt-token logprobs."""
+            logits = bundle.apply(
+                params, tokens, lora_idx=lora_idx
+            ).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits[0, :-1])
+            tgt = tokens[0, 1:]
+            chosen = jnp.take_along_axis(lp, tgt[:, None], axis=1)[:, 0]
+            top_lp, top_id = jax.lax.top_k(lp, self._lp_k)
+            return chosen, top_id.astype(jnp.int32), top_lp
+
+        self._score_prompt_jit = jax.jit(_score_prompt)
+
         self._first_lp_jit = jax.jit(
             lambda logits, chosen: _lp_of(logits, chosen, logits.shape[0])
         )
@@ -1379,6 +1395,44 @@ class LLMEngineCore:
         with self._rng_lock:  # called from the loop thread AND prefill workers
             self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def score_prompt(
+        self, prompt_ids: List[int], adapter: Optional[str] = None
+    ) -> List[dict]:
+        """Per-token prompt logprob entries (same shape as
+        GenRequest.logprob_entries) for positions 1..n-1 — the first token
+        has no conditional. Serves OpenAI completions ``echo`` +
+        ``logprobs``; ``adapter`` selects the same LoRA the generation uses
+        so prompt and generated logprobs come from ONE model. Pads to the
+        prefill bucket (causal attention keeps right padding from touching
+        real positions) so traces stay bounded; read-only on params, safe
+        alongside decode dispatches."""
+        n = len(prompt_ids)
+        if n < 2:
+            return []
+        bucket = self._bucket_for(n)
+        row = np.zeros((1, bucket), np.int32)
+        row[0, :n] = prompt_ids
+        lora_idx = (
+            jnp.full((1,), self._adapter_index.get(adapter or "", 0), jnp.int32)
+            if self._lora_enabled
+            else None
+        )
+        chosen, top_id, top_lp = self._score_prompt_jit(
+            self.params, jnp.asarray(row), lora_idx
+        )
+        chosen = np.asarray(chosen)
+        top_id = np.asarray(top_id)
+        top_lp = np.asarray(top_lp)
+        return [
+            {
+                "id": int(prompt_ids[i + 1]),
+                "logprob": float(chosen[i]),
+                "top_ids": top_id[i].tolist(),
+                "top_logprobs": top_lp[i].tolist(),
+            }
+            for i in range(n - 1)
+        ]
 
     def _wake_loop(self) -> None:
         if self._wake is not None:

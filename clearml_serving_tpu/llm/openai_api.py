@@ -629,6 +629,29 @@ class LLMEngineRequest(BaseEngineRequest):
         )
         return lp
 
+    def _echo_prompt_logprobs(self, prompt_ids: List[int], request):
+        """OpenAI `echo` + `logprobs`: the logprobs block starts with the
+        PROMPT tokens — the first has null logprob/top (no conditional), the
+        rest come from one teacher-forced scoring pass
+        (engine.score_prompt, same LoRA adapter as the generation). Returns
+        (lp dict, next text offset) for the generated entries to append to.
+        Blocking device work — callers run it via asyncio.to_thread."""
+        k = int(request.logprobs or 0)
+        as_ids = getattr(request, "tokens_as_ids", False)
+        entries = self.engine.score_prompt(
+            prompt_ids, adapter=getattr(request, "adapter", None)
+        )
+        first = self._token_repr(prompt_ids[0], as_ids)
+        lp, offset = self._completion_lp_entries(
+            entries, k, offset=len(self._token_str(prompt_ids[0])),
+            as_ids=as_ids,
+        )
+        lp["tokens"].insert(0, first)
+        lp["token_logprobs"].insert(0, None)
+        lp["top_logprobs"].insert(0, None)
+        lp["text_offset"].insert(0, 0)
+        return lp, offset
+
     # -- OpenAI route handlers (dispatched by serve_type) -----------------------
 
     def _require_engine(self, route: str) -> None:
@@ -990,6 +1013,16 @@ class LLMEngineRequest(BaseEngineRequest):
         completion_id = _gen_id("cmpl")
         created = _now()
 
+        raw_max = body.get("max_tokens", body.get("max_completion_tokens"))
+        if raw_max is not None and int(raw_max) == 0:
+            # OpenAI's canonical prompt-scoring call: echo + logprobs +
+            # max_tokens 0 returns the scored prompt and generates nothing
+            # (the falsy-zero would otherwise fall through to the default
+            # budget and bill 128 unasked-for tokens)
+            return await self._zero_completion(body, prompt_id_lists, model,
+                                               completion_id, created,
+                                               collect_fn)
+
         if body.get("stream"):
             if len(prompt_id_lists) != 1:
                 raise EndpointModelError(
@@ -1017,10 +1050,28 @@ class LLMEngineRequest(BaseEngineRequest):
                     chunk["usage"] = None if usage == "omit" else usage
                 return "data: {}\n\n".format(json.dumps(chunk))
 
+            echo = bool(body.get("echo"))
+
             async def sse():
                 lp_offset = 0
                 as_ids = getattr(request, "tokens_as_ids", False)
                 try:
+                    if echo:
+                        # OpenAI echo semantics: the prompt text arrives as
+                        # the first chunk (with its logprob entries when
+                        # logprobs is set; scoring runs off-loop)
+                        first = {
+                            "index": 0,
+                            "text": self.tokenizer.decode(prompt_id_lists[0]),
+                            "finish_reason": None,
+                        }
+                        if request.logprobs is not None:
+                            lp, lp_offset = await asyncio.to_thread(
+                                self._echo_prompt_logprobs,
+                                prompt_id_lists[0], request,
+                            )
+                            first["logprobs"] = lp
+                        yield cmpl_chunk([first])
                     try:
                         async for piece in self._stream_deltas(request, stops):
                             choice = {"index": 0, "text": piece["delta"],
@@ -1102,6 +1153,17 @@ class LLMEngineRequest(BaseEngineRequest):
                 sel.extend(grp[:n])
         else:
             sel = list(range(len(requests)))
+        echo = bool(body.get("echo"))
+        # echo+logprobs: ONE teacher-forced scoring pass per distinct
+        # prompt (choices share it), off the event loop — the jitted
+        # forward (plus a first-hit compile) would stall every concurrent
+        # stream if run inline
+        echo_lp: Dict[int, Any] = {}
+        if echo and requests[0].logprobs is not None and not lp_internal:
+            for p, ids in enumerate(prompt_id_lists):
+                echo_lp[p] = await asyncio.to_thread(
+                    self._echo_prompt_logprobs, ids, requests[p * best_of]
+                )
         choices = []
         for i, idx in enumerate(sel):
             r, res = requests[idx], results[idx]
@@ -1115,6 +1177,24 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
+            if echo:
+                # OpenAI `echo`: the prompt text leads the output; with
+                # logprobs, prompt-token entries lead the block (first one
+                # null — no conditional)
+                p_ids = requests[idx].prompt_ids
+                choice["text"] = self.tokenizer.decode(p_ids) + res["text"]
+                if idx // best_of in echo_lp:
+                    lp0, off = echo_lp[idx // best_of]
+                    lp = {k2: list(v2) for k2, v2 in lp0.items()}
+                    gen_lp, _ = self._completion_lp_entries(
+                        r.logprob_entries[: len(res["ids"])],
+                        int(r.logprobs or 0), offset=off,
+                        as_ids=getattr(r, "tokens_as_ids", False),
+                    )
+                    for key in ("tokens", "token_logprobs", "top_logprobs",
+                                "text_offset"):
+                        lp[key].extend(gen_lp[key])
+                    choice["logprobs"] = lp
             choices.append(choice)
         prompt_tokens = sum(len(ids) for ids in prompt_id_lists)
         return {
@@ -1129,6 +1209,89 @@ class LLMEngineRequest(BaseEngineRequest):
                 "total_tokens": prompt_tokens + sum(r.produced for r in requests),
             },
         }
+
+    async def _zero_completion(self, body, prompt_id_lists, model,
+                               completion_id, created, collect_fn):
+        """max_tokens=0 completions: no generation; echo/logprobs still
+        apply (per-prompt scoring pass off the event loop)."""
+        echo = bool(body.get("echo"))
+        raw_lp = body.get("logprobs")
+        logprobs = (
+            int(raw_lp) if raw_lp is not None and raw_lp is not False else None
+        )
+        n = int(body.get("n", 1) or 1)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        as_ids = bool(body.get("return_tokens_as_token_ids"))
+        adapter = self._adapter_for(body)
+        choices = []
+        for p, ids in enumerate(prompt_id_lists):
+            text = self.tokenizer.decode(ids) if echo else ""
+            lp = None
+            if logprobs is not None and echo:
+                entries = await asyncio.to_thread(
+                    self.engine.score_prompt, ids, adapter
+                )
+                lp, _ = self._completion_lp_entries(
+                    entries, logprobs,
+                    offset=len(self._token_str(ids[0])), as_ids=as_ids,
+                )
+                lp["tokens"].insert(0, self._token_repr(ids[0], as_ids))
+                lp["token_logprobs"].insert(0, None)
+                lp["top_logprobs"].insert(0, None)
+                lp["text_offset"].insert(0, 0)
+            elif logprobs is not None:
+                lp = {"tokens": [], "token_logprobs": [],
+                      "top_logprobs": [], "text_offset": []}
+            for _ in range(n):
+                choices.append({
+                    "index": len(choices),
+                    "text": text,
+                    "finish_reason": "length",
+                    "logprobs": dict(lp) if lp is not None else None,
+                })
+        if collect_fn is not None:
+            collect_fn({
+                "gen_tokens": 0,
+                "prompt_tokens": sum(len(i) for i in prompt_id_lists),
+            })
+        prompt_tokens = sum(len(i) for i in prompt_id_lists)
+        out = {
+            "id": completion_id,
+            "object": "text_completion",
+            "created": created,
+            "model": model,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": 0,
+                "total_tokens": prompt_tokens,
+            },
+        }
+        if body.get("stream"):
+            include_usage = bool(
+                (body.get("stream_options") or {}).get("include_usage")
+            )
+
+            async def sse():
+                for ch in choices:
+                    chunk = {
+                        "id": completion_id, "object": "text_completion",
+                        "created": created, "model": model, "choices": [ch],
+                    }
+                    if include_usage:
+                        chunk["usage"] = None
+                    yield "data: {}\n\n".format(json.dumps(chunk))
+                if include_usage:
+                    yield "data: {}\n\n".format(json.dumps({
+                        "id": completion_id, "object": "text_completion",
+                        "created": created, "model": model, "choices": [],
+                        "usage": out["usage"],
+                    }))
+                yield "data: [DONE]\n\n"
+
+            return StreamingOutput(sse())
+        return out
 
     async def v1_models(self, body: Dict[str, Any], state: dict, collect_fn=None):
         data = [

@@ -396,3 +396,21 @@ def test_engine_lora_with_speculation():
     plain = asyncio.run(run())
     spec = asyncio.run(run(speculation="ngram", spec_k=2, spec_ngram=2))
     assert spec == plain
+
+
+def test_score_prompt_uses_adapter():
+    """echo+logprobs prompt scoring must run through the SAME LoRA the
+    generation uses — base-model prompt logprobs next to adapter generated
+    logprobs would be silently wrong (r5 review)."""
+    bundle = models.build_model("llama", TINY)
+    params = bundle.init(jax.random.PRNGKey(0))
+    ad = _rand_adapter(bundle.config, bundle.n_layers, jax.random.PRNGKey(7))
+    engine = _engine(bundle, params, lora_adapters={"tune": ad})
+    prompt = [5, 9, 2, 17, 33, 1]
+    base = engine.score_prompt(prompt)
+    tuned = engine.score_prompt(prompt, adapter="tune")
+    assert len(base) == len(tuned) == len(prompt) - 1
+    assert any(
+        abs(a["logprob"] - b["logprob"]) > 1e-6 for a, b in zip(base, tuned)
+    ), "adapter did not change prompt scoring"
+    engine.stop()

@@ -441,3 +441,86 @@ def test_best_of_with_logprobs_false(llm_served):
     # same seeds -> same candidate pool: the winner must match the
     # logprobs-on run's winner, proving ranking actually happened
     assert choice["text"] == ref["choices"][0]["text"]
+
+
+def test_echo_prepends_prompt_with_logprobs(llm_served):
+    """OpenAI completions `echo`: the prompt text leads the output, and with
+    `logprobs` the block starts with prompt-token entries (first one null)
+    followed by the generated entries, offsets continuous."""
+
+    async def fn(client):
+        base = {"model": "tiny_llm", "prompt": "abc", "max_tokens": 4,
+                "logprobs": 1}
+        plain = await client.post("/serve/openai/v1/completions", json=base)
+        echoed = await client.post(
+            "/serve/openai/v1/completions", json=dict(base, echo=True))
+        assert plain.status == 200 and echoed.status == 200
+        return await plain.json(), await echoed.json()
+
+    plain, echoed = _run(llm_served, fn)
+    p_choice, e_choice = plain["choices"][0], echoed["choices"][0]
+    assert e_choice["text"].endswith(p_choice["text"])
+    assert "abc" in e_choice["text"][: len(e_choice["text"]) - len(p_choice["text"])]
+    lp = e_choice["logprobs"]
+    n_prompt = len(lp["tokens"]) - len(p_choice["logprobs"]["tokens"])
+    assert n_prompt >= 2  # BOS + "abc" bytes
+    assert lp["token_logprobs"][0] is None and lp["top_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    # offsets strictly increase across the prompt/generated boundary
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+    # generated entries identical to the non-echo run's
+    assert lp["token_logprobs"][n_prompt:] == pytest.approx(
+        p_choice["logprobs"]["token_logprobs"], abs=1e-4
+    )
+
+
+def test_echo_streaming_prompt_first_chunk(llm_served):
+    """Streaming echo: the first SSE chunk carries the prompt text."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "xyz", "max_tokens": 3,
+                  "stream": True, "echo": True},
+        )
+        assert r.status == 200
+        return (await r.read()).decode()
+
+    raw = _run(llm_served, fn)
+    import json as _json
+
+    texts = []
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        for ch in _json.loads(line[6:]).get("choices", []):
+            if ch.get("text"):
+                texts.append(ch["text"])
+    assert texts and "xyz" in texts[0]
+    assert len(texts) >= 2  # prompt chunk + generated deltas
+
+
+def test_echo_max_tokens_zero_scores_prompt(llm_served):
+    """The canonical OpenAI scoring call — echo + logprobs + max_tokens 0 —
+    returns the scored prompt, generates nothing, and bills nothing (a
+    falsy-zero must not fall through to the default budget)."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "abc", "max_tokens": 0,
+                  "echo": True, "logprobs": 1},
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(llm_served, fn)
+    (choice,) = out["choices"]
+    assert choice["text"] == "abc" or choice["text"].endswith("abc")
+    assert choice["finish_reason"] == "length"
+    lp = choice["logprobs"]
+    assert len(lp["tokens"]) >= 2
+    assert lp["token_logprobs"][0] is None
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+    assert out["usage"]["completion_tokens"] == 0
+    assert out["usage"]["total_tokens"] == out["usage"]["prompt_tokens"]
