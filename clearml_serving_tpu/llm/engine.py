@@ -691,15 +691,37 @@ class LLMEngineCore:
             """Teacher-forced scoring: tokens [1, S] -> (chosen [S-1],
             top_ids [S-1, K], top_lp [S-1, K]) for positions 1..S-1 (the
             first token has no conditional). OpenAI completions
-            `echo` + `logprobs` needs per-prompt-token logprobs."""
-            logits = bundle.apply(
-                params, tokens, lora_idx=lora_idx
-            ).astype(jnp.float32)
-            lp = jax.nn.log_softmax(logits[0, :-1])
+            `echo` + `logprobs` needs per-prompt-token logprobs.
+
+            The softmax/top-k pass runs in SEQUENTIAL position blocks
+            (lax.map): a full-bucket float32 log_softmax over a 128k vocab
+            would be a multi-GB HBM transient next to resident weights +
+            KV — an OOM that kills in-flight decode."""
+            logits = bundle.apply(params, tokens, lora_idx=lora_idx)[0]
+            src = logits[:-1]                            # [S-1, V] model dtype
             tgt = tokens[0, 1:]
-            chosen = jnp.take_along_axis(lp, tgt[:, None], axis=1)[:, 0]
-            top_lp, top_id = jax.lax.top_k(lp, self._lp_k)
-            return chosen, top_id.astype(jnp.int32), top_lp
+            block = 256
+            s1, v = src.shape
+            pad = (-s1) % block
+            src = jnp.pad(src, ((0, pad), (0, 0)))
+            tgt = jnp.pad(tgt, (0, pad))
+
+            def blk(args):
+                lg, tg = args
+                lp = jax.nn.log_softmax(lg.astype(jnp.float32))
+                chosen = jnp.take_along_axis(lp, tg[:, None], axis=1)[:, 0]
+                tl, ti = jax.lax.top_k(lp, self._lp_k)
+                return chosen, ti.astype(jnp.int32), tl
+
+            ch, ti, tl = jax.lax.map(
+                blk,
+                (src.reshape(-1, block, v), tgt.reshape(-1, block)),
+            )
+            return (
+                ch.reshape(-1)[:s1],
+                ti.reshape(-1, self._lp_k)[:s1],
+                tl.reshape(-1, self._lp_k)[:s1],
+            )
 
         self._score_prompt_jit = jax.jit(_score_prompt)
 

@@ -1215,32 +1215,27 @@ class LLMEngineRequest(BaseEngineRequest):
         """max_tokens=0 completions: no generation; echo/logprobs still
         apply (per-prompt scoring pass off the event loop)."""
         echo = bool(body.get("echo"))
-        raw_lp = body.get("logprobs")
-        logprobs = (
-            int(raw_lp) if raw_lp is not None and raw_lp is not False else None
-        )
         n = int(body.get("n", 1) or 1)
         if n < 1:
             raise ValueError("n must be >= 1")
-        as_ids = bool(body.get("return_tokens_as_token_ids"))
-        adapter = self._adapter_for(body)
         choices = []
         for p, ids in enumerate(prompt_id_lists):
+            if not ids:
+                raise ValueError("prompt must not be empty")
+            # a probe request runs the SAME validation (prompt length,
+            # logprobs ceiling, guided config) every generating path runs —
+            # this path must not 500 where those would 4xx
+            probe = self._gen_request_from_body(body, list(ids), chat=False)
+            probe.max_new_tokens = 1
+            probe.prompt_len = len(ids)
+            self.engine.validate(probe)
             text = self.tokenizer.decode(ids) if echo else ""
             lp = None
-            if logprobs is not None and echo:
-                entries = await asyncio.to_thread(
-                    self.engine.score_prompt, ids, adapter
+            if probe.logprobs is not None and echo:
+                lp, _ = await asyncio.to_thread(
+                    self._echo_prompt_logprobs, ids, probe
                 )
-                lp, _ = self._completion_lp_entries(
-                    entries, logprobs,
-                    offset=len(self._token_str(ids[0])), as_ids=as_ids,
-                )
-                lp["tokens"].insert(0, self._token_repr(ids[0], as_ids))
-                lp["token_logprobs"].insert(0, None)
-                lp["top_logprobs"].insert(0, None)
-                lp["text_offset"].insert(0, 0)
-            elif logprobs is not None:
+            elif probe.logprobs is not None:
                 lp = {"tokens": [], "token_logprobs": [],
                       "top_logprobs": [], "text_offset": []}
             for _ in range(n):

@@ -96,10 +96,49 @@ def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
                 "longrope rope_scaling needs original_max_position_embeddings"
             )
         return freqs
+    if rope_type == "yarn":
+        # YaRN (Peng et al.): NTK-by-parts — high frequencies extrapolate
+        # (unscaled), low frequencies interpolate (1/factor), a linear ramp
+        # between wavelength bands derived from beta_fast/beta_slow blends
+        # the middle; the attention temperature rides cos/sin in _rope.
+        # Mirrors transformers' _compute_yarn_parameters exactly: band
+        # indices live in FULL head_dim space (clamped to head_dim-1, not
+        # head_dim//2-1), truncate floors/ceils them (default on), missing
+        # original_max_position_embeddings falls back to the deployed
+        # length (injected by build from max_seq_len).
+        factor = float(rope_scaling["factor"])
+        orig = float(
+            rope_scaling.get("original_max_position_embeddings")
+            or rope_scaling.get("max_position_embeddings")
+            or 4096
+        )
+        beta_fast = float(rope_scaling.get("beta_fast") or 32.0)
+        beta_slow = float(rope_scaling.get("beta_slow") or 1.0)
+        hd2 = head_dim // 2
+
+        def band(beta):
+            # dim index whose wavelength covers `beta` periods over orig
+            return head_dim * math.log(orig / (beta * 2.0 * math.pi)) / (
+                2.0 * math.log(theta)
+            )
+
+        low, high = band(beta_fast), band(beta_slow)
+        if rope_scaling.get("truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low = max(low, 0)
+        high = min(high, head_dim - 1)
+        if low == high:
+            high += 0.001  # prevent singularity
+        ramp = jnp.clip(
+            (jnp.arange(hd2, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0,
+        )
+        extrap_w = 1.0 - ramp  # 1 = keep unscaled, 0 = fully interpolated
+        return (freqs / factor) * (1.0 - extrap_w) + freqs * extrap_w
     if rope_type != "llama3":
         raise ValueError(
             "unsupported rope_scaling type {!r} (supported: llama3, "
-            "linear, longrope)".format(rope_type)
+            "linear, yarn, longrope)".format(rope_type)
         )
     # Llama-3.1 frequency-dependent scaling: long wavelengths scale by
     # 1/factor, short ones stay, the middle band interpolates smoothly.
@@ -119,6 +158,28 @@ def _rope_freqs(head_dim: int, theta: float, rope_scaling: Optional[dict]):
     )
 
 
+def _yarn_attention_factor(rope_scaling: dict) -> float:
+    """YaRN attention temperature on cos/sin: explicit attention_factor,
+    else DeepSeek's mscale pair, else 0.1*ln(factor)+1 (the paper's
+    default; HF _compute_yarn_parameters order)."""
+    att = rope_scaling.get("attention_factor")
+    if att is not None:
+        return float(att)
+    factor = float(rope_scaling["factor"])
+
+    def get_mscale(scale, m=1.0):
+        return 1.0 if scale <= 1.0 else 0.1 * m * math.log(scale) + 1.0
+
+    mscale = rope_scaling.get("mscale")
+    mscale_all_dim = rope_scaling.get("mscale_all_dim")
+    # HF semantics: the DeepSeek pair applies only when BOTH are truthy
+    if mscale and mscale_all_dim:
+        return get_mscale(factor, float(mscale)) / get_mscale(
+            factor, float(mscale_all_dim)
+        )
+    return get_mscale(factor)
+
+
 def _rope(positions: jnp.ndarray, head_dim: int, theta: float,
           rope_scaling: Optional[dict] = None):
     """cos/sin tables for given positions: [..., head_dim//2]."""
@@ -127,6 +188,11 @@ def _rope(positions: jnp.ndarray, head_dim: int, theta: float,
         if rope_scaling
         else None
     )
+    if rope_type == "yarn":
+        freqs = _rope_freqs(head_dim, theta, rope_scaling)
+        att = _yarn_attention_factor(rope_scaling)
+        angles = positions.astype(jnp.float32)[..., None] * freqs
+        return jnp.cos(angles) * att, jnp.sin(angles) * att
     if rope_type == "longrope":
         # Phi-3 LongRoPE (vLLM Phi3LongRoPEScaledRotaryEmbedding layout):
         # per-dim rescale factors — SHORT factors for positions inside the
@@ -187,15 +253,27 @@ def build(config: dict) -> SimpleNamespace:
     dtype = jnp.dtype(cfg["dtype"])
     # head_dim may be decoupled from dim (Gemma-2: 16 heads x 256 > dim)
     head_dim = int(cfg.get("head_dim") or dim // n_heads)
-    if rope_scaling and (
-        rope_scaling.get("rope_type") or rope_scaling.get("type")
-    ) == "longrope":
+    _rt = (
+        (rope_scaling.get("rope_type") or rope_scaling.get("type"))
+        if rope_scaling
+        else None
+    )
+    if _rt == "longrope":
         # the attention scale needs the DEPLOYED context length; HF keeps it
         # outside the rope_scaling dict, so default it from the model's own
         # max_seq_len rather than silently degrading to scale 1.0
         rope_scaling = dict(rope_scaling)
         rope_scaling.setdefault(
             "max_position_embeddings", int(cfg.get("max_seq_len") or 0) or None
+        )
+    elif _rt == "yarn":
+        # HF falls back to config.max_position_embeddings when the dict
+        # omits the original window; a silent 4096 default would shift the
+        # correction bands and diverge from the HF tables
+        rope_scaling = dict(rope_scaling)
+        rope_scaling.setdefault(
+            "original_max_position_embeddings",
+            int(cfg.get("max_seq_len") or 0) or None,
         )
     _rope_freqs(head_dim, theta, rope_scaling)  # fail fast on bad cfg
     assert n_heads % n_kv == 0, "n_heads must be divisible by n_kv_heads"

@@ -430,7 +430,7 @@ def test_rope_longrope_validation():
     with pytest.raises(ValueError):
         models.build_model("llama", {
             "preset": "llama-tiny", "dtype": "float32",
-            "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
+            "rope_scaling": {"rope_type": "dynamic", "factor": 2.0},
         })
 
 
@@ -558,3 +558,48 @@ def test_partial_rotary_factor_is_rejected():
     hf = Phi3ForCausalLM(config)
     with pytest.raises(ValueError, match="partial_rotary_factor"):
         convert_hf_llama(hf)
+
+
+def test_rope_scaling_yarn_matches_hf():
+    """YaRN rope_scaling: full-logits fidelity against transformers
+    (NTK-by-parts bands + attention temperature on cos/sin)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+    )
+    torch.manual_seed(3)
+    hf = LlamaForCausalLM(config)
+    hf.eval()
+    cfg = _convert_and_compare(hf, atol=3e-4)
+    assert (cfg["rope_scaling"].get("rope_type")
+            or cfg["rope_scaling"].get("type")) == "yarn"
+
+
+def test_rope_yarn_tables_match_hf_init():
+    """YaRN inverse frequencies and attention scaling pinned against HF's
+    rope-init directly (incl. non-default betas)."""
+    from transformers import LlamaConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from clearml_serving_tpu.models.llama import (
+        _rope_freqs,
+        _yarn_attention_factor,
+    )
+
+    scaling = {"rope_type": "yarn", "factor": 8.0,
+               "original_max_position_embeddings": 128,
+               "beta_fast": 16.0, "beta_slow": 2.0}
+    cfg = LlamaConfig(
+        hidden_size=128, num_attention_heads=4,
+        max_position_embeddings=1024, rope_theta=10000.0,
+        rope_scaling=dict(scaling),
+    )
+    inv, att = ROPE_INIT_FUNCTIONS["yarn"](cfg, device=None)
+    ours = np.asarray(_rope_freqs(32, 10000.0, scaling))
+    np.testing.assert_allclose(ours, inv.numpy(), rtol=1e-5, atol=1e-7)
+    assert _yarn_attention_factor(scaling) == pytest.approx(float(att))
