@@ -1028,14 +1028,21 @@ class LLMEngineRequest(BaseEngineRequest):
                 raise EndpointModelError(
                     "streaming completions support a single prompt per request"
                 )
-            if int(body.get("n", 1) or 1) != 1:
-                raise EndpointModelError("streaming supports a single choice (n=1)")
-            if body.get("best_of") not in (None, 1):
-                raise EndpointModelError("best_of cannot be used with streaming")
-            request = self._gen_request_from_body(
-                body, prompt_id_lists[0], chat=False
-            )
-            self.engine.validate(request)
+            stream_n = int(body.get("n", 1) or 1)
+            if (
+                body.get("best_of") is not None
+                and int(body["best_of"]) != stream_n
+            ):
+                # OpenAI: a server-side candidate pool cannot stream (which
+                # choice to emit is unknown until the end); best_of == n
+                # degenerates to plain n and may stream
+                raise EndpointModelError(
+                    "best_of must equal n when streaming"
+                )
+            stream_requests = self._n_requests(body, prompt_id_lists[0],
+                                               chat=False)
+            for r in stream_requests:
+                self.engine.validate(r)
 
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage")
@@ -1053,61 +1060,92 @@ class LLMEngineRequest(BaseEngineRequest):
             echo = bool(body.get("echo"))
 
             async def sse():
-                lp_offset = 0
-                as_ids = getattr(request, "tokens_as_ids", False)
+                # one pump per choice feeding a shared queue: chunks
+                # interleave as each choice's deltas land, tagged with the
+                # OpenAI per-chunk `index` (n>1 streaming parity)
+                lp_offsets = [0] * stream_n
+                queue: "asyncio.Queue" = asyncio.Queue()
+
+                async def pump(i, req):
+                    try:
+                        async for piece in self._stream_deltas(req, stops):
+                            await queue.put((i, "delta", piece))
+                        await queue.put((i, "finish", None))
+                    except Exception as ex:  # surfaced as an SSE error
+                        await queue.put((i, "error", ex))
+
+                tasks: List[asyncio.Task] = []
                 try:
                     if echo:
                         # OpenAI echo semantics: the prompt text arrives as
-                        # the first chunk (with its logprob entries when
-                        # logprobs is set; scoring runs off-loop)
-                        first = {
-                            "index": 0,
-                            "text": self.tokenizer.decode(prompt_id_lists[0]),
-                            "finish_reason": None,
-                        }
-                        if request.logprobs is not None:
-                            lp, lp_offset = await asyncio.to_thread(
+                        # each choice's first chunk (logprob entries scored
+                        # ONCE off-loop; choices share the prompt)
+                        prompt_text = self.tokenizer.decode(prompt_id_lists[0])
+                        echo_lp = None
+                        if stream_requests[0].logprobs is not None:
+                            echo_lp, off = await asyncio.to_thread(
                                 self._echo_prompt_logprobs,
-                                prompt_id_lists[0], request,
+                                prompt_id_lists[0], stream_requests[0],
                             )
-                            first["logprobs"] = lp
-                        yield cmpl_chunk([first])
-                    try:
-                        async for piece in self._stream_deltas(request, stops):
-                            choice = {"index": 0, "text": piece["delta"],
-                                      "finish_reason": None}
-                            if piece.get("entries") is not None:
-                                lp, lp_offset = self._completion_lp_entries(
-                                    piece["entries"],
-                                    int(request.logprobs or 0),
-                                    offset=lp_offset,
-                                    as_ids=as_ids,
-                                )
-                                choice["logprobs"] = lp
-                            yield cmpl_chunk([choice])
-                    except Exception as ex:
-                        yield "data: {}\n\n".format(json.dumps(
-                            {"error": {"message": str(ex), "type": type(ex).__name__}}
-                        ))
-                        yield "data: [DONE]\n\n"
-                        return
-                    yield cmpl_chunk(
-                        [{"index": 0, "text": "",
-                          "finish_reason": self._finish_reason(request)}]
-                    )
+                            lp_offsets = [off] * stream_n
+                        for i in range(stream_n):
+                            first = {"index": i, "text": prompt_text,
+                                     "finish_reason": None}
+                            if echo_lp is not None:
+                                first["logprobs"] = {
+                                    k: list(v) for k, v in echo_lp.items()
+                                }
+                            yield cmpl_chunk([first])
+                    tasks = [
+                        asyncio.get_running_loop().create_task(pump(i, r))
+                        for i, r in enumerate(stream_requests)
+                    ]
+                    live = stream_n
+                    while live:
+                        i, kind, payload = await queue.get()
+                        if kind == "error":
+                            yield "data: {}\n\n".format(json.dumps(
+                                {"error": {"message": str(payload),
+                                           "type": type(payload).__name__}}
+                            ))
+                            yield "data: [DONE]\n\n"
+                            return
+                        req = stream_requests[i]
+                        if kind == "finish":
+                            yield cmpl_chunk(
+                                [{"index": i, "text": "",
+                                  "finish_reason": self._finish_reason(req)}]
+                            )
+                            live -= 1
+                            continue
+                        choice = {"index": i, "text": payload["delta"],
+                                  "finish_reason": None}
+                        if payload.get("entries") is not None:
+                            lp, lp_offsets[i] = self._completion_lp_entries(
+                                payload["entries"],
+                                int(req.logprobs or 0),
+                                offset=lp_offsets[i],
+                                as_ids=getattr(req, "tokens_as_ids", False),
+                            )
+                            choice["logprobs"] = lp
+                        yield cmpl_chunk([choice])
                     if include_usage:
+                        total = sum(r.produced for r in stream_requests)
                         yield cmpl_chunk([], usage={
-                            "prompt_tokens": request.prompt_len,
-                            "completion_tokens": request.produced,
-                            "total_tokens": request.prompt_len
-                            + request.produced,
+                            "prompt_tokens": stream_requests[0].prompt_len,
+                            "completion_tokens": total,
+                            "total_tokens": stream_requests[0].prompt_len
+                            + total,
                         })
                     yield "data: [DONE]\n\n"
                 finally:
                     # normal completion AND client disconnect (GeneratorExit):
-                    # free the decode slot early, record streaming stats
-                    request.cancel()
-                    self._report_gen_stats(request, collect_fn)
+                    # free every decode slot early, record streaming stats
+                    for t in tasks:
+                        t.cancel()
+                    for r in stream_requests:
+                        r.cancel()
+                        self._report_gen_stats(r, collect_fn)
 
             return StreamingOutput(sse())
 

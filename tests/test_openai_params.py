@@ -524,3 +524,53 @@ def test_echo_max_tokens_zero_scores_prompt(llm_served):
     assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
     assert out["usage"]["completion_tokens"] == 0
     assert out["usage"]["total_tokens"] == out["usage"]["prompt_tokens"]
+
+
+def test_streaming_completions_multi_choice(llm_served):
+    """OpenAI n>1 streaming: chunks interleave with per-chunk `index`, each
+    choice finishes independently, and accumulating by index reproduces the
+    non-streaming choices (same seeds: seed+i per choice)."""
+    import json as _json
+
+    async def fn(client):
+        body = {"model": "tiny_llm", "prompt": "go", "max_tokens": 6,
+                "temperature": 1.0, "seed": 21, "n": 3,
+                "stream_options": {"include_usage": True}}
+        r = await client.post(
+            "/serve/openai/v1/completions", json=dict(body, stream=True))
+        assert r.status == 200
+        raw = (await r.read()).decode()
+        r2 = await client.post("/serve/openai/v1/completions", json=body)
+        assert r2.status == 200, await r2.text()
+        return raw, await r2.json()
+
+    raw, ref = _run(llm_served, fn)
+    texts = {0: "", 1: "", 2: ""}
+    finishes = {}
+    usage = None
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        chunk = _json.loads(line[6:])
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for ch in chunk.get("choices", []):
+            texts[ch["index"]] += ch.get("text") or ""
+            if ch.get("finish_reason"):
+                finishes[ch["index"]] = ch["finish_reason"]
+    assert set(finishes) == {0, 1, 2}
+    ref_texts = {c["index"]: c["text"] for c in ref["choices"]}
+    assert texts == ref_texts
+    assert usage is not None and usage["completion_tokens"] == 18
+
+
+def test_streaming_best_of_must_equal_n(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "x", "max_tokens": 4,
+                  "stream": True, "n": 2, "best_of": 4},
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
