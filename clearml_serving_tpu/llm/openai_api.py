@@ -629,6 +629,60 @@ class LLMEngineRequest(BaseEngineRequest):
         )
         return lp
 
+    async def _fanout_stream(self, requests, stops, collect_fn, *,
+                             head, delta, finish, usage):
+        """Shared multi-choice SSE core (chat and completions n>1
+        streaming): one _stream_deltas pump per choice feeds a queue and
+        chunks interleave by arrival, tagged with the OpenAI per-chunk
+        index by the format callbacks. ``head``: pre-built leading chunks;
+        ``delta(i, req, piece)`` / ``finish(i, req)`` format per-choice
+        chunks; ``usage()`` returns the trailing usage chunk or None. The
+        finally block frees every decode slot and reports stats on normal
+        completion AND client disconnect."""
+        queue: "asyncio.Queue" = asyncio.Queue()
+
+        async def pump(i, req):
+            try:
+                async for piece in self._stream_deltas(req, stops):
+                    await queue.put((i, "delta", piece))
+                await queue.put((i, "finish", None))
+            except Exception as ex:  # surfaced as an SSE error event
+                await queue.put((i, "error", ex))
+
+        tasks: List[asyncio.Task] = []
+        try:
+            for chunk in head:
+                yield chunk
+            tasks = [
+                asyncio.get_running_loop().create_task(pump(i, r))
+                for i, r in enumerate(requests)
+            ]
+            live = len(requests)
+            while live:
+                i, kind, payload = await queue.get()
+                if kind == "error":
+                    yield "data: {}\n\n".format(json.dumps(
+                        {"error": {"message": str(payload),
+                                   "type": type(payload).__name__}}
+                    ))
+                    yield "data: [DONE]\n\n"
+                    return
+                if kind == "finish":
+                    yield finish(i, requests[i])
+                    live -= 1
+                    continue
+                yield delta(i, requests[i], payload)
+            tail = usage()
+            if tail is not None:
+                yield tail
+            yield "data: [DONE]\n\n"
+        finally:
+            for t in tasks:
+                t.cancel()
+            for r in requests:
+                r.cancel()
+                self._report_gen_stats(r, collect_fn)
+
     def _echo_prompt_logprobs(self, prompt_ids: List[int], request):
         """OpenAI `echo` + `logprobs`: the logprobs block starts with the
         PROMPT tokens — the first has null logprob/top (no conditional), the
@@ -739,8 +793,60 @@ class LLMEngineRequest(BaseEngineRequest):
             return "data: {}\n\n".format(json.dumps(chunk))
 
         if body.get("stream"):
-            if int(body.get("n", 1) or 1) != 1:
-                raise EndpointModelError("streaming supports a single choice (n=1)")
+            n_stream = int(body.get("n", 1) or 1)
+            if n_stream != 1:
+                if tools:
+                    # the tool-call sniff/buffer machinery is per-choice
+                    # state; multi-choice streaming is supported for plain
+                    # chat only
+                    raise EndpointModelError(
+                        "streaming chat with tools supports a single "
+                        "choice (n=1)"
+                    )
+                requests = self._n_requests(
+                    body, prompt_ids, guided_override=guided_override
+                )
+                for r in requests:
+                    self.engine.validate(r)
+
+                def chat_delta(i, req, piece):
+                    choice = {"index": i,
+                              "delta": {"content": piece["delta"]},
+                              "finish_reason": None}
+                    if piece.get("entries") is not None:
+                        choice["logprobs"] = {
+                            "content": self._chat_lp_entries(
+                                piece["entries"], int(req.logprobs or 0),
+                                as_ids=getattr(req, "tokens_as_ids", False),
+                            )
+                        }
+                    return chat_chunk(choice)
+
+                def chat_finish(i, req):
+                    return chat_chunk({
+                        "index": i, "delta": {},
+                        "finish_reason": self._finish_reason(req),
+                    })
+
+                def chat_usage():
+                    if not include_usage:
+                        return None
+                    total = sum(r.produced for r in requests)
+                    return chat_chunk(None, usage={
+                        "prompt_tokens": requests[0].prompt_len,
+                        "completion_tokens": total,
+                        "total_tokens": requests[0].prompt_len + total,
+                    })
+
+                return StreamingOutput(self._fanout_stream(
+                    requests, stops, collect_fn,
+                    head=[
+                        chat_chunk({"index": i, "delta": {"role": role},
+                                    "finish_reason": None})
+                        for i in range(n_stream)
+                    ],
+                    delta=chat_delta, finish=chat_finish, usage=chat_usage,
+                ))
             request = self._gen_request_from_body(
                 body, prompt_ids, guided_override=guided_override
             )
@@ -1059,93 +1165,64 @@ class LLMEngineRequest(BaseEngineRequest):
 
             echo = bool(body.get("echo"))
 
+            lp_offsets = [0] * stream_n
+
+            def cmpl_delta(i, req, piece):
+                choice = {"index": i, "text": piece["delta"],
+                          "finish_reason": None}
+                if piece.get("entries") is not None:
+                    lp, lp_offsets[i] = self._completion_lp_entries(
+                        piece["entries"], int(req.logprobs or 0),
+                        offset=lp_offsets[i],
+                        as_ids=getattr(req, "tokens_as_ids", False),
+                    )
+                    choice["logprobs"] = lp
+                return cmpl_chunk([choice])
+
+            def cmpl_finish(i, req):
+                return cmpl_chunk(
+                    [{"index": i, "text": "",
+                      "finish_reason": self._finish_reason(req)}]
+                )
+
+            def cmpl_usage():
+                if not include_usage:
+                    return None
+                total = sum(r.produced for r in stream_requests)
+                return cmpl_chunk([], usage={
+                    "prompt_tokens": stream_requests[0].prompt_len,
+                    "completion_tokens": total,
+                    "total_tokens": stream_requests[0].prompt_len + total,
+                })
+
             async def sse():
-                # one pump per choice feeding a shared queue: chunks
-                # interleave as each choice's deltas land, tagged with the
-                # OpenAI per-chunk `index` (n>1 streaming parity)
-                lp_offsets = [0] * stream_n
-                queue: "asyncio.Queue" = asyncio.Queue()
-
-                async def pump(i, req):
-                    try:
-                        async for piece in self._stream_deltas(req, stops):
-                            await queue.put((i, "delta", piece))
-                        await queue.put((i, "finish", None))
-                    except Exception as ex:  # surfaced as an SSE error
-                        await queue.put((i, "error", ex))
-
-                tasks: List[asyncio.Task] = []
-                try:
-                    if echo:
-                        # OpenAI echo semantics: the prompt text arrives as
-                        # each choice's first chunk (logprob entries scored
-                        # ONCE off-loop; choices share the prompt)
-                        prompt_text = self.tokenizer.decode(prompt_id_lists[0])
-                        echo_lp = None
-                        if stream_requests[0].logprobs is not None:
-                            echo_lp, off = await asyncio.to_thread(
-                                self._echo_prompt_logprobs,
-                                prompt_id_lists[0], stream_requests[0],
-                            )
-                            lp_offsets = [off] * stream_n
-                        for i in range(stream_n):
-                            first = {"index": i, "text": prompt_text,
-                                     "finish_reason": None}
-                            if echo_lp is not None:
-                                first["logprobs"] = {
-                                    k: list(v) for k, v in echo_lp.items()
-                                }
-                            yield cmpl_chunk([first])
-                    tasks = [
-                        asyncio.get_running_loop().create_task(pump(i, r))
-                        for i, r in enumerate(stream_requests)
-                    ]
-                    live = stream_n
-                    while live:
-                        i, kind, payload = await queue.get()
-                        if kind == "error":
-                            yield "data: {}\n\n".format(json.dumps(
-                                {"error": {"message": str(payload),
-                                           "type": type(payload).__name__}}
-                            ))
-                            yield "data: [DONE]\n\n"
-                            return
-                        req = stream_requests[i]
-                        if kind == "finish":
-                            yield cmpl_chunk(
-                                [{"index": i, "text": "",
-                                  "finish_reason": self._finish_reason(req)}]
-                            )
-                            live -= 1
-                            continue
-                        choice = {"index": i, "text": payload["delta"],
-                                  "finish_reason": None}
-                        if payload.get("entries") is not None:
-                            lp, lp_offsets[i] = self._completion_lp_entries(
-                                payload["entries"],
-                                int(req.logprobs or 0),
-                                offset=lp_offsets[i],
-                                as_ids=getattr(req, "tokens_as_ids", False),
-                            )
-                            choice["logprobs"] = lp
-                        yield cmpl_chunk([choice])
-                    if include_usage:
-                        total = sum(r.produced for r in stream_requests)
-                        yield cmpl_chunk([], usage={
-                            "prompt_tokens": stream_requests[0].prompt_len,
-                            "completion_tokens": total,
-                            "total_tokens": stream_requests[0].prompt_len
-                            + total,
-                        })
-                    yield "data: [DONE]\n\n"
-                finally:
-                    # normal completion AND client disconnect (GeneratorExit):
-                    # free every decode slot early, record streaming stats
-                    for t in tasks:
-                        t.cancel()
-                    for r in stream_requests:
-                        r.cancel()
-                        self._report_gen_stats(r, collect_fn)
+                head = []
+                if echo:
+                    # OpenAI echo semantics: the prompt text arrives as
+                    # each choice's first chunk (logprob entries scored
+                    # ONCE off-loop; choices share the prompt)
+                    prompt_text = self.tokenizer.decode(prompt_id_lists[0])
+                    echo_lp = None
+                    if stream_requests[0].logprobs is not None:
+                        echo_lp, off = await asyncio.to_thread(
+                            self._echo_prompt_logprobs,
+                            prompt_id_lists[0], stream_requests[0],
+                        )
+                        lp_offsets[:] = [off] * stream_n
+                    for i in range(stream_n):
+                        first = {"index": i, "text": prompt_text,
+                                 "finish_reason": None}
+                        if echo_lp is not None:
+                            first["logprobs"] = {
+                                k: list(v) for k, v in echo_lp.items()
+                            }
+                        head.append(cmpl_chunk([first]))
+                async for chunk in self._fanout_stream(
+                    stream_requests, stops, collect_fn,
+                    head=head, delta=cmpl_delta, finish=cmpl_finish,
+                    usage=cmpl_usage,
+                ):
+                    yield chunk
 
             return StreamingOutput(sse())
 

@@ -240,7 +240,10 @@ def test_stop_with_logprobs_is_consistent(llm_served):
     assert out["usage"]["completion_tokens"] == 2
 
 
-def test_streaming_rejects_multi_choice(llm_served):
+def test_streaming_accepts_multi_choice(llm_served):
+    """Plain chat n>1 streaming is supported (r5); tools still require a
+    single choice (covered below)."""
+
     async def fn(client):
         r = await client.post(
             "/serve/openai/v1/chat/completions",
@@ -248,7 +251,7 @@ def test_streaming_rejects_multi_choice(llm_served):
         )
         return r.status
 
-    assert _run(llm_served, fn) == 422
+    assert _run(llm_served, fn) == 200
 
 
 def test_penalties_and_seed_passthrough(llm_served):
@@ -570,6 +573,55 @@ def test_streaming_best_of_must_equal_n(llm_served):
             "/serve/openai/v1/completions",
             json={"model": "tiny_llm", "prompt": "x", "max_tokens": 4,
                   "stream": True, "n": 2, "best_of": 4},
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
+
+
+def test_streaming_chat_multi_choice(llm_served):
+    """Chat n>1 streaming (no tools): role chunk per choice, interleaved
+    content deltas by index, independent finishes; accumulation matches the
+    non-streaming choices under the same seeds."""
+    import json as _json
+
+    async def fn(client):
+        body = _chat_body(n=3, temperature=1.0, seed=9, max_tokens=5)
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=dict(body, stream=True))
+        assert r.status == 200, await r.text()
+        raw = (await r.read()).decode()
+        r2 = await client.post("/serve/openai/v1/chat/completions", json=body)
+        assert r2.status == 200, await r2.text()
+        return raw, await r2.json()
+
+    raw, ref = _run(llm_served, fn)
+    texts = {0: "", 1: "", 2: ""}
+    roles, finishes = set(), {}
+    for line in raw.splitlines():
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            continue
+        for ch in _json.loads(line[6:]).get("choices", []):
+            delta = ch.get("delta", {})
+            if delta.get("role"):
+                roles.add(ch["index"])
+            texts[ch["index"]] += delta.get("content") or ""
+            if ch.get("finish_reason"):
+                finishes[ch["index"]] = ch["finish_reason"]
+    assert roles == {0, 1, 2} and set(finishes) == {0, 1, 2}
+    assert texts == {
+        c["index"]: c["message"]["content"] for c in ref["choices"]
+    }
+
+
+def test_streaming_chat_multi_choice_with_tools_rejected(llm_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(n=2, stream=True, tools=[{
+                "type": "function",
+                "function": {"name": "f", "parameters": {"type": "object"}},
+            }]),
         )
         return r.status
 
