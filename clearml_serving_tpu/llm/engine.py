@@ -710,15 +710,19 @@ class LLMEngineCore:
                 lg, tg = args
                 lp = jax.nn.log_softmax(lg.astype(jnp.float32))
                 chosen = jnp.take_along_axis(lp, tg[:, None], axis=1)[:, 0]
+                # exact rank among the full vocab (vLLM prompt_logprobs
+                # reports true ranks, not top-k positions)
+                rank = 1 + jnp.sum(lp > chosen[:, None], axis=-1)
                 tl, ti = jax.lax.top_k(lp, self._lp_k)
-                return chosen, ti.astype(jnp.int32), tl
+                return chosen, rank.astype(jnp.int32), ti.astype(jnp.int32), tl
 
-            ch, ti, tl = jax.lax.map(
+            ch, rk, ti, tl = jax.lax.map(
                 blk,
                 (src.reshape(-1, block, v), tgt.reshape(-1, block)),
             )
             return (
                 ch.reshape(-1)[:s1],
+                rk.reshape(-1)[:s1],
                 ti.reshape(-1, self._lp_k)[:s1],
                 tl.reshape(-1, self._lp_k)[:s1],
             )
@@ -1440,16 +1444,18 @@ class LLMEngineCore:
             if self._lora_enabled
             else None
         )
-        chosen, top_id, top_lp = self._score_prompt_jit(
+        chosen, rank, top_id, top_lp = self._score_prompt_jit(
             self.params, jnp.asarray(row), lora_idx
         )
         chosen = np.asarray(chosen)
+        rank = np.asarray(rank)
         top_id = np.asarray(top_id)
         top_lp = np.asarray(top_lp)
         return [
             {
                 "id": int(prompt_ids[i + 1]),
                 "logprob": float(chosen[i]),
+                "rank": int(rank[i]),
                 "top_ids": top_id[i].tolist(),
                 "top_logprobs": top_lp[i].tolist(),
             }

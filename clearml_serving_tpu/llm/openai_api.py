@@ -683,6 +683,49 @@ class LLMEngineRequest(BaseEngineRequest):
                 r.cancel()
                 self._report_gen_stats(r, collect_fn)
 
+    def _prompt_logprobs_payload(self, prompt_ids: List[int], n_top: int,
+                                 adapter: Optional[str]):
+        """vLLM `prompt_logprobs` extension: per-prompt-position dicts of
+        token_id -> {logprob, rank, decoded_token} (first position None —
+        no conditional), the top-n_top tokens plus the actual token with
+        its EXACT vocab rank. Blocking device work — call off-loop."""
+        entries = self.engine.score_prompt(prompt_ids, adapter=adapter)
+        out: List[Optional[dict]] = [None]
+        for e, tok in zip(entries, prompt_ids[1:]):
+            d: Dict[str, Any] = {}
+            for r_i, (t, lp) in enumerate(
+                zip(e["top_ids"][:n_top], e["top_logprobs"][:n_top])
+            ):
+                d[str(int(t))] = {
+                    "logprob": lp,
+                    "rank": r_i + 1,
+                    "decoded_token": self._token_str(int(t)),
+                }
+            d.setdefault(str(int(tok)), {
+                "logprob": e["logprob"],
+                "rank": int(e["rank"]),
+                "decoded_token": self._token_str(int(tok)),
+            })
+            out.append(d)
+        return out
+
+    def _prompt_logprobs_n(self, body: Dict[str, Any]) -> Optional[int]:
+        """Parse + validate the vLLM `prompt_logprobs` knob (None = off)."""
+        raw = body.get("prompt_logprobs")
+        if raw is None or raw is False:
+            return None
+        n_top = int(raw)
+        if n_top < 0:
+            raise ValueError("prompt_logprobs must be >= 0")
+        ceiling = int(getattr(self.engine, "_lp_k", 20))
+        if n_top > ceiling:
+            raise ValueError(
+                "prompt_logprobs {} exceeds the engine ceiling {}".format(
+                    n_top, ceiling
+                )
+            )
+        return n_top
+
     def _echo_prompt_logprobs(self, prompt_ids: List[int], request):
         """OpenAI `echo` + `logprobs`: the logprobs block starts with the
         PROMPT tokens — the first has null logprob/top (no conditional), the
@@ -792,7 +835,13 @@ class LLMEngineRequest(BaseEngineRequest):
                 chunk["usage"] = None if usage == "omit" else usage
             return "data: {}\n\n".format(json.dumps(chunk))
 
+        plp_n = self._prompt_logprobs_n(body)  # validate BEFORE any device work
         if body.get("stream"):
+            if plp_n is not None:
+                # vLLM semantics: prompt_logprobs cannot stream
+                raise EndpointModelError(
+                    "prompt_logprobs is not supported with streaming"
+                )
             n_stream = int(body.get("n", 1) or 1)
             if n_stream != 1:
                 if tools:
@@ -1038,6 +1087,13 @@ class LLMEngineRequest(BaseEngineRequest):
         )
         for r in requests:
             self._report_gen_stats(r, collect_fn)
+        # vLLM prompt_logprobs extension: one scoring pass, shared by choices
+        plp_payload = None
+        if plp_n is not None:
+            plp_payload = await asyncio.to_thread(
+                self._prompt_logprobs_payload, prompt_ids, plp_n,
+                requests[0].adapter,
+            )
         choices = []
         for i, (r, res) in enumerate(zip(requests, results)):
             choice = {
@@ -1050,6 +1106,8 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
+            if plp_payload is not None:
+                choice["prompt_logprobs"] = plp_payload
             # a body-supplied guided response_format pins the OUTPUT shape —
             # the JSON answer is the deliverable, not a tool call; skipping
             # the parse keeps stream and non-stream responses identical
@@ -1119,6 +1177,7 @@ class LLMEngineRequest(BaseEngineRequest):
         completion_id = _gen_id("cmpl")
         created = _now()
 
+        plp_n = self._prompt_logprobs_n(body)  # validate BEFORE any device work
         raw_max = body.get("max_tokens", body.get("max_completion_tokens"))
         if raw_max is not None and int(raw_max) == 0:
             # OpenAI's canonical prompt-scoring call: echo + logprobs +
@@ -1127,9 +1186,14 @@ class LLMEngineRequest(BaseEngineRequest):
             # budget and bill 128 unasked-for tokens)
             return await self._zero_completion(body, prompt_id_lists, model,
                                                completion_id, created,
-                                               collect_fn)
+                                               collect_fn, plp_n)
 
         if body.get("stream"):
+            if plp_n is not None:
+                # vLLM semantics: prompt_logprobs cannot stream
+                raise EndpointModelError(
+                    "prompt_logprobs is not supported with streaming"
+                )
             if len(prompt_id_lists) != 1:
                 raise EndpointModelError(
                     "streaming completions support a single prompt per request"
@@ -1279,6 +1343,14 @@ class LLMEngineRequest(BaseEngineRequest):
                 echo_lp[p] = await asyncio.to_thread(
                     self._echo_prompt_logprobs, ids, requests[p * best_of]
                 )
+        # vLLM prompt_logprobs extension: scored once per distinct prompt
+        plp: Dict[int, Any] = {}
+        if plp_n is not None:
+            for p, ids in enumerate(prompt_id_lists):
+                plp[p] = await asyncio.to_thread(
+                    self._prompt_logprobs_payload, ids, plp_n,
+                    requests[p * best_of].adapter,
+                )
         choices = []
         for i, idx in enumerate(sel):
             r, res = requests[idx], results[idx]
@@ -1292,6 +1364,8 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
+            if idx // best_of in plp:
+                choice["prompt_logprobs"] = plp[idx // best_of]
             if echo:
                 # OpenAI `echo`: the prompt text leads the output; with
                 # logprobs, prompt-token entries lead the block (first one
@@ -1326,9 +1400,11 @@ class LLMEngineRequest(BaseEngineRequest):
         }
 
     async def _zero_completion(self, body, prompt_id_lists, model,
-                               completion_id, created, collect_fn):
-        """max_tokens=0 completions: no generation; echo/logprobs still
-        apply (per-prompt scoring pass off the event loop)."""
+                               completion_id, created, collect_fn,
+                               plp_n=None):
+        """max_tokens=0 completions: no generation; echo/logprobs and
+        prompt_logprobs still apply (per-prompt scoring passes off the
+        event loop) — this IS the canonical prompt-scoring call."""
         echo = bool(body.get("echo"))
         n = int(body.get("n", 1) or 1)
         if n < 1:
@@ -1353,13 +1429,21 @@ class LLMEngineRequest(BaseEngineRequest):
             elif probe.logprobs is not None:
                 lp = {"tokens": [], "token_logprobs": [],
                       "top_logprobs": [], "text_offset": []}
+            plp_payload = None
+            if plp_n is not None:
+                plp_payload = await asyncio.to_thread(
+                    self._prompt_logprobs_payload, ids, plp_n, probe.adapter
+                )
             for _ in range(n):
-                choices.append({
+                choice = {
                     "index": len(choices),
                     "text": text,
                     "finish_reason": "length",
                     "logprobs": dict(lp) if lp is not None else None,
-                })
+                }
+                if plp_payload is not None:
+                    choice["prompt_logprobs"] = plp_payload
+                choices.append(choice)
         if collect_fn is not None:
             collect_fn({
                 "gen_tokens": 0,

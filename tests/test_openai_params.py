@@ -626,3 +626,71 @@ def test_streaming_chat_multi_choice_with_tools_rejected(llm_served):
         return r.status
 
     assert _run(llm_served, fn) == 422
+
+
+def test_prompt_logprobs_extension(llm_served):
+    """vLLM `prompt_logprobs`: per-prompt-position dicts of token_id ->
+    {logprob, rank, decoded_token}, first position None, the actual token
+    always present with its exact vocab rank — on completions and chat."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "abc", "max_tokens": 2,
+                  "prompt_logprobs": 2},
+        )
+        assert r.status == 200, await r.text()
+        rc = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(max_tokens=2, prompt_logprobs=1),
+        )
+        assert rc.status == 200, await rc.text()
+        bad = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "x", "max_tokens": 2,
+                  "prompt_logprobs": 10_000},
+        )
+        return await r.json(), await rc.json(), bad.status
+
+    out, chat, bad_status = _run(llm_served, fn)
+    for payload in (out["choices"][0]["prompt_logprobs"],
+                    chat["choices"][0]["prompt_logprobs"]):
+        assert payload[0] is None and len(payload) >= 2
+        for pos in payload[1:]:
+            assert isinstance(pos, dict) and pos
+            for info in pos.values():
+                assert set(info) == {"logprob", "rank", "decoded_token"}
+                assert info["rank"] >= 1
+            # top-1 entry has rank 1 and the best logprob in the dict
+            best = min(info["rank"] for info in pos.values())
+            assert best == 1
+    assert bad_status == 422  # over the engine top-k ceiling
+
+
+def test_prompt_logprobs_streaming_rejected_and_zero_gen_supported(llm_served):
+    """r5 review: prompt_logprobs + stream must 422 up front (vLLM
+    semantics), and the max_tokens=0 scoring call returns them."""
+
+    async def fn(client):
+        r1 = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "x", "max_tokens": 2,
+                  "stream": True, "prompt_logprobs": 1},
+        )
+        r2 = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(stream=True, prompt_logprobs=1),
+        )
+        r3 = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "abc", "max_tokens": 0,
+                  "prompt_logprobs": 1},
+        )
+        assert r3.status == 200, await r3.text()
+        return r1.status, r2.status, await r3.json()
+
+    s1, s2, zero = _run(llm_served, fn)
+    assert s1 == 422 and s2 == 422
+    plp = zero["choices"][0]["prompt_logprobs"]
+    assert plp[0] is None and len(plp) >= 2
+    assert zero["usage"]["completion_tokens"] == 0
