@@ -85,13 +85,22 @@ def run_ab(
             quantize=quantize,
             prefill_segments_per_decode=None,
         )
-        for kind in ("repeat", "miss"):
-            prompts = _prompts(kind, batch, prompt_len, vocab)
+        # greedy workloads exercise the exact argmax chain; "sampled"
+        # (temperature 0.8 on repetitive prompts) exercises the rejection
+        # chain (spec_sampling) — the A/B shows its win on real traffic
+        for kind, temperature in (
+            ("repeat", 0.0), ("miss", 0.0), ("sampled", 0.8)
+        ):
+            prompts = _prompts(
+                "repeat" if kind == "sampled" else kind,
+                batch, prompt_len, vocab,
+            )
 
             async def drive():
                 async def one(p):
                     n = 0
-                    req = GenRequest(prompt_ids=p, max_new_tokens=new_tokens)
+                    req = GenRequest(prompt_ids=p, max_new_tokens=new_tokens,
+                                     temperature=temperature)
                     async for _ in engine.generate(req):
                         n += 1
                     return n

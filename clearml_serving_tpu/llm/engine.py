@@ -1402,6 +1402,12 @@ class LLMEngineCore:
     def active_slots(self) -> int:
         return sum(1 for r in self._slot_req if r is not None)
 
+    @property
+    def logprobs_k(self) -> int:
+        """Public top-k ceiling for logprob reporting (OpenAI top_logprobs
+        and vLLM prompt_logprobs validate against this)."""
+        return self._lp_k
+
     # -- internals -------------------------------------------------------------
 
     def _ensure_loop(self) -> None:

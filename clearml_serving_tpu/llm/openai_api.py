@@ -684,12 +684,15 @@ class LLMEngineRequest(BaseEngineRequest):
                 self._report_gen_stats(r, collect_fn)
 
     def _prompt_logprobs_payload(self, prompt_ids: List[int], n_top: int,
-                                 adapter: Optional[str]):
+                                 adapter: Optional[str], entries=None):
         """vLLM `prompt_logprobs` extension: per-prompt-position dicts of
         token_id -> {logprob, rank, decoded_token} (first position None —
         no conditional), the top-n_top tokens plus the actual token with
-        its EXACT vocab rank. Blocking device work — call off-loop."""
-        entries = self.engine.score_prompt(prompt_ids, adapter=adapter)
+        its EXACT vocab rank. Blocking device work unless precomputed
+        ``entries`` are passed (echo+prompt_logprobs shares ONE scoring
+        pass) — call off-loop."""
+        if entries is None:
+            entries = self.engine.score_prompt(prompt_ids, adapter=adapter)
         out: List[Optional[dict]] = [None]
         for e, tok in zip(entries, prompt_ids[1:]):
             d: Dict[str, Any] = {}
@@ -717,7 +720,7 @@ class LLMEngineRequest(BaseEngineRequest):
         n_top = int(raw)
         if n_top < 0:
             raise ValueError("prompt_logprobs must be >= 0")
-        ceiling = int(getattr(self.engine, "_lp_k", 20))
+        ceiling = int(self.engine.logprobs_k)
         if n_top > ceiling:
             raise ValueError(
                 "prompt_logprobs {} exceeds the engine ceiling {}".format(
@@ -726,18 +729,21 @@ class LLMEngineRequest(BaseEngineRequest):
             )
         return n_top
 
-    def _echo_prompt_logprobs(self, prompt_ids: List[int], request):
+    def _echo_prompt_logprobs(self, prompt_ids: List[int], request,
+                              entries=None):
         """OpenAI `echo` + `logprobs`: the logprobs block starts with the
         PROMPT tokens — the first has null logprob/top (no conditional), the
         rest come from one teacher-forced scoring pass
         (engine.score_prompt, same LoRA adapter as the generation). Returns
         (lp dict, next text offset) for the generated entries to append to.
-        Blocking device work — callers run it via asyncio.to_thread."""
+        Blocking device work unless precomputed ``entries`` are passed —
+        callers run it via asyncio.to_thread."""
         k = int(request.logprobs or 0)
         as_ids = getattr(request, "tokens_as_ids", False)
-        entries = self.engine.score_prompt(
-            prompt_ids, adapter=getattr(request, "adapter", None)
-        )
+        if entries is None:
+            entries = self.engine.score_prompt(
+                prompt_ids, adapter=getattr(request, "adapter", None)
+            )
         first = self._token_repr(prompt_ids[0], as_ids)
         lp, offset = self._completion_lp_entries(
             entries, k, offset=len(self._token_str(prompt_ids[0])),
@@ -1106,8 +1112,7 @@ class LLMEngineRequest(BaseEngineRequest):
                     else None
                 ),
             }
-            if plp_payload is not None:
-                choice["prompt_logprobs"] = plp_payload
+
             # a body-supplied guided response_format pins the OUTPUT shape —
             # the JSON answer is the deliverable, not a tool call; skipping
             # the parse keeps stream and non-stream responses identical
@@ -1129,7 +1134,7 @@ class LLMEngineRequest(BaseEngineRequest):
                     }
                     choice["finish_reason"] = "tool_calls"
             choices.append(choice)
-        return {
+        out = {
             "id": completion_id,
             "object": "chat.completion",
             "created": created,
@@ -1143,6 +1148,11 @@ class LLMEngineRequest(BaseEngineRequest):
                 + sum(r.produced for r in requests),
             },
         }
+        if plp_payload is not None:
+            # vLLM ChatCompletionResponse shape: prompt_logprobs is a
+            # TOP-LEVEL response field (per-choice is the completions shape)
+            out["prompt_logprobs"] = plp_payload
+        return out
 
     def _check_token_ids(self, ids: List[int]) -> List[int]:
         core = self.engine if self.engine is not None else self.encoder
@@ -1178,6 +1188,12 @@ class LLMEngineRequest(BaseEngineRequest):
         created = _now()
 
         plp_n = self._prompt_logprobs_n(body)  # validate BEFORE any device work
+        if body.get("stream") and plp_n is not None:
+            # vLLM semantics: prompt_logprobs cannot stream (checked before
+            # the max_tokens=0 short-circuit so that path can't bypass it)
+            raise EndpointModelError(
+                "prompt_logprobs is not supported with streaming"
+            )
         raw_max = body.get("max_tokens", body.get("max_completion_tokens"))
         if raw_max is not None and int(raw_max) == 0:
             # OpenAI's canonical prompt-scoring call: echo + logprobs +
@@ -1189,11 +1205,6 @@ class LLMEngineRequest(BaseEngineRequest):
                                                collect_fn, plp_n)
 
         if body.get("stream"):
-            if plp_n is not None:
-                # vLLM semantics: prompt_logprobs cannot stream
-                raise EndpointModelError(
-                    "prompt_logprobs is not supported with streaming"
-                )
             if len(prompt_id_lists) != 1:
                 raise EndpointModelError(
                     "streaming completions support a single prompt per request"
@@ -1337,20 +1348,27 @@ class LLMEngineRequest(BaseEngineRequest):
         # prompt (choices share it), off the event loop — the jitted
         # forward (plus a first-hit compile) would stall every concurrent
         # stream if run inline
+        # echo+logprobs and prompt_logprobs share ONE teacher-forced scoring
+        # pass per distinct prompt
         echo_lp: Dict[int, Any] = {}
-        if echo and requests[0].logprobs is not None and not lp_internal:
-            for p, ids in enumerate(prompt_id_lists):
-                echo_lp[p] = await asyncio.to_thread(
-                    self._echo_prompt_logprobs, ids, requests[p * best_of]
-                )
-        # vLLM prompt_logprobs extension: scored once per distinct prompt
         plp: Dict[int, Any] = {}
-        if plp_n is not None:
+        want_echo_lp = (
+            echo and requests[0].logprobs is not None and not lp_internal
+        )
+        if want_echo_lp or plp_n is not None:
             for p, ids in enumerate(prompt_id_lists):
-                plp[p] = await asyncio.to_thread(
-                    self._prompt_logprobs_payload, ids, plp_n,
-                    requests[p * best_of].adapter,
+                req0 = requests[p * best_of]
+                entries = await asyncio.to_thread(
+                    self.engine.score_prompt, ids, req0.adapter
                 )
+                if want_echo_lp:
+                    echo_lp[p] = self._echo_prompt_logprobs(
+                        ids, req0, entries=entries
+                    )
+                if plp_n is not None:
+                    plp[p] = self._prompt_logprobs_payload(
+                        ids, plp_n, req0.adapter, entries=entries
+                    )
         choices = []
         for i, idx in enumerate(sel):
             r, res = requests[idx], results[idx]
@@ -1422,17 +1440,20 @@ class LLMEngineRequest(BaseEngineRequest):
             self.engine.validate(probe)
             text = self.tokenizer.decode(ids) if echo else ""
             lp = None
-            if probe.logprobs is not None and echo:
-                lp, _ = await asyncio.to_thread(
-                    self._echo_prompt_logprobs, ids, probe
+            plp_payload = None
+            entries = None
+            if (probe.logprobs is not None and echo) or plp_n is not None:
+                entries = await asyncio.to_thread(
+                    self.engine.score_prompt, ids, probe.adapter
                 )
+            if probe.logprobs is not None and echo:
+                lp, _ = self._echo_prompt_logprobs(ids, probe, entries=entries)
             elif probe.logprobs is not None:
                 lp = {"tokens": [], "token_logprobs": [],
                       "top_logprobs": [], "text_offset": []}
-            plp_payload = None
             if plp_n is not None:
-                plp_payload = await asyncio.to_thread(
-                    self._prompt_logprobs_payload, ids, plp_n, probe.adapter
+                plp_payload = self._prompt_logprobs_payload(
+                    ids, plp_n, probe.adapter, entries=entries
                 )
             for _ in range(n):
                 choice = {

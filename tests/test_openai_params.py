@@ -653,8 +653,9 @@ def test_prompt_logprobs_extension(llm_served):
         return await r.json(), await rc.json(), bad.status
 
     out, chat, bad_status = _run(llm_served, fn)
+    # completions: per-choice; chat: TOP-LEVEL field (vLLM response shapes)
     for payload in (out["choices"][0]["prompt_logprobs"],
-                    chat["choices"][0]["prompt_logprobs"]):
+                    chat["prompt_logprobs"]):
         assert payload[0] is None and len(payload) >= 2
         for pos in payload[1:]:
             assert isinstance(pos, dict) and pos
@@ -694,3 +695,18 @@ def test_prompt_logprobs_streaming_rejected_and_zero_gen_supported(llm_served):
     plp = zero["choices"][0]["prompt_logprobs"]
     assert plp[0] is None and len(plp) >= 2
     assert zero["usage"]["completion_tokens"] == 0
+
+
+def test_prompt_logprobs_zero_gen_stream_still_rejected(llm_served):
+    """The stream rejection must hold even with max_tokens=0 (the zero
+    short-circuit cannot bypass it — r5 review)."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "x", "max_tokens": 0,
+                  "stream": True, "prompt_logprobs": 1},
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
