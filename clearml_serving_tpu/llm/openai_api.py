@@ -1349,26 +1349,38 @@ class LLMEngineRequest(BaseEngineRequest):
         # forward (plus a first-hit compile) would stall every concurrent
         # stream if run inline
         # echo+logprobs and prompt_logprobs share ONE teacher-forced scoring
-        # pass per distinct prompt
+        # pass per distinct prompt; the payload build (O(prompt x top_k)
+        # tokenizer decodes) stays off the event loop with it
         echo_lp: Dict[int, Any] = {}
         plp: Dict[int, Any] = {}
         want_echo_lp = (
             echo and requests[0].logprobs is not None and not lp_internal
         )
         if want_echo_lp or plp_n is not None:
-            for p, ids in enumerate(prompt_id_lists):
-                req0 = requests[p * best_of]
-                entries = await asyncio.to_thread(
-                    self.engine.score_prompt, ids, req0.adapter
+            def build_payloads(ids, req0):
+                entries = self.engine.score_prompt(ids, req0.adapter)
+                e = (
+                    self._echo_prompt_logprobs(ids, req0, entries=entries)
+                    if want_echo_lp
+                    else None
                 )
-                if want_echo_lp:
-                    echo_lp[p] = self._echo_prompt_logprobs(
-                        ids, req0, entries=entries
-                    )
-                if plp_n is not None:
-                    plp[p] = self._prompt_logprobs_payload(
+                q = (
+                    self._prompt_logprobs_payload(
                         ids, plp_n, req0.adapter, entries=entries
                     )
+                    if plp_n is not None
+                    else None
+                )
+                return e, q
+
+            for p, ids in enumerate(prompt_id_lists):
+                e, q = await asyncio.to_thread(
+                    build_payloads, ids, requests[p * best_of]
+                )
+                if e is not None:
+                    echo_lp[p] = e
+                if q is not None:
+                    plp[p] = q
         choices = []
         for i, idx in enumerate(sel):
             r, res = requests[idx], results[idx]
@@ -1441,20 +1453,31 @@ class LLMEngineRequest(BaseEngineRequest):
             text = self.tokenizer.decode(ids) if echo else ""
             lp = None
             plp_payload = None
-            entries = None
             if (probe.logprobs is not None and echo) or plp_n is not None:
-                entries = await asyncio.to_thread(
-                    self.engine.score_prompt, ids, probe.adapter
-                )
-            if probe.logprobs is not None and echo:
-                lp, _ = self._echo_prompt_logprobs(ids, probe, entries=entries)
-            elif probe.logprobs is not None:
+                def build_payloads(ids=ids, probe=probe):
+                    entries = self.engine.score_prompt(ids, probe.adapter)
+                    e = (
+                        self._echo_prompt_logprobs(ids, probe,
+                                                   entries=entries)
+                        if probe.logprobs is not None and echo
+                        else None
+                    )
+                    q = (
+                        self._prompt_logprobs_payload(
+                            ids, plp_n, probe.adapter, entries=entries
+                        )
+                        if plp_n is not None
+                        else None
+                    )
+                    return e, q
+
+                e, plp_payload = await asyncio.to_thread(build_payloads)
+                if e is not None:
+                    lp = e[0]
+            if probe.logprobs is not None and lp is None:
+                # logprobs without echo: nothing generated -> empty block
                 lp = {"tokens": [], "token_logprobs": [],
                       "top_logprobs": [], "text_offset": []}
-            if plp_n is not None:
-                plp_payload = self._prompt_logprobs_payload(
-                    ids, plp_n, probe.adapter, entries=entries
-                )
             for _ in range(n):
                 choice = {
                     "index": len(choices),
@@ -1576,7 +1599,28 @@ class LLMEngineRequest(BaseEngineRequest):
         fmt = body.get("encoding_format", "float")
         if fmt not in ("float", "base64"):
             raise ValueError("encoding_format must be 'float' or 'base64'")
+        dims = body.get("dimensions")
+        if dims is not None:
+            dims = int(dims)  # type/lower-bound BEFORE the device forward
+            if dims < 1:
+                raise ValueError("dimensions must be >= 1")
         vecs = await asyncio.to_thread(self.encoder.embed, id_lists)
+        if dims is not None:
+            # OpenAI `dimensions` (matryoshka truncation): keep the leading
+            # dims and re-normalize so cosine similarity stays meaningful
+            import numpy as _np
+
+            full = len(vecs[0]) if len(vecs) else 0
+            if full and dims > full:
+                raise ValueError(
+                    "dimensions must be in [1, {}]".format(full)
+                )
+            out_vecs = []
+            for v in vecs:
+                t = _np.asarray(v, _np.float32)[:dims]
+                norm = float(_np.linalg.norm(t))
+                out_vecs.append(t / norm if norm > 0 else t)
+            vecs = out_vecs
         n_tokens = sum(len(ids) for ids in id_lists)
         if collect_fn is not None:
             collect_fn({"prompt_tokens": n_tokens, "n_inputs": len(id_lists)})

@@ -489,3 +489,34 @@ def test_unknown_task_rejected(tmp_path):
     status, text = _run(mrp, fn)
     assert status == 422
     assert "unknown engine task" in text
+
+
+def test_embeddings_dimensions(encoder_served):
+    """OpenAI `dimensions` (matryoshka truncation): leading dims kept,
+    re-normalized; out-of-range values 422."""
+
+    async def fn(client):
+        full = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_bert", "input": "hi"},
+        )
+        cut = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_bert", "input": "hi", "dimensions": 16},
+        )
+        bad = await client.post(
+            "/serve/openai/v1/embeddings",
+            json={"model": "tiny_bert", "input": "hi", "dimensions": 9999},
+        )
+        assert full.status == 200 and cut.status == 200
+        return await full.json(), await cut.json(), bad.status
+
+    full, cut, bad_status = _run(encoder_served, fn)
+    v_full = np.array(full["data"][0]["embedding"])
+    v_cut = np.array(cut["data"][0]["embedding"])
+    assert v_cut.shape[0] == 16
+    np.testing.assert_allclose(np.linalg.norm(v_cut), 1.0, rtol=1e-5)
+    # the truncated vector is the renormalized prefix of the full one
+    expect = v_full[:16] / np.linalg.norm(v_full[:16])
+    np.testing.assert_allclose(v_cut, expect, rtol=1e-5)
+    assert bad_status == 422
