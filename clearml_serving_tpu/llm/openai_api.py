@@ -1181,6 +1181,15 @@ class LLMEngineRequest(BaseEngineRequest):
 
     async def v1_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
         self._require_engine("v1/completions")
+        if body.get("suffix") is not None:
+            # vLLM rejects suffix explicitly — even "" — (fill-in-middle
+            # needs a FIM-trained model + template); silent ignoring would
+            # return a continuation the client believes is an infill.
+            # Checked before prompt tokenization: doomed requests pay no
+            # host work and report THIS error, not a downstream one.
+            raise EndpointModelError(
+                "suffix is not supported (no fill-in-middle template)"
+            )
         prompt_id_lists = self._encode_prompts(body.get("prompt") or "")
         stops = self._stops_from_body(body)
         model = body.get("model", self._model_name)

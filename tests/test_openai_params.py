@@ -710,3 +710,19 @@ def test_prompt_logprobs_zero_gen_stream_still_rejected(llm_served):
         return r.status
 
     assert _run(llm_served, fn) == 422
+
+
+def test_suffix_rejected(llm_served):
+    """vLLM semantics: `suffix` (fill-in-middle) is rejected explicitly —
+    silently ignoring it would return a continuation the client believes
+    is an infill."""
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "def f(", "max_tokens": 4,
+                  "suffix": "return x"},
+        )
+        return r.status
+
+    assert _run(llm_served, fn) == 422
