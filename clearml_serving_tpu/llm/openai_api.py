@@ -810,6 +810,10 @@ class LLMEngineRequest(BaseEngineRequest):
                 "json_schema",
                 json.dumps(tool_call_schema(tools, forced_tool)),
             )
+        # OpenAI `parallel_tool_calls` (default true): false caps auto-mode
+        # parses at ONE call (required/forced already emit exactly one by
+        # grammar construction)
+        single_call = body.get("parallel_tool_calls") is False
         prompt = render_chat_with_tools(self.tokenizer, messages, tools_render)
         # encode_chat: no special-token re-add — HF chat templates already
         # emit BOS in the template text (double-BOS degrades fidelity)
@@ -1027,6 +1031,8 @@ class LLMEngineRequest(BaseEngineRequest):
                         if text and tools and finish != "length"
                         else None
                     )
+                    if calls and single_call:
+                        calls = calls[:1]
                     if calls:
                         # prose around <tool_call> blocks still streams as
                         # content (OpenAI allows content + tool_calls)
@@ -1120,6 +1126,8 @@ class LLMEngineRequest(BaseEngineRequest):
             parse_ok = tool_mode in ("required", "forced") or r.guided is None
             if tools and parse_ok and res["finish_reason"] != "length":
                 calls = parse_tool_calls(res["text"], tool_names)
+                if calls and single_call:
+                    calls = calls[:1]
                 if calls:
                     # hermes-style prose around the <tool_call> blocks is
                     # kept as content (OpenAI allows content + tool_calls)

@@ -497,3 +497,54 @@ def test_failed_tools_render_falls_back_to_preamble():
     text = render_chat_with_tools(tok, [{"role": "user", "content": "hi"}], tools)
     assert "get_weather" in text  # preamble injected
     assert tok._tools_template_native is False
+
+
+def test_parallel_tool_calls_false_caps_auto_mode(tool_served, monkeypatch):
+    """OpenAI `parallel_tool_calls: false` restricts AUTO-mode parses to a
+    single call. A tiny random model won't reliably emit two <tool_call>
+    blocks, so the parser is stubbed to return two calls — pinning the
+    route-level cap itself (delete the cap and this fails)."""
+    from clearml_serving_tpu.llm import tools as tools_mod
+
+    two = [
+        {"name": "get_weather", "arguments": '{"location": "tokyo"}'},
+        {"name": "get_time", "arguments": "{}"},
+    ]
+    monkeypatch.setattr(
+        tools_mod, "parse_tool_calls", lambda text, names=None: list(two)
+    )
+
+    async def fn(client):
+        # bias the EOS token so finish_reason is "stop" (a length-cut
+        # response is never parsed for calls, per OpenAI semantics)
+        eos_bias = {"logit_bias": {"257": 200.0}, "max_tokens": 6}
+        on = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(parallel_tool_calls=False, **eos_bias),
+        )
+        off = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(**eos_bias),
+        )
+        assert on.status == 200 and off.status == 200
+        return await on.json(), await off.json()
+
+    capped, free = _run(tool_served, fn)
+    assert len(capped["choices"][0]["message"]["tool_calls"]) == 1
+    assert len(free["choices"][0]["message"]["tool_calls"]) == 2
+
+
+def test_parallel_tool_calls_false_http(tool_served):
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(tool_choice="required", seed=11,
+                            parallel_tool_calls=False),
+        )
+        assert r.status == 200, await r.text()
+        return await r.json()
+
+    out = _run(tool_served, fn)
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert len(choice["message"]["tool_calls"]) == 1
