@@ -24,11 +24,16 @@ class DynamicBatcher:
         preferred_batch_size: int = 8,
         max_queue_delay_us: int = 2000,
         max_batch_size: int = 64,
+        bucket_for: Optional[Callable[[int], int]] = None,
     ):
         self._run_batch = run_batch  # takes list of input arrays (batch-concat'd)
         self.preferred = int(preferred_batch_size)
         self.max_delay_s = float(max_queue_delay_us) / 1e6
         self.max_batch = int(max_batch_size)
+        # rows -> executed bucket rows (repo.CompiledModel's bucket set);
+        # lets the batcher account the padding waste of the bucket-padding
+        # path it feeds without knowing the model's buckets itself
+        self.bucket_for = bucket_for
         # items: (inputs, future, rows, enqueue_time)
         self._queue: "asyncio.Queue[Tuple[List[np.ndarray], asyncio.Future, int, float]]" = (
             asyncio.Queue()
@@ -38,10 +43,16 @@ class DynamicBatcher:
         self.batches_executed = 0
         self.requests_served = 0
         self.batch_size_sum = 0
+        # padding efficiency: rows the bucket-padding path executed beyond
+        # the real request rows (pure XLA-shape waste; high values mean the
+        # bucket set or batching knobs are mis-tuned for the traffic)
+        self.padded_rows_sum = 0
         # queue-time hook (enqueue -> batch execution start), feeding the
         # engine server's queue-delay histogram (Triton exports the
         # equivalent nv_inference_queue_duration)
         self.on_queue_delay = None  # optional callable(seconds)
+        # padding hook: callable(real_rows, padded_rows) per executed batch
+        self.on_padding = None
 
     async def infer(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
         """Submit one request's input list; rows = inputs[i].shape[0]."""
@@ -113,7 +124,14 @@ class DynamicBatcher:
             outputs = await asyncio.to_thread(self._run_batch, concat)
             self.batches_executed += 1
             self.requests_served += len(batch)
-            self.batch_size_sum += sum(rows)
+            total_rows = sum(rows)
+            self.batch_size_sum += total_rows
+            padded = 0
+            if self.bucket_for is not None:
+                padded = max(0, int(self.bucket_for(total_rows)) - total_rows)
+            self.padded_rows_sum += padded
+            if self.on_padding is not None:
+                self.on_padding(total_rows, padded)
             # split each output back per-request along the leading axis
             offset = 0
             for fut, n in zip(futures, rows):

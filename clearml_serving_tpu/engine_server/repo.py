@@ -49,6 +49,11 @@ class CompiledModel:
             preferred_batch_size=int(batching.get("preferred_batch_size", 8)),
             max_queue_delay_us=int(batching.get("max_queue_delay_us", 2000)),
             max_batch_size=int(batching.get("max_batch_size", 64)),
+            # padding-efficiency accounting: the batcher reports how many
+            # rows run_batch's bucket padding wastes per executed batch
+            bucket_for=lambda rows: next(
+                (b for b in self.buckets if rows <= b), rows
+            ),
         )
         self.input_names = endpoint.input_name or []
         self.input_types = endpoint.input_type or []
@@ -123,6 +128,8 @@ class EngineModelRepo:
                 "buckets": cm.buckets,
                 "requests_served": cm.batcher.requests_served,
                 "batches_executed": cm.batcher.batches_executed,
+                "rows_executed": cm.batcher.batch_size_sum,
+                "padded_rows": cm.batcher.padded_rows_sum,
             }
         return out
 

@@ -81,11 +81,33 @@ class EngineMetrics:
             ["model", "outcome"],
             registry=registry,
         )
+        # padding efficiency of the bucket-padding path: real request rows
+        # vs rows added purely to reach the compiled bucket shape. A high
+        # padded/real ratio means the bucket set or dynamic-batching knobs
+        # are mis-tuned for the traffic (rate() these two against each other)
+        self.batch_rows = Counter(
+            "engine_batch_rows_total",
+            "rows entering executed batches, by kind (real request rows vs "
+            "bucket-padding waste)",
+            ["model", "kind"],
+            registry=registry,
+        )
 
     def wire_batcher(self, name: str, batcher) -> None:
         if batcher.on_queue_delay is None:
             observe = self.queue_delay.labels(model=name).observe
             batcher.on_queue_delay = observe
+        if batcher.on_padding is None:
+            real_c = self.batch_rows.labels(model=name, kind="real")
+            pad_c = self.batch_rows.labels(model=name, kind="padded")
+
+            def on_padding(real_rows: int, padded_rows: int) -> None:
+                if real_rows:
+                    real_c.inc(real_rows)
+                if padded_rows:
+                    pad_c.inc(padded_rows)
+
+            batcher.on_padding = on_padding
 
 
 class EngineServer:

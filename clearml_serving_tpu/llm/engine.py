@@ -101,6 +101,10 @@ class GenRequest:
     # engine frees the slot and KV pages at the next emission point instead
     # of decoding the request to max_new_tokens for nobody
     cancelled: bool = False
+    # engine-internal (paged prefix cache): pinned shared-page hit carried
+    # from the admission worker to the loop-thread commit; every failure
+    # path between the two must release it (engine._release_prefix_hit)
+    _prefix_hit: Optional[Any] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -209,6 +213,7 @@ class LLMEngineCore:
         prefix_cache: Optional[int] = None,
         prefix_block: int = 64,
         prefix_cache_bytes: Optional[int] = None,
+        prefix_cache_pages: Optional[int] = None,
         logprobs_k: int = 20,  # OpenAI's top_logprobs ceiling
         tokenizer=None,  # required for guided decoding (token byte tables)
     ):
@@ -515,21 +520,57 @@ class LLMEngineCore:
         else:
             self._chunked = 0
 
-        # automatic prefix caching (llm/prefix_cache.py): block-aligned
-        # prompt-prefix KV reuse across admissions — a hit assembles the
-        # stored KV into the mini cache and prefills only the remainder via
-        # prefill_chunk. Dense cache only; ring-prefill prompts skip it.
+        # automatic prefix caching (llm/prefix_cache.py): radix tree of
+        # block-granular prompt-prefix KV shared across admissions. On the
+        # dense path a hit assembles the stored KV into the mini cache and
+        # prefills only the remainder via prefill_chunk; on the paged path a
+        # hit maps refcounted pool pages straight into the slot's page table
+        # (zero KV copies for the shared run) and storing a prompt is a
+        # refcount bump on the slot's own pages. Ring-prefill prompts skip it.
         self._prefix = None
-        if (
-            prefix_cache
-            and hasattr(bundle, "prefill_chunk")
-            and cache_mode == "dense"
-        ):
-            from .prefix_cache import PrefixKVCache
+        if prefix_cache and hasattr(bundle, "prefill_chunk"):
+            from .prefix_cache import RadixPrefixCache
 
-            self._prefix = PrefixKVCache(
-                int(prefix_cache), int(prefix_block), max_bytes=prefix_cache_bytes
-            )
+            if cache_mode == "paged":
+                # shared runs must cover whole pages (a block ending mid-page
+                # would put live-slot writes inside shared pages): round the
+                # block up to the page size
+                block = -(-int(prefix_block) // page_size) * page_size
+                pool = self.paged_cache.pool
+                page_bytes = 2 * int(
+                    self.paged_cache.k.dtype.itemsize
+                    * bundle.n_layers * bundle.n_kv_heads
+                    * page_size * bundle.head_dim
+                )
+                self._prefix = RadixPrefixCache(
+                    int(prefix_cache), block, max_bytes=prefix_cache_bytes,
+                    max_pages=prefix_cache_pages, pool=pool,
+                    page_bytes=page_bytes,
+                )
+
+                def _gather_pages(kp, vp, pages, plen):
+                    # shared pages -> dense mini-cache layout [L,1,S,Hkv,D]
+                    # (compute input for the tail's prefill_chunk; the pool
+                    # pages themselves are mapped by reference at commit).
+                    # `pages` is padded with the null page to the bucket's
+                    # page count so traces stay bucketed; garbage beyond
+                    # plen is masked by the cache length.
+                    sk = kp[:, :, pages]                   # [L,H,NP,P,D]
+                    l, h, n, p, d = sk.shape
+                    k = jnp.moveaxis(sk.reshape(l, h, n * p, d), 1, 2)[:, None]
+                    sv = vp[:, :, pages]
+                    v = jnp.moveaxis(sv.reshape(l, h, n * p, d), 1, 2)[:, None]
+                    return {
+                        "k": k, "v": v,
+                        "length": jnp.reshape(plen, (1,)).astype(jnp.int32),
+                    }
+
+                self._gather_pages_jit = jax.jit(_gather_pages)
+            else:
+                self._prefix = RadixPrefixCache(
+                    int(prefix_cache), int(prefix_block),
+                    max_bytes=prefix_cache_bytes,
+                )
             self._prefix_chunk = self._chunked or int(prefix_block)
 
             def _assemble(template, prefix_bufs, plen):
@@ -1527,7 +1568,12 @@ class LLMEngineCore:
         # prompt (same adapter) skips straight to its remainder
         prefix_result = None
         if self._prefix is not None and not use_ring:
-            prefix_result = self._prefix_admission(ids, lora_arr, lora_i)
+            if self.cache_mode == "paged":
+                prefix_result = self._prefix_admission_paged(
+                    ids, lora_arr, lora_i, request
+                )
+            else:
+                prefix_result = self._prefix_admission(ids, lora_arr, lora_i)
         c = self._chunked
         # the chunked mini cache must be a multiple of C: a final chunk
         # overflowing the bucket would be CLAMPED backward by
@@ -1593,8 +1639,10 @@ class LLMEngineCore:
             last_logits, mini_cache = prefill_fn(
                 self.params, jnp.asarray(tokens), seq_lens, template, lora_arr
             )
-        if self._prefix is not None and not use_ring:
-            # make this prompt's prefix available to future admissions
+        if self._prefix is not None and not use_ring and self.cache_mode != "paged":
+            # make this prompt's prefix available to future admissions (the
+            # paged path stores by page reference at commit time instead —
+            # its pages exist only once the loop thread has written them)
             self._prefix.store(
                 ids, lora_i,
                 {k: v for k, v in mini_cache.items() if k != "length"},
@@ -1653,38 +1701,28 @@ class LLMEngineCore:
             }
         return first_id, mini_cache, first_lp
 
-    def _prefix_admission(self, ids, lora_arr, lora_i):
-        """Prefix-cache hit path: assemble the stored prefix KV into a mini
-        cache and prefill only the remainder through prefill_chunk. Returns
-        (last_logits, mini_cache) or None (miss / doesn't fit)."""
-        hit = self._prefix.lookup(ids, lora_i)
-        if hit is None:
-            return None
+    def _prefix_bucket(self, prefix_len: int, n_tokens: int) -> Optional[int]:
+        """Mini-cache bucket covering the prefix plus the tail's segment
+        windows, from the bounded engine bucket set — minting a size per
+        (prefix_len, remainder) combination would permanently cache a fresh
+        multi-hundred-MB template (8B-class) and recompile prefill_chunk for
+        every new shape, turning "hits" into compile storms and an HBM
+        leak. None when no bucket fits."""
         c2 = self._prefix_chunk
-        prefix_len = hit["len"]
-        remainder = len(ids) - prefix_len
-        # the mini cache must cover the last segment's full C2 window...
+        remainder = n_tokens - prefix_len
         required = prefix_len + -(-remainder // c2) * c2
-        # ...but its SIZE comes from the bounded engine bucket set — minting
-        # a size per (prefix_len, remainder) combination would permanently
-        # cache a fresh multi-hundred-MB template (8B-class) and recompile
-        # prefill_chunk for every new shape, turning "hits" into compile
-        # storms and an HBM leak
         bucket = self._bucket_for(required)
         if bucket < required or bucket > self.max_seq_len:
             return None
-        with self._template_lock:
-            template = self._prefill_templates.get(bucket)
-            if template is None:
-                template = self.bundle.init_cache(1, bucket)
-                self._prefill_templates[bucket] = template
-        prefix_bufs = {
-            name: buf for name, buf in hit.items()
-            if name not in ("len", "nbytes")
-        }
-        cache = self._assemble_prefix_jit(
-            template, prefix_bufs, jnp.asarray(prefix_len, jnp.int32)
-        )
+        return bucket
+
+    def _prefill_tail(self, cache, ids, prefix_len: int, lora_arr):
+        """Prefill only the non-shared tail of ``ids`` through the donating
+        prefill_chunk, attending over the prefix KV already in ``cache``.
+        The cache is owned by this admission, so every segment may donate it
+        (unlike the cold chunked path, whose first segment reads the shared
+        template). Returns (last_logits, cache)."""
+        c2 = self._prefix_chunk
         last_logits = None
         starts = list(range(prefix_len, len(ids), c2))
         for si, s in enumerate(starts):
@@ -1693,9 +1731,6 @@ class LLMEngineCore:
             seg_tokens[0, : len(seg)] = seg
             if self._prefill_gate is not None:
                 self._prefill_gate.acquire()
-            # the assembled cache is owned by this admission, so every
-            # segment may donate it (unlike the cold chunked path, whose
-            # first segment reads the shared template)
             last_logits, cache = self._prefill_chunk_jit(
                 self.params,
                 jnp.asarray(seg_tokens),
@@ -1707,10 +1742,77 @@ class LLMEngineCore:
             )
         return last_logits, cache
 
+    def _prefix_admission(self, ids, lora_arr, lora_i):
+        """Dense prefix-cache hit path: assemble the tree's block run into a
+        mini cache and prefill only the remainder through prefill_chunk.
+        Returns (last_logits, mini_cache) or None (miss / doesn't fit)."""
+        hit = self._prefix.lookup(ids, lora_i)
+        if hit is None:
+            return None
+        prefix_len = hit["len"]
+        bucket = self._prefix_bucket(prefix_len, len(ids))
+        if bucket is None:
+            self._prefix.uncount_hit(hit)  # recomputed cold: not a real hit
+            return None
+        with self._template_lock:
+            template = self._prefill_templates.get(bucket)
+            if template is None:
+                template = self.bundle.init_cache(1, bucket)
+                self._prefill_templates[bucket] = template
+        cache = self._assemble_prefix_jit(
+            template, hit["bufs"], jnp.asarray(prefix_len, jnp.int32)
+        )
+        return self._prefill_tail(cache, ids, prefix_len, lora_arr)
+
+    def _prefix_admission_paged(self, ids, lora_arr, lora_i, request):
+        """Paged prefix-cache hit path. The shared pages are PINNED by the
+        lookup and carried on the request until the loop-thread commit maps
+        them into the slot's page table by reference (zero KV copies for the
+        shared run — kv_cache.write_prompt_shared). Here they are only
+        GATHERED into the dense mini-cache layout as the compute input for
+        the tail's prefill_chunk; that transient is dropped after admission.
+        Returns (last_logits, mini_cache) or None (miss / doesn't fit)."""
+        hit = self._prefix.lookup_pages(ids, lora_i)
+        if hit is None:
+            return None
+        try:
+            prefix_len = hit["len"]
+            bucket = self._prefix_bucket(prefix_len, len(ids))
+            page_size = self.paged_cache.pool.page_size
+            if bucket is None or bucket % page_size:
+                self._prefix.release(hit)
+                self._prefix.uncount_hit(hit)  # recomputed cold
+                return None
+            # pad the page list with the null page to the bucket's page count
+            # so the gather compiles once per bucket, not per prefix length
+            pages = list(hit["pages"])
+            padded = pages + [0] * (bucket // page_size - len(pages))
+            with self.paged_cache.dispatch_lock:
+                cache = self._gather_pages_jit(
+                    self.paged_cache.k, self.paged_cache.v,
+                    jnp.asarray(padded, jnp.int32),
+                    jnp.asarray(prefix_len, jnp.int32),
+                )
+            last_logits, cache = self._prefill_tail(
+                cache, ids, prefix_len, lora_arr
+            )
+        except BaseException:
+            self._prefix.release(hit)
+            raise
+        request._prefix_hit = hit
+        return last_logits, cache
+
+    def _release_prefix_hit(self, request: GenRequest) -> None:
+        """Admission failed/dropped before its slot commit: drop the pin the
+        paged lookup took on the shared pages. No-op otherwise."""
+        hit, request._prefix_hit = request._prefix_hit, None
+        if hit is not None and self._prefix is not None:
+            self._prefix.release(hit)
+
     def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache, first_lp=None) -> None:
         """Loop-thread-only: route the prefilled KV into the shared cache and
         activate the slot. Never runs concurrently with a decode chunk."""
-        self._insert_prefill(slot, mini_cache, request.prompt_len)
+        self._insert_prefill(slot, mini_cache, request.prompt_len, request)
         self._slot_req[slot] = request
         self._next_token[slot] = first_id
         if self._tokbuf is not None:
@@ -1776,6 +1878,7 @@ class LLMEngineCore:
         except Exception as ex:
             # a failed admission fails only its own request
             self._deref_guided_request(request)
+            self._release_prefix_hit(request)
             request.error = ex
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
@@ -1783,6 +1886,7 @@ class LLMEngineCore:
             return
         if self._stopped:
             self._deref_guided_request(request)
+            self._release_prefix_hit(request)
             request.error = RuntimeError("engine stopped")
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
@@ -1794,13 +1898,40 @@ class LLMEngineCore:
             # fail anything stranded in the ready queue (incl. our item)
             self._drain_ready(RuntimeError("engine loop exited"))
 
-    def _insert_prefill(self, slot, mini_cache, n_tokens: int) -> None:
+    def _insert_prefill(self, slot, mini_cache, n_tokens: int,
+                        request: Optional[GenRequest] = None) -> None:
         """Route the prefilled prompt KV into the active cache backend."""
         if self.cache_mode == "paged":
-            # mini_cache k/v: [L, 1, bucket, Hkv, D] -> stacked [L, S, Hkv, D]
-            k_stack = mini_cache["k"][:, 0, :n_tokens]
-            v_stack = mini_cache["v"][:, 0, :n_tokens]
-            self.paged_cache.write_prompt(slot, k_stack, v_stack, n_tokens)
+            hit = request._prefix_hit if request is not None else None
+            if hit is not None:
+                # prefix-cache hit: shared pages map into the slot's page
+                # table BY REFERENCE; only the tail's KV is scattered
+                prefix_len = hit["len"]
+                request._prefix_hit = None
+                try:
+                    self.paged_cache.write_prompt_shared(
+                        slot, hit["pages"], prefix_len,
+                        mini_cache["k"][:, 0, prefix_len:n_tokens],
+                        mini_cache["v"][:, 0, prefix_len:n_tokens],
+                        n_tokens,
+                    )
+                finally:
+                    # the slot holds its own refs now; drop the lookup pin
+                    self._prefix.release(hit)
+            else:
+                # mini_cache k/v: [L,1,bucket,Hkv,D] -> stacked [L,S,Hkv,D]
+                k_stack = mini_cache["k"][:, 0, :n_tokens]
+                v_stack = mini_cache["v"][:, 0, :n_tokens]
+                self.paged_cache.write_prompt(slot, k_stack, v_stack, n_tokens)
+            if self._prefix is not None and request is not None:
+                # zero-copy store: the tree takes references on this slot's
+                # own pages (shared prefix blocks walk existing nodes; only
+                # the newly computed tail blocks add nodes)
+                self._prefix.store_pages(
+                    request.prompt_ids,
+                    self._slot_lora(request),
+                    self.paged_cache.pool.slot_pages(slot),
+                )
         else:
             self.cache = self._insert_jit(
                 self.cache,
@@ -1849,6 +1980,7 @@ class LLMEngineCore:
             request, slot, _first, _cache, _lp = self._ready.get_nowait()
             self._admitting.discard(slot)
             self._deref_guided_request(request)
+            self._release_prefix_hit(request)
             request.error = err
             request.out_queue.put_nowait(_FINISHED)
 
@@ -1981,27 +2113,29 @@ class LLMEngineCore:
                     pool.truncate(s, int(lengths0[s]))
                 return None
             extended.append(slot)
+        self.paged_cache.apply_pending_cow()
         page_table = pool.page_table(self._pages_per_seq)
         tail, use_extras, gtables = self._spec_common_args(
             active_mask, spec_mask, sspec_mask, sampling
         )
-        (tokbuf, pending, (k_pools, v_pools), gs, accs, new_counts,
-         gstate_out, lp) = self._spec_paged_jit(
-            self.params,
-            jnp.asarray(self._tokbuf),
-            jnp.asarray(self._next_token),
-            (
-                self.paged_cache.k,
-                self.paged_cache.v,
-                jnp.asarray(page_table),
-                jnp.asarray(lengths0),
-            ),
-            *tail,
-            want_lp=want_lp,
-            with_sspec=bool(sspec_mask.any()),
-        )
-        self.paged_cache.k = k_pools
-        self.paged_cache.v = v_pools
+        with self.paged_cache.dispatch_lock:
+            (tokbuf, pending, (k_pools, v_pools), gs, accs, new_counts,
+             gstate_out, lp) = self._spec_paged_jit(
+                self.params,
+                jnp.asarray(self._tokbuf),
+                jnp.asarray(self._next_token),
+                (
+                    self.paged_cache.k,
+                    self.paged_cache.v,
+                    jnp.asarray(page_table),
+                    jnp.asarray(lengths0),
+                ),
+                *tail,
+                want_lp=want_lp,
+                with_sspec=bool(sspec_mask.any()),
+            )
+            self.paged_cache.k = k_pools
+            self.paged_cache.v = v_pools
         lp_np = self._spec_commit_state(
             tokbuf, new_counts, gstate_out, lp, use_extras, gtables
         )
@@ -2043,36 +2177,43 @@ class LLMEngineCore:
             for i, (page, offset) in enumerate(pool.token_coords(slot, start, n)):
                 write_pages[slot, i] = page
                 write_offsets[slot, i] = offset
+        # copy-on-write: extends may have swapped a shared tail page for a
+        # private one; its contents must be duplicated before this chunk's
+        # writes land in it
+        self.paged_cache.apply_pending_cow()
         page_table = pool.page_table(self._pages_per_seq)
         use_extras = self._extras_active(active_mask)
         use_guided = bool(np.any(self._gstate[active_mask] >= 0))
         gtables = self._guided_device_tables() if use_guided else None
-        (
-            chunk,
-            self.paged_cache.k,
-            self.paged_cache.v,
-            new_counts,
-            lp,
-            gstate_out,
-        ) = self._decode_paged_chunk_jit(
-            self.params,
-            jnp.asarray(self._next_token),
-            self.paged_cache.k,
-            self.paged_cache.v,
-            jnp.asarray(page_table),
-            jnp.asarray(lengths0),
-            jnp.asarray(write_pages),
-            jnp.asarray(write_offsets),
-            sampling,
-            self._next_rng(),
-            jnp.asarray(self._lora_slots) if self._lora_enabled else None,
-            self._batch_extras() if use_extras else None,
-            self._counts_dev if use_extras else None,
-            self._pmask_dev if use_extras else None,
-            gtables,
-            jnp.asarray(self._gstate) if gtables is not None else None,
-            want_lp=want_lp,
-        )
+        # dispatch under the pool lock: admission workers concurrently
+        # enqueue prefix-page gathers against the same (here donated) pools
+        with self.paged_cache.dispatch_lock:
+            (
+                chunk,
+                self.paged_cache.k,
+                self.paged_cache.v,
+                new_counts,
+                lp,
+                gstate_out,
+            ) = self._decode_paged_chunk_jit(
+                self.params,
+                jnp.asarray(self._next_token),
+                self.paged_cache.k,
+                self.paged_cache.v,
+                jnp.asarray(page_table),
+                jnp.asarray(lengths0),
+                jnp.asarray(write_pages),
+                jnp.asarray(write_offsets),
+                sampling,
+                self._next_rng(),
+                jnp.asarray(self._lora_slots) if self._lora_enabled else None,
+                self._batch_extras() if use_extras else None,
+                self._counts_dev if use_extras else None,
+                self._pmask_dev if use_extras else None,
+                gtables,
+                jnp.asarray(self._gstate) if gtables is not None else None,
+                want_lp=want_lp,
+            )
         if use_extras:
             self._counts_dev = new_counts
         if gtables is not None:
@@ -2142,6 +2283,7 @@ class LLMEngineCore:
                 self._admitting.discard(slot)
                 if request.cancelled:
                     self._deref_guided_request(request)
+                    self._release_prefix_hit(request)
                     request.out_queue.put_nowait(_FINISHED)
                     continue
                 self._commit_admission(request, slot, first_id, mini_cache, first_lp)

@@ -1,4 +1,4 @@
-"""Paged KV cache: page pool + per-sequence page tables.
+"""Paged KV cache: refcounted page pool + per-sequence page tables.
 
 vLLM's PagedAttention memory model rebuilt for TPU/HBM (SURVEY.md §2.9 row 2):
 the cache is a fixed pool of fixed-size pages per layer; sequences own page
@@ -7,24 +7,40 @@ max_seq_len. Allocation is host-side (cheap integer bookkeeping); the device
 side sees dense pools + int32 page tables, which feed
 ops/paged_attention.paged_attention.
 
+Pages are REFCOUNTED so they can be shared between live slots and the radix
+prefix cache (llm/prefix_cache.py): the cache stores a prompt prefix by
+taking a reference on the admitting slot's pages, and a later admission
+sharing that prefix maps the same pages into its own page table — zero HBM
+copies either way. A page returns to the free list only when its last
+reference (slot or cache) drops. A slot that must WRITE into a shared page
+(its tail page is referenced elsewhere) gets a private replacement first —
+copy-on-write: the pool swaps the page id host-side and records a
+(src, dst) pair; PagedKVCache.apply_pending_cow() performs the device copy
+before the next write lands.
+
 Device layout per layer:   k_pool/v_pool [Hkv, num_pages, page_size, D]
 (head-major — the layout ops/paged_attention.py's kernel tiles over)
-Host bookkeeping:          free-page stack + per-slot page lists
+Host bookkeeping:          free-page stack + per-slot page lists + refcounts
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 
 class PagePool:
-    """Host-side page allocator for a fixed pool.
+    """Host-side refcounted page allocator for a fixed pool.
 
     Page 0 is RESERVED as the null page: unused page-table entries point at it
     and inactive batch slots write their garbage KV there — it is never
-    allocated to a sequence."""
+    allocated to a sequence and never refcounted.
+
+    A single re-entrant lock guards all bookkeeping: the engine loop thread,
+    decode worker threads, and admission workers (prefix-cache pins) all
+    mutate refcounts concurrently."""
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int):
         self.num_pages = int(num_pages)
@@ -33,55 +49,175 @@ class PagePool:
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
         self._slot_len: List[int] = [0] * max_slots
+        self._refs: List[int] = [0] * num_pages
+        self._lock = threading.RLock()
+        # copy-on-write bookkeeping: host-side id swaps whose device copy is
+        # still pending (drained by PagedKVCache.apply_pending_cow)
+        self._pending_cow: List[Tuple[int, int]] = []
+        self.cow_events = 0
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     def pages_needed(self, tokens: int) -> int:
         return -(-tokens // self.page_size)
 
     def can_allocate(self, tokens: int) -> bool:
-        return self.pages_needed(tokens) <= len(self._free)
+        with self._lock:
+            return self.pages_needed(tokens) <= len(self._free)
+
+    def _pop_free(self) -> int:
+        page = self._free.pop()
+        self._refs[page] = 1
+        return page
+
+    def _unref(self, page: int) -> bool:
+        """Drop one reference; True when the page returned to the free list."""
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            self._free.append(page)
+            return True
+        if self._refs[page] < 0:
+            raise RuntimeError("page {} refcount went negative".format(page))
+        return False
 
     def allocate(self, slot: int, tokens: int) -> List[int]:
         """Give `slot` enough pages for `tokens` total; returns new page ids."""
-        have = len(self._slot_pages[slot])
-        need = self.pages_needed(tokens) - have
-        if need > len(self._free):
-            raise MemoryError(
-                "page pool exhausted: need {} pages, {} free".format(need, len(self._free))
-            )
-        new = [self._free.pop() for _ in range(max(0, need))]
-        self._slot_pages[slot].extend(new)
-        self._slot_len[slot] = tokens
-        return new
+        with self._lock:
+            have = len(self._slot_pages[slot])
+            need = self.pages_needed(tokens) - have
+            if need > len(self._free):
+                raise MemoryError(
+                    "page pool exhausted: need {} pages, {} free".format(
+                        need, len(self._free)
+                    )
+                )
+            new = [self._pop_free() for _ in range(max(0, need))]
+            self._slot_pages[slot].extend(new)
+            self._slot_len[slot] = tokens
+            return new
 
     def extend(self, slot: int, extra_tokens: int = 1) -> List[int]:
         """Grow a sequence; returns ALL newly allocated page ids (possibly
-        several when `extra_tokens` spans page boundaries; empty if none)."""
-        return self.allocate(slot, self._slot_len[slot] + extra_tokens)
+        several when `extra_tokens` spans page boundaries; empty if none).
+
+        Copy-on-write: if the slot's write position falls inside a page that
+        is ALSO referenced elsewhere (prefix cache or another slot), the page
+        is replaced with a private copy first — writing in place would
+        corrupt every other reader. The device copy is deferred to
+        PagedKVCache.apply_pending_cow()."""
+        with self._lock:
+            length = self._slot_len[slot]
+            if extra_tokens > 0 and length % self.page_size:
+                idx = length // self.page_size
+                page = self._slot_pages[slot][idx]
+                if self._refs[page] > 1:
+                    if not self._free:
+                        raise MemoryError(
+                            "page pool exhausted (copy-on-write of shared "
+                            "page {})".format(page)
+                        )
+                    fresh = self._pop_free()
+                    self._slot_pages[slot][idx] = fresh
+                    self._refs[page] -= 1  # > 1, so never frees here
+                    self._pending_cow.append((page, fresh))
+                    self.cow_events += 1
+            return self.allocate(slot, length + extra_tokens)
 
     def free(self, slot: int) -> None:
-        self._free.extend(reversed(self._slot_pages[slot]))
-        self._slot_pages[slot] = []
-        self._slot_len[slot] = 0
+        """Release the slot's references; pages still referenced by the
+        prefix cache (or another slot) stay allocated."""
+        with self._lock:
+            for page in reversed(self._slot_pages[slot]):
+                self._unref(page)
+            self._slot_pages[slot] = []
+            self._slot_len[slot] = 0
 
     def truncate(self, slot: int, tokens: int) -> None:
-        """Shrink a sequence to `tokens`, returning surplus pages to the
-        pool (speculative chunks over-allocate for the worst-case accepted
-        length, then roll back to what was actually emitted)."""
-        if tokens > self._slot_len[slot]:
-            raise ValueError(
-                "truncate({}) past current length {}".format(
-                    tokens, self._slot_len[slot]
+        """Shrink a sequence to `tokens`, dropping this slot's references to
+        the surplus pages (speculative chunks over-allocate for the
+        worst-case accepted length, then roll back to what was actually
+        emitted). Surplus pages shared with the cache stay allocated."""
+        with self._lock:
+            if tokens > self._slot_len[slot]:
+                raise ValueError(
+                    "truncate({}) past current length {}".format(
+                        tokens, self._slot_len[slot]
+                    )
                 )
-            )
-        keep = self.pages_needed(tokens)
-        surplus = self._slot_pages[slot][keep:]
-        self._slot_pages[slot] = self._slot_pages[slot][:keep]
-        self._free.extend(reversed(surplus))
-        self._slot_len[slot] = tokens
+            keep = self.pages_needed(tokens)
+            surplus = self._slot_pages[slot][keep:]
+            self._slot_pages[slot] = self._slot_pages[slot][:keep]
+            for page in reversed(surplus):
+                self._unref(page)
+            self._slot_len[slot] = tokens
+
+    # -- sharing (prefix cache) --------------------------------------------
+
+    def ref_pages(self, pages: List[int]) -> None:
+        """Take one reference on each page (cache store / lookup pin)."""
+        with self._lock:
+            for page in pages:
+                if self._refs[page] <= 0:
+                    raise RuntimeError(
+                        "ref_pages on unallocated page {}".format(page)
+                    )
+                self._refs[page] += 1
+
+    def unref_pages(self, pages: List[int]) -> int:
+        """Drop one reference per page; returns how many were freed."""
+        freed = 0
+        with self._lock:
+            for page in pages:
+                if self._unref(page):
+                    freed += 1
+        return freed
+
+    def map_shared(self, slot: int, pages: List[int], tokens: int) -> None:
+        """Map already-allocated (shared) pages as the slot's first pages —
+        the zero-copy half of a prefix-cache hit. The slot takes its own
+        reference on each page; ``tokens`` must cover the pages exactly
+        (page-aligned prefix)."""
+        with self._lock:
+            if self._slot_pages[slot]:
+                raise RuntimeError(
+                    "map_shared into non-empty slot {}".format(slot)
+                )
+            if tokens != len(pages) * self.page_size:
+                raise ValueError(
+                    "shared prefix of {} tokens does not fill {} pages".format(
+                        tokens, len(pages)
+                    )
+                )
+            for page in pages:
+                if self._refs[page] <= 0:
+                    raise RuntimeError(
+                        "map_shared of unallocated page {}".format(page)
+                    )
+                self._refs[page] += 1
+            self._slot_pages[slot] = list(pages)
+            self._slot_len[slot] = tokens
+
+    def drain_pending_cow(self) -> List[Tuple[int, int]]:
+        with self._lock:
+            out, self._pending_cow = self._pending_cow, []
+            return out
+
+    def page_refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs[page]
+
+    def slot_pages(self, slot: int) -> List[int]:
+        with self._lock:
+            return list(self._slot_pages[slot])
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages with more than one reference (slot+cache or slot+slot)."""
+        with self._lock:
+            return sum(1 for r in self._refs[1:] if r > 1)
 
     def slot_length(self, slot: int) -> int:
         return self._slot_len[slot]
@@ -90,7 +226,8 @@ class PagePool:
         """(page_id, offset) for token positions [start, start+count) of a
         slot. The single source of the page//offset math for engine, cache,
         and tests."""
-        pages = self._slot_pages[slot]
+        with self._lock:
+            pages = list(self._slot_pages[slot])
         out = []
         for pos in range(start, start + count):
             out.append((pages[pos // self.page_size], pos % self.page_size))
@@ -101,19 +238,21 @@ class PagePool:
         page 0 — they are masked by lengths on the device side). Raises if any
         slot owns more pages than the table can express — silently truncating
         would drop the newest tokens from attention."""
-        table = np.zeros((self.max_slots, pages_per_seq), np.int32)
-        for slot, pages in enumerate(self._slot_pages):
-            if len(pages) > pages_per_seq:
-                raise ValueError(
-                    "slot {} holds {} pages > table width {}".format(
-                        slot, len(pages), pages_per_seq
+        with self._lock:
+            table = np.zeros((self.max_slots, pages_per_seq), np.int32)
+            for slot, pages in enumerate(self._slot_pages):
+                if len(pages) > pages_per_seq:
+                    raise ValueError(
+                        "slot {} holds {} pages > table width {}".format(
+                            slot, len(pages), pages_per_seq
+                        )
                     )
-                )
-            table[slot, : len(pages)] = pages
-        return table
+                table[slot, : len(pages)] = pages
+            return table
 
     def lengths(self) -> np.ndarray:
-        return np.asarray(self._slot_len, np.int32)
+        with self._lock:
+            return np.asarray(self._slot_len, np.int32)
 
 
 class PagedKVCache:
@@ -122,7 +261,14 @@ class PagedKVCache:
     Pools are ONE stacked array per side — ``k``/``v`` [L, Hkv, N, P, D] — and
     every write goes through a jitted, buffer-donating scatter: the pool is
     updated in place in HBM, never copied (an eager ``.at[].set`` would copy
-    the whole multi-GB pool per token)."""
+    the whole multi-GB pool per token).
+
+    ``dispatch_lock`` serializes DISPATCH of device programs that touch the
+    pools: the decode/spec chunks donate k/v while admission workers
+    concurrently enqueue prefix-KV gathers and commit writes — without the
+    lock a gather could grab a pool reference that a racing donating dispatch
+    has already invalidated. Execution still overlaps; only the (cheap,
+    host-side) enqueue is serialized."""
 
     def __init__(
         self,
@@ -143,6 +289,7 @@ class PagedKVCache:
         shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
         self.k = jnp.zeros(shape, jnp.dtype(dtype))
         self.v = jnp.zeros(shape, jnp.dtype(dtype))
+        self.dispatch_lock = threading.Lock()
 
         def _write_pages(pool, chunks, pages):
             # chunks [NP, L, Hkv, P, D], pages [NP] -> scatter all pages in ONE
@@ -157,8 +304,18 @@ class PagedKVCache:
                 pool, kv[:, :, None, None], (0, 0, page, offset, 0)
             )
 
+        def _copy_page(pool, src, dst):
+            # copy-on-write: duplicate one page inside the pool (src read,
+            # dst written, one fused donated program — no host round trip)
+            page = jax.lax.dynamic_slice(
+                pool, (0, 0, src, 0, 0),
+                (pool.shape[0], pool.shape[1], 1, pool.shape[3], pool.shape[4]),
+            )
+            return jax.lax.dynamic_update_slice(pool, page, (0, 0, dst, 0, 0))
+
         self._write_pages = jax.jit(_write_pages, donate_argnums=(0,))
         self._write_token = jax.jit(_write_token, donate_argnums=(0,))
+        self._copy_page = jax.jit(_copy_page, donate_argnums=(0,))
 
     def layer(self, li: int):
         """Per-layer head-major views for ops.paged_attention."""
@@ -167,14 +324,29 @@ class PagedKVCache:
     def max_pages_per_seq(self, max_seq_len: int) -> int:
         return self.pool.pages_needed(max_seq_len)
 
-    def write_prompt(self, slot: int, k_stack, v_stack, length: int) -> None:
-        """Scatter a prefilled prompt's KV (stacked [L, S, Hkv, D]) into this
-        slot's pages via donated jitted writes."""
+    def apply_pending_cow(self) -> int:
+        """Perform the device copies for any host-side copy-on-write page
+        swaps (PagePool.extend). MUST run after extending slots and before
+        the writes of the extension land. Returns the number of pages
+        copied."""
         import jax.numpy as jnp
 
-        self.pool.free(slot)
-        self.pool.allocate(slot, length)
-        pages = self.pool._slot_pages[slot]
+        pairs = self.pool.drain_pending_cow()
+        if not pairs:
+            return 0
+        with self.dispatch_lock:
+            for src, dst in pairs:
+                s = jnp.asarray(src, jnp.int32)
+                d = jnp.asarray(dst, jnp.int32)
+                self.k = self._copy_page(self.k, s, d)
+                self.v = self._copy_page(self.v, s, d)
+        return len(pairs)
+
+    def _scatter_pages(self, pages: List[int], k_stack, v_stack) -> None:
+        """Scatter token KV (stacked [L, S, Hkv, D], S <= len(pages)*P) into
+        the given pages via the donated jitted page write."""
+        import jax.numpy as jnp
+
         page_size = self.pool.page_size
         n_pages = len(pages)
         k_hm = jnp.moveaxis(jnp.asarray(k_stack), 2, 1)  # [L, Hkv, S, D]
@@ -187,8 +359,35 @@ class PagedKVCache:
         k_chunks = k_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
         v_chunks = v_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
         page_ids = jnp.asarray(pages, jnp.int32)
-        self.k = self._write_pages(self.k, k_chunks, page_ids)
-        self.v = self._write_pages(self.v, v_chunks, page_ids)
+        with self.dispatch_lock:
+            self.k = self._write_pages(self.k, k_chunks, page_ids)
+            self.v = self._write_pages(self.v, v_chunks, page_ids)
+
+    def write_prompt(self, slot: int, k_stack, v_stack, length: int) -> None:
+        """Scatter a prefilled prompt's KV (stacked [L, S, Hkv, D]) into this
+        slot's pages via donated jitted writes."""
+        self.pool.free(slot)
+        self.pool.allocate(slot, length)
+        self._scatter_pages(self.pool.slot_pages(slot), k_stack, v_stack)
+
+    def write_prompt_shared(
+        self, slot: int, shared_pages: List[int], prefix_len: int,
+        k_tail, v_tail, length: int,
+    ) -> None:
+        """Prefix-cache hit admission: map ``shared_pages`` (holding the
+        first ``prefix_len`` tokens, page-aligned) into the slot's page table
+        BY REFERENCE — zero KV copies for the shared run — then scatter only
+        the tail's KV ([L, length - prefix_len, Hkv, D]) into freshly
+        allocated pages."""
+        if prefix_len % self.pool.page_size:
+            raise ValueError(
+                "shared prefix length {} is not page-aligned".format(prefix_len)
+            )
+        self.pool.free(slot)
+        self.pool.map_shared(slot, shared_pages, prefix_len)
+        tail_pages = self.pool.allocate(slot, length)
+        if tail_pages:
+            self._scatter_pages(tail_pages, k_tail, v_tail)
 
     def append_token(self, slot: int, k_token, v_token) -> None:
         """Append one token's KV (stacked [L, Hkv, D]) to the slot."""
@@ -196,6 +395,8 @@ class PagedKVCache:
 
         length = self.pool.slot_length(slot)
         self.pool.extend(slot, 1)
+        self.apply_pending_cow()
         ((page, offset),) = self.pool.token_coords(slot, length, 1)
-        self.k = self._write_token(self.k, jnp.asarray(k_token), page, offset)
-        self.v = self._write_token(self.v, jnp.asarray(v_token), page, offset)
+        with self.dispatch_lock:
+            self.k = self._write_token(self.k, jnp.asarray(k_token), page, offset)
+            self.v = self._write_token(self.v, jnp.asarray(v_token), page, offset)

@@ -205,9 +205,30 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("prefix_cache_mb")
                 else None
             ),
+            prefix_cache_pages=(
+                int(engine_cfg["prefix_cache_pages"])
+                if engine_cfg.get("prefix_cache_pages")
+                else None
+            ),
             tokenizer=self.tokenizer,  # guided decoding needs token bytes
         )
         self._model_name = self.endpoint.serving_url
+        if self.engine._prefix is not None:
+            # hit rate / shared pages / CoW visible from day one on the same
+            # Prometheus registry the serving process already exports
+            try:
+                from ..statistics.metrics import register_prefix_cache
+
+                pool = (
+                    self.engine.paged_cache.pool
+                    if self.engine.paged_cache is not None
+                    else None
+                )
+                self._prefix_collector = register_prefix_cache(
+                    self.engine._prefix, pool, key=self._model_name
+                )
+            except Exception:
+                self._prefix_collector = None  # registry unavailable etc.
         return self.engine
 
     def _load_lora_cfg(self, engine_cfg: Dict[str, Any]):

@@ -1,113 +1,363 @@
-"""Automatic prefix caching: reuse prompt-prefix KV across requests.
+"""Radix-tree prefix caching: block-granular prompt-prefix KV reuse.
 
 The reference's LLM engine (vLLM, reference serving/preprocess_service.py
 §2.8) ships automatic prefix caching — chat workloads share a system prompt,
 so the prefix's KV is computed once and reused, cutting TTFT for every
-follow-up request. This is the TPU-native equivalent for the dense-slot
-engine (llm/engine.py):
+follow-up request. This module is the TPU-native equivalent for BOTH cache
+backends of llm/engine.py, organized as a radix tree over block-granular
+token runs (SGLang's RadixAttention layout; see docs/prefix_caching.md):
 
-- Prefixes are **block-aligned** (default 64 tokens, like vLLM's block size):
-  a prompt stores its KV up to the largest block multiple that is strictly
-  shorter than the prompt (the final token must always be processed live to
-  produce the first-token logits).
-- Entries live in an LRU keyed by the EXACT token prefix (and the LoRA
-  adapter index — K/V projections differ per adapter). Values are jax device
-  arrays sliced from the admission's prefill cache: immutable, shareable
-  across slots, and resident in HBM until evicted.
-- On admission, the longest stored prefix is assembled into the mini-cache
-  (one dynamic_update_slice) and only the remainder runs through
-  ``prefill_chunk`` — an admission that shares a 1000-token system prompt
-  prefills only its tail.
+- Each tree edge carries exactly one ``block`` of tokens (default 64, like
+  vLLM's block size); children are keyed by the block's token tuple, so a
+  probe walks the tree block by block — O(prompt) TOTAL hashing per lookup,
+  not O(prompt) per candidate length like the previous exact-match LRU.
+- ANY shared block run matches (partial-prefix hits): two prompts sharing
+  only their first k blocks reuse exactly those k blocks, whether or not
+  that exact prefix was ever stored as a whole.
+- Payloads are per-backend:
+  * dense — immutable jax KV slices ([L, 1, block, Hkv, D] per node), which
+    the engine concatenates and assembles into the admission mini cache;
+  * paged — page ids in the engine's ``PagePool`` with CACHE-HELD refcounts:
+    storing a prompt's prefix takes a reference on the admitting slot's own
+    pages (zero copies), and a hit maps those pages straight into the new
+    slot's page table (zero copies again). Pages are physically freed only
+    when the last referencing slot AND the cache let go.
+- Eviction is LRU at LEAF granularity (a node is evictable only once no
+  longer prefix depends on it), under three budgets: node count, bytes, and
+  (paged) pages. Evicting a paged node only drops the cache's reference —
+  a page a live slot still maps keeps its data until that slot frees.
+- Trees are namespaced per LoRA adapter index (K/V projections differ per
+  adapter), exactly like the previous cache's key tuple.
 
-Thread-safety: admissions run in worker threads; a single mutex guards the
-OrderedDict. The stored arrays themselves are immutable jax buffers.
+The prompt's final token is never cached: it must always compute live to
+produce the first-token logits (``longest_prefix_len``).
+
+Thread-safety: admissions run in worker threads; one mutex guards the tree.
+Dense payloads are immutable jax buffers. Paged lookups PIN the returned
+pages (refcount bump under the tree lock) so a concurrent eviction cannot
+free them between lookup and slot mapping; the engine releases the pin once
+the pages are mapped (or the admission fails).
 """
 
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 
-class PrefixKVCache:
-    """LRU of block-aligned prompt-prefix KV buffers.
+class _Node:
+    """One block-granular edge of the radix tree."""
 
-    Bounded by BOTH entry count and bytes: a stored prefix holds
-    ~2·L·P·Hkv·D·itemsize of HBM (hundreds of MB for a multi-thousand-token
-    prefix on an 8B model), so an entry-only bound could exceed a chip's HBM
-    next to the weights and the decode cache. Default byte budget: 2 GiB.
+    __slots__ = (
+        "parent", "edge", "children", "bufs", "pages", "nbytes", "last_used",
+    )
+
+    def __init__(self, parent: Optional["_Node"], edge: Tuple[int, ...]):
+        self.parent = parent
+        self.edge = edge          # this node's block of tokens
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.bufs: Optional[Dict[str, Any]] = None   # dense payload
+        self.pages: Optional[List[int]] = None       # paged payload
+        self.nbytes = 0
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Radix tree of block-aligned prompt-prefix KV.
+
+    Bounded by node count AND bytes (and pages on the paged backend): a
+    cached block holds ~2·L·block·Hkv·D·itemsize of HBM, so an entry-only
+    bound could exceed a chip's HBM next to the weights and the decode
+    cache. Default byte budget: 2 GiB.
+
+    ``pool``/``page_bytes`` select the paged backend: payloads are page ids
+    refcounted against ``pool`` instead of dense KV slices.
     """
 
-    def __init__(self, max_entries: int = 32, block: int = 64,
-                 max_bytes: Optional[int] = None):
+    def __init__(
+        self,
+        max_nodes: int = 512,
+        block: int = 64,
+        max_bytes: Optional[int] = None,
+        *,
+        max_pages: Optional[int] = None,
+        pool=None,
+        page_bytes: int = 0,
+    ):
         self.block = int(block)
-        self.max_entries = int(max_entries)
+        self.max_nodes = int(max_nodes)
         self.max_bytes = int(max_bytes) if max_bytes else 2 << 30
-        self._entries: "OrderedDict[Tuple, Dict[str, Any]]" = OrderedDict()
+        self.max_pages = int(max_pages) if max_pages else None
+        self._pool = pool
+        self._page_bytes = int(page_bytes)
+        self._roots: Dict[int, _Node] = {}
+        # incrementally maintained leaf set (nodes with no children): LRU
+        # eviction scans candidates directly instead of a whole-tree DFS per
+        # evicted node (O(leaves) vs O(nodes) with the lock held)
+        self._leaf_nodes: set = set()
         self._bytes = 0
+        self._pages = 0
+        self._n_nodes = 0
+        self._clock = 0
         self._lock = threading.Lock()
+        # observability (statistics/metrics.py PrefixCacheCollector)
         self.hits = 0
         self.misses = 0
+        self.hit_tokens = 0     # prompt tokens served from cache
+        self.evictions = 0
 
-    def _key(self, ids: List[int], p: int, lora: int) -> Tuple:
-        return (lora, tuple(ids[:p]))
+    # -- shared helpers ------------------------------------------------------
 
     def longest_prefix_len(self, n_tokens: int) -> int:
         """Largest storable/lookupable prefix for a prompt of n tokens: the
         final token always computes live (its logits seed decoding)."""
         return ((n_tokens - 1) // self.block) * self.block
 
-    def lookup(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
-        """Longest stored entry matching a block-aligned prefix of ``ids``.
-        Returns {"k": [L,1,P,H,D], "v": ..., "len": P} or None."""
+    def _root(self, lora: int) -> _Node:
+        root = self._roots.get(lora)
+        if root is None:
+            root = _Node(None, ())
+            self._roots[lora] = root
+        return root
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _walk(self, ids: List[int], lora: int) -> Tuple[_Node, int]:
+        """Descend matching blocks; returns (deepest node, depth tokens).
+        Touches every node on the path (LRU). Lock held by caller."""
+        node = self._roots.get(lora)
+        if node is None:
+            return self._root(lora), 0
+        depth = 0
+        limit = self.longest_prefix_len(len(ids))
+        now = self._tick()
+        while depth + self.block <= limit:
+            blk = tuple(ids[depth : depth + self.block])
+            child = node.children.get(blk)
+            if child is None:
+                break
+            child.last_used = now
+            node = child
+            depth += self.block
+        return node, depth
+
+    def _path_nodes(self, node: _Node) -> List[_Node]:
+        """Root-exclusive path from the root down to ``node``."""
+        path: List[_Node] = []
+        while node is not None and node.parent is not None:
+            path.append(node)
+            node = node.parent
+        path.reverse()
+        return path
+
+    def _attach(self, parent: _Node, child: _Node) -> None:
+        """Insert ``child`` under ``parent`` and keep the leaf set current.
+        Lock held by caller; accounting is the caller's job."""
+        parent.children[child.edge] = child
+        self._leaf_nodes.discard(parent)
+        self._leaf_nodes.add(child)
+        self._n_nodes += 1
+
+    def uncount_hit(self, hit: Optional[Dict[str, Any]]) -> None:
+        """The engine could not use a returned hit (no prefill bucket fits
+        the prefix+tail): reclassify it as a miss so hit-rate metrics and
+        hit_tokens reflect prefill compute actually skipped, not matches
+        that were recomputed cold anyway."""
+        if not hit:
+            return
         with self._lock:
-            p = self.longest_prefix_len(len(ids))
-            while p >= self.block:
-                entry = self._entries.get(self._key(ids, p, lora))
-                if entry is not None:
-                    self._entries.move_to_end(self._key(ids, p, lora))
-                    self.hits += 1
-                    return entry
-                p -= self.block
+            self.hits -= 1
             self.misses += 1
-            return None
+            self.hit_tokens -= int(hit.get("len", 0))
+
+    # -- dense backend -------------------------------------------------------
+
+    def lookup(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
+        """Longest shared block run of ``ids`` (dense backend).
+        Returns {"len": P, "bufs": {name: [L, 1, P, ...]}} or None."""
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            if depth < self.block:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self.hit_tokens += depth
+            blocks = [n.bufs for n in self._path_nodes(node)]
+        # concatenate outside the lock: blocks are immutable device arrays,
+        # and the eager concat dispatch must not serialize other admissions
+        import jax.numpy as jnp
+
+        if len(blocks) == 1:
+            bufs = dict(blocks[0])
+        else:
+            bufs = {
+                name: jnp.concatenate([b[name] for b in blocks], axis=2)
+                for name in blocks[0]
+            }
+        return {"len": depth, "bufs": bufs}
 
     def store(self, ids: List[int], lora: int, bufs: Dict[str, Any]) -> None:
-        """Store the prompt's largest block-aligned prefix KV. ``bufs`` maps
-        cache buffer keys (k/v, plus k_scale/v_scale on the int8-KV path) to
-        the admission's prefill buffers [L, 1, bucket, ...] with the token
-        dim at axis 2 (any bucket >= the prefix length); slices are taken
-        here."""
+        """Store the prompt's block-aligned prefix KV (dense backend).
+        ``bufs`` maps cache buffer keys (k/v, plus k_scale/v_scale on the
+        int8-KV path) to the admission's prefill buffers [L, 1, bucket, ...]
+        with the token dim at axis 2 (any bucket >= the prefix length);
+        blocks already in the tree are only touched, new ones are sliced."""
         p = self.longest_prefix_len(len(ids))
         if p < self.block:
             return
-        key = self._key(ids, p, lora)
         with self._lock:
-            if key in self._entries:
-                self._entries.move_to_end(key)
-                return
-            slices = {name: buf[:, :, :p] for name, buf in bufs.items()}
+            _, depth0 = self._walk(ids, lora)
+        # slice the missing blocks OUTSIDE the lock: each slice is an eager
+        # device dispatch, and holding the mutex across them would stall
+        # every concurrent admission's lookup (worst case: a cold long
+        # prompt storing dozens of blocks). A raced store of the same blocks
+        # just wastes these slices — the insert below skips existing nodes.
+        pending = []
+        for depth in range(depth0, p, self.block):
+            slices = {
+                name: buf[:, :, depth : depth + self.block]
+                for name, buf in bufs.items()
+            }
             nbytes = sum(
                 int(getattr(s, "nbytes", 0)) for s in slices.values()
             )
             if nbytes > self.max_bytes:
-                return  # a single over-budget prefix is never worth the HBM
-            entry = dict(slices)
-            entry["len"] = p
-            entry["nbytes"] = nbytes
-            self._entries[key] = entry
-            self._bytes += nbytes
-            while (
-                len(self._entries) > self.max_entries
-                or self._bytes > self.max_bytes
-            ):
-                _, old = self._entries.popitem(last=False)
-                self._bytes -= old["nbytes"]
+                break  # a single over-budget block is never worth it
+            pending.append((depth, slices, nbytes))
+        if not pending:
+            return
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            now = self._clock
+            for blk_depth, slices, nbytes in pending:
+                if blk_depth < depth:
+                    continue  # another admission inserted it meanwhile
+                if blk_depth > depth:
+                    break  # budget broke the chain above this block
+                blk = tuple(ids[depth : depth + self.block])
+                child = _Node(node, blk)
+                child.bufs = slices
+                child.nbytes = nbytes
+                child.last_used = now
+                self._attach(node, child)
+                self._bytes += nbytes
+                node = child
+                depth += self.block
+            self._evict_over_budget()
+
+    # -- paged backend -------------------------------------------------------
+
+    def lookup_pages(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
+        """Longest shared block run (paged backend). Returns {"len": P,
+        "pages": [ids]} with the pages PINNED (one cache-side refcount taken
+        on the caller's behalf) so eviction cannot free them before the
+        engine maps them into a slot — the caller MUST release() the hit."""
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            if depth < self.block:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self.hit_tokens += depth
+            pages: List[int] = []
+            for n in self._path_nodes(node):
+                pages.extend(n.pages)
+            self._pool.ref_pages(pages)  # pin for the admission in flight
+        return {"len": depth, "pages": pages}
+
+    def release(self, hit: Dict[str, Any]) -> None:
+        """Drop a lookup_pages() pin (after slot mapping took its own refs,
+        or the admission failed)."""
+        pages = hit.pop("pages", None) if hit else None
+        if pages:
+            self._pool.unref_pages(pages)
+
+    def store_pages(self, ids: List[int], lora: int, slot_pages: List[int]) -> None:
+        """Store the prompt's block-aligned prefix by REFERENCE to the
+        admitting slot's pages (paged backend; zero copies). ``block`` must
+        be a page-size multiple so shared runs cover whole pages. Blocks
+        already in the tree are skipped — their pages may belong to an
+        earlier admission and are already shared."""
+        p = self.longest_prefix_len(len(ids))
+        if p < self.block:
+            return
+        ppb = self.block // self._pool.page_size
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            now = self._clock
+            while depth + self.block <= p:
+                blk = tuple(ids[depth : depth + self.block])
+                first = (depth // self._pool.page_size)
+                pages = list(slot_pages[first : first + ppb])
+                if len(pages) < ppb:
+                    break  # slot shorter than the prefix? defensive stop
+                child = _Node(node, blk)
+                child.pages = pages
+                child.nbytes = ppb * self._page_bytes
+                child.last_used = now
+                self._pool.ref_pages(pages)
+                self._attach(node, child)
+                self._bytes += child.nbytes
+                self._pages += ppb
+                node = child
+                depth += self.block
+            self._evict_over_budget()
+
+    # -- eviction ------------------------------------------------------------
+
+    def _over_budget(self) -> bool:
+        return (
+            self._n_nodes > self.max_nodes
+            or self._bytes > self.max_bytes
+            or (self.max_pages is not None and self._pages > self.max_pages)
+        )
+
+    def _evict_over_budget(self) -> None:
+        """LRU leaf eviction over the incrementally maintained leaf set
+        (O(leaves) per eviction, no tree walk). A paged leaf only drops the
+        CACHE's page refs; pages a live slot still maps stay allocated until
+        that slot frees (the pool's refcount is the single source of
+        truth)."""
+        while self._over_budget():
+            if not self._leaf_nodes:
+                return
+            victim = min(self._leaf_nodes, key=lambda n: n.last_used)
+            self._leaf_nodes.discard(victim)
+            parent = victim.parent
+            parent.children.pop(victim.edge, None)
+            if not parent.children and parent.parent is not None:
+                self._leaf_nodes.add(parent)  # parent became a leaf
+            self._n_nodes -= 1
+            self._bytes -= victim.nbytes
+            if victim.pages is not None:
+                self._pages -= len(victim.pages)
+                self._pool.unref_pages(victim.pages)
+            victim.parent = None
+            self.evictions += 1
+
+    # -- observability -------------------------------------------------------
 
     @property
     def total_bytes(self) -> int:
         return self._bytes
 
+    @property
+    def cached_pages(self) -> int:
+        return self._pages
+
     def __len__(self) -> int:
-        return len(self._entries)
+        return self._n_nodes
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions,
+                "nodes": self._n_nodes,
+                "cached_bytes": self._bytes,
+                "cached_pages": self._pages,
+            }

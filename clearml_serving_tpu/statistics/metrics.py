@@ -91,6 +91,128 @@ class EnumHistogram:
         return self.collect()
 
 
+class PrefixCacheCollector:
+    """Live LLM prefix-cache observability (llm/prefix_cache.py
+    RadixPrefixCache): collect() reads each registered cache's counters —
+    and, on the paged backend, the page pool's sharing/CoW counters — at
+    scrape time, so the hit rate and HBM dedup of "millions of users share a
+    system prompt" traffic are visible without the engine pushing samples
+    anywhere.
+
+    ONE collector per registry holds an entry per model (label ``model``):
+    re-registering a model (endpoint hot-reload rebuilds its engine)
+    REPLACES its entry, dropping the dead engine's cache reference — a
+    per-engine collector would both leak the old cache's device KV and emit
+    duplicate metric families, which makes Prometheus reject the scrape."""
+
+    def __init__(self, prefix: str = "llm_prefix_cache"):
+        self._prefix = _sanitize(prefix)
+        self._entries: Dict[str, tuple] = {}  # model key -> (cache, pool)
+        self._lock = threading.Lock()
+
+    def set_entry(self, key: str, cache, pool=None) -> None:
+        with self._lock:
+            self._entries[str(key)] = (cache, pool)
+
+    def remove_entry(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(str(key), None)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        with self._lock:
+            entries = dict(self._entries)
+        p = self._prefix
+        cache_fams = [
+            ("hits", CounterMetricFamily(
+                p + "_hits", "prefix-cache lookups that matched >= 1 block",
+                labels=["model"])),
+            ("misses", CounterMetricFamily(
+                p + "_misses", "prefix-cache lookups with no shared block",
+                labels=["model"])),
+            ("hit_tokens", CounterMetricFamily(
+                p + "_hit_tokens", "prompt tokens served from cached KV "
+                "(prefill compute skipped)", labels=["model"])),
+            ("evictions", CounterMetricFamily(
+                p + "_evictions", "radix-tree leaf evictions",
+                labels=["model"])),
+            ("nodes", GaugeMetricFamily(
+                p + "_nodes", "cached block-granular tree nodes",
+                labels=["model"])),
+            ("cached_bytes", GaugeMetricFamily(
+                p + "_bytes", "bytes of KV held (dense) or referenced "
+                "(paged) by the cache", labels=["model"])),
+            ("cached_pages", GaugeMetricFamily(
+                p + "_pages", "KV pool pages referenced by the cache (paged "
+                "backend)", labels=["model"])),
+        ]
+        shared = GaugeMetricFamily(
+            "kv_pool_shared_pages",
+            "pool pages with more than one reference (slot+cache or "
+            "slot+slot zero-copy sharing)", labels=["model"],
+        )
+        free = GaugeMetricFamily(
+            "kv_pool_free_pages", "unreferenced pool pages", labels=["model"]
+        )
+        cow = CounterMetricFamily(
+            "kv_pool_cow_events",
+            "copy-on-write page duplications (live slot extended into a "
+            "shared page)", labels=["model"],
+        )
+        any_pool = False
+        for key, (cache, pool) in entries.items():
+            s = cache.stats()
+            for stat_key, fam in cache_fams:
+                fam.add_metric([key], s[stat_key])
+            if pool is not None:
+                any_pool = True
+                shared.add_metric([key], pool.shared_pages)
+                free.add_metric([key], pool.free_pages)
+                cow.add_metric([key], pool.cow_events)
+        for _, fam in cache_fams:
+            yield fam
+        if any_pool:
+            yield shared
+            yield free
+            yield cow
+
+    def describe(self):
+        # empty describe => prometheus_client registers without probing
+        # collect() (the engine may not be fully constructed yet)
+        return []
+
+
+# one collector per live registry (weak: test registries die with their
+# tests; a reused id must not resurrect a collector bound to a dead one)
+_prefix_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
+
+
+def register_prefix_cache(cache, pool=None, registry=REGISTRY,
+                          key: str = "llm",
+                          prefix: str = "llm_prefix_cache"):
+    """Expose live prefix-cache metrics for ``key`` (the model/endpoint
+    name). Idempotent per (registry, key): re-registering replaces the
+    entry, so engine hot-reloads neither leak the old cache nor duplicate
+    metric families. Returns the registry's shared collector."""
+    global _prefix_collectors
+    import weakref
+
+    if _prefix_collectors is None:
+        _prefix_collectors = weakref.WeakKeyDictionary()
+    per_registry = _prefix_collectors.setdefault(registry, {})
+    collector = per_registry.get(prefix)
+    if collector is None:
+        collector = PrefixCacheCollector(prefix)
+        registry.register(collector)
+        per_registry[prefix] = collector
+    collector.set_entry(key, cache, pool)
+    return collector
+
+
 class StatisticsController:
     _sync_threshold_sec = 30.0
 

@@ -19,9 +19,28 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.4.x with the explicit knob; older/other versions rely on the
+    # XLA_FLAGS fallback set above
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+assert jax.device_count() == 8, (
+    "tests need 8 virtual CPU devices (got {}); the XLA_FLAGS "
+    "--xla_force_host_platform_device_count=8 fallback did not take — jax "
+    "was initialized before conftest ran".format(jax.device_count())
+)
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    # the repo has no pytest.ini/pyproject marker section; register the
+    # tier-1 exclusion marker here so `-m 'not slow'` runs warning-free
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the tier-1 run"
+    )
 
 
 @pytest.fixture()

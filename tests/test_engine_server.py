@@ -53,6 +53,61 @@ def test_dynamic_batcher_batches_concurrent_requests():
     assert batcher.requests_served == 4
 
 
+def test_dynamic_batcher_padding_observability():
+    """The bucket-padding path's waste is counted: padded_rows_sum and the
+    on_padding hook report bucket - real rows per executed batch."""
+    buckets = [4, 8]
+
+    def run_batch(concat):
+        return [concat[0]]
+
+    seen = []
+
+    async def run():
+        batcher = DynamicBatcher(
+            run_batch, preferred_batch_size=4, max_queue_delay_us=1000,
+            bucket_for=lambda rows: next((b for b in buckets if rows <= b), rows),
+        )
+        batcher.on_padding = lambda real, pad: seen.append((real, pad))
+        await asyncio.gather(
+            *[batcher.infer([np.zeros((1, 2), np.float32)]) for _ in range(3)]
+        )
+        return batcher
+
+    batcher = asyncio.run(run())
+    assert batcher.batch_size_sum == 3
+    # 3 real rows pad to the 4-bucket (possibly split across batches; total
+    # waste is bucket-sum minus real rows either way)
+    assert batcher.padded_rows_sum == sum(p for _, p in seen)
+    assert sum(r for r, _ in seen) == 3
+    assert batcher.padded_rows_sum >= 1
+
+
+def test_engine_metrics_padding_counter():
+    """EngineMetrics wires the padding hook into the per-model
+    engine_batch_rows_total{kind} counter next to the queue-delay series."""
+    from prometheus_client import CollectorRegistry
+
+    from clearml_serving_tpu.engine_server.server import EngineMetrics
+
+    registry = CollectorRegistry()
+    metrics = EngineMetrics(registry=registry)
+
+    class _B:
+        on_queue_delay = None
+        on_padding = None
+
+    b = _B()
+    metrics.wire_batcher("m", b)
+    b.on_padding(3, 5)
+    assert registry.get_sample_value(
+        "engine_batch_rows_total", {"model": "m", "kind": "real"}
+    ) == 3
+    assert registry.get_sample_value(
+        "engine_batch_rows_total", {"model": "m", "kind": "padded"}
+    ) == 5
+
+
 def test_dynamic_batcher_error_propagates():
     def run_batch(concat):
         raise RuntimeError("boom")

@@ -1,0 +1,198 @@
+"""KV page lifecycle: PagePool refcount / copy-on-write / truncate / free
+interactions, and the PagedKVCache device side of CoW (llm/kv_cache.py).
+
+These are the invariants the radix prefix cache (llm/prefix_cache.py) leans
+on: a page is recycled exactly when its LAST reference (slot or cache)
+drops, a slot never writes into a page someone else still references, and
+rollback (truncate) never strands or double-frees shared pages.
+"""
+
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.llm.kv_cache import PagedKVCache, PagePool
+
+
+def _pool(num_pages=16, page_size=4, max_slots=4):
+    return PagePool(num_pages=num_pages, page_size=page_size, max_slots=max_slots)
+
+
+# -- refcount basics ----------------------------------------------------------
+
+
+def test_allocate_free_roundtrip():
+    pool = _pool()
+    pages = pool.allocate(0, 10)  # 3 pages
+    assert len(pages) == 3
+    assert all(pool.page_refcount(p) == 1 for p in pages)
+    assert pool.free_pages == 15 - 3
+    pool.free(0)
+    assert pool.free_pages == 15
+    assert all(pool.page_refcount(p) == 0 for p in pages)
+
+
+def test_truncate_returns_only_unshared_surplus():
+    pool = _pool()
+    pool.allocate(0, 16)  # 4 pages
+    pages = pool.slot_pages(0)
+    pool.ref_pages(pages[3:])  # cache holds the last page
+    pool.truncate(0, 5)        # keep 2 pages, surplus = pages[2:]
+    assert pool.slot_pages(0) == pages[:2]
+    assert pool.page_refcount(pages[2]) == 0   # unshared -> freed
+    assert pool.page_refcount(pages[3]) == 1   # cache ref keeps it
+    assert pool.slot_length(0) == 5
+    # the shared surplus page is NOT in the free list
+    assert pool.free_pages == 15 - 2 - 1
+
+
+def test_truncate_past_length_raises():
+    pool = _pool()
+    pool.allocate(0, 4)
+    with pytest.raises(ValueError):
+        pool.truncate(0, 5)
+
+
+def test_extend_after_truncate_reuses_tail_page():
+    pool = _pool()
+    pool.allocate(0, 8)
+    pool.truncate(0, 5)
+    new = pool.extend(0, 1)  # token 5 fits the kept tail page
+    assert new == []
+    new = pool.extend(0, 3)  # tokens 6,7,8 -> one new page
+    assert len(new) == 1
+
+
+def test_ref_unref_errors():
+    pool = _pool()
+    with pytest.raises(RuntimeError):
+        pool.ref_pages([3])  # never allocated
+    pages = pool.allocate(0, 4)
+    pool.ref_pages(pages)
+    assert pool.unref_pages(pages) == 0  # slot still holds them
+    pool.free(0)
+    assert pool.page_refcount(pages[0]) == 0
+
+
+# -- sharing / map_shared -----------------------------------------------------
+
+
+def test_map_shared_zero_copy_mapping():
+    pool = _pool()
+    pool.allocate(0, 8)
+    shared = pool.slot_pages(0)
+    pool.ref_pages(shared)   # cache stores them
+    pool.free(0)             # original slot finishes
+    assert all(pool.page_refcount(p) == 1 for p in shared)
+    pool.map_shared(1, shared, 8)
+    assert pool.slot_pages(1) == shared
+    assert all(pool.page_refcount(p) == 2 for p in shared)
+    assert pool.slot_length(1) == 8
+    # both release: pages recycle exactly once
+    pool.free(1)
+    assert pool.unref_pages(shared) == len(shared)
+    assert pool.free_pages == 15
+
+
+def test_map_shared_requires_alignment_and_empty_slot():
+    pool = _pool()
+    pool.allocate(0, 8)
+    shared = pool.slot_pages(0)
+    with pytest.raises(ValueError):
+        pool.map_shared(1, shared, 7)  # not page-aligned
+    pool.allocate(1, 2)
+    with pytest.raises(RuntimeError):
+        pool.map_shared(1, shared, 8)  # slot not empty
+
+
+# -- copy-on-write ------------------------------------------------------------
+
+
+def test_extend_into_shared_tail_page_cows():
+    pool = _pool()
+    pool.allocate(0, 6)  # 2 pages; tail page half full
+    pages = pool.slot_pages(0)
+    pool.ref_pages([pages[1]])  # someone else references the tail page
+    new = pool.extend(0, 1)     # write position 6 is INSIDE the shared page
+    assert pool.cow_events == 1
+    swapped = pool.slot_pages(0)
+    assert swapped[0] == pages[0]
+    assert swapped[1] != pages[1]          # private replacement
+    assert pool.page_refcount(pages[1]) == 1   # only the external ref left
+    assert pool.page_refcount(swapped[1]) == 1
+    assert pool.drain_pending_cow() == [(pages[1], swapped[1])]
+    assert new == []  # token 6 fit the (replacement) tail page
+
+
+def test_extend_page_aligned_never_cows():
+    pool = _pool()
+    pool.allocate(0, 8)  # exactly 2 full pages
+    pages = pool.slot_pages(0)
+    pool.ref_pages(pages)  # everything shared
+    new = pool.extend(0, 1)  # next write starts a FRESH page
+    assert pool.cow_events == 0
+    assert len(new) == 1
+
+
+def test_cow_exhaustion_raises_memory_error():
+    pool = PagePool(num_pages=3, page_size=4, max_slots=2)  # 2 usable
+    pool.allocate(0, 6)  # both pages
+    pool.ref_pages([pool.slot_pages(0)[1]])
+    with pytest.raises(MemoryError):
+        pool.extend(0, 1)  # CoW needs a free page; none left
+
+
+def test_paged_kv_cache_cow_copies_device_page():
+    """The device side: after a CoW swap, apply_pending_cow duplicates the
+    page contents so the slot's history is intact in its private copy."""
+    cache = PagedKVCache(
+        n_layers=1, n_kv_heads=1, head_dim=2,
+        num_pages=8, page_size=4, max_slots=2, dtype="float32",
+    )
+    pool = cache.pool
+    # write a 6-token prompt (2 pages, tail half full)
+    k = np.arange(6 * 2, dtype=np.float32).reshape(1, 6, 1, 2)
+    cache.write_prompt(0, k, k * 10.0, 6)
+    pages = pool.slot_pages(0)
+    pool.ref_pages([pages[1]])            # share the tail page
+    pool.extend(0, 1)
+    assert pool.cow_events == 1
+    copied = cache.apply_pending_cow()
+    assert copied == 1
+    new_tail = pool.slot_pages(0)[1]
+    np.testing.assert_array_equal(
+        np.asarray(cache.k[0, 0, new_tail]), np.asarray(cache.k[0, 0, pages[1]])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache.v[0, 0, new_tail]), np.asarray(cache.v[0, 0, pages[1]])
+    )
+
+
+def test_write_prompt_shared_scatters_only_tail():
+    """write_prompt_shared maps the prefix by reference and scatters only
+    the tail KV; the shared pages' contents are untouched."""
+    cache = PagedKVCache(
+        n_layers=1, n_kv_heads=1, head_dim=2,
+        num_pages=8, page_size=4, max_slots=2, dtype="float32",
+    )
+    pool = cache.pool
+    k = np.arange(8 * 2, dtype=np.float32).reshape(1, 8, 1, 2)
+    cache.write_prompt(0, k, k, 8)
+    shared = pool.slot_pages(0)
+    pool.ref_pages(shared)  # "cache" keeps them
+    before = np.asarray(cache.k[0, 0, shared[0]]).copy()
+    tail = 100.0 + np.arange(3 * 2, dtype=np.float32).reshape(1, 3, 1, 2)
+    cache.write_prompt_shared(1, shared, 8, tail, tail, 11)
+    assert pool.slot_pages(1)[:2] == shared
+    assert len(pool.slot_pages(1)) == 3
+    np.testing.assert_array_equal(np.asarray(cache.k[0, 0, shared[0]]), before)
+    own = pool.slot_pages(1)[2]
+    np.testing.assert_array_equal(
+        np.asarray(cache.k[0, 0, own, :3]), tail[0, :, 0]
+    )
+    # misaligned prefix refused (would put live writes inside shared pages)
+    cache2 = PagedKVCache(
+        n_layers=1, n_kv_heads=1, head_dim=2,
+        num_pages=8, page_size=4, max_slots=2, dtype="float32",
+    )
+    with pytest.raises(ValueError):
+        cache2.write_prompt_shared(0, [1], 3, tail, tail, 6)

@@ -520,3 +520,67 @@ def test_embeddings_dimensions(encoder_served):
     expect = v_full[:16] / np.linalg.norm(v_full[:16])
     np.testing.assert_allclose(v_cut, expect, rtol=1e-5)
     assert bad_status == 422
+
+
+def test_prefix_cache_aux_config_plumbing(tmp_path, state_root):
+    """aux engine.{prefix_cache,prefix_block,prefix_cache_pages} builds a
+    radix cache on the paged backend, repeated chats hit it, and the live
+    Prometheus collector is registered — all through the public API layer."""
+    mrp = ModelRequestProcessor(
+        state_root=str(state_root), force_create=True, name="llmpfx"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_llm_pfx",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32, 64],
+                    "cache": "paged",
+                    "page_size": 4,
+                    "prefix_cache": 64,
+                    "prefix_block": 16,
+                    "prefix_cache_pages": 32,
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    body = {
+        "model": "tiny_llm_pfx",
+        "messages": [{"role": "user", "content": "repeat after me please"}],
+        "max_tokens": 5,
+        "temperature": 0,
+    }
+
+    async def fn(client):
+        a = await client.post("/serve/openai/v1/chat/completions", json=body)
+        assert a.status == 200, await a.text()
+        b = await client.post("/serve/openai/v1/chat/completions", json=body)
+        assert b.status == 200, await b.text()
+        return await a.json(), await b.json()
+
+    out_a, out_b = _run(mrp, fn)
+    assert (
+        out_a["choices"][0]["message"]["content"]
+        == out_b["choices"][0]["message"]["content"]
+    )
+    processor = mrp._get_processor("tiny_llm_pfx")
+    prefix = processor.engine._prefix
+    assert prefix is not None
+    assert prefix.block == 16  # 16 is already a page multiple
+    assert prefix.max_pages == 32
+    assert prefix.hits >= 1
+    assert getattr(processor, "_prefix_collector", None) is not None
+    # the collector scrapes the live cache under the model's label
+    sample = {
+        m.name: {s.labels["model"]: s.value for s in m.samples}
+        for m in processor._prefix_collector.collect()
+    }
+    assert sample["llm_prefix_cache_hits"]["tiny_llm_pfx"] == prefix.hits

@@ -130,3 +130,55 @@ def test_device_gauges_no_crash(tmp_path):
     registry = CollectorRegistry()
     ctl = StatisticsController("", registry=registry)
     ctl.update_device_gauges()  # CPU backend: must not raise
+
+
+def test_prefix_cache_collector_exports_live_counters():
+    """The radix prefix cache's hit/miss/eviction counters and the page
+    pool's sharing/CoW gauges are scraped live (no push path needed)."""
+    import numpy as np
+
+    from clearml_serving_tpu.llm.kv_cache import PagePool
+    from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+    from clearml_serving_tpu.statistics.metrics import register_prefix_cache
+
+    pool = PagePool(num_pages=16, page_size=2, max_slots=2)
+    cache = RadixPrefixCache(block=4, pool=pool, page_bytes=32)
+    registry = CollectorRegistry()
+    register_prefix_cache(cache, pool, registry=registry, key="m1")
+
+    ids = [1, 2, 3, 4, 5, 6]
+    assert cache.lookup_pages(ids, 0) is None          # miss
+    pool.allocate(0, 6)
+    cache.store_pages(ids, 0, pool.slot_pages(0))
+    hit = cache.lookup_pages(ids, 0)                   # hit (4 tokens)
+    cache.release(hit)
+
+    def val(name, key="m1"):
+        return registry.get_sample_value(name, {"model": key})
+
+    assert val("llm_prefix_cache_hits_total") == 1
+    assert val("llm_prefix_cache_misses_total") == 1
+    assert val("llm_prefix_cache_hit_tokens_total") == 4
+    assert val("llm_prefix_cache_nodes") == 1
+    assert val("llm_prefix_cache_pages") == 2
+    assert val("llm_prefix_cache_bytes") == 64
+    assert val("kv_pool_shared_pages") == 2            # slot + cache refs
+    assert val("kv_pool_cow_events_total") == 0
+    assert val("kv_pool_free_pages") == pool.free_pages
+
+    # dense-backend registration (no pool) lands on the SAME collector
+    # under its own model label; re-registering a key REPLACES the entry
+    # (engine hot-reload must not leak the old cache or split series)
+    dense = RadixPrefixCache(block=2)
+    c2 = register_prefix_cache(dense, registry=registry, key="m2")
+    k = np.zeros((1, 1, 4, 1, 2), np.float32)
+    dense.store([1, 2, 3], 0, {"k": k, "v": k})
+    assert dense.lookup([1, 2, 9], 0) is not None
+    assert val("llm_prefix_cache_hits_total", "m2") == 1
+    assert val("kv_pool_shared_pages", "m2") is None
+    assert val("llm_prefix_cache_hits_total", "m1") == 1  # m1 intact
+
+    fresh = RadixPrefixCache(block=2)
+    c3 = register_prefix_cache(fresh, registry=registry, key="m2")
+    assert c3 is c2  # same collector, entry swapped
+    assert val("llm_prefix_cache_hits_total", "m2") == 0
