@@ -12,15 +12,47 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
+import time
 import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .base import BaseEngineRequest, EndpointModelError, register_engine
+from ..errors import UpstreamTimeoutError, UpstreamUnavailableError
+from ..llm import faults
 
 # NOTE: ..engine_server.protocol (msgpack) and grpc are imported lazily inside
 # methods so importing the engine registry never requires optional deps.
+
+# upstream statuses worth retrying: the engine server restarting
+# (UNAVAILABLE) or a transient per-call deadline (DEADLINE_EXCEEDED)
+_TRANSIENT_CODES = ("UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+# scrape-time retry counters (statistics.metrics register_engine_lifecycle
+# can export them; plain dict so no prometheus dependency here)
+RETRY_STATS: Dict[str, int] = {"attempts": 0, "retries": 0, "exhausted": 0}
+
+
+def grpc_lifecycle_stats() -> Dict[str, Any]:
+    """Provider for the statistics lifecycle collector."""
+    return {"grpc": dict(RETRY_STATS)}
+
+
+def _grpc_code_name(ex: BaseException) -> Optional[str]:
+    """Status-code name for a failed attempt: real AioRpcError or an
+    injected fault carrying grpc_code (chaos tests run without a server)."""
+    injected = getattr(ex, "grpc_code", None)
+    if injected:
+        return str(injected)
+    code = getattr(ex, "code", None)
+    if callable(code):
+        try:
+            return code().name
+        except Exception:
+            return None
+    return None
 
 
 def _channel_options() -> List:
@@ -48,7 +80,15 @@ class JaxGrpcEngineRequest(BaseEngineRequest):
         super().__init__(*args, **kwargs)
 
     def _native_load(self) -> Any:
-        # model lives in the engine-server process; nothing to load here
+        # model lives in the engine-server process; nothing to load here.
+        # Expose the module-wide retry counters on the serving registry
+        # (idempotent; keyed once for all jax_grpc endpoints).
+        try:
+            from ..statistics.metrics import register_engine_lifecycle
+
+            register_engine_lifecycle(grpc_lifecycle_stats, key="grpc_client")
+        except Exception:
+            pass
         return self.endpoint.model_id or True
 
     def _address(self) -> str:
@@ -98,6 +138,72 @@ class JaxGrpcEngineRequest(BaseEngineRequest):
         name = names[0] if names else "input_0"
         return {name: np.asarray(data, dtype=dtype)}
 
+    def _retry_config(self) -> Dict[str, float]:
+        """Retry policy for transient upstream failures. Env-tunable:
+        TPUSERVE_GRPC_RETRIES (attempt ceiling, default 3),
+        TPUSERVE_GRPC_RETRY_BACKOFF (first delay seconds, default 0.05),
+        TPUSERVE_GRPC_RETRY_BACKOFF_MAX (per-delay cap, default 2.0),
+        TPUSERVE_GRPC_RETRY_BUDGET (total seconds across attempts,
+        default 10). Server config keys of the same lowercase names win."""
+        cfg = self.get_server_config()
+
+        def knob(name: str, default: float) -> float:
+            v = cfg.get(name.lower(), os.environ.get(name.upper()))
+            return float(v) if v is not None else default
+
+        return {
+            "attempts": knob("tpuserve_grpc_retries", 3),
+            "backoff": knob("tpuserve_grpc_retry_backoff", 0.05),
+            "backoff_max": knob("tpuserve_grpc_retry_backoff_max", 2.0),
+            "budget": knob("tpuserve_grpc_retry_budget", 10.0),
+        }
+
+    async def _call_with_retry(self, call, payload, timeout: float):
+        """One logical inference call with jittered exponential backoff on
+        transient upstream codes, bounded by an attempt ceiling AND a total
+        time budget. After exhaustion the last transient failure maps to a
+        structured 503 (UNAVAILABLE) / 504 (DEADLINE_EXCEEDED) instead of a
+        raw AioRpcError traceback; NOT_FOUND keeps its 422 mapping."""
+        policy = self._retry_config()
+        attempts = max(1, int(policy["attempts"]))
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
+            RETRY_STATS["attempts"] += 1
+            try:
+                if faults.active():
+                    faults.fire("grpc.call", attempt=attempt)
+                return await call(payload, timeout=timeout)
+            except Exception as ex:
+                code = _grpc_code_name(ex)
+                if code == "NOT_FOUND":
+                    detail = getattr(ex, "details", None)
+                    raise EndpointModelError(
+                        str(detail() if callable(detail) else ex)
+                    ) from None
+                if code not in _TRANSIENT_CODES:
+                    raise
+                delay = min(
+                    policy["backoff_max"],
+                    policy["backoff"] * (2 ** (attempt - 1)),
+                ) * (0.5 + random.random())  # full jitter in [0.5x, 1.5x)
+                out_of_budget = (
+                    time.monotonic() - t0 + delay > policy["budget"]
+                )
+                if attempt >= attempts or out_of_budget:
+                    RETRY_STATS["exhausted"] += 1
+                    msg = (
+                        "engine upstream {} after {} attempt(s): {}".format(
+                            code, attempt, ex
+                        )
+                    )
+                    if code == "DEADLINE_EXCEEDED":
+                        raise UpstreamTimeoutError(msg) from ex
+                    raise UpstreamUnavailableError(msg) from ex
+                RETRY_STATS["retries"] += 1
+                await asyncio.sleep(delay)
+
     async def process(self, data: Any, state: dict, collect_fn=None) -> Any:
         if self._preprocess is not None and hasattr(self._preprocess, "process"):
             out = self._preprocess.process(data, state, collect_fn)
@@ -127,12 +233,9 @@ class JaxGrpcEngineRequest(BaseEngineRequest):
             inputs=inputs,
             output_names=self.endpoint.output_name,
         )
-        try:
-            response = await call(payload, timeout=self.request_timeout())
-        except grpc.aio.AioRpcError as ex:
-            if ex.code() == grpc.StatusCode.NOT_FOUND:
-                raise EndpointModelError(str(ex.details())) from None
-            raise
+        response = await self._call_with_retry(
+            call, payload, timeout=self.request_timeout()
+        )
         outputs = protocol.decode_infer_response(response)
         if len(outputs) == 1:
             return next(iter(outputs.values()))

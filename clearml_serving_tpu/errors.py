@@ -1,0 +1,125 @@
+"""Structured request-lifecycle errors shared by every serving layer.
+
+The engine (llm/engine.py), the OpenAI/REST fronts (llm/openai_api.py,
+serving/main.py) and the gRPC forwarding path (engines/grpc_client.py) all
+raise these instead of bare RuntimeError/AioRpcError so the router can map a
+failure to the correct HTTP status (408 deadline, 429/503 shed with
+``Retry-After``, 503/504 upstream) and clients can branch on a stable
+machine-readable ``code`` instead of parsing tracebacks.
+
+This module is dependency-free on purpose: the router must import it without
+pulling jax, and the engine without pulling aiohttp/grpc.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def is_hbm_oom(ex: BaseException) -> bool:
+    """Only XLA allocation failures qualify — never user-code error text (a
+    user exception mentioning 'out of memory' must not kill the process).
+    Shared by the router's crash-and-restart policy and the engine's
+    step-failure handler, which must NOT wrap these in a RequestError (the
+    wrap would route them away from the crash path)."""
+    if type(ex).__name__ not in ("XlaRuntimeError", "RuntimeError"):
+        return False
+    text = str(ex)
+    return "RESOURCE_EXHAUSTED" in text and (
+        "hbm" in text.lower() or "allocat" in text.lower()
+    )
+
+
+class RequestError(Exception):
+    """A request-scoped failure with an HTTP mapping.
+
+    ``status``: the HTTP status the router returns. ``code``: stable
+    machine-readable identifier carried in the JSON payload and SSE error
+    events. ``retry_after``: seconds hint for the ``Retry-After`` header
+    (None omits the header).
+    """
+
+    status: int = 500
+    code: str = "internal"
+    default_retry_after: Optional[float] = None
+
+    def __init__(self, message: str, *, retry_after: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after = (
+            retry_after if retry_after is not None else self.default_retry_after
+        )
+
+    def payload(self) -> dict:
+        return {"detail": str(self), "code": self.code}
+
+
+class DeadlineExceededError(RequestError):
+    """A per-request budget (queue-wait, TTFT, or total) elapsed."""
+
+    status = 408
+    code = "deadline_exceeded"
+
+    def __init__(self, message: str, *, stage: str = "total",
+                 retry_after: Optional[float] = None):
+        super().__init__(message, retry_after=retry_after)
+        self.stage = stage  # "queue" | "ttft" | "total"
+
+    def payload(self) -> dict:
+        out = super().payload()
+        out["stage"] = self.stage
+        return out
+
+
+class EngineOverloadedError(RequestError):
+    """Shed at admission: the pending queue or KV pool is saturated.
+
+    429 (not 503): the server is healthy, the CLIENT should back off and
+    retry — the Retry-After hint sizes the backoff.
+    """
+
+    status = 429
+    code = "overloaded"
+    default_retry_after = 1.0
+
+
+class EngineUnavailableError(RequestError):
+    """The engine is stopped or the server is draining (SIGTERM)."""
+
+    status = 503
+    code = "unavailable"
+    default_retry_after = 2.0
+
+
+class EngineStepError(RequestError):
+    """A device step (decode chunk / prefill) failed for this request.
+
+    The engine recovered — only the affected request(s) carry this error;
+    the process keeps serving.
+    """
+
+    status = 500
+    code = "engine_step_failed"
+
+
+class EngineStuckError(RequestError):
+    """The watchdog detected a stalled decode loop and failed this request
+    while recovering. Retryable once the engine reports ready again."""
+
+    status = 503
+    code = "engine_stalled"
+    default_retry_after = 5.0
+
+
+class UpstreamTimeoutError(RequestError):
+    """gRPC upstream DEADLINE_EXCEEDED after the retry budget."""
+
+    status = 504
+    code = "upstream_timeout"
+
+
+class UpstreamUnavailableError(RequestError):
+    """gRPC upstream UNAVAILABLE after the retry budget."""
+
+    status = 503
+    code = "upstream_unavailable"
+    default_retry_after = 2.0

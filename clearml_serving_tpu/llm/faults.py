@@ -1,0 +1,164 @@
+"""Fault-injection seam for request-lifecycle chaos testing.
+
+Production code calls :func:`fire` at a handful of well-known points; with no
+faults configured the call is a single attribute read (``active()`` short
+circuit), so the seam costs nothing on the hot path. Tests (and operators,
+via the ``TPUSERVE_FAULTS`` env var) arm :class:`FaultSpec` entries that make
+a point delay, raise, or surface a fake gRPC status — which is how the chaos
+suite proves the deadline, shedding, watchdog-recovery, and retry paths
+without real hardware failures.
+
+Known points (ctx carried with each):
+
+- ``engine.prefill``   — inside the admission worker, before device prefill
+                         (``request``); ``delay`` = slow prefill,
+                         ``raise`` = failed admission.
+- ``engine.decode``    — inside the decode-chunk dispatch worker, before the
+                         device step (``requests`` = active GenRequests);
+                         ``match_token`` poisons only the request whose
+                         prompt contains that token; ``delay`` = stuck loop.
+- ``engine.admit``     — inside check_admission (``request``); a raise is
+                         converted to a load-shed (429).
+- ``engine.pool``      — inside check_admission's KV-pool headroom check; a
+                         raise simulates pool exhaustion.
+- ``grpc.call``        — before each gRPC attempt (``attempt``); set
+                         ``grpc_code`` ("UNAVAILABLE"/"DEADLINE_EXCEEDED")
+                         to exercise the transient-retry path.
+
+Env format (``TPUSERVE_FAULTS``): a JSON list of spec dicts, e.g.::
+
+    TPUSERVE_FAULTS='[{"point": "engine.decode", "action": "raise",
+                       "match_token": 300, "times": 1}]'
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    action: str = "raise"          # "raise" | "delay"
+    times: int = -1                # firings before the spec disarms (-1 = inf)
+    delay: float = 0.0             # seconds slept before acting
+    match_token: Optional[int] = None  # only fire when a request's prompt has it
+    grpc_code: Optional[str] = None    # fake upstream status for grpc points
+    message: str = "injected fault"
+    fired: int = field(default=0, compare=False)
+
+    def exhausted(self) -> bool:
+        return 0 <= self.times <= self.fired
+
+
+class InjectedFault(Exception):
+    """Raised by an armed ``action="raise"`` spec. Carries the spec and the
+    matched request (when ``match_token`` selected one) so the engine can
+    fail ONLY that request instead of the whole batch."""
+
+    def __init__(self, spec: FaultSpec, request: Any = None):
+        super().__init__("{} [{}]".format(spec.message, spec.point))
+        self.spec = spec
+        self.request = request
+
+    @property
+    def grpc_code(self) -> Optional[str]:
+        return self.spec.grpc_code
+
+
+class FaultInjector:
+    def __init__(self):
+        self._specs: List[FaultSpec] = []
+        self._lock = threading.Lock()
+        self.load_env()
+
+    # -- configuration ----------------------------------------------------
+
+    def configure(self, specs) -> None:
+        """Arm the given specs (list of FaultSpec or dicts). Replaces any
+        previously armed set."""
+        armed = []
+        for s in specs or []:
+            armed.append(s if isinstance(s, FaultSpec) else FaultSpec(**s))
+        with self._lock:
+            self._specs = armed
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs = []
+
+    def load_env(self) -> None:
+        raw = os.environ.get("TPUSERVE_FAULTS")
+        if not raw:
+            return
+        try:
+            self.configure(json.loads(raw))
+        except (ValueError, TypeError) as ex:
+            raise ValueError("unparseable TPUSERVE_FAULTS: {}".format(ex))
+
+    def active(self) -> bool:
+        return bool(self._specs)
+
+    # -- firing -----------------------------------------------------------
+
+    @staticmethod
+    def _match(spec: FaultSpec, request, requests) -> Any:
+        """The request a spec applies to, or None when match_token filters
+        everything out. Specs without match_token apply unconditionally."""
+        if spec.match_token is None:
+            return request
+        candidates = list(requests or [])
+        if request is not None:
+            candidates.append(request)
+        for r in candidates:
+            if spec.match_token in (getattr(r, "prompt_ids", None) or []):
+                return r
+        return None
+
+    def fire(self, point: str, request: Any = None, requests=None, **ctx) -> None:
+        """Run every armed spec for ``point``: sleep for ``delay`` actions,
+        raise :class:`InjectedFault` for ``raise`` actions. No-op when
+        nothing matches."""
+        with self._lock:
+            specs = [s for s in self._specs if s.point == point]
+        for spec in specs:
+            target = self._match(spec, request, requests)
+            if spec.match_token is not None and target is None:
+                continue
+            with self._lock:
+                # check-and-claim one firing atomically: the loop thread and
+                # dispatch workers race here, and a times-bounded spec must
+                # never fire more than its limit
+                if spec.exhausted():
+                    continue
+                spec.fired += 1
+            if spec.delay:
+                time.sleep(spec.delay)
+            if spec.action == "raise":
+                raise InjectedFault(spec, target)
+
+
+# module singleton: production call sites and tests share it
+injector = FaultInjector()
+
+
+def active() -> bool:
+    return injector.active()
+
+
+def fire(point: str, request: Any = None, requests=None, **ctx) -> None:
+    if injector.active():
+        injector.fire(point, request=request, requests=requests, **ctx)
+
+
+def configure(specs) -> None:
+    injector.configure(specs)
+
+
+def clear() -> None:
+    injector.clear()

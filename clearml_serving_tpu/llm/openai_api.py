@@ -211,6 +211,19 @@ class LLMEngineRequest(BaseEngineRequest):
                 else None
             ),
             tokenizer=self.tokenizer,  # guided decoding needs token bytes
+            # request-lifecycle hardening (docs/robustness.md): production
+            # defaults ON at the serving front — bounded admission and a
+            # stall watchdog; aux engine.* knobs override, 0/false disables
+            max_pending=self._lifecycle_knob(
+                engine_cfg, "max_pending",
+                max(16, 4 * int(engine_cfg.get("max_batch", 8))),
+            ),
+            queue_timeout=self._lifecycle_knob(engine_cfg, "queue_timeout", None),
+            ttft_timeout=self._lifecycle_knob(engine_cfg, "ttft_timeout", None),
+            total_timeout=self._lifecycle_knob(engine_cfg, "timeout", None),
+            watchdog_interval=self._lifecycle_knob(
+                engine_cfg, "watchdog_interval", 30.0
+            ),
         )
         self._model_name = self.endpoint.serving_url
         if self.engine._prefix is not None:
@@ -229,7 +242,37 @@ class LLMEngineRequest(BaseEngineRequest):
                 )
             except Exception:
                 self._prefix_collector = None  # registry unavailable etc.
+        try:
+            # shed/deadline/watchdog counters + queue-depth/active-slot
+            # gauges on the same registry (docs/robustness.md). The provider
+            # holds the engine WEAKLY: the process-lifetime registry must
+            # not pin an evicted endpoint's engine (params + KV = GBs of
+            # device memory) after the processor cache drops it.
+            import weakref
+
+            from ..statistics.metrics import register_engine_lifecycle
+
+            engine_ref = weakref.ref(self.engine)
+
+            def _lifecycle_provider():
+                engine = engine_ref()
+                return engine.lifecycle_stats() if engine is not None else None
+
+            self._lifecycle_collector = register_engine_lifecycle(
+                _lifecycle_provider, key=self._model_name
+            )
+        except Exception:
+            self._lifecycle_collector = None
         return self.engine
+
+    @staticmethod
+    def _lifecycle_knob(engine_cfg: Dict[str, Any], key: str, default):
+        """Aux-config override for a lifecycle knob: absent -> default,
+        0/false/None -> disabled (the engine treats falsy as off)."""
+        if key not in engine_cfg:
+            return default
+        value = engine_cfg[key]
+        return float(value) if value else None
 
     def _load_lora_cfg(self, engine_cfg: Dict[str, Any]):
         """(config_overrides, adapters) from the aux engine.lora block."""
@@ -334,6 +377,21 @@ class LLMEngineRequest(BaseEngineRequest):
             adapter=self._adapter_for(body),
             min_tokens=int(body.get("min_tokens", 0) or 0),
             guided=guided_override or self._guided_spec(body),
+            # per-request lifecycle budgets (seconds); engine defaults apply
+            # when absent. `timeout` bounds the WHOLE request (vLLM-style).
+            total_timeout=(
+                float(body["timeout"]) if body.get("timeout") is not None else None
+            ),
+            queue_timeout=(
+                float(body["queue_timeout"])
+                if body.get("queue_timeout") is not None
+                else None
+            ),
+            ttft_timeout=(
+                float(body["ttft_timeout"])
+                if body.get("ttft_timeout") is not None
+                else None
+            ),
         )
         # vLLM `return_tokens_as_token_ids`: logprob token strings become
         # "token_id:<id>" (API-layer formatting, so not a GenRequest field)
@@ -886,8 +944,12 @@ class LLMEngineRequest(BaseEngineRequest):
                 requests = self._n_requests(
                     body, prompt_ids, guided_override=guided_override
                 )
-                for r in requests:
+                for i, r in enumerate(requests):
                     self.engine.validate(r)
+                    # shed/deadline BEFORE the 200 headers: a saturated
+                    # engine answers 429/408, not a broken SSE body; the
+                    # reserve accounts for this batch's own earlier choices
+                    self.engine.check_admission(r, reserve=i)
 
                 def chat_delta(i, req, piece):
                     choice = {"index": i,
@@ -931,8 +993,10 @@ class LLMEngineRequest(BaseEngineRequest):
                 body, prompt_ids, guided_override=guided_override
             )
             # validate BEFORE returning the stream — a late ValueError would
-            # abort mid-SSE after the 200 headers are already sent
+            # abort mid-SSE after the 200 headers are already sent; same for
+            # load-shed/expired-deadline (429/408 precede the headers)
             self.engine.validate(request)
+            self.engine.check_admission(request)
             # required/forced always buffers (output IS a tool call); auto
             # sniffs the first text for a call-shaped prefix and buffers
             # only then, so plain answers still stream token by token. A
@@ -1260,8 +1324,9 @@ class LLMEngineRequest(BaseEngineRequest):
                 )
             stream_requests = self._n_requests(body, prompt_id_lists[0],
                                                chat=False)
-            for r in stream_requests:
+            for i, r in enumerate(stream_requests):
                 self.engine.validate(r)
+                self.engine.check_admission(r, reserve=i)
 
             include_usage = bool(
                 (body.get("stream_options") or {}).get("include_usage")

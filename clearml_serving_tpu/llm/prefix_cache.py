@@ -174,6 +174,15 @@ class RadixPrefixCache:
 
     # -- dense backend -------------------------------------------------------
 
+    def match_len(self, ids: List[int], lora: int = 0) -> int:
+        """Tokens a lookup for ``ids`` would serve from the cache, WITHOUT
+        pinning pages or counting a hit/miss. Admission control uses this to
+        size its KV-pool headroom check: a request whose prefix is cached
+        only needs pages for the tail."""
+        with self._lock:
+            _, depth = self._walk(ids, lora)
+        return depth
+
     def lookup(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
         """Longest shared block run of ``ids`` (dense backend).
         Returns {"len": P, "bufs": {name: [L, 1, P, ...]}} or None."""

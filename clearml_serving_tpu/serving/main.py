@@ -28,6 +28,7 @@ import asyncio
 import gzip
 import json
 import os
+import signal
 import traceback
 from typing import Any, AsyncIterator, Optional
 
@@ -41,19 +42,25 @@ from .model_request_processor import (
 )
 from .responses import JSONOutput, StreamingOutput, TextOutput
 from ..engines.base import EndpointModelError
+from ..errors import RequestError, is_hbm_oom as _is_hbm_oom
 
 
 def _instance_id(processor: Optional[ModelRequestProcessor]) -> str:
     return getattr(processor, "_instance_id", "unknown") if processor else "unknown"
 
 
-def _is_hbm_oom(ex: BaseException) -> bool:
-    """Only XLA allocation failures qualify — never user-code error text
-    (a user exception mentioning 'out of memory' must not kill the process)."""
-    if type(ex).__name__ not in ("XlaRuntimeError", "RuntimeError"):
-        return False
-    text = str(ex)
-    return "RESOURCE_EXHAUSTED" in text and ("hbm" in text.lower() or "allocat" in text.lower())
+def _request_error_response(
+    ex: RequestError, processor: Optional[ModelRequestProcessor]
+) -> web.Response:
+    """Structured lifecycle errors (errors.RequestError) map to their own
+    status (408 deadline, 429/503 shed, 503/504 upstream) with a
+    ``Retry-After`` hint so clients back off instead of hammering."""
+    payload = ex.payload()
+    payload["instance"] = _instance_id(processor)
+    headers = {}
+    if ex.retry_after is not None:
+        headers["Retry-After"] = str(max(1, int(round(ex.retry_after))))
+    return web.json_response(payload, status=ex.status, headers=headers)
 
 
 async def _read_body(request: web.Request) -> Any:
@@ -92,9 +99,30 @@ async def _read_body(request: web.Request) -> Any:
         return raw
 
 
+def _engine_health(processor: ModelRequestProcessor) -> dict:
+    """Per-endpoint engine health for /ready: any loaded processor exposing
+    an ``engine`` with a ``health()`` surface (the LLM engine core)
+    contributes; plain CPU/gRPC engines are stateless and always ready."""
+    out = {}
+    for url, proc in getattr(processor, "_engine_processor_lookup", {}).items():
+        engine = getattr(proc, "engine", None)
+        health = getattr(engine, "health", None)
+        if callable(health):
+            try:
+                out[url] = health()
+            except Exception as ex:
+                out[url] = {"ready": False, "error": str(ex)}
+    return out
+
+
 def build_app(processor: ModelRequestProcessor) -> web.Application:
     app = web.Application(client_max_size=int(os.environ.get("TPUSERVE_MAX_BODY", 64 * 1024 * 1024)))
     app["processor"] = processor
+    # SIGTERM drain state: once draining, new requests shed with 503 while
+    # in-flight ones (inflight counter) finish up to the drain timeout.
+    # A plain mutable dict: aiohttp deprecates reassigning app keys after
+    # startup, so the handlers mutate THIS object, never the app mapping.
+    app["lifecycle"] = {"draining": False, "inflight": 0}
     serve_suffix = os.environ.get("TPUSERVE_DEFAULT_SERVE_SUFFIX", "serve").strip("/")
     dev_mode = bool(os.environ.get("TPUSERVE_DEV_MODE"))
 
@@ -109,6 +137,8 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
             return web.json_response(
                 {"detail": "Error processing request: {}".format(ex)}, status=404
             )
+        except RequestError as ex:
+            return _request_error_response(ex, processor)
         except (EndpointModelError, EndpointBackendError, ValueError) as ex:
             return web.json_response(
                 {
@@ -199,6 +229,21 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
         return result
 
     async def serve_model(request: web.Request) -> web.StreamResponse:
+        state = app["lifecycle"]
+        if state["draining"]:
+            # graceful shutdown: stop admitting, let in-flight work finish
+            return web.json_response(
+                {"detail": "server is draining", "code": "draining"},
+                status=503,
+                headers={"Retry-After": "5"},
+            )
+        state["inflight"] += 1
+        try:
+            return await _serve_model_inner(request)
+        finally:
+            state["inflight"] -= 1
+
+    async def _serve_model_inner(request: web.Request) -> web.StreamResponse:
         tail = request.match_info["tail"].strip("/")
         try:
             body = await _read_body(request)
@@ -251,12 +296,106 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
     async def dashboard(request: web.Request) -> web.Response:
         return web.json_response(processor.get_serving_layout())
 
+    async def ready(request: web.Request) -> web.Response:
+        """Readiness (distinct from /health liveness): 503 while draining or
+        while any loaded engine reports not-ready (stopped / watchdog
+        recovery in progress) — so load balancers stop routing here while
+        /health keeps the container from being killed."""
+        engines = _engine_health(processor)
+        not_ready = sorted(
+            url for url, h in engines.items() if not h.get("ready")
+        )
+        draining = app["lifecycle"]["draining"]
+        if draining or not_ready:
+            return web.json_response(
+                {
+                    "status": "draining" if draining else "not_ready",
+                    "instance": _instance_id(processor),
+                    "not_ready": not_ready,
+                    "engines": engines,
+                },
+                status=503,
+                headers={"Retry-After": "5"},
+            )
+        return web.json_response(
+            {
+                "status": "ready",
+                "instance": _instance_id(processor),
+                "engines": engines,
+            }
+        )
+
     app.router.add_post("/{}/{{tail:.+}}".format(serve_suffix), serve_model)
     app.router.add_get("/{}/{{tail:openai/.+}}".format(serve_suffix), serve_model)
     app.router.add_get("/health", health)
+    app.router.add_get("/ready", ready)
     app.router.add_get("/dashboard", dashboard)
     app.router.add_get("/", health)
     return app
+
+
+async def drain_app(
+    app: web.Application,
+    processor: Optional[ModelRequestProcessor],
+    timeout: Optional[float] = None,
+) -> None:
+    """Graceful drain: stop admitting (serve_model starts answering 503 the
+    moment ``draining`` flips), wait for in-flight requests up to
+    ``timeout`` seconds, then stop the engines and daemons cleanly. Called
+    from the SIGTERM handler; exposed separately so tests can drive it."""
+    state = app["lifecycle"]
+    state["draining"] = True
+    if timeout is None:
+        timeout = float(os.environ.get("TPUSERVE_DRAIN_TIMEOUT", 30.0))
+    deadline = asyncio.get_running_loop().time() + timeout
+    while state["inflight"] > 0 and asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.05)
+    if processor is not None:
+        for proc in list(
+            getattr(processor, "_engine_processor_lookup", {}).values()
+        ):
+            engine = getattr(proc, "engine", None)
+            stop = getattr(engine, "stop", None)
+            if callable(stop):
+                try:
+                    stop()
+                except Exception:
+                    traceback.print_exc()
+        try:
+            processor.stop()
+        except Exception:
+            traceback.print_exc()
+
+
+def install_graceful_drain(app: web.Application) -> None:
+    """SIGTERM -> drain -> exit. aiohttp's run_app exits on SIGINT; after
+    the drain completes we re-raise SIGINT against ourselves so its normal
+    graceful-shutdown path (connection close, cleanup hooks) runs."""
+
+    async def _on_startup(app: web.Application) -> None:
+        loop = asyncio.get_running_loop()
+
+        def _begin_drain() -> None:
+            state = app["lifecycle"]
+            if state["draining"]:
+                return  # second SIGTERM: drain already in progress
+            # flip synchronously: the guard above must close the window
+            # BEFORE the drain task gets scheduled, or back-to-back SIGTERMs
+            # would spawn duplicate drains (and duplicate exit SIGINTs)
+            state["draining"] = True
+
+            async def _drain_then_exit() -> None:
+                await drain_app(app, app.get("processor"))
+                os.kill(os.getpid(), signal.SIGINT)
+
+            loop.create_task(_drain_then_exit())
+
+        try:
+            loop.add_signal_handler(signal.SIGTERM, _begin_drain)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix / nested-loop environments keep default handling
+
+    app.on_startup.append(_on_startup)
 
 
 def maybe_start_profiler() -> None:
@@ -303,19 +442,33 @@ def main() -> None:
 
         def _worker():
             processor = setup_processor()
+            app = build_app(processor)
+            install_graceful_drain(app)
             web.run_app(
-                build_app(processor), host=host, port=port, reuse_port=True,
+                app, host=host, port=port, reuse_port=True,
                 print=None,
             )
 
         procs = [multiprocessing.Process(target=_worker) for _ in range(num_proc)]
         for p in procs:
             p.start()
+
+        def _forward_term(signum, frame):
+            # pre-fork mode: SIGTERM lands on THIS parent (pid 1 in a
+            # container) — forward it so every worker runs its graceful
+            # drain instead of dying with the parent
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+
+        signal.signal(signal.SIGTERM, _forward_term)
         for p in procs:
             p.join()
     else:
         processor = setup_processor()
-        web.run_app(build_app(processor), host=host, port=port)
+        app = build_app(processor)
+        install_graceful_drain(app)
+        web.run_app(app, host=host, port=port)
 
 
 if __name__ == "__main__":

@@ -186,9 +186,142 @@ class PrefixCacheCollector:
         return []
 
 
+class EngineLifecycleCollector:
+    """Request-lifecycle observability (docs/robustness.md): shed / deadline
+    / watchdog / step-failure counters plus queue-depth and active-slot
+    gauges, read live from each registered provider at scrape time so
+    shedding decisions are observable next to what triggered them.
+
+    A provider is a zero-arg callable returning the engine's
+    ``lifecycle_stats()`` dict (or the gRPC client's retry stats); unknown
+    keys are ignored so providers can grow without a collector change. One
+    collector per registry holds an entry per model key — re-registering a
+    key REPLACES its provider (engine hot-reload must not leak the old
+    engine or duplicate families)."""
+
+    def __init__(self, prefix: str = "engine"):
+        self._prefix = _sanitize(prefix)
+        self._providers: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set_entry(self, key: str, provider) -> None:
+        with self._lock:
+            self._providers[str(key)] = provider
+
+    def remove_entry(self, key: str) -> None:
+        with self._lock:
+            self._providers.pop(str(key), None)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        with self._lock:
+            providers = dict(self._providers)
+        p = self._prefix
+        queue_depth = GaugeMetricFamily(
+            p + "_queue_depth",
+            "requests waiting in the engine's admission queue",
+            labels=["model"],
+        )
+        active_slots = GaugeMetricFamily(
+            p + "_active_slots", "decode slots currently generating",
+            labels=["model"],
+        )
+        ready = GaugeMetricFamily(
+            p + "_ready", "1 while the engine accepts work (0 = stopped or "
+            "watchdog recovery in progress)", labels=["model"],
+        )
+        sheds = CounterMetricFamily(
+            p + "_sheds_total", "admissions shed at the front door",
+            labels=["model", "reason"],
+        )
+        deadlines = CounterMetricFamily(
+            p + "_deadline_hits_total",
+            "requests failed on an elapsed budget",
+            labels=["model", "stage"],
+        )
+        trips = CounterMetricFamily(
+            p + "_watchdog_trips_total",
+            "stalled-loop detections (each failed the in-flight batch and "
+            "recovered the loop)", labels=["model"],
+        )
+        failures = CounterMetricFamily(
+            p + "_step_failures_total",
+            "decode dispatch failures survived by the loop",
+            labels=["model"],
+        )
+        grpc = CounterMetricFamily(
+            "grpc_client_upstream_total",
+            "engine-server gRPC attempts/retries/retry-budget exhaustions",
+            labels=["model", "kind"],
+        )
+        any_grpc = False
+        for key, provider in providers.items():
+            try:
+                s = provider() or {}
+            except Exception:
+                continue
+            if "queue_depth" in s:
+                queue_depth.add_metric([key], s["queue_depth"])
+            if "active_slots" in s:
+                active_slots.add_metric([key], s["active_slots"])
+            if "ready" in s:
+                ready.add_metric([key], s["ready"])
+            for reason, v in (s.get("sheds") or {}).items():
+                sheds.add_metric([key, reason], v)
+            for stage, v in (s.get("deadlines") or {}).items():
+                deadlines.add_metric([key, stage], v)
+            if "watchdog_trips" in s:
+                trips.add_metric([key], s["watchdog_trips"])
+            if "step_failures" in s:
+                failures.add_metric([key], s["step_failures"])
+            for kind, v in (s.get("grpc") or {}).items():
+                any_grpc = True
+                grpc.add_metric([key, kind], v)
+        yield queue_depth
+        yield active_slots
+        yield ready
+        yield sheds
+        yield deadlines
+        yield trips
+        yield failures
+        if any_grpc:
+            yield grpc
+
+    def describe(self):
+        # empty describe => register without probing collect() (providers
+        # may not be fully constructed yet)
+        return []
+
+
 # one collector per live registry (weak: test registries die with their
 # tests; a reused id must not resurrect a collector bound to a dead one)
 _prefix_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
+_lifecycle_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
+
+
+def register_engine_lifecycle(provider, registry=REGISTRY, key: str = "llm",
+                              prefix: str = "engine"):
+    """Expose live request-lifecycle metrics for ``key`` (model/endpoint
+    name). ``provider`` is a zero-arg callable returning a
+    ``lifecycle_stats()``-shaped dict. Idempotent per (registry, key):
+    re-registering replaces the provider. Returns the shared collector."""
+    global _lifecycle_collectors
+    import weakref
+
+    if _lifecycle_collectors is None:
+        _lifecycle_collectors = weakref.WeakKeyDictionary()
+    per_registry = _lifecycle_collectors.setdefault(registry, {})
+    collector = per_registry.get(prefix)
+    if collector is None:
+        collector = EngineLifecycleCollector(prefix)
+        registry.register(collector)
+        per_registry[prefix] = collector
+    collector.set_entry(key, provider)
+    return collector
 
 
 def register_prefix_cache(cache, pool=None, registry=REGISTRY,

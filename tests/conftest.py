@@ -41,6 +41,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test excluded from the tier-1 run"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection robustness test (CPU-fast, runs in tier-1; "
+        "select with -m chaos)",
+    )
 
 
 @pytest.fixture()

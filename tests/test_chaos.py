@@ -1,0 +1,449 @@
+"""Fault-injection chaos suite (llm/faults.py seam; docs/robustness.md).
+
+Proves the request-lifecycle hardening contracts end to end on CPU:
+
+- a poisoned decode step fails ONLY the affected request; concurrently
+  active requests complete and the engine keeps serving without a restart;
+- the watchdog detects a stuck decode loop, fails the stalled batch with a
+  structured error, flips not-ready, and recovers;
+- admission sheds (queue bound / KV-pool saturation) raise structured 429s;
+- queue-wait / TTFT / total deadlines fail requests with structured 408s;
+- the gRPC client retries transient upstream codes with backoff and maps
+  exhaustion to 503/504 instead of raw tracebacks.
+
+All tests are fast and deterministic (faults fire on exact match/points, no
+sleeps racing compiles beyond an explicit warmup) — they run inside tier-1
+(`scripts/tier1.sh`); select just this suite with `pytest -m chaos`.
+"""
+
+import asyncio
+import time
+
+import jax
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.errors import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    EngineStepError,
+    EngineStuckError,
+    EngineUnavailableError,
+    UpstreamTimeoutError,
+    UpstreamUnavailableError,
+)
+from clearml_serving_tpu.llm import faults
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_engine(bundle, params, **kwargs):
+    kwargs.setdefault("max_batch", 4)
+    kwargs.setdefault("max_seq_len", 128)
+    kwargs.setdefault("prefill_buckets", [16, 32])
+    kwargs.setdefault("eos_token_id", 257)
+    return LLMEngineCore(bundle, params, **kwargs)
+
+
+async def _collect(engine, req):
+    out = []
+    async for token in engine.generate(req):
+        out.append(token)
+    return out
+
+
+# -- decode-step poison: failure isolation ------------------------------------
+
+
+def test_poisoned_decode_fails_only_that_request(parts):
+    """Acceptance: with fault injection poisoning one request's decode step,
+    that request fails with a structured error while a concurrently active
+    request completes and the engine serves new requests — no restart."""
+    bundle, params = parts
+    marker = 300  # token only the poisoned request's prompt contains
+
+    async def run():
+        engine = _make_engine(bundle, params, decode_steps=1)
+        # warm up (compile the decode chunk) before arming the fault
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+
+        a = GenRequest(prompt_ids=[256, 5, 6], max_new_tokens=12)
+        a_task = asyncio.create_task(_collect(engine, a))
+        # wait until A is decoding so the poison has a live co-resident
+        while a.produced < 2:
+            await asyncio.sleep(0.01)
+        faults.configure([
+            {"point": "engine.decode", "action": "raise",
+             "match_token": marker, "times": 1, "message": "poisoned step"},
+        ])
+        b = GenRequest(prompt_ids=[256, marker, 7], max_new_tokens=12)
+        with pytest.raises(EngineStepError):
+            await _collect(engine, b)
+        # the co-resident completes in full
+        out_a = await a_task
+        assert len(out_a) == 12 or 257 in out_a
+        # and the engine keeps serving new work without a process restart
+        out_c = await _collect(
+            engine, GenRequest(prompt_ids=[256, 9], max_new_tokens=4)
+        )
+        assert len(out_c) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["step_failures"] == 1
+    assert engine.active_slots == 0
+
+
+def test_batch_wide_decode_failure_recovers_engine(parts):
+    """An unattributable dispatch exception fails the in-flight batch with
+    structured errors but the loop survives: new requests are served by the
+    same engine instance."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, decode_steps=1)
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        faults.configure([
+            {"point": "engine.decode", "action": "raise", "times": 1,
+             "message": "device exploded"},
+        ])
+        with pytest.raises(EngineStepError):
+            await _collect(
+                engine, GenRequest(prompt_ids=[256, 2], max_new_tokens=8)
+            )
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 3], max_new_tokens=4)
+        )
+        assert len(out) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["step_failures"] == 1
+
+
+# -- watchdog: stuck loop detection + supervised recovery ---------------------
+
+
+def test_watchdog_trips_on_stalled_decode_and_recovers(parts):
+    """A wedged decode dispatch (worker-thread stall) trips the watchdog:
+    the stalled request fails with EngineStuckError, the engine reports
+    not-ready while recovering, then flips back to ready and serves new
+    requests — all inside one process."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=1, watchdog_interval=0.3
+        )
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        assert engine.is_ready
+        faults.configure([
+            {"point": "engine.decode.stall", "action": "delay",
+             "delay": 1.2, "times": 1},
+        ])
+        req = GenRequest(prompt_ids=[256, 4, 5], max_new_tokens=50)
+        task = asyncio.create_task(_collect(engine, req))
+        saw_not_ready = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            await asyncio.sleep(0.01)
+            if not engine.is_ready:
+                saw_not_ready = True
+            if task.done():
+                break
+        with pytest.raises(EngineStuckError):
+            await task
+        assert saw_not_ready, "/ready never observed the recovery window"
+        assert engine.counters["watchdog_trips"] >= 1
+        # the stalled dispatch drains and the engine flips back to ready
+        t0 = time.monotonic()
+        while not engine.is_ready and time.monotonic() - t0 < 10.0:
+            await asyncio.sleep(0.01)
+        assert engine.is_ready
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 8], max_new_tokens=3)
+        )
+        assert len(out) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.health()["ready"]
+
+
+# -- admission shedding -------------------------------------------------------
+
+
+def test_queue_bound_sheds_with_retry_after(parts):
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, max_batch=1, max_pending=1)
+        a = GenRequest(prompt_ids=[256, 1], max_new_tokens=10_000)
+        agen = engine.generate(a)
+        await agen.__anext__()  # A holds the single slot
+        b = GenRequest(prompt_ids=[256, 2], max_new_tokens=2)
+        b_task = asyncio.create_task(_collect(engine, b))
+        while engine._pending.qsize() < 1:  # B parked in the queue
+            await asyncio.sleep(0.005)
+        c = GenRequest(prompt_ids=[256, 3], max_new_tokens=2)
+        with pytest.raises(EngineOverloadedError) as ei:
+            async for _ in engine.generate(c):
+                pass
+        assert ei.value.status == 429 and ei.value.retry_after is not None
+        await agen.aclose()  # free the slot; B proceeds
+        out_b = await b_task
+        assert len(out_b) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["sheds_queue"] == 1
+
+
+def test_pool_saturation_sheds_paged_admission(parts):
+    """With admission control on, a prompt the KV pool cannot hold right now
+    is shed 429 at the front door instead of queueing forever."""
+    bundle, params = parts
+    engine = _make_engine(
+        bundle, params, cache_mode="paged", page_size=16, max_batch=2,
+        max_pending=8,
+    )
+    pool = engine.paged_cache.pool
+    # occupy nearly the whole pool via a raw slot allocation
+    free0 = pool.free_pages
+    pool.allocate(0, (free0 - 1) * pool.page_size)
+    big = GenRequest(prompt_ids=list(range(64)), max_new_tokens=2)
+    with pytest.raises(EngineOverloadedError):
+        engine.check_admission(big)
+    assert engine.counters["sheds_pool"] == 1
+    pool.free(0)
+    engine.check_admission(big)  # headroom restored -> admissible again
+
+
+def test_pool_shed_accounts_for_cached_prefix(parts):
+    """The headroom check must charge only the NON-cached tail: a request
+    whose prefix the radix cache already holds is admissible where a cold
+    prompt of the same length is shed."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, cache_mode="paged", page_size=4, max_batch=2,
+            max_pending=8, prefix_cache=64, prefix_block=16,
+        )
+        system = [(i * 5 + 1) % 256 for i in range(32)]
+        await _collect(engine, GenRequest(
+            prompt_ids=system + [9], max_new_tokens=2
+        ))
+        return engine, system
+
+    engine, system = asyncio.run(run())
+    pool = engine.paged_cache.pool
+    assert engine._prefix.match_len(system + [7], 0) == 32
+    # leave exactly 2 free pages (8 tokens of headroom)
+    pool.allocate(0, (pool.free_pages - 2) * 4)
+    warm = GenRequest(prompt_ids=system + [7], max_new_tokens=2)
+    engine.check_admission(warm)  # 32/33 tokens cached -> 1 page suffices
+    cold = GenRequest(prompt_ids=list(range(33)), max_new_tokens=2)
+    with pytest.raises(EngineOverloadedError):
+        engine.check_admission(cold)
+    pool.free(0)
+
+
+def test_injected_admission_shed(parts):
+    bundle, params = parts
+    engine = _make_engine(bundle, params)
+    faults.configure([{"point": "engine.admit", "times": 1}])
+    with pytest.raises(EngineOverloadedError):
+        engine.check_admission(GenRequest(prompt_ids=[256], max_new_tokens=1))
+    engine.check_admission(GenRequest(prompt_ids=[256], max_new_tokens=1))
+
+
+def test_stopped_engine_is_unavailable(parts):
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params)
+        engine.stop()
+        with pytest.raises(EngineUnavailableError):
+            async for _ in engine.generate(
+                GenRequest(prompt_ids=[256], max_new_tokens=1)
+            ):
+                pass
+        return engine
+
+    engine = asyncio.run(run())
+    assert not engine.is_ready
+
+
+# -- deadlines ----------------------------------------------------------------
+
+
+def test_ttft_deadline_on_slow_prefill(parts):
+    """Delayed prefill (injected) blows the request's TTFT budget: the
+    request fails 408/ttft at the commit boundary, the engine stays up."""
+    bundle, params = parts
+    marker = 301
+
+    async def run():
+        engine = _make_engine(bundle, params)
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        faults.configure([
+            {"point": "engine.prefill", "action": "delay", "delay": 0.4,
+             "match_token": marker, "times": 1},
+        ])
+        req = GenRequest(
+            prompt_ids=[256, marker], max_new_tokens=4, ttft_timeout=0.1
+        )
+        with pytest.raises(DeadlineExceededError) as ei:
+            await _collect(engine, req)
+        assert ei.value.stage == "ttft" and ei.value.status == 408
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 2], max_new_tokens=3)
+        )
+        assert len(out) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["deadline_ttft"] == 1
+
+
+def test_queue_wait_deadline_expires_parked_request(parts):
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, max_batch=1, decode_steps=1)
+        a = GenRequest(prompt_ids=[256, 1], max_new_tokens=10_000)
+        agen = engine.generate(a)
+        await agen.__anext__()  # A pins the only slot
+        b = GenRequest(
+            prompt_ids=[256, 2], max_new_tokens=2, queue_timeout=0.1
+        )
+        with pytest.raises(DeadlineExceededError) as ei:
+            await _collect(engine, b)
+        assert ei.value.stage == "queue"
+        await agen.aclose()
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["deadline_queue"] == 1
+
+
+def test_total_deadline_cuts_generation_short(parts):
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, decode_steps=1)
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        req = GenRequest(
+            prompt_ids=[256, 3], max_new_tokens=100_000, total_timeout=0.25
+        )
+        got = []
+        with pytest.raises(DeadlineExceededError) as ei:
+            async for tok in engine.generate(req):
+                got.append(tok)
+        assert ei.value.stage == "total"
+        assert got, "some tokens should stream before the budget elapses"
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["deadline_total"] >= 1
+    assert engine.active_slots == 0  # slot + pages reclaimed
+
+
+# -- gRPC retry/backoff -------------------------------------------------------
+
+
+class _FakeRpcError(Exception):
+    def __init__(self, code):
+        super().__init__("fake upstream error {}".format(code))
+        self.grpc_code = code
+
+
+def _grpc_client(monkeypatch):
+    from clearml_serving_tpu.engines.grpc_client import JaxGrpcEngineRequest
+
+    monkeypatch.setenv("TPUSERVE_GRPC_RETRY_BACKOFF", "0.001")
+    monkeypatch.setenv("TPUSERVE_GRPC_RETRY_BACKOFF_MAX", "0.002")
+    return object.__new__(JaxGrpcEngineRequest)
+
+
+def test_grpc_transient_errors_retry_then_succeed(monkeypatch):
+    from clearml_serving_tpu.engines import grpc_client as gc
+
+    cli = _grpc_client(monkeypatch)
+    calls = []
+
+    async def flaky(payload, timeout=None):
+        calls.append(1)
+        if len(calls) < 3:
+            raise _FakeRpcError("UNAVAILABLE")
+        return b"ok"
+
+    before = dict(gc.RETRY_STATS)
+    out = asyncio.run(cli._call_with_retry(flaky, b"req", timeout=1.0))
+    assert out == b"ok" and len(calls) == 3
+    assert gc.RETRY_STATS["retries"] - before["retries"] == 2
+
+
+def test_grpc_retry_budget_maps_to_structured_errors(monkeypatch):
+    cli = _grpc_client(monkeypatch)
+
+    async def always_unavailable(payload, timeout=None):
+        raise _FakeRpcError("UNAVAILABLE")
+
+    async def always_deadline(payload, timeout=None):
+        raise _FakeRpcError("DEADLINE_EXCEEDED")
+
+    with pytest.raises(UpstreamUnavailableError) as ei:
+        asyncio.run(cli._call_with_retry(always_unavailable, b"r", timeout=1.0))
+    assert ei.value.status == 503 and ei.value.retry_after is not None
+    with pytest.raises(UpstreamTimeoutError) as ei:
+        asyncio.run(cli._call_with_retry(always_deadline, b"r", timeout=1.0))
+    assert ei.value.status == 504
+
+
+def test_grpc_non_transient_errors_do_not_retry(monkeypatch):
+    cli = _grpc_client(monkeypatch)
+    calls = []
+
+    async def internal(payload, timeout=None):
+        calls.append(1)
+        raise _FakeRpcError("INTERNAL")
+
+    with pytest.raises(_FakeRpcError):
+        asyncio.run(cli._call_with_retry(internal, b"r", timeout=1.0))
+    assert len(calls) == 1
+
+
+def test_grpc_injected_fault_exercises_retry_path(monkeypatch):
+    """The faults seam covers the gRPC path too: injected UNAVAILABLE on the
+    first two attempts, then the real call runs."""
+    cli = _grpc_client(monkeypatch)
+    faults.configure([
+        {"point": "grpc.call", "grpc_code": "UNAVAILABLE", "times": 2},
+    ])
+    calls = []
+
+    async def ok(payload, timeout=None):
+        calls.append(1)
+        return b"fine"
+
+    out = asyncio.run(cli._call_with_retry(ok, b"r", timeout=1.0))
+    assert out == b"fine" and len(calls) == 1

@@ -1,0 +1,297 @@
+"""HTTP surface of the request-lifecycle hardening (docs/robustness.md):
+
+- shed paths answer 429 with ``Retry-After`` on BOTH the OpenAI streaming
+  and non-streaming routes (pre-headers for streams);
+- expired deadlines answer 408 with the structured code on both routes;
+- /ready reflects engine health (not-ready during watchdog recovery,
+  draining) while /health stays liveness-only;
+- SIGTERM drain: new requests shed 503 while in-flight ones finish, then
+  engines stop cleanly.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from clearml_serving_tpu.llm import faults
+from clearml_serving_tpu.serving.endpoints import ModelEndpoint
+from clearml_serving_tpu.serving.main import build_app, drain_app
+from clearml_serving_tpu.serving.model_request_processor import (
+    ModelRequestProcessor,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def llm_served(tmp_path_factory):
+    import os
+
+    root = tmp_path_factory.mktemp("state")
+    os.environ["TPUSERVE_STATE_ROOT"] = str(root)
+    mrp = ModelRequestProcessor(
+        state_root=str(root), force_create=True, name="llm-lifecycle"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32],
+                    "watchdog_interval": 0,  # not under test here
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run(mrp, fn):
+    async def runner():
+        app = build_app(mrp)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return await fn(client, app)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def _chat_body(**extra):
+    return {
+        "model": "tiny_llm",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 4,
+        **extra,
+    }
+
+
+def test_shed_returns_429_with_retry_after(llm_served):
+    async def fn(client, app):
+        # warm path first (also instantiates the engine)
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=_chat_body()
+        )
+        assert r.status == 200, await r.text()
+
+        # non-streaming: injected admission shed -> 429 + Retry-After
+        faults.configure([{"point": "engine.admit", "times": 1}])
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=_chat_body()
+        )
+        assert r.status == 429, await r.text()
+        assert "Retry-After" in r.headers
+        body = await r.json()
+        assert body["code"] == "overloaded"
+
+        # streaming: the shed precedes the 200/SSE headers entirely
+        faults.configure([{"point": "engine.admit", "times": 1}])
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(stream=True),
+        )
+        assert r.status == 429, await r.text()
+        assert "Retry-After" in r.headers
+        assert (await r.json())["code"] == "overloaded"
+
+        # and the engine still serves once the overload clears
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=_chat_body()
+        )
+        assert r.status == 200
+        return True
+
+    assert _run(llm_served, fn)
+
+
+def test_deadline_returns_408_on_both_routes(llm_served):
+    async def fn(client, app):
+        # a zero total budget is already expired at submission: 408 before
+        # any device work, on the non-streaming AND the streaming route
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=_chat_body(timeout=0)
+        )
+        assert r.status == 408, await r.text()
+        assert (await r.json())["code"] == "deadline_exceeded"
+
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(timeout=0, stream=True),
+        )
+        assert r.status == 408, await r.text()
+        assert (await r.json())["code"] == "deadline_exceeded"
+
+        # completions route (non-chat) maps identically
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_llm", "prompt": "hi", "max_tokens": 4,
+                  "timeout": 0},
+        )
+        assert r.status == 408, await r.text()
+        return True
+
+    assert _run(llm_served, fn)
+
+
+def test_streaming_deadline_mid_stream_emits_sse_error(llm_served):
+    """A budget that expires AFTER headers (mid-generation) cannot change
+    the status line; the structured error arrives as an SSE error event."""
+    async def fn(client, app):
+        r = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(stream=True, max_tokens=100_000, timeout=0.3),
+        )
+        assert r.status == 200
+        text = await r.text()
+        assert "DeadlineExceededError" in text or "data: [DONE]" in text
+        return True
+
+    assert _run(llm_served, fn)
+
+
+def test_ready_reflects_engine_health(llm_served):
+    async def fn(client, app):
+        # instantiate the engine, then flip its recovery flag
+        r = await client.post(
+            "/serve/openai/v1/chat/completions", json=_chat_body()
+        )
+        assert r.status == 200
+        engine = llm_served._engine_processor_lookup["tiny_llm"].engine
+
+        r = await client.get("/ready")
+        assert r.status == 200
+        body = await r.json()
+        assert body["status"] == "ready"
+        assert body["engines"]["tiny_llm"]["ready"]
+
+        engine._recovering = True  # what a watchdog trip sets
+        try:
+            r = await client.get("/ready")
+            assert r.status == 503
+            body = await r.json()
+            assert body["status"] == "not_ready"
+            assert "tiny_llm" in body["not_ready"]
+            assert "Retry-After" in r.headers
+            # /health stays liveness-only: still 200 while recovering
+            r = await client.get("/health")
+            assert r.status == 200
+        finally:
+            engine._recovering = False
+
+        r = await client.get("/ready")
+        assert r.status == 200
+        return True
+
+    assert _run(llm_served, fn)
+
+
+# -- graceful drain (cheap custom endpoint; no LLM engine needed) -------------
+
+
+ECHO_CODE = """
+from clearml_serving_tpu.serving.main import StreamingOutput
+
+class Preprocess:
+    def process(self, data, state, collect_fn):
+        delay = float((data or {}).get("sleep", 0) or 0)
+        if not delay:
+            return {"echo": data}
+        # slow in-flight work modeled as a stream (async; the custom
+        # engine's plain process hook is synchronous)
+        async def gen():
+            import asyncio
+            await asyncio.sleep(delay)
+            yield "data: done\\n\\n"
+        return StreamingOutput(gen())
+"""
+
+
+class _DummyEngine:
+    def __init__(self):
+        self.stopped = False
+
+    def health(self):
+        return {"ready": not self.stopped}
+
+    def stop(self):
+        self.stopped = True
+
+
+class _DummyProc:
+    def __init__(self):
+        self.engine = _DummyEngine()
+
+
+@pytest.fixture()
+def echo_served(state_root, tmp_path):
+    mrp = ModelRequestProcessor(
+        state_root=str(state_root), force_create=True, name="drain"
+    )
+    f = tmp_path / "echo.py"
+    f.write_text(ECHO_CODE)
+    mrp.add_endpoint(
+        ModelEndpoint(engine_type="custom", serving_url="echo"),
+        preprocess_code=str(f),
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+    return mrp
+
+
+def test_graceful_drain_sheds_new_lets_inflight_finish(echo_served):
+    dummy = _DummyProc()
+
+    async def fn(client, app):
+        echo_served._engine_processor_lookup["dummy_llm"] = dummy
+        r = await client.post("/serve/echo", json={"x": 1})
+        assert r.status == 200
+
+        # start a slow in-flight request, then begin the drain
+        inflight = asyncio.create_task(
+            client.post("/serve/echo", json={"sleep": 0.4})
+        )
+        await asyncio.sleep(0.1)  # request is in flight
+        drain = asyncio.create_task(
+            drain_app(app, echo_served, timeout=5.0)
+        )
+        await asyncio.sleep(0.05)
+
+        # new requests shed immediately with 503 + Retry-After
+        r = await client.post("/serve/echo", json={"x": 2})
+        assert r.status == 503
+        assert (await r.json())["code"] == "draining"
+        assert "Retry-After" in r.headers
+        # /ready flips too
+        r = await client.get("/ready")
+        assert r.status == 503
+        assert (await r.json())["status"] == "draining"
+
+        # the in-flight request still completes normally
+        r = await inflight
+        assert r.status == 200
+        assert "done" in await r.text()
+
+        await drain
+        # engines were stopped only after the drain completed
+        assert dummy.engine.stopped
+        return True
+
+    assert _run(echo_served, fn)

@@ -746,3 +746,133 @@ def test_sampled_speculation_in_engine(tiny_engine_parts):
     off = build(spec_sampling=False)
     a3 = asyncio.run(run_alone(off))
     assert len(a3) >= 1
+
+
+# -- cancellation during admission (request-lifecycle hardening) --------------
+
+
+def test_cancel_while_parked_in_pending(tiny_engine_parts):
+    """Client disconnect while the request sits in _pending: the consumer
+    unblocks promptly, the queued request never takes a slot, and the engine
+    keeps serving (slot pipeline untouched)."""
+    bundle, params = tiny_engine_parts
+
+    async def run():
+        engine = _make_engine(bundle, params, max_batch=1, decode_steps=1)
+        a = GenRequest(prompt_ids=[256, 1], max_new_tokens=10_000)
+        agen = engine.generate(a)
+        await agen.__anext__()  # A pins the single slot
+        b = GenRequest(prompt_ids=[256, 2], max_new_tokens=4)
+        b_task = asyncio.create_task(_collect(engine, b))
+        while engine._pending.qsize() < 1:
+            await asyncio.sleep(0.005)
+        b.cancel()  # disconnect while parked
+        out_b = await asyncio.wait_for(b_task, timeout=30)
+        assert out_b == []
+        await agen.aclose()
+        out_c = await _collect(
+            engine, GenRequest(prompt_ids=[256, 3], max_new_tokens=3)
+        )
+        return out_c, engine
+
+    out_c, engine = asyncio.run(run())
+    assert len(out_c) >= 1
+    assert engine.active_slots == 0
+
+
+def test_cancel_during_prefill_releases_guided_refs(tiny_engine_parts):
+    """Client disconnect while the request's prefill is in flight must
+    return the grammar ref _ensure_grammar took in the admission worker —
+    a leaked ref would block the guided-table compaction forever."""
+    from clearml_serving_tpu.llm import faults
+    from clearml_serving_tpu.llm.guided import GuidedSpec
+    from clearml_serving_tpu.llm.tokenizer import ByteTokenizer
+
+    bundle, params = tiny_engine_parts
+    tok = ByteTokenizer(512)
+    marker = 301
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, eos_token_id=tok.eos_token_id, tokenizer=tok
+        )
+        faults.configure([
+            {"point": "engine.prefill", "action": "delay", "delay": 0.3,
+             "match_token": marker, "times": 1},
+        ])
+        b = GenRequest(
+            prompt_ids=[256, marker], max_new_tokens=8,
+            guided=GuidedSpec("regex", "(yes|no)"),
+        )
+        b_task = asyncio.create_task(_collect(engine, b))
+        await asyncio.sleep(0.1)  # prefill (delayed) is in flight
+        b.cancel()  # disconnect mid-admission
+        out_b = await asyncio.wait_for(b_task, timeout=30)
+        assert out_b == []
+        # the compiled grammar's ref came back (slot never committed)
+        assert all(e["refs"] == 0 for e in engine._grammars.values())
+        # and guided decoding still works for the next client
+        out = await _collect(engine, GenRequest(
+            prompt_ids=[256, 2], max_new_tokens=8,
+            guided=GuidedSpec("regex", "(yes|no)"),
+        ))
+        return out, engine
+
+    try:
+        out, engine = asyncio.run(run())
+    finally:
+        from clearml_serving_tpu.llm import faults as _f
+
+        _f.clear()
+    assert len(out) >= 1
+    assert all(e["refs"] == 0 for e in engine._grammars.values())
+
+
+def test_cancel_during_prefill_releases_prefix_pin(tiny_engine_parts):
+    """Paged prefix cache: a lookup pins shared pages until the loop-thread
+    commit. A client disconnect while that prefill is in flight must drop
+    the pin — otherwise the pages leak out of the pool forever."""
+    from clearml_serving_tpu.llm import faults
+
+    bundle, params = tiny_engine_parts
+    system = [(i * 5 + 1) % 256 for i in range(32)]
+    marker = 302
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, cache_mode="paged", page_size=4,
+            prefix_cache=64, prefix_block=16,
+        )
+        pool = engine.paged_cache.pool
+        # request 1 stores the 32-token prefix by page reference
+        await _collect(engine, GenRequest(
+            prompt_ids=system + [9, 8], max_new_tokens=3
+        ))
+        free0, shared0 = pool.free_pages, pool.shared_pages
+        faults.configure([
+            {"point": "engine.prefill", "action": "delay", "delay": 0.3,
+             "match_token": marker, "times": 1},
+        ])
+        b = GenRequest(
+            prompt_ids=system + [marker, 7], max_new_tokens=3
+        )
+        b_task = asyncio.create_task(_collect(engine, b))
+        await asyncio.sleep(0.1)  # lookup will pin the shared pages
+        b.cancel()
+        out_b = await asyncio.wait_for(b_task, timeout=30)
+        assert out_b == []
+        # pin released, no page leaked: pool refcounts back to baseline
+        assert pool.free_pages == free0
+        assert pool.shared_pages == shared0
+        # the prefix is still hittable by the next client
+        out = await _collect(engine, GenRequest(
+            prompt_ids=system + [5, 4], max_new_tokens=3
+        ))
+        assert engine._prefix.hits >= 1
+        return out
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        faults.clear()
+    assert len(out) >= 1

@@ -182,3 +182,58 @@ def test_prefix_cache_collector_exports_live_counters():
     c3 = register_prefix_cache(fresh, registry=registry, key="m2")
     assert c3 is c2  # same collector, entry swapped
     assert val("llm_prefix_cache_hits_total", "m2") == 0
+
+
+def test_engine_lifecycle_collector_exports_counters_and_gauges():
+    """Shed/deadline/watchdog counters and the queue-depth / active-slot
+    gauges scrape live from a provider callable (the engine's
+    lifecycle_stats, or the gRPC client's retry stats)."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 3,
+        "active_slots": 2,
+        "ready": 1,
+        "sheds": {"queue": 4, "pool": 1},
+        "deadlines": {"queue": 2, "ttft": 1, "total": 5},
+        "watchdog_trips": 1,
+        "step_failures": 2,
+    }
+    registry = CollectorRegistry()
+    collector = register_engine_lifecycle(
+        lambda: stats, registry=registry, key="m1"
+    )
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_queue_depth") == 3
+    assert val("engine_active_slots") == 2
+    assert val("engine_ready") == 1
+    assert val("engine_sheds_total", reason="queue") == 4
+    assert val("engine_sheds_total", reason="pool") == 1
+    assert val("engine_deadline_hits_total", stage="ttft") == 1
+    assert val("engine_watchdog_trips_total") == 1
+    assert val("engine_step_failures_total") == 2
+
+    # gauges move on the next scrape (read live, not pushed)
+    stats["queue_depth"] = 7
+    assert val("engine_queue_depth") == 7
+
+    # the gRPC client's retry stats ride the same collector
+    from clearml_serving_tpu.engines.grpc_client import grpc_lifecycle_stats
+
+    c2 = register_engine_lifecycle(
+        grpc_lifecycle_stats, registry=registry, key="grpc"
+    )
+    assert c2 is collector
+    assert registry.get_sample_value(
+        "grpc_client_upstream_total", {"model": "grpc", "kind": "retries"}
+    ) is not None
+
+    # re-registering a key replaces the provider (hot-reload semantics)
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 0, "active_slots": 0}, registry=registry,
+        key="m1",
+    )
+    assert val("engine_queue_depth") == 0
