@@ -1,0 +1,295 @@
+"""tpuserve-analyze: project-native static analysis for the TPU serving tree.
+
+The orchestration layer survives on reconciliation loops; the engine tier
+survives on *invariants* — PagePool refcount conservation under a lock,
+donation-safe ``jax.jit`` boundaries, no blocking work on the asyncio serving
+path, structured errors on router paths. None of those are enforceable by a
+generic linter, so this package implements them as AST rules over stdlib
+``ast`` only (no third-party deps — it must run under ``JAX_PLATFORMS=cpu``
+in any container the tests run in, without importing jax or the code under
+analysis).
+
+Usage::
+
+    python -m clearml_serving_tpu.analyze [paths ...]    # default: package tree
+    scripts/check.sh                                     # ruff -> mypy -> this
+
+Every finding carries a rule code, ``file:line:col``, a message, and a fix-it
+hint. A deliberate violation is silenced inline::
+
+    time.sleep(0.1)  # tpuserve: ignore[TPU101] warmup outside the event loop
+
+An ignore comment on a ``def``/``class``/``async def`` line exempts that whole
+scope (used for "lock held by caller" helpers). The rule catalog lives in
+docs/static_analysis.md; tests/test_analyze.py pins every rule with positive,
+negative, and ignore-comment fixtures, plus a tree-wide zero-findings gate
+that runs in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "iter_python_files",
+]
+
+# -- rule catalog -------------------------------------------------------------
+# code -> (one-line summary, fix-it hint). The authoritative prose catalog is
+# docs/static_analysis.md; keep the two in sync (test_analyze checks this
+# table covers every emitted code).
+RULES: Dict[str, Tuple[str, str]] = {
+    "TPU000": (
+        "file does not parse",
+        "fix the syntax error; nothing else can be checked until it parses",
+    ),
+    "TPU101": (
+        "blocking sleep/subprocess call inside `async def`",
+        "use `await asyncio.sleep(...)` or move the work to "
+        "`asyncio.to_thread(...)`",
+    ),
+    "TPU102": (
+        "synchronous file/socket I/O inside `async def`",
+        "wrap the call in `asyncio.to_thread(...)` (or do it before entering "
+        "the event loop)",
+    ),
+    "TPU103": (
+        "device synchronization (`block_until_ready`/`jax.device_get`) "
+        "inside `async def`",
+        "dispatch on a worker thread (`asyncio.to_thread`) so the event loop "
+        "never blocks on the device",
+    ),
+    "TPU104": (
+        "unawaited `.acquire()` inside `async def` (blocks the event loop "
+        "for threading locks, silently returns a coroutine for asyncio ones)",
+        "use `async with lock:` / `await lock.acquire()`, or take threading "
+        "locks on a worker thread",
+    ),
+    "TPU201": (
+        "`jax.jit`-wrapped function closes over `self` (mutable state is "
+        "baked into the trace; mutations after compile are silently ignored)",
+        "pass the state as an explicit argument (pytree leaf or static arg)",
+    ),
+    "TPU202": (
+        "donated buffer used again after the donating jitted call "
+        "(the buffer is invalidated by donation)",
+        "rebind the result over the donated name "
+        "(`self.k = self._write(self.k, ...)`) before any further use",
+    ),
+    "TPU203": (
+        "unhashable literal (list/dict/set) passed at a static argument "
+        "position of a jitted function (TypeError at trace time; dynamic "
+        "values there recompile per call)",
+        "pass a tuple (hashable) or make the argument dynamic",
+    ),
+    "TPU301": (
+        "guarded attribute mutated outside its declared lock scope",
+        "wrap the mutation in `with self.<lock>:` or annotate the helper "
+        "with `# tpuserve: ignore[TPU301] lock held by caller`",
+    ),
+    "TPU401": (
+        "bare `except:` / `except Exception: pass` swallows errors on a "
+        "router path",
+        "catch the narrowest type, re-raise, or map to the errors.py "
+        "hierarchy; annotate genuinely best-effort sites",
+    ),
+    "TPU402": (
+        "`raise Exception(...)` on a router path defeats structured error "
+        "mapping (every caller sees an opaque 500)",
+        "raise a clearml_serving_tpu.errors.RequestError subclass (or a "
+        "specific builtin like ValueError)",
+    ),
+    "TPU403": (
+        "faults.fire() call site names a point missing from the "
+        "faults.KNOWN_POINTS registry",
+        "add the point (with a docstring entry) to llm/faults.py "
+        "KNOWN_POINTS so chaos specs can target it",
+    ),
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None. Shared by every rule
+    module — name-chain resolution must behave identically across rules."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        out = "{}:{}:{}: {} {}".format(
+            self.path, self.line, self.col, self.code, self.message
+        )
+        if self.hint:
+            out += "\n    fix: {}".format(self.hint)
+        return out
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.code)
+
+
+# -- inline escape hatch ------------------------------------------------------
+
+_IGNORE_RE = re.compile(
+    r"#\s*tpuserve:\s*ignore(?:\[(?P<codes>[A-Z0-9,\s]+)\])?", re.IGNORECASE
+)
+
+
+def _ignore_map(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> set of ignored codes (None = ignore every rule on that line).
+
+    Built from the token stream, not a substring scan, so a ``tpuserve:
+    ignore`` inside a string literal never silences anything.
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_RE.search(tok.string)
+            if not m:
+                continue
+            codes = m.group("codes")
+            line = tok.start[0]
+            if not codes:
+                out[line] = None  # ignore every rule on this line
+            elif out.get(line, set()) is not None:
+                parsed = {c.strip().upper() for c in codes.split(",") if c.strip()}
+                out[line] = (out.get(line) or set()) | parsed
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _scope_ignores(tree: ast.AST, ignores: Dict[int, Optional[Set[str]]]):
+    """Expand def/class-line ignores to cover the whole scope body."""
+    expanded: Dict[int, Optional[Set[str]]] = dict(ignores)
+    for node in ast.walk(tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        # the comment may sit on the `def` line or on the decorator line
+        decl_lines = [node.lineno] + [d.lineno for d in node.decorator_list]
+        scoped: Optional[Set[str]] = set()
+        hit = False
+        for ln in decl_lines:
+            if ln in ignores:
+                hit = True
+                if ignores[ln] is None:
+                    scoped = None
+                    break
+                scoped |= ignores[ln]  # type: ignore[operator]
+        if not hit:
+            continue
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        for ln in range(node.lineno, end + 1):
+            prev = expanded.get(ln, set())
+            if scoped is None or (ln in expanded and prev is None):
+                expanded[ln] = None
+            else:
+                expanded[ln] = (prev or set()) | scoped
+    return expanded
+
+
+def _filter_ignored(
+    findings: List[Finding], ignores: Dict[int, Optional[Set[str]]]
+) -> List[Finding]:
+    kept = []
+    for f in findings:
+        allowed = ignores.get(f.line, set())
+        if allowed is None or (allowed and f.code in allowed):
+            continue
+        kept.append(f)
+    return kept
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """All findings for one module's source text (ignores already applied)."""
+    from . import rules_async, rules_errors, rules_jit, rules_locks
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as ex:
+        return [
+            Finding(
+                "TPU000", path, ex.lineno or 0, ex.offset or 0,
+                "syntax error: {}".format(ex.msg),
+                "the analyzer (and the interpreter) cannot parse this file",
+            )
+        ]
+    findings: List[Finding] = []
+    for mod in (rules_async, rules_jit, rules_locks, rules_errors):
+        findings.extend(mod.check(tree, path, source))
+    ignores = _scope_ignores(tree, _ignore_map(source))
+    findings = _filter_ignored(findings, ignores)
+    if select is not None:
+        chosen = {c.upper() for c in select}
+        findings = [f for f in findings if f.code in chosen]
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def analyze_file(path: str, select: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(fh.read(), path, select=select)
+
+
+_SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", "build", "dist"}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def analyze_paths(
+    paths: Sequence[str], select: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(analyze_file(path, select=select))
+    return findings

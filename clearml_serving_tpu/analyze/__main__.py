@@ -1,0 +1,90 @@
+"""CLI: ``python -m clearml_serving_tpu.analyze [paths ...]``.
+
+Exit status 0 when the tree is clean, 1 when any finding survives the
+inline ignores — tier-1 (scripts/check.sh) treats non-zero as a hard fail
+and prints the per-rule table so the offending invariant is obvious.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+from . import RULES, analyze_paths
+
+
+def _default_root() -> str:
+    # the package directory itself (…/clearml_serving_tpu)
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m clearml_serving_tpu.analyze",
+        description="project-native static analysis (stdlib ast only; "
+        "rule catalog in docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: the installed package tree)",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="suppress per-finding output; only the summary table",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            summary, hint = RULES[code]
+            print("{}  {}\n         fix: {}".format(code, summary, hint))
+        return 0
+
+    paths = args.paths or [_default_root()]
+    select = (
+        [c.strip() for c in args.select.split(",") if c.strip()]
+        if args.select
+        else None
+    )
+    findings = analyze_paths(paths, select=select)
+    if not args.quiet:
+        for finding in findings:
+            print(finding.render())
+    if findings:
+        counts = Counter(f.code for f in findings)
+        print("\ntpuserve-analyze: {} finding(s)".format(len(findings)))
+        width = max(len(c) for c in counts)
+        for code in sorted(counts):
+            print(
+                "  {:<{w}}  {:>4}  {}".format(
+                    code, counts[code], RULES.get(code, ("?", ""))[0], w=width
+                )
+            )
+        print(
+            "\nsilence a deliberate violation with "
+            "`# tpuserve: ignore[CODE] reason` on the offending line."
+        )
+        return 1
+    print(
+        "tpuserve-analyze: clean ({} rule(s) over {})".format(
+            len(RULES), ", ".join(paths)
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; findings already flowed
+        sys.exit(1)

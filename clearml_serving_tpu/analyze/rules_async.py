@@ -1,0 +1,135 @@
+"""TPU1xx — blocking work inside ``async def`` on the serving path.
+
+The router, the OpenAI front, the engine loop, and the gRPC client all share
+ONE asyncio event loop; a single synchronous ``time.sleep``, file read, or
+``block_until_ready()`` inside an ``async def`` stalls every in-flight
+request at once (and defeats the deadline/watchdog machinery of PR 2, which
+assumes the loop keeps turning). These rules only fire inside ``async def``
+bodies — the same calls on worker threads are the *correct* pattern.
+
+Scope note: nested ``def`` inside an ``async def`` re-enters synchronous
+land (it may be handed to ``asyncio.to_thread``), so the visitor tracks the
+innermost function kind, not just "am I somewhere under an async def".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from . import Finding, RULES, dotted_name as _dotted
+
+# qualified call names that block the loop outright
+_BLOCKING_CALLS = {
+    ("time", "sleep"): "TPU101",
+    ("os", "system"): "TPU101",
+    ("subprocess", "run"): "TPU101",
+    ("subprocess", "call"): "TPU101",
+    ("subprocess", "check_call"): "TPU101",
+    ("subprocess", "check_output"): "TPU101",
+    # sync network/file I/O
+    ("socket", "create_connection"): "TPU102",
+    ("request", "urlopen"): "TPU102",   # urllib.request.urlopen
+    ("urllib", "urlopen"): "TPU102",
+    ("requests", "get"): "TPU102",
+    ("requests", "post"): "TPU102",
+    ("requests", "request"): "TPU102",
+    # device syncs: the host thread parks until the TPU finishes
+    ("jax", "device_get"): "TPU103",
+    ("jax", "block_until_ready"): "TPU103",
+}
+
+# bare-name calls that block (builtins)
+_BLOCKING_BARE = {"open": "TPU102"}
+
+# attribute-only matches: any receiver (``x.block_until_ready()``)
+_BLOCKING_ATTRS = {"block_until_ready": "TPU103"}
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        # innermost function kind stack: True = async, False = sync
+        self._fn: List[bool] = []
+        # Await expressions wrap their value; remember them so x.acquire()
+        # under an await is not flagged
+        self._awaited: set = set()
+
+    # -- scope tracking ----------------------------------------------------
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._fn.append(True)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn.append(False)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._fn.append(False)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    def _in_async(self) -> bool:
+        return bool(self._fn) and self._fn[-1]
+
+    # -- checks ------------------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def _emit(self, code: str, node: ast.AST, detail: str) -> None:
+        summary, hint = RULES[code]
+        self.findings.append(
+            Finding(
+                code, self.path, node.lineno, node.col_offset,
+                "{} ({})".format(summary, detail), hint,
+            )
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_async():
+            matched = False
+            name = _dotted(node.func)
+            if name is not None:
+                parts = name.split(".")
+                # match on the LAST two components so `self._mod.time.sleep`
+                # style aliases still hit; single names match builtins
+                pair = tuple(parts[-2:]) if len(parts) >= 2 else None
+                if pair in _BLOCKING_CALLS:
+                    matched = True
+                    self._emit(_BLOCKING_CALLS[pair], node, "call to {}".format(name))
+                elif len(parts) == 1 and parts[0] in _BLOCKING_BARE:
+                    matched = True
+                    self._emit(_BLOCKING_BARE[parts[0]], node, "call to {}()".format(name))
+            if isinstance(node.func, ast.Attribute) and not matched:
+                # fallback for arbitrary receivers (`x.block_until_ready()`);
+                # skipped when the qualified table above already fired so one
+                # call never yields two findings
+                attr = node.func.attr
+                if attr in _BLOCKING_ATTRS:
+                    self._emit(
+                        _BLOCKING_ATTRS[attr], node,
+                        ".{}() forces a device sync".format(attr),
+                    )
+                elif attr == "acquire" and id(node) not in self._awaited:
+                    self._emit(
+                        "TPU104", node,
+                        "{}.acquire() without await".format(
+                            _dotted(node.func.value) or "lock"
+                        ),
+                    )
+        self.generic_visit(node)
+
+
+def check(tree: ast.AST, path: str, source: str) -> List[Finding]:
+    visitor = _AsyncVisitor(path)
+    # visit Await parents before Call children: ast.NodeVisitor already
+    # descends parent-first, and visit_Await records the wrapped call before
+    # generic_visit reaches it
+    visitor.visit(tree)
+    return visitor.findings

@@ -1,0 +1,179 @@
+"""TPU4xx — structured-error discipline on router paths.
+
+PR 2 made failures *mean* something: the router maps the errors.py hierarchy
+to 408/429/503/504 with Retry-After, and the chaos suite drives every path
+through llm/faults.py. Both contracts erode silently — a new `except
+Exception: pass` swallows the structured error, a `raise Exception` comes
+out as an opaque 500, and a `faults.fire("typo.point")` never fires because
+no spec targets it. These rules pin the contracts.
+
+Router-path scope (TPU401 pass-swallow and TPU402): files under
+``serving/``, ``engines/``, ``engine_server/``, and ``llm/openai_api.py`` —
+the layers whose exceptions reach clients as HTTP statuses. Bare ``except:``
+is flagged everywhere (it catches KeyboardInterrupt/SystemExit too, which no
+serving layer may eat).
+
+TPU403 validates ``faults.fire("<point>")`` string literals against the
+``KNOWN_POINTS`` registry in llm/faults.py — parsed from source (stdlib ast
+only, jax never imported). Registry drift therefore fails CI, not a 3 a.m.
+chaos run.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional
+
+from . import Finding, RULES, dotted_name as _dotted
+
+_ROUTER_MARKERS = ("serving", "engines", "engine_server")
+
+# fallback when the analyzed file is a detached fixture and llm/faults.py is
+# not reachable from it; kept in sync with faults.KNOWN_POINTS by
+# test_analyze (the runtime registry is authoritative)
+FALLBACK_POINTS: FrozenSet[str] = frozenset({
+    "engine.prefill",
+    "engine.decode",
+    "engine.decode.stall",
+    "engine.admit",
+    "engine.pool",
+    "engine.release",
+    "grpc.call",
+})
+
+_points_cache: Dict[str, FrozenSet[str]] = {}
+
+
+def _is_router_path(path: str) -> bool:
+    norm = path.replace(os.sep, "/")
+    parts = norm.split("/")
+    if any(marker in parts for marker in _ROUTER_MARKERS):
+        return True
+    return norm.endswith("llm/openai_api.py")
+
+
+def _known_points(path: str) -> FrozenSet[str]:
+    """KNOWN_POINTS parsed from the llm/faults.py nearest to ``path``."""
+    directory = os.path.dirname(os.path.abspath(path))
+    for _ in range(8):
+        candidate = os.path.join(directory, "llm", "faults.py")
+        if os.path.isfile(candidate):
+            break
+        parent = os.path.dirname(directory)
+        if parent == directory:
+            candidate = None
+            break
+        directory = parent
+    else:
+        candidate = None
+    if candidate is None:
+        return FALLBACK_POINTS
+    if candidate in _points_cache:
+        return _points_cache[candidate]
+    points = FALLBACK_POINTS
+    try:
+        with open(candidate, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                for t in node.targets
+            ):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]  # frozenset({...})
+            try:
+                literal = ast.literal_eval(value)
+                points = frozenset(str(p) for p in literal)
+            except (ValueError, SyntaxError):
+                pass
+            break
+    except (OSError, SyntaxError):
+        pass
+    _points_cache[candidate] = points
+    return points
+
+
+def _imports_fire(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.split(".")[-1] == "faults":
+                if any(a.name == "fire" for a in node.names):
+                    return True
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """A handler that does nothing with the error (pure swallow)."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        if isinstance(stmt, ast.Continue):
+            continue
+        return False
+    return True
+
+
+def check(tree: ast.AST, path: str, source: str) -> List[Finding]:
+    findings: List[Finding] = []
+    router = _is_router_path(path)
+    bare_fire = _imports_fire(tree)
+    known = None  # resolved lazily: most files have no fire() call sites
+
+    def emit(code: str, node: ast.AST, detail: str) -> None:
+        summary, hint = RULES[code]
+        findings.append(
+            Finding(
+                code, path, node.lineno, node.col_offset,
+                "{} ({})".format(summary, detail), hint,
+            )
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                emit(
+                    "TPU401", node,
+                    "bare `except:` also catches KeyboardInterrupt/SystemExit",
+                )
+            elif router and _swallows(node):
+                caught = _dotted(node.type) or ""
+                if caught in ("Exception", "BaseException"):
+                    emit(
+                        "TPU401", node,
+                        "`except {}` with a pass-only body".format(caught),
+                    )
+        elif isinstance(node, ast.Raise) and router:
+            exc = node.exc
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            name = _dotted(exc) if exc is not None else None
+            if name in ("Exception", "BaseException"):
+                emit("TPU402", node, "raise {}".format(name))
+        elif isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            is_fire = name.endswith("faults.fire") or name == "faults.fire" or (
+                bare_fire and name == "fire"
+            )
+            if not is_fire or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                if known is None:
+                    known = _known_points(path)
+                if first.value not in known:
+                    emit(
+                        "TPU403", node,
+                        "point {!r} not in faults.KNOWN_POINTS".format(
+                            first.value
+                        ),
+                    )
+    return findings

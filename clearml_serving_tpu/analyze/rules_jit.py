@@ -1,0 +1,282 @@
+"""TPU2xx — ``jax.jit`` boundary hazards.
+
+Three failure modes dominate JAX serving-stack incidents (PAPERS.md: pjit
+training report; ragged paged attention):
+
+- TPU201 a jitted function that closes over ``self``: the attribute values
+  present at TRACE time are baked into the executable, so later mutations
+  are silently ignored — classic "why does the engine still use the old
+  table" bug. Methods taking ``self`` as a real parameter are fine (it's a
+  traced input); closures are not.
+- TPU202 use-after-donation: ``donate_argnums`` invalidates the caller's
+  buffer. Reading the donated reference after the call returns garbage (or
+  crashes on TPU). The only safe idiom is rebinding the result over the
+  donated name in the SAME statement: ``self.k = self._write(self.k, ...)``.
+- TPU203 unhashable/dynamic values at static positions: ``static_argnums``
+  hashes the argument into the compile cache key — a list/dict/set literal
+  is a TypeError at trace time, and a per-call-varying value recompiles on
+  every request (the silent-recompile hazard the papers call out).
+
+The pass is module-local by design: it resolves jit wrappers assigned to
+names or ``self.<attr>`` within the analyzed file and checks call sites by
+the wrapper's final name component. Cross-module donation is out of scope
+(no such call sites exist in this tree; the sanitizer covers the runtime
+side).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, RULES, dotted_name as _dotted
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = _dotted(node.func)
+    return name is not None and (name == "jit" or name.endswith(".jit"))
+
+
+def _int_tuple(node: Optional[ast.AST]) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums/static_argnums value -> tuple of ints."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _references_self_freely(fn: ast.AST) -> bool:
+    """True when the function body reads ``self`` without declaring it."""
+    if isinstance(fn, ast.Lambda):
+        args = fn.args
+        body: List[ast.AST] = [fn.body]
+    elif isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        args = fn.args
+        body = list(fn.body)
+    else:
+        return False
+    declared = {
+        a.arg
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        )
+    }
+    if "self" in declared:
+        return False
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and node.id == "self":
+                return True
+            # nested defs that declare their own self (rare) still count as
+            # a closure over the outer self only if they don't declare it —
+            # keep it simple: any `self` Name inside counts unless shadowed,
+            # and nothing in this tree shadows `self`.
+    return False
+
+
+class _Wrapper:
+    __slots__ = ("donate", "static", "line")
+
+    def __init__(self, donate, static, line):
+        self.donate: Set[int] = set(donate or ())
+        self.static: Set[int] = set(static or ())
+        self.line = line
+
+
+def _collect(tree: ast.AST):
+    """(local defs by name, jit calls, wrapper registry by final name)."""
+    defs: Dict[str, List[ast.AST]] = {}
+    jit_calls: List[ast.Call] = []
+    wrappers: Dict[str, _Wrapper] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Call) and _is_jit_call(node):
+            jit_calls.append(node)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if not _is_jit_call(call):
+                continue
+            donate = _int_tuple(_kw(call, "donate_argnums"))
+            static = _int_tuple(_kw(call, "static_argnums"))
+            if not donate and not static:
+                continue
+            for target in node.targets:
+                name = _dotted(target)
+                if name:
+                    wrappers[name.split(".")[-1]] = _Wrapper(
+                        donate, static, node.lineno
+                    )
+    return defs, jit_calls, wrappers
+
+
+def _assign_targets_text(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            for elt in t.elts:
+                name = _dotted(elt)
+                if name:
+                    out.add(name)
+        else:
+            name = _dotted(t)
+            if name:
+                out.add(name)
+    return out
+
+
+def check(tree: ast.AST, path: str, source: str) -> List[Finding]:
+    findings: List[Finding] = []
+    defs, jit_calls, wrappers = _collect(tree)
+
+    def emit(code: str, node: ast.AST, detail: str) -> None:
+        summary, hint = RULES[code]
+        findings.append(
+            Finding(
+                code, path, node.lineno, node.col_offset,
+                "{} ({})".format(summary, detail), hint,
+            )
+        )
+
+    # -- TPU201: jitted function closes over self --------------------------
+    for call in jit_calls:
+        if not call.args:
+            continue
+        fn_arg = call.args[0]
+        if isinstance(fn_arg, ast.Lambda):
+            if _references_self_freely(fn_arg):
+                emit("TPU201", call, "lambda passed to jit reads self")
+        elif isinstance(fn_arg, ast.Name):
+            for fn in defs.get(fn_arg.id, []):
+                if _references_self_freely(fn):
+                    emit(
+                        "TPU201", call,
+                        "local function {!r} reads self from its closure".format(
+                            fn_arg.id
+                        ),
+                    )
+                    break
+
+    # -- TPU202/TPU203: wrapper call-site discipline -----------------------
+    # walk each function body in source order; nested defs are their own
+    # scopes (they run later or never) and are analyzed separately
+    fn_nodes = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in fn_nodes:
+        stmts = sorted(_own_statements(fn), key=lambda s: s.lineno)
+        # donated-expr text -> (line of donating stmt, wrapper name)
+        killed: Dict[str, Tuple[int, str]] = {}
+        for stmt in stmts:
+            # runtime order within one statement: the RHS (reads, calls)
+            # evaluates BEFORE the assignment binds — so 1) flag reads of
+            # names donated by EARLIER statements (catches the
+            # `self.k = f(self.k)`-after-donation case), 2) let this
+            # statement's rebind resurrect, 3) register this statement's
+            # donations (the same-statement rebind idiom stays exempt).
+            for node in _walk_stmt(stmt):
+                if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load
+                ):
+                    text = _dotted(node)
+                    if text in killed:
+                        line, via = killed[text]
+                        emit(
+                            "TPU202", node,
+                            "{!r} was donated to {} on line {}".format(
+                                text, via, line
+                            ),
+                        )
+                        del killed[text]
+            assigned = _assign_targets_text(stmt)
+            for name in list(killed):
+                if name in assigned:
+                    del killed[name]  # rebind: fresh buffer under the name
+            for node in _walk_stmt(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                cal_name = _dotted(node.func)
+                if cal_name is None:
+                    continue
+                wrapper = wrappers.get(cal_name.split(".")[-1])
+                if wrapper is None:
+                    continue
+                # TPU203: unhashable literals at static positions
+                for pos in wrapper.static:
+                    if pos < len(node.args) and isinstance(
+                        node.args[pos],
+                        (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp, ast.GeneratorExp),
+                    ):
+                        emit(
+                            "TPU203", node.args[pos],
+                            "argument {} of {} is static".format(pos, cal_name),
+                        )
+                # TPU202: donated args must be rebound by this statement
+                for pos in wrapper.donate:
+                    if pos >= len(node.args):
+                        continue
+                    text = _dotted(node.args[pos])
+                    if text is None:
+                        continue  # temporaries can't be read again
+                    if text in assigned:
+                        continue  # x = f(x, ...) — the safe idiom
+                    killed[text] = (node.lineno, cal_name)
+    return findings
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _walk_stmt(stmt: ast.AST):
+    """Yield the expression nodes belonging to exactly this statement: no
+    nested scopes, and no nested STATEMENTS — those appear in
+    _own_statements() in their own right, so descending here would visit
+    (and flag) their calls twice."""
+    stack = [stmt]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (_SCOPE_NODES) + (ast.stmt,)):
+                continue
+            stack.append(child)
+
+
+def _own_statements(fn: ast.AST) -> List[ast.stmt]:
+    """Every statement lexically inside ``fn`` but not in a nested scope."""
+    out: List[ast.stmt] = []
+    stack: List[ast.AST] = list(getattr(fn, "body", []))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.stmt):
+            out.append(cur)
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            stack.append(child)
+    return out

@@ -87,8 +87,11 @@ class CompiledModel:
                 dtype = np.dtype(self.input_types[i]) if i < len(self.input_types) else np.float32
                 inputs.append(np.zeros(shape, dtype))
             self.run_batch(inputs)
-        except Exception:
-            pass
+        except Exception as ex:
+            # warmup is best-effort (the first request compiles instead),
+            # but a failure here usually means the endpoint I/O spec is
+            # wrong — say so instead of deferring the surprise
+            print("warmup of {!r} failed: {}".format(self.key, ex))
 
 
 class EngineModelRepo:

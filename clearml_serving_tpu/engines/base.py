@@ -177,8 +177,10 @@ class BaseEngineRequest:
         if old is not None and hasattr(old, "unload"):
             try:
                 old.unload()
-            except Exception:
-                pass
+            except Exception as ex:
+                # hot swap proceeds — the NEW code is already installed —
+                # but a throwing unload leaks whatever it held; leave a trace
+                print("unload of replaced preprocess failed: {}".format(ex))
 
     def _import_user_module(self, path: Path):
         """Import a single .py file, or a zip package (extracted; must contain
@@ -266,13 +268,13 @@ class BaseEngineRequest:
         if self._preprocess is not None and hasattr(self._preprocess, "unload"):
             try:
                 self._preprocess.unload()
-            except Exception:
-                pass
+            except Exception as ex:
+                print("preprocess unload failed: {}".format(ex))
         self._preprocess = None
         self._model = None
 
     def __del__(self):
         try:
             self.unload()
-        except Exception:
+        except Exception:  # tpuserve: ignore[TPU401] finalizer: exceptions here are unraisable by design
             pass

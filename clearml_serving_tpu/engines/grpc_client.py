@@ -87,7 +87,7 @@ class JaxGrpcEngineRequest(BaseEngineRequest):
             from ..statistics.metrics import register_engine_lifecycle
 
             register_engine_lifecycle(grpc_lifecycle_stats, key="grpc_client")
-        except Exception:
+        except Exception:  # tpuserve: ignore[TPU401] metrics registry is optional observability, never load-bearing
             pass
         return self.endpoint.model_id or True
 

@@ -140,7 +140,7 @@ def parse_graphdef(data: bytes) -> List[Dict[str, Any]]:
     try:
         graph = _parse_message(data, _GRAPH_DEF)
         nodes = graph.get("node") or []
-    except Exception:
+    except Exception:  # tpuserve: ignore[TPU401] format probe: fall through to the SavedModel parse
         pass  # not a bare GraphDef; try the SavedModel wrapper below
     # real SavedModel files lead with saved_model_schema_version (field 1,
     # varint), which the GraphDef probe skips -> zero nodes -> fall through

@@ -54,7 +54,7 @@ def enable_persistent_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         _compilation_cache_ready = True
-    except Exception:
+    except Exception:  # tpuserve: ignore[TPU401] cache dir may be read-only/unsupported; compile-per-process still works
         pass
 
 
@@ -221,10 +221,13 @@ class JaxEngineRequest(BaseEngineRequest):
 
         fn = self._jitted.get(bucket)
         if fn is None:
+            # bind the apply fn as a local: a lambda closing over self would
+            # bake the attribute lookup's trace-time value in (TPU201)
+            apply_fn = self._apply_fn
             if self._params is not None:
-                fn = jax.jit(lambda params, *xs: self._apply_fn(params, *xs))
+                fn = jax.jit(lambda params, *xs: apply_fn(params, *xs))
             else:
-                fn = jax.jit(lambda *xs: self._apply_fn(*xs))
+                fn = jax.jit(lambda *xs: apply_fn(*xs))
             self._jitted[bucket] = fn
         return fn
 

@@ -117,7 +117,9 @@ class AudioCore:
         self.n_mels = int(cfg["n_mels"])
         self.max_target = int(cfg["max_target_positions"])
         self.max_new_tokens = int(max_new_tokens or self.max_target - 8)
-        self.decode_steps = max(1, int(decode_steps))
+        # captured as a local below: jitted closures must not read self
+        # (trace-time snapshot; tpuserve-analyze TPU201)
+        self.decode_steps = decode_steps = max(1, int(decode_steps))
         self.eos_token_id = int(cfg.get("eos_token_id", 50257))
         self._prompts = {
             "transcribe": list(cfg.get("transcribe_prompt_ids") or []),
@@ -157,7 +159,7 @@ class AudioCore:
                 return (nxt, cache), nxt
 
             (_, cache), toks = jax.lax.scan(
-                body, (token, cache), None, length=self.decode_steps
+                body, (token, cache), None, length=decode_steps
             )
             return toks, cache  # [steps, B]
 
@@ -252,7 +254,7 @@ class AudioCore:
                 (token, pen_is_ts, max_ts, cache), toks = jax.lax.scan(
                     partial(_ts_body, params),
                     (token, pen_is_ts, max_ts, cache),
-                    start_step + jnp.arange(self.decode_steps),
+                    start_step + jnp.arange(decode_steps),
                 )
                 return toks, token, pen_is_ts, max_ts, cache
 

@@ -73,17 +73,21 @@ class EncoderCore:
         self._batch_buckets = sorted(batch_buckets or _DEFAULT_BATCH_BUCKETS)
         self._jit_lock = threading.Lock()
 
+        # locals, not self: jitted closures snapshot attribute values at
+        # trace time (tpuserve-analyze TPU201)
+        pooling, normalize = self.pooling, self.normalize
+
         def _embed(params, input_ids, attention_mask):
             x = bundle.hidden(params, input_ids, attention_mask)  # [B,S,D]
             x32 = x.astype(jnp.float32)
-            if self.pooling == "cls":
+            if pooling == "cls":
                 pooled = x32[:, 0]
             else:
                 mask = attention_mask.astype(jnp.float32)[:, :, None]
                 pooled = (x32 * mask).sum(axis=1) / jnp.maximum(
                     mask.sum(axis=1), 1.0
                 )
-            if self.normalize:
+            if normalize:
                 pooled = pooled / jnp.maximum(
                     jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
                 )

@@ -44,7 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import faults
+from . import faults, kv_sanitizer
 from ..errors import (
     DeadlineExceededError,
     EngineOverloadedError,
@@ -501,6 +501,11 @@ class LLMEngineCore:
         self._wake: Optional[asyncio.Event] = None
 
         # -- compiled functions --------------------------------------------
+        # frozen config the traced closures need is captured as LOCALS, not
+        # read off self: a jitted function that closes over self bakes the
+        # attribute value into the trace, and a later mutation is silently
+        # ignored (tpuserve-analyze TPU201 enforces this tree-wide)
+        decode_steps = self.decode_steps
 
         def _prefill(params, tokens, seq_lens, cache_template, lora_idx=None):
             if lora_idx is None:  # static at trace: non-LoRA graphs unchanged
@@ -517,10 +522,10 @@ class LLMEngineCore:
                               lora_idx=None):
                 if lora_idx is None:
                     return bundle.prefill_ring(
-                        params, tokens, seq_lens, cache_template, self._mesh
+                        params, tokens, seq_lens, cache_template, mesh
                     )
                 return bundle.prefill_ring(
-                    params, tokens, seq_lens, cache_template, self._mesh, lora_idx
+                    params, tokens, seq_lens, cache_template, mesh, lora_idx
                 )
 
             self._prefill_ring_jit = jax.jit(_prefill_ring)
@@ -542,12 +547,14 @@ class LLMEngineCore:
             and not lora_adapters
         ):
 
+            pp_stages, pp_chunk = self._pp, self._pp_chunk
+
             def _prefill_pp(params, tokens, seq_lens, cache_template,
                             lora_idx=None):
                 assert lora_idx is None
                 return bundle.prefill_pipeline(
                     params, tokens, seq_lens, cache_template,
-                    stages=self._pp, chunk=self._pp_chunk,
+                    stages=pp_stages, chunk=pp_chunk,
                 )
 
             self._prefill_pipeline_jit = jax.jit(_prefill_pp)
@@ -667,7 +674,7 @@ class LLMEngineCore:
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
-        self._lp_k = max(1, int(logprobs_k))
+        self._lp_k = lp_k = max(1, int(logprobs_k))
 
         def _lp_of(logits, sampled, nb):
             """(chosen logprob [B], top ids [B,K], top logprobs [B,K]).
@@ -676,7 +683,7 @@ class LLMEngineCore:
             (OpenAI semantics for logit_bias)."""
             lp_full = jax.nn.log_softmax(logits)
             chosen = lp_full[jnp.arange(nb), sampled]
-            top_lp, top_id = jax.lax.top_k(lp_full, self._lp_k)
+            top_lp, top_id = jax.lax.top_k(lp_full, lp_k)
             return chosen, top_id.astype(jnp.int32), top_lp
 
         def _guided_mask(logits, gstate, guided):
@@ -763,8 +770,8 @@ class LLMEngineCore:
                 out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
                 return (sampled, cache, counts, gstate), out
 
-            rngs = jax.random.split(rng, self.decode_steps)
-            steps = jnp.arange(self.decode_steps, dtype=jnp.int32)
+            rngs = jax.random.split(rng, decode_steps)
+            steps = jnp.arange(decode_steps, dtype=jnp.int32)
             if gstate is None:
                 gstate = jnp.full((nb,), -1, jnp.int32)
             (_, cache, counts, gstate), out = jax.lax.scan(
@@ -807,7 +814,7 @@ class LLMEngineCore:
                 # exact rank among the full vocab (vLLM prompt_logprobs
                 # reports true ranks, not top-k positions)
                 rank = 1 + jnp.sum(lp > chosen[:, None], axis=-1)
-                tl, ti = jax.lax.top_k(lp, self._lp_k)
+                tl, ti = jax.lax.top_k(lp, lp_k)
                 return chosen, rank.astype(jnp.int32), ti.astype(jnp.int32), tl
 
             ch, rk, ti, tl = jax.lax.map(
@@ -817,8 +824,8 @@ class LLMEngineCore:
             return (
                 ch.reshape(-1)[:s1],
                 rk.reshape(-1)[:s1],
-                ti.reshape(-1, self._lp_k)[:s1],
-                tl.reshape(-1, self._lp_k)[:s1],
+                ti.reshape(-1, lp_k)[:s1],
+                tl.reshape(-1, lp_k)[:s1],
             )
 
         self._score_prompt_jit = jax.jit(_score_prompt)
@@ -1007,8 +1014,8 @@ class LLMEngineCore:
                             carry = (tokbuf, pending, cache, counts, gstate)
                         return carry, out
 
-                    rngs = jax.random.split(rng, self.decode_steps)
-                    steps = jnp.arange(self.decode_steps, dtype=jnp.int32)
+                    rngs = jax.random.split(rng, decode_steps)
+                    steps = jnp.arange(decode_steps, dtype=jnp.int32)
                     if paged:
                         carry0 = (tokbuf, pending, k_pools, v_pools,
                                   lengths, counts, gstate)
@@ -1099,7 +1106,7 @@ class LLMEngineCore:
                 out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
                 return (sampled, k_pools, v_pools, counts, step + 1, gstate), out
 
-            rngs = jax.random.split(rng, self.decode_steps)
+            rngs = jax.random.split(rng, decode_steps)
             if gstate is None:
                 gstate = jnp.full((nb,), -1, jnp.int32)
             (_, k_pools, v_pools, counts, _, gstate), out = jax.lax.scan(
@@ -1118,6 +1125,21 @@ class LLMEngineCore:
             static_argnames=("want_lp",),
         )
         self._sample_jit = sample_tokens
+
+        # runtime KV/refcount sanitizer (llm/kv_sanitizer.py): armed via
+        # TPUSERVE_SANITIZE=1 (tests arm it for the chaos + paged suites).
+        # After every decode step and at drain it audits refcount
+        # conservation across slot tables, the radix cache, admission pins,
+        # and pending CoW — a violated invariant raises instead of limping.
+        self._sanitizer = None
+        if self.paged_cache is not None and kv_sanitizer.enabled():
+            self._sanitizer = kv_sanitizer.KVSanitizer(
+                self.paged_cache.pool, self._prefix
+            )
+
+    def _sanitize(self, where: str, drained: bool = False) -> None:
+        if self._sanitizer is not None:
+            self._sanitizer.check(where, drained=drained)
 
     # -- public API ----------------------------------------------------------
 
@@ -2361,7 +2383,15 @@ class LLMEngineCore:
             self._slot_req[slot] = None
             self._release_guided(slot)
             if self.paged_cache is not None:
-                self.paged_cache.pool.free(slot)  # recycle the slot's pages
+                try:
+                    # chaos seam: an injected raise here models a teardown
+                    # bug that loses the slot's page references — the armed
+                    # KV sanitizer must then fail the drain check, naming
+                    # the leaked pages (tests/test_chaos.py)
+                    faults.fire("engine.release", request=request)
+                    self.paged_cache.pool.free(slot)  # recycle the slot's pages
+                except faults.InjectedFault:
+                    pass
 
     def _drain_ready(self, err: BaseException) -> None:
         """Fail every completed-but-uncommitted admission (loop is exiting)."""
@@ -2719,6 +2749,9 @@ class LLMEngineCore:
                     and self._ready.empty()
                     and not self._admitting
                 ):
+                    # drained: nothing owns pages but the prefix cache —
+                    # anything else is a leak the sanitizer names by id
+                    self._sanitize("drain", drained=True)
                     return  # drained; a new generate() restarts the loop
                 # idle but admissions in flight: sleep until a prefill lands
                 # or a new request arrives (no busy-spin)
@@ -2736,6 +2769,11 @@ class LLMEngineCore:
                 raise
             except Exception as ex:
                 self._handle_step_failure(ex, step_epoch)
+            # armed sanitizer: audit page accounting after every step —
+            # including steps that just went through failure recovery, which
+            # is exactly where reclamation bugs hide. A violation raises out
+            # of the loop (fail loud beats serving corrupted KV).
+            self._sanitize("decode-step")
             await asyncio.sleep(0)  # let HTTP handlers interleave
 
     async def _decode_step(self, active_mask: np.ndarray, epoch: int) -> None:

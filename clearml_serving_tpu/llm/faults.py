@@ -21,9 +21,20 @@ Known points (ctx carried with each):
                          converted to a load-shed (429).
 - ``engine.pool``      — inside check_admission's KV-pool headroom check; a
                          raise simulates pool exhaustion.
+- ``engine.release``   — at paged-slot teardown, before the slot's pages are
+                         freed (``request``); a raise simulates a teardown
+                         bug that LEAKS the slot's pages — the KV sanitizer
+                         (llm/kv_sanitizer.py, TPUSERVE_SANITIZE=1) must
+                         catch it at drain.
 - ``grpc.call``        — before each gRPC attempt (``attempt``); set
                          ``grpc_code`` ("UNAVAILABLE"/"DEADLINE_EXCEEDED")
                          to exercise the transient-retry path.
+
+Every point a production call site fires MUST be listed in
+:data:`KNOWN_POINTS`: the static analyzer (``tpuserve-analyze`` TPU403)
+checks call-site literals against it, and :func:`configure` rejects specs
+targeting unknown points — a typo'd point would otherwise arm a fault that
+never fires and silently prove nothing.
 
 Env format (``TPUSERVE_FAULTS``): a JSON list of spec dicts, e.g.::
 
@@ -39,6 +50,20 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
+
+
+# the registry of production fault seams (module docstring documents each).
+# tpuserve-analyze parses this assignment from source (stdlib ast, no import)
+# — keep it a literal.
+KNOWN_POINTS = frozenset({
+    "engine.prefill",
+    "engine.decode",
+    "engine.decode.stall",
+    "engine.admit",
+    "engine.pool",
+    "engine.release",
+    "grpc.call",
+})
 
 
 @dataclass
@@ -81,10 +106,18 @@ class FaultInjector:
 
     def configure(self, specs) -> None:
         """Arm the given specs (list of FaultSpec or dicts). Replaces any
-        previously armed set."""
+        previously armed set. Unknown points are rejected loudly — a spec
+        that can never fire reads as "chaos test passed"."""
         armed = []
         for s in specs or []:
-            armed.append(s if isinstance(s, FaultSpec) else FaultSpec(**s))
+            spec = s if isinstance(s, FaultSpec) else FaultSpec(**s)
+            if spec.point not in KNOWN_POINTS:
+                raise ValueError(
+                    "unknown fault point {!r} (known: {})".format(
+                        spec.point, ", ".join(sorted(KNOWN_POINTS))
+                    )
+                )
+            armed.append(spec)
         with self._lock:
             self._specs = armed
 
@@ -97,9 +130,12 @@ class FaultInjector:
         if not raw:
             return
         try:
-            self.configure(json.loads(raw))
-        except (ValueError, TypeError) as ex:
+            specs = json.loads(raw)
+        except ValueError as ex:
             raise ValueError("unparseable TPUSERVE_FAULTS: {}".format(ex))
+        # configure() raises its own precise error for valid-JSON specs with
+        # an unknown point/field — don't relabel that as a parse failure
+        self.configure(specs)
 
     def active(self) -> bool:
         return bool(self._specs)

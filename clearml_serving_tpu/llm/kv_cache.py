@@ -42,6 +42,14 @@ class PagePool:
     decode worker threads, and admission workers (prefix-cache pins) all
     mutate refcounts concurrently."""
 
+    # lock-discipline registry (tpuserve-analyze TPU301): every mutation of
+    # these attributes must sit inside `with self._lock:`; helpers called
+    # with the lock already held annotate their def line
+    __guarded_by__ = {
+        "_lock": ("_free", "_slot_pages", "_slot_len", "_refs",
+                  "_pending_cow", "_pins"),
+    }
+
     def __init__(self, num_pages: int, page_size: int, max_slots: int):
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
@@ -55,6 +63,11 @@ class PagePool:
         # still pending (drained by PagedKVCache.apply_pending_cow)
         self._pending_cow: List[Tuple[int, int]] = []
         self.cow_events = 0
+        # transient out-of-structure references (prefix-cache lookup pins):
+        # page -> count of refs held by in-flight admissions. Tracked apart
+        # from _refs so the KV sanitizer (llm/kv_sanitizer.py) can prove
+        # refcount CONSERVATION: refs == slot-table + cache-node + pin refs.
+        self._pins: Dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -68,12 +81,12 @@ class PagePool:
         with self._lock:
             return self.pages_needed(tokens) <= len(self._free)
 
-    def _pop_free(self) -> int:
+    def _pop_free(self) -> int:  # tpuserve: ignore[TPU301] lock held by caller
         page = self._free.pop()
         self._refs[page] = 1
         return page
 
-    def _unref(self, page: int) -> bool:
+    def _unref(self, page: int) -> bool:  # tpuserve: ignore[TPU301] lock held by caller
         """Drop one reference; True when the page returned to the free list."""
         self._refs[page] -= 1
         if self._refs[page] == 0:
@@ -157,13 +170,17 @@ class PagePool:
     # -- sharing (prefix cache) --------------------------------------------
 
     def ref_pages(self, pages: List[int]) -> None:
-        """Take one reference on each page (cache store / lookup pin)."""
+        """Take one reference on each page (cache store / lookup pin).
+        Validates the whole batch before mutating anything: a mid-loop
+        raise must not leave earlier pages referenced (the failure fires
+        exactly when accounting is already suspect — don't compound it)."""
         with self._lock:
             for page in pages:
                 if self._refs[page] <= 0:
                     raise RuntimeError(
                         "ref_pages on unallocated page {}".format(page)
                     )
+            for page in pages:
                 self._refs[page] += 1
 
     def unref_pages(self, pages: List[int]) -> int:
@@ -174,6 +191,58 @@ class PagePool:
                 if self._unref(page):
                     freed += 1
         return freed
+
+    def pin_pages(self, pages: List[int]) -> None:
+        """Take one TRANSIENT reference per page (prefix-cache lookup pin,
+        held by an in-flight admission). Same refcount semantics as
+        ref_pages, but accounted separately so the sanitizer can attribute
+        every reference to a holder."""
+        with self._lock:
+            # validate-then-mutate: no partial pins on error
+            for page in pages:
+                if self._refs[page] <= 0:
+                    raise RuntimeError(
+                        "pin_pages on unallocated page {}".format(page)
+                    )
+            for page in pages:
+                self._refs[page] += 1
+                self._pins[page] = self._pins.get(page, 0) + 1
+
+    def unpin_pages(self, pages: List[int]) -> int:
+        """Drop one transient reference per page; returns pages freed."""
+        freed = 0
+        with self._lock:
+            # validate-then-mutate: no partial unpins on error
+            counted: Dict[int, int] = {}
+            for page in pages:
+                counted[page] = counted.get(page, 0) + 1
+                if self._pins.get(page, 0) < counted[page]:
+                    raise RuntimeError(
+                        "unpin_pages on unpinned page {}".format(page)
+                    )
+            for page in pages:
+                count = self._pins[page]
+                if count == 1:
+                    self._pins.pop(page)
+                else:
+                    self._pins[page] = count - 1
+                if self._unref(page):
+                    freed += 1
+        return freed
+
+    def snapshot(self) -> Dict[str, object]:
+        """Consistent copy of all bookkeeping (one lock hold) for the KV
+        sanitizer: refcounts, free list, slot tables/lengths, transient
+        pins, and pending copy-on-write pairs."""
+        with self._lock:
+            return {
+                "refs": list(self._refs),
+                "free": list(self._free),
+                "slot_pages": [list(p) for p in self._slot_pages],
+                "slot_len": list(self._slot_len),
+                "pins": dict(self._pins),
+                "pending_cow": list(self._pending_cow),
+            }
 
     def map_shared(self, slot: int, pages: List[int], tokens: int) -> None:
         """Map already-allocated (shared) pages as the slot's first pages —
@@ -196,6 +265,7 @@ class PagePool:
                     raise RuntimeError(
                         "map_shared of unallocated page {}".format(page)
                     )
+            for page in pages:
                 self._refs[page] += 1
             self._slot_pages[slot] = list(pages)
             self._slot_len[slot] = tokens
@@ -269,6 +339,10 @@ class PagedKVCache:
     lock a gather could grab a pool reference that a racing donating dispatch
     has already invalidated. Execution still overlaps; only the (cheap,
     host-side) enqueue is serialized."""
+
+    # pool-handle rebinds happen only under the dispatch lock (a donating
+    # dispatch invalidates the old handle; tpuserve-analyze TPU301)
+    __guarded_by__ = {"dispatch_lock": ("k", "v")}
 
     def __init__(
         self,

@@ -1,0 +1,203 @@
+"""Runtime KV/refcount sanitizer: prove page-accounting invariants, don't
+assume them.
+
+The paged KV tier shares physical pages between live slots, the radix prefix
+cache, in-flight admission pins, and pending copy-on-write swaps — four
+holders, one refcount. The chaos suite (watchdog recovery, poison isolation,
+shed paths) exercises exactly the code that reclaims those references under
+failure; "the test passed" only means the TOKENS came out right. With
+``TPUSERVE_SANITIZE=1`` (or programmatic arming) the engine additionally
+asserts, after every decode step and at drain:
+
+1. **Refcount conservation** — for every page, ``refcount == slot-table
+   references + radix-cache node references + admission pins``. A page the
+   books can't explain is a leak (never reclaimable) or a time bomb (freed
+   while someone still reads it).
+2. **Free-list integrity** — no duplicates, no referenced page on the free
+   list, every zero-ref page on it, and the reserved null page (0) neither
+   free nor referenced.
+3. **Slot-table shape** — each slot's page count matches its token length
+   (``pages_needed``), so device page tables never index garbage.
+4. **Pending-CoW sanity** — every recorded (src, dst) swap still has a live
+   src (the sharers that forced the copy) and a dst owned by some slot.
+5. **At drain** (no active requests, no admissions in flight) — no slot
+   holds pages, no pins remain, and every surviving reference belongs to
+   the prefix cache. Anything else is a leaked page, reported BY ID.
+
+Failures raise :class:`KVSanitizerError` (an AssertionError subclass: armed
+test suites fail closed) with a diagnostic naming the offending pages.
+
+The checks are host-side integer audits over a locked snapshot —
+O(num_pages + cached nodes), no device work — cheap enough for every test
+step but off by default in production (arm via env to debug a live leak).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["KVSanitizerError", "KVSanitizer", "enabled"]
+
+
+def enabled() -> bool:
+    """Armed via ``TPUSERVE_SANITIZE`` (1/true/yes; 0/empty disarms)."""
+    return os.environ.get("TPUSERVE_SANITIZE", "").lower() in ("1", "true", "yes")
+
+
+class KVSanitizerError(AssertionError):
+    """A KV page-accounting invariant failed. Carries the offending page ids
+    (``pages``) and the check site (``where``) for programmatic triage."""
+
+    def __init__(self, message: str, *, where: str, pages: Optional[List[int]] = None):
+        super().__init__(message)
+        self.where = where
+        self.pages = list(pages or [])
+
+
+class KVSanitizer:
+    """Audits one PagedKVCache's PagePool (and the radix prefix cache that
+    shares it) against the conservation invariants above.
+
+    ``check()`` is thread-safe: the snapshot is taken under the cache's tree
+    lock and the pool's bookkeeping lock (same order as every mutating cache
+    path), so a concurrent admission can interleave only BETWEEN atomic pool
+    operations — each of which preserves the invariants — never inside one.
+    """
+
+    def __init__(self, pool, prefix_cache=None):
+        self.pool = pool
+        self.prefix = prefix_cache
+        self.checks = 0     # observability: how many audits ran
+        self.failures = 0
+
+    # -- snapshot ----------------------------------------------------------
+
+    def _snapshot(self):
+        if self.prefix is not None and getattr(self.prefix, "_pool", None) is self.pool:
+            cache_refs, snap = self.prefix.page_refs(self.pool)
+        else:
+            cache_refs = {}
+            snap = self.pool.snapshot()
+        return cache_refs, snap
+
+    # -- checks ------------------------------------------------------------
+
+    def check(self, where: str = "step", drained: bool = False) -> None:
+        """Raise KVSanitizerError on the first violated invariant."""
+        self.checks += 1
+        cache_refs, snap = self._snapshot()
+        refs: List[int] = snap["refs"]
+        free: List[int] = snap["free"]
+        slot_pages: List[List[int]] = snap["slot_pages"]
+        slot_len: List[int] = snap["slot_len"]
+        pins: Dict[int, int] = snap["pins"]
+        pending_cow = snap["pending_cow"]
+
+        def fail(message: str, pages: Optional[List[int]] = None) -> None:
+            self.failures += 1
+            raise KVSanitizerError(
+                "KV sanitizer [{}]: {}".format(where, message),
+                where=where, pages=pages,
+            )
+
+        # slot-table occurrences per page (a page CAN legally appear in
+        # several slots — shared prefix mapped into multiple page tables)
+        slot_occ: Dict[int, int] = {}
+        for slot, pages in enumerate(slot_pages):
+            for page in pages:
+                slot_occ[page] = slot_occ.get(page, 0) + 1
+        # (3) slot-table shape
+        for slot, pages in enumerate(slot_pages):
+            need = self.pool.pages_needed(slot_len[slot])
+            if len(pages) != need:
+                fail(
+                    "slot {} holds {} pages for {} tokens (expected {})".format(
+                        slot, len(pages), slot_len[slot], need
+                    ),
+                    pages=pages,
+                )
+
+        # (2) free-list integrity + null page
+        if len(set(free)) != len(free):
+            dupes = sorted({p for p in free if free.count(p) > 1})
+            fail("free list contains duplicates: {}".format(dupes), pages=dupes)
+        bad = sorted(p for p in free if refs[p] != 0)
+        if bad:
+            fail(
+                "pages {} are on the free list with refcount > 0".format(bad),
+                pages=bad,
+            )
+        if 0 in free or refs[0] != 0 or slot_occ.get(0) or cache_refs.get(0):
+            fail("reserved null page 0 entered circulation", pages=[0])
+        free_set = set(free)
+
+        # (1) refcount conservation, page by page
+        leaked: List[str] = []
+        leaked_ids: List[int] = []
+        for page in range(1, len(refs)):
+            expected = (
+                slot_occ.get(page, 0)
+                + cache_refs.get(page, 0)
+                + pins.get(page, 0)
+            )
+            if refs[page] != expected:
+                leaked_ids.append(page)
+                leaked.append(
+                    "page {}: refcount {} != {} accounted "
+                    "(slots {} + cache {} + pins {})".format(
+                        page, refs[page], expected,
+                        slot_occ.get(page, 0), cache_refs.get(page, 0),
+                        pins.get(page, 0),
+                    )
+                )
+            if refs[page] == 0 and page not in free_set:
+                leaked_ids.append(page)
+                leaked.append(
+                    "page {}: refcount 0 but missing from the free list".format(
+                        page
+                    )
+                )
+        if leaked:
+            fail(
+                "refcount conservation violated:\n  " + "\n  ".join(leaked),
+                pages=sorted(set(leaked_ids)),
+            )
+
+        # (4) pending-CoW sanity
+        for src, dst in pending_cow:
+            if refs[src] <= 0:
+                fail(
+                    "pending CoW src page {} has no live references".format(src),
+                    pages=[src],
+                )
+            if not slot_occ.get(dst):
+                fail(
+                    "pending CoW dst page {} is in no slot table".format(dst),
+                    pages=[dst],
+                )
+
+        # (5) drain: only the prefix cache may keep references
+        if drained:
+            held = {
+                slot: pages for slot, pages in enumerate(slot_pages) if pages
+            }
+            if held:
+                detail = ", ".join(
+                    "slot {} -> pages {}".format(slot, pages)
+                    for slot, pages in sorted(held.items())
+                )
+                fail(
+                    "leaked pages at drain (no live requests): {}".format(
+                        detail
+                    ),
+                    pages=sorted(p for pages in held.values() for p in pages),
+                )
+            if pins:
+                fail(
+                    "admission pins outlived drain: {}".format(dict(pins)),
+                    pages=sorted(pins),
+                )
+
+    def stats(self) -> Dict[str, int]:
+        return {"checks": self.checks, "failures": self.failures}

@@ -74,6 +74,13 @@ class RadixPrefixCache:
     refcounted against ``pool`` instead of dense KV slices.
     """
 
+    # lock-discipline registry (tpuserve-analyze TPU301): tree state is
+    # mutated only under self._lock; helpers called with it held annotate
+    # their def line
+    __guarded_by__ = {
+        "_lock": ("_roots", "_leaf_nodes", "_n_nodes", "_clock"),
+    }
+
     def __init__(
         self,
         max_nodes: int = 512,
@@ -113,14 +120,14 @@ class RadixPrefixCache:
         final token always computes live (its logits seed decoding)."""
         return ((n_tokens - 1) // self.block) * self.block
 
-    def _root(self, lora: int) -> _Node:
+    def _root(self, lora: int) -> _Node:  # tpuserve: ignore[TPU301] lock held by caller
         root = self._roots.get(lora)
         if root is None:
             root = _Node(None, ())
             self._roots[lora] = root
         return root
 
-    def _tick(self) -> int:
+    def _tick(self) -> int:  # tpuserve: ignore[TPU301] lock held by caller
         self._clock += 1
         return self._clock
 
@@ -152,7 +159,7 @@ class RadixPrefixCache:
         path.reverse()
         return path
 
-    def _attach(self, parent: _Node, child: _Node) -> None:
+    def _attach(self, parent: _Node, child: _Node) -> None:  # tpuserve: ignore[TPU301] lock held by caller
         """Insert ``child`` under ``parent`` and keep the leaf set current.
         Lock held by caller; accounting is the caller's job."""
         parent.children[child.edge] = child
@@ -273,7 +280,7 @@ class RadixPrefixCache:
             pages: List[int] = []
             for n in self._path_nodes(node):
                 pages.extend(n.pages)
-            self._pool.ref_pages(pages)  # pin for the admission in flight
+            self._pool.pin_pages(pages)  # pin for the admission in flight
         return {"len": depth, "pages": pages}
 
     def release(self, hit: Dict[str, Any]) -> None:
@@ -281,7 +288,7 @@ class RadixPrefixCache:
         or the admission failed)."""
         pages = hit.pop("pages", None) if hit else None
         if pages:
-            self._pool.unref_pages(pages)
+            self._pool.unpin_pages(pages)
 
     def store_pages(self, ids: List[int], lora: int, slot_pages: List[int]) -> None:
         """Store the prompt's block-aligned prefix by REFERENCE to the
@@ -323,7 +330,7 @@ class RadixPrefixCache:
             or (self.max_pages is not None and self._pages > self.max_pages)
         )
 
-    def _evict_over_budget(self) -> None:
+    def _evict_over_budget(self) -> None:  # tpuserve: ignore[TPU301] lock held by caller
         """LRU leaf eviction over the incrementally maintained leaf set
         (O(leaves) per eviction, no tree walk). A paged leaf only drops the
         CACHE's page refs; pages a live slot still maps stay allocated until
@@ -345,6 +352,26 @@ class RadixPrefixCache:
                 self._pool.unref_pages(victim.pages)
             victim.parent = None
             self.evictions += 1
+
+    # -- sanitizer support ---------------------------------------------------
+
+    def page_refs(self, pool=None):
+        """Cache-held references per page id (each node's pages hold one
+        pool reference apiece). With ``pool`` given, also return a pool
+        snapshot taken UNDER the tree lock, so no store/evict can slip
+        between the two — the lock order (tree, then pool) matches every
+        mutating cache path."""
+        with self._lock:
+            counts: Dict[int, int] = {}
+            stack = [root for root in self._roots.values()]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                for page in node.pages or ():
+                    counts[page] = counts.get(page, 0) + 1
+            if pool is None:
+                return counts
+            return counts, pool.snapshot()
 
     # -- observability -------------------------------------------------------
 

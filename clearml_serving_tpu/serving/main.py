@@ -217,7 +217,7 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
                 if aclose is not None:
                     try:
                         await aclose()
-                    except Exception:
+                    except Exception:  # tpuserve: ignore[TPU401] client is gone; generator cleanup has no receiver
                         pass
                 if out.on_complete is not None:
                     out.on_complete()

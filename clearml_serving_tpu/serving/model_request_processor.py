@@ -115,7 +115,7 @@ class FastSimpleQueue:
                 from ..native import NativeQueue
 
                 self._native = NativeQueue(capacity=1024, cell_bytes=4096)
-            except Exception:
+            except Exception:  # tpuserve: ignore[TPU401] optional native accel; deque fallback below
                 pass
         self._q = deque()
         self._event = threading.Event()
@@ -449,7 +449,7 @@ class ModelRequestProcessor:
             for url in list(self._endpoints) + list(self._model_monitoring_endpoints):
                 try:
                     self._get_processor(url)
-                except Exception:
+                except Exception:  # tpuserve: ignore[TPU401] prefetch only warms the cache; the request path re-raises properly
                     pass
         return True
 

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Static checks, one entrypoint: ruff -> mypy -> tpuserve-analyze.
+#
+# The project-native analyzer is the HARD gate: dependency-free (stdlib ast
+# only, no jax import), so it runs identically in every container and its
+# findings always fail this script.
+#
+# ruff and mypy run with the permissive pyproject.toml baselines when
+# installed; the serving container does not ship them, so their baselines
+# have not been validated against this tree on every image. To keep tier-1
+# hermetic (green here must not mean red on an image that happens to have
+# them), their findings are ADVISORY by default — printed, not fatal. Set
+# CHECK_STRICT=1 to make them fail the script once the baselines have been
+# validated where the tools exist.
+#
+# Usage: scripts/check.sh [paths...]   (default: clearml_serving_tpu/)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+paths=("$@")
+if [ ${#paths[@]} -eq 0 ]; then
+  paths=(clearml_serving_tpu/)
+fi
+
+rc=0
+advisory_rc=0
+
+if python -c "import ruff" >/dev/null 2>&1 || command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  if command -v ruff >/dev/null 2>&1; then
+    ruff check "${paths[@]}" || advisory_rc=1
+  else
+    python -m ruff check "${paths[@]}" || advisory_rc=1
+  fi
+else
+  echo "== ruff == (not installed; skipped)"
+fi
+
+if python -c "import mypy" >/dev/null 2>&1; then
+  echo "== mypy =="
+  python -m mypy "${paths[@]}" || advisory_rc=1
+else
+  echo "== mypy == (not installed; skipped)"
+fi
+
+if [ "$advisory_rc" -ne 0 ]; then
+  if [ -n "$CHECK_STRICT" ]; then
+    rc=1
+  else
+    echo "(ruff/mypy findings above are advisory; CHECK_STRICT=1 makes them fatal)"
+  fi
+fi
+
+echo "== tpuserve-analyze =="
+python -m clearml_serving_tpu.analyze "${paths[@]}" || rc=1
+
+exit $rc

@@ -4,6 +4,12 @@
 # pipeline, so the pass-count extraction and flags can never drift.
 set -o pipefail
 cd "$(dirname "$0")/.."
+# static checks gate the run: a lock-discipline or donation violation fails
+# fast with the rule table instead of surfacing as a flaky test 10 minutes in
+# (skip with TIER1_SKIP_CHECKS=1 when bisecting runtime-only failures)
+if [ -z "$TIER1_SKIP_CHECKS" ]; then
+  scripts/check.sh || exit 1
+fi
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

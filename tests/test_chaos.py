@@ -34,6 +34,7 @@ from clearml_serving_tpu.errors import (
 )
 from clearml_serving_tpu.llm import faults
 from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.kv_sanitizer import KVSanitizerError
 
 pytestmark = pytest.mark.chaos
 
@@ -52,6 +53,15 @@ def clean_faults():
     faults.clear()
     yield
     faults.clear()
+
+
+@pytest.fixture(autouse=True)
+def armed_sanitizer(monkeypatch):
+    """Every engine this suite builds runs with the KV sanitizer armed:
+    recovery paths must not merely produce the right tokens — page
+    accounting must balance after every step and at drain
+    (docs/static_analysis.md, invariant list)."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
 
 
 def _make_engine(bundle, params, **kwargs):
@@ -136,6 +146,88 @@ def test_batch_wide_decode_failure_recovers_engine(parts):
 
     engine = asyncio.run(run())
     assert engine.counters["step_failures"] == 1
+
+
+def test_paged_poison_recovery_conserves_pages(parts):
+    """Paged-cache variant of poison isolation, audited: the sanitizer
+    checks refcount conservation after every decode step INCLUDING the
+    recovery epoch, and at drain every page is back on the free list."""
+    bundle, params = parts
+    marker = 310
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=1, cache_mode="paged", page_size=16
+        )
+        assert engine._sanitizer is not None, "TPUSERVE_SANITIZE did not arm"
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        a = GenRequest(prompt_ids=[256, 5, 6], max_new_tokens=10)
+        a_task = asyncio.create_task(_collect(engine, a))
+        while a.produced < 2:
+            await asyncio.sleep(0.01)
+        faults.configure([
+            {"point": "engine.decode", "action": "raise",
+             "match_token": marker, "times": 1, "message": "poisoned step"},
+        ])
+        b = GenRequest(prompt_ids=[256, marker, 7], max_new_tokens=10)
+        with pytest.raises(EngineStepError):
+            await _collect(engine, b)
+        out_a = await a_task
+        assert len(out_a) >= 1
+        # wait for drain so the drain-audit (strictest check) also ran
+        t0 = time.monotonic()
+        while (
+            engine._loop_task is not None
+            and not engine._loop_task.done()
+            and time.monotonic() - t0 < 10.0
+        ):
+            await asyncio.sleep(0.01)
+        if engine._loop_task is not None and engine._loop_task.done():
+            assert engine._loop_task.exception() is None
+        return engine
+
+    engine = asyncio.run(run())
+    stats = engine._sanitizer.stats()
+    assert stats["checks"] > 0 and stats["failures"] == 0
+    pool = engine.paged_cache.pool
+    # no prefix cache configured: at drain every usable page is free again
+    assert pool.free_pages == pool.num_pages - 1
+
+
+def test_deliberate_leak_is_caught_with_named_pages(parts):
+    """Acceptance: a seeded teardown bug (engine.release fault swallows the
+    page free) must fail CLOSED — the sanitizer's drain audit raises
+    KVSanitizerError naming the leaked pages, instead of the pool quietly
+    shrinking forever."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=1, cache_mode="paged", page_size=16
+        )
+        assert engine._sanitizer is not None
+        # clean warmup request: proves the audit passes when teardown works
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        faults.configure([
+            {"point": "engine.release", "times": 1, "message": "lost free"},
+        ])
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 2, 3], max_new_tokens=3)
+        )
+        assert out, "the request itself succeeds; the leak is in teardown"
+        t0 = time.monotonic()
+        while not engine._loop_task.done() and time.monotonic() - t0 < 10.0:
+            await asyncio.sleep(0.01)
+        assert engine._loop_task.done(), "loop should exit at drain"
+        return engine, engine._loop_task.exception()
+
+    engine, exc = asyncio.run(run())
+    assert isinstance(exc, KVSanitizerError), exc
+    assert exc.where == "drain"
+    assert exc.pages, "diagnostic must name the leaked page ids"
+    assert "leaked pages at drain" in str(exc)
+    assert all(str(p) in str(exc) for p in exc.pages)
+    assert engine._sanitizer.stats()["failures"] == 1
 
 
 # -- watchdog: stuck loop detection + supervised recovery ---------------------

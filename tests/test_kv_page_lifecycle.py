@@ -11,6 +11,20 @@ import numpy as np
 import pytest
 
 from clearml_serving_tpu.llm.kv_cache import PagedKVCache, PagePool
+from clearml_serving_tpu.llm.kv_sanitizer import (
+    KVSanitizer,
+    KVSanitizerError,
+    enabled as sanitizer_enabled,
+)
+from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+
+
+@pytest.fixture(autouse=True)
+def armed_sanitizer(monkeypatch):
+    """Paged-engine construction in this suite (and any engine built through
+    it) runs with the runtime sanitizer armed."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    assert sanitizer_enabled()
 
 
 def _pool(num_pages=16, page_size=4, max_slots=4):
@@ -196,3 +210,109 @@ def test_write_prompt_shared_scatters_only_tail():
     )
     with pytest.raises(ValueError):
         cache2.write_prompt_shared(0, [1], 3, tail, tail, 6)
+
+
+# -- transient pins (prefix-cache lookup accounting) --------------------------
+
+
+def test_pin_unpin_roundtrip_and_accounting():
+    pool = _pool()
+    pages = pool.allocate(0, 8)
+    pool.pin_pages(pages)  # in-flight admission holds them
+    assert all(pool.page_refcount(p) == 2 for p in pages)
+    pool.free(0)  # slot exits first
+    assert all(pool.page_refcount(p) == 1 for p in pages)  # pin keeps them
+    assert pool.unpin_pages(pages) == len(pages)
+    assert pool.free_pages == 15
+
+
+def test_unpin_without_pin_raises():
+    pool = _pool()
+    pages = pool.allocate(0, 4)
+    with pytest.raises(RuntimeError):
+        pool.unpin_pages(pages)
+    pool.free(0)
+
+
+# -- runtime KV sanitizer (llm/kv_sanitizer.py) -------------------------------
+
+
+def test_sanitizer_clean_pool_passes_all_checks():
+    pool = _pool()
+    san = KVSanitizer(pool)
+    pool.allocate(0, 10)
+    pool.allocate(1, 5)
+    san.check("step")
+    pool.free(0)
+    pool.free(1)
+    san.check("drain", drained=True)
+    assert san.stats() == {"checks": 2, "failures": 0}
+
+
+def test_sanitizer_names_unaccounted_reference():
+    pool = _pool()
+    san = KVSanitizer(pool)
+    pages = pool.allocate(0, 4)
+    with pool._lock:
+        pool._refs[pages[0]] += 1  # simulate a lost unref (leak)
+    with pytest.raises(KVSanitizerError) as ei:
+        san.check("step")
+    assert ei.value.pages == [pages[0]]
+    assert "refcount conservation" in str(ei.value)
+    assert "page {}".format(pages[0]) in str(ei.value)
+
+
+def test_sanitizer_catches_free_list_corruption():
+    pool = _pool()
+    san = KVSanitizer(pool)
+    pages = pool.allocate(0, 4)
+    with pool._lock:
+        pool._free.append(pages[0])  # referenced page back on the free list
+    with pytest.raises(KVSanitizerError) as ei:
+        san.check("step")
+    assert "free list" in str(ei.value)
+
+
+def test_sanitizer_catches_slot_table_shape_drift():
+    pool = _pool()
+    san = KVSanitizer(pool)
+    pool.allocate(0, 5)  # 2 pages
+    with pool._lock:
+        pool._slot_len[0] = 9  # claims 3 pages' worth of tokens
+    with pytest.raises(KVSanitizerError) as ei:
+        san.check("step")
+    assert "slot 0" in str(ei.value)
+
+
+def test_sanitizer_drain_flags_abandoned_slot_pages():
+    pool = _pool()
+    san = KVSanitizer(pool)
+    pages = pool.allocate(0, 8)
+    san.check("step")  # mid-run: a populated slot is normal
+    with pytest.raises(KVSanitizerError) as ei:
+        san.check("drain", drained=True)
+    assert ei.value.where == "drain"
+    assert sorted(ei.value.pages) == sorted(pages)
+    assert "leaked pages at drain" in str(ei.value)
+
+
+def test_sanitizer_accounts_radix_cache_and_pins():
+    """Full holder set: slot + radix-cache nodes + a lookup pin, all
+    attributed; then each holder exits and the drain audit passes."""
+    pool = _pool(num_pages=32, page_size=4)
+    cache = RadixPrefixCache(
+        max_nodes=16, block=4, pool=pool, page_bytes=64,
+    )
+    san = KVSanitizer(pool, cache)
+    ids = list(range(1, 14))  # 13 tokens -> 12-token (3-block) prefix
+    pool.allocate(0, len(ids))
+    cache.store_pages(ids, 0, pool.slot_pages(0))
+    san.check("step")
+    hit = cache.lookup_pages(ids, 0)
+    assert hit is not None and len(hit["pages"]) == 3
+    san.check("step")           # pin attributed
+    cache.release(hit)          # admission mapped (or failed): pin drops
+    san.check("step")
+    pool.free(0)                # slot exits; cache still holds the prefix
+    san.check("drain", drained=True)
+    assert san.stats()["failures"] == 0
