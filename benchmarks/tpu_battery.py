@@ -144,10 +144,33 @@ def main() -> int:
         emit({"metric": "llm_engine_spec_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t1, 1)})
 
+    # -- phase 4: pipelined-decode A/B (docs/pipelined_decode.md) -----------
+    # the real engine at TPUSERVE_PIPELINE_DEPTH=1 (serial) vs 2 (double-
+    # buffered chunk dispatch + device-resident token chaining); on a TPU
+    # the depth-2 win is the retired chunk's ~90 ms host dispatch/readback
+    # hidden behind the next chunk's device compute
+    t2 = time.time()
+    try:
+        row = bench.run_pipeline_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "scan_layers": True,
+             "kv_quant": "int8"},
+            batch=16, decode_steps=25, new_tokens=200, prompt_len=128,
+            max_seq_len=1024, quantize="int8",
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t2, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_pipelined_decode_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t2, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
         "spec_ab_wall_s": round(time.time() - t1, 1),
+        "pipeline_ab_wall_s": round(time.time() - t2, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

@@ -36,6 +36,7 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.prefill",
     "engine.decode",
     "engine.decode.stall",
+    "engine.decode.retire",
     "engine.admit",
     "engine.pool",
     "engine.release",

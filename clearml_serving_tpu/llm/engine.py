@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -135,6 +137,72 @@ _FINISHED = object()
 # more stop ids than this keep finishing on all of them — only the floor's
 # suppression is bounded)
 _STOP_SLOTS = 8
+
+# decode pipeline depth: in-flight decode-chunk dispatches the loop keeps
+# enqueued ahead of retirement. 1 = the historical serial
+# dispatch->sync->emit loop; 2 (default) overlaps chunk N's host readback +
+# emission with chunk N+1's device compute (docs/pipelined_decode.md)
+_DEFAULT_PIPELINE_DEPTH = 2
+
+
+def _env_pipeline_depth() -> int:
+    raw = os.environ.get("TPUSERVE_PIPELINE_DEPTH", "")
+    try:
+        return max(1, int(raw)) if raw else _DEFAULT_PIPELINE_DEPTH
+    except ValueError:
+        return _DEFAULT_PIPELINE_DEPTH
+
+
+class _MsHistogram:
+    """Host-side fixed-bucket millisecond histogram for scrape-time export
+    (statistics.metrics turns snapshots into Prometheus histograms). One
+    writer at a time (the dispatch worker / retire stage); snapshot()
+    copies under the GIL so scrapes never see torn lists."""
+
+    BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0)
+
+    def __init__(self):
+        self.counts = [0] * (len(self.BUCKETS) + 1)
+        self.total_ms = 0.0
+        self.n = 0
+
+    def observe(self, ms: float) -> None:
+        for i, edge in enumerate(self.BUCKETS):
+            if ms <= edge:
+                break
+        else:
+            i = len(self.BUCKETS)
+        self.counts[i] += 1
+        self.total_ms += float(ms)
+        self.n += 1
+
+    def snapshot(self) -> dict:
+        return {
+            "buckets": list(self.BUCKETS),
+            "counts": list(self.counts),
+            "sum_ms": self.total_ms,
+            "count": self.n,
+        }
+
+
+@dataclass
+class _InFlightChunk:
+    """One dispatched-but-unretired decode chunk. ``chunk``/``gstate``/``lp``
+    are DEVICE arrays (possibly still computing); retire syncs them to host.
+    ``active_mask`` is the host snapshot the dispatch was built from — the
+    retire stage emits exactly those slots and nothing newer."""
+
+    seq: int
+    epoch: int
+    active_mask: "np.ndarray"
+    chunk: Any
+    gstate: Any = None
+    lp: Any = None
+    want_lp: bool = False
+    dispatched_at: float = 0.0
+    # paged backend: slots dropped from this chunk because the pool could
+    # not hold their page extension (failed by the loop thread on landing)
+    exhausted: List[int] = field(default_factory=list)
 
 
 class _PrefillGate:
@@ -243,6 +311,9 @@ class LLMEngineCore:
         ttft_timeout: Optional[float] = None,   # default first-token budget
         total_timeout: Optional[float] = None,  # default whole-request budget
         watchdog_interval: Optional[float] = None,  # stall detector period
+        # decode pipeline depth (None -> TPUSERVE_PIPELINE_DEPTH env, default
+        # 2); 1 restores the serial dispatch->sync->emit loop
+        pipeline_depth: Optional[int] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -336,13 +407,20 @@ class LLMEngineCore:
                 shard_params,
             )
 
+            heads = dict(
+                n_kv_heads=getattr(bundle, "n_kv_heads", None),
+                n_heads=bundle.config.get("n_heads"),
+            )
             if not self._quantized:
-                self.params = shard_params(mesh, params, llama_param_sharding(mesh, params))
+                self.params = shard_params(
+                    mesh, params, llama_param_sharding(mesh, params, **heads)
+                )
             else:
                 # int8 tree TP-shards like the bf16 weights (scales lose the
                 # input-axis entry) — per-chip HBM ≈ 1/tp of the model
                 self.params = shard_params(
-                    mesh, params, llama_quantized_param_sharding(mesh, params)
+                    mesh, params,
+                    llama_quantized_param_sharding(mesh, params, **heads),
                 )
             self._cache_sharding = llama_cache_sharding(
                 mesh, quantized=bool(bundle.config.get("kv_quant"))
@@ -499,6 +577,45 @@ class LLMEngineCore:
             else None
         )
         self._wake: Optional[asyncio.Event] = None
+
+        # -- pipelined decode (docs/pipelined_decode.md) -------------------
+        # Bounded in-flight dispatch queue: chunk N+1 is enqueued while
+        # chunk N still computes on device; chunk N's readback + emission
+        # (the retire stage) overlaps chunk N+1's compute. The only
+        # cross-chunk data dependency — the last sampled token — chains on
+        # device (chunk[:, -1]), so no host roundtrip sits between chunks.
+        self.pipeline_depth = (
+            max(1, int(pipeline_depth))
+            if pipeline_depth is not None
+            else _env_pipeline_depth()
+        )
+        self._inflight: Deque[_InFlightChunk] = deque()
+        self._dispatch_seq = 0
+        # (seq, active_mask) of a chunk whose worker-thread dispatch is in
+        # progress (not yet an _inflight entry): the slot-reuse barrier
+        # must see it — the concurrent retire stage can free slots
+        self._dispatching: Optional[tuple] = None
+        # slot -> dispatch seq that must retire before the slot's pages may
+        # be freed / the slot re-admitted: it was freed at a retire while
+        # younger chunks that still decode it were in flight (their extra
+        # tokens are dropped by _emit's None check; their KV writes must not
+        # land in re-allocated pages)
+        self._quarantine: Dict[int, int] = {}
+        # device-resident cross-chunk state (None -> upload the host
+        # mirror); _slot_overrides marks slots whose host value must win at
+        # the next dispatch (fresh commits between dispatches)
+        self._next_token_dev = None
+        self._gstate_dev = None
+        self._slot_overrides = np.zeros(self.max_batch, bool)
+        # cached device-side sampling constants: re-uploading temperature /
+        # top_k / top_p (and the static extras rows) as fresh device arrays
+        # every chunk puts 6+ tiny host->device transfers on every dispatch;
+        # they only change at commit (invalidated there)
+        self._sampling_dev = None
+        self._extras_dev = None
+        # dispatch/retire stage timing for the lifecycle collector
+        self._hist_dispatch = _MsHistogram()
+        self._hist_retire = _MsHistogram()
 
         # -- compiled functions --------------------------------------------
         # frozen config the traced closures need is captured as LOCALS, not
@@ -673,6 +790,13 @@ class LLMEngineCore:
             return out
 
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+
+        def _merge_rows(dev, host, override):
+            """Fold host-side per-slot overrides (fresh commits) into a
+            device-chained [B] vector without a full re-upload."""
+            return jnp.where(override, host, dev)
+
+        self._merge_rows_jit = jax.jit(_merge_rows)
 
         self._lp_k = lp_k = max(1, int(logprobs_k))
 
@@ -1139,7 +1263,9 @@ class LLMEngineCore:
 
     def _sanitize(self, where: str, drained: bool = False) -> None:
         if self._sanitizer is not None:
-            self._sanitizer.check(where, drained=drained)
+            self._sanitizer.check(
+                where, drained=drained, inflight=len(self._inflight)
+            )
 
     # -- public API ----------------------------------------------------------
 
@@ -1420,23 +1546,53 @@ class LLMEngineCore:
             np.any(self._slot_extra[active_mask])
         )
 
+    def _batch_sampling(self) -> "SamplingParams":
+        """Device-side SamplingParams for the slot batch, cached across
+        chunks — the rows only change at commit (which invalidates). The
+        host rows are COPIED before upload: zero-copy aliasing of a live,
+        commit-mutated buffer would let a future commit rewrite what an
+        in-flight chunk samples with (see _chain_input)."""
+        if self._sampling_dev is None:
+            self._sampling_dev = SamplingParams(
+                temperature=jnp.asarray(self._temperature.copy()),
+                top_k=jnp.asarray(self._top_k.copy()),
+                top_p=jnp.asarray(self._top_p.copy()),
+            )
+        return self._sampling_dev
+
     def _batch_extras(self) -> "SamplingExtras":
+        """Device-side sampling extras. The per-slot config rows (penalties
+        / seeds / min_tokens / stop sets) are cached device constants,
+        invalidated only at commit; the produced-token counters are
+        per-dispatch data and account for chunks still in flight (a live
+        slot advances decode_steps per in-flight chunk — dead slots'
+        counters are garbage by then, but their samples are dropped at
+        retire anyway)."""
+        if self._extras_dev is None:
+            seeds = np.where(
+                self._seeds < 0, -1, self._seeds & 0x7FFFFFFF
+            ).astype(np.int32)
+            # host rows COPIED before upload (live buffers; see _chain_input)
+            self._extras_dev = SamplingExtras(
+                presence=jnp.asarray(self._presence.copy()),
+                frequency=jnp.asarray(self._frequency.copy()),
+                repetition=jnp.asarray(self._repetition.copy()),
+                bias=None,       # device-chained state, patched per call
+                seeds=jnp.asarray(seeds),
+                counters=None,   # per-dispatch, patched below
+                min_new=jnp.asarray(self._min_tokens.copy()),
+                stop=jnp.asarray(self._stop_rows.copy()),
+            )
         produced = np.asarray(
             [r.produced if r is not None else 0 for r in self._slot_req],
             np.int32,
         )
-        seeds = np.where(
-            self._seeds < 0, -1, self._seeds & 0x7FFFFFFF
-        ).astype(np.int32)
-        return SamplingExtras(
-            presence=jnp.asarray(self._presence),
-            frequency=jnp.asarray(self._frequency),
-            repetition=jnp.asarray(self._repetition),
-            bias=self._bias_dev,
-            seeds=jnp.asarray(seeds),
-            counters=jnp.asarray(produced),
-            min_new=jnp.asarray(self._min_tokens),
-            stop=jnp.asarray(self._stop_rows),
+        for entry in self._inflight:
+            produced = produced + (
+                entry.active_mask.astype(np.int32) * self.decode_steps
+            )
+        return self._extras_dev._replace(
+            bias=self._bias_dev, counters=jnp.asarray(produced)
         )
 
     def _bias_pmask_rows(self, request: GenRequest):
@@ -1609,6 +1765,20 @@ class LLMEngineCore:
     def active_slots(self) -> int:
         return sum(1 for r in self._slot_req if r is not None)
 
+    async def wait_drained(self, timeout: float = 30.0) -> None:
+        """Await the decode loop going fully idle (loop task returned: no
+        active slots, no in-flight pipeline chunks, no admissions). Under
+        the pipelined loop a consumer can see its last token while younger
+        chunks are still in flight — page accounting is only FINAL at
+        drain, so tests/ops code that audits the pool should await this."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            task = self._loop_task
+            if task is None or task.done():
+                return
+            await asyncio.sleep(0.005)
+        raise TimeoutError("engine loop did not drain within {}s".format(timeout))
+
     @property
     def is_ready(self) -> bool:
         """Liveness signal for the HTTP /ready endpoint: False while the
@@ -1624,6 +1794,10 @@ class LLMEngineCore:
             "queue_depth": self._pending.qsize(),
             "watchdog_trips": self.counters["watchdog_trips"],
             "step_failures": self.counters["step_failures"],
+            "pipeline": {
+                "depth": self.pipeline_depth,
+                "inflight": len(self._inflight),
+            },
         }
 
     def lifecycle_stats(self) -> dict:
@@ -1642,6 +1816,12 @@ class LLMEngineCore:
             },
             "watchdog_trips": c["watchdog_trips"],
             "step_failures": c["step_failures"],
+            "pipeline": {
+                "depth": self.pipeline_depth,
+                "inflight": len(self._inflight),
+                "dispatch_ms": self._hist_dispatch.snapshot(),
+                "retire_ms": self._hist_retire.snapshot(),
+            },
         }
 
     @property
@@ -1688,6 +1868,19 @@ class LLMEngineCore:
                     # leave that request unsupervised.
                     self._last_progress = time.monotonic()
                     continue
+                disp = self._dispatching
+                if disp is not None and (
+                    time.monotonic() - disp[2] < 10.0 * interval
+                ):
+                    # a dispatch call is mid-flight in its worker thread:
+                    # first-use XLA compiles run inside that call and can
+                    # legitimately take many seconds (the serial loop hid
+                    # this by blocking the event loop through the compile).
+                    # The grace is BOUNDED at 10x the interval — a dispatch
+                    # wedged past that (lock deadlock, hung inline backend)
+                    # is a stall, not a compile; device hangs also surface
+                    # at the retire sync, where no grace applies.
+                    continue
                 if time.monotonic() - self._last_progress > interval:
                     self._watchdog_trip(interval)
         except asyncio.CancelledError:
@@ -1712,9 +1905,17 @@ class LLMEngineCore:
                 # the next safe boundary (_finish_recovery)
         self._last_progress = time.monotonic()
 
-    def _finish_recovery(self) -> None:
-        """Loop-thread-only, after a stale-epoch dispatch returned (or
-        raised): reclaim freed slots' pages and report ready again."""
+    async def _finish_recovery(self) -> None:
+        """After a stale-epoch dispatch returned (or raised): discard the
+        whole in-flight pipeline, reclaim freed slots' pages and report
+        ready again. DEFERRED while a dispatch worker is still mid-call —
+        its device program may still be writing the very pages this would
+        free; the dispatch leg (or the step-failure handler) completes
+        recovery once it lands, and a dispatch wedged forever correctly
+        keeps the engine not-ready instead of freeing pages under it."""
+        if self._dispatching is not None:
+            return
+        await self._discard_pipeline()
         if self.paged_cache is not None:
             for slot in range(self.max_batch):
                 if self._slot_req[slot] is None and slot not in self._admitting:
@@ -1732,16 +1933,100 @@ class LLMEngineCore:
         request.out_queue.put_nowait(_FINISHED)
         self._slot_req[slot] = None
         self._release_guided(slot)
+        self._free_slot_pages(slot)
+
+    # -- pipelined decode: slot-reuse barrier ---------------------------------
+
+    def _pipeline_barrier(self, slot: int) -> Optional[int]:
+        """Newest in-flight (or currently-dispatching) chunk that still
+        decodes ``slot`` (None when the pipeline holds no reference)."""
+        barrier = None
+        for entry in self._inflight:
+            if entry.active_mask[slot]:
+                barrier = entry.seq
+        disp = self._dispatching
+        if disp is not None and disp[1][slot]:
+            barrier = disp[0]
+        return barrier
+
+    def _free_slot_pages(self, slot: int) -> None:
+        """Release a freed slot's KV pages — immediately when no in-flight
+        chunk still references the slot, otherwise deferred to the retire of
+        the newest chunk that does. Until then the slot is also quarantined
+        against re-admission: a chunk dispatched before the slot was freed
+        still writes its KV region / pages, and a new occupant would receive
+        the dead request's leftover tokens at that chunk's retire."""
+        barrier = self._pipeline_barrier(slot)
+        if barrier is not None:
+            self._quarantine[slot] = barrier
+            return
         if self.paged_cache is not None:
             self.paged_cache.pool.free(slot)
 
-    def _handle_step_failure(self, ex: BaseException, epoch: int) -> None:
+    def _release_quarantine(self, retired_seq: int) -> None:
+        """Retire point: slots whose barrier has passed become reusable and
+        their deferred page frees execute (loop-thread only)."""
+        for slot, barrier in list(self._quarantine.items()):
+            if barrier <= retired_seq:
+                del self._quarantine[slot]
+                if (
+                    self.paged_cache is not None
+                    and self._slot_req[slot] is None
+                    and slot not in self._admitting
+                ):
+                    self.paged_cache.pool.free(slot)
+
+    async def _discard_pipeline(self) -> None:
+        """Drop every in-flight chunk and the device-resident chains
+        (watchdog recovery / batch-wide step failure: the queued results are
+        stale or poisoned). Deferred frees execute now — after waiting out
+        the discarded chunks' DEVICE work: an async-dispatched chunk may
+        still be writing its slots' pages, and freeing them under that
+        write would hand corrupted pages to the next admission (the same
+        hazard the quarantine barrier covers on the normal path). The wait
+        runs in a worker thread — blocking the event loop on a wedged
+        device would freeze /ready, admissions and the watchdog itself.
+        The host mirrors become the source of truth for the next dispatch."""
+        dropped = list(self._inflight)
+        self._inflight.clear()
+        pending = list(self._quarantine)
+        self._quarantine.clear()
+        self._reset_device_chains()
+        if self.paged_cache is not None and dropped:
+            await asyncio.to_thread(self._wait_chunks, dropped)
+        for slot in pending:
+            if (
+                self.paged_cache is not None
+                and self._slot_req[slot] is None
+                and slot not in self._admitting
+            ):
+                self.paged_cache.pool.free(slot)
+
+    @staticmethod
+    def _wait_chunks(entries) -> None:
+        """Worker-thread wait for discarded chunks' device programs (their
+        pool writes complete with the same program that produces tokens)."""
+        for entry in entries:
+            try:
+                if entry.chunk is not None:
+                    jax.block_until_ready(entry.chunk)
+            except Exception:
+                pass  # failed execution: nothing more will be written
+
+    def _reset_device_chains(self) -> None:
+        """Forget the device-resident token/DFA chains; the next dispatch
+        re-uploads from the host mirrors."""
+        self._next_token_dev = None
+        self._gstate_dev = None
+        self._slot_overrides[:] = False
+
+    async def _handle_step_failure(self, ex: BaseException, epoch: int) -> None:
         """A decode dispatch raised. Fail the affected request(s) and keep
         the loop alive — one poisoned step must not kill the engine."""
         if epoch != self._recover_epoch:
             # the watchdog already failed this batch while the dispatch was
             # stuck; nothing left to fail — just reclaim
-            self._finish_recovery()
+            await self._finish_recovery()
             return
         if is_hbm_oom(ex):
             # device allocator poisoned: wrapping in a RequestError would
@@ -1765,8 +2050,10 @@ class LLMEngineCore:
                     break
             return
         # batch-wide failure: every in-flight request's device state is
-        # suspect — fail them all with a structured error, then reset what
-        # the failed dispatch may have consumed (donated buffers)
+        # suspect — discard the whole pipeline (queued chunks chain off the
+        # poisoned buffers), fail all requests with a structured error, then
+        # reset what the failed dispatch may have consumed (donated buffers)
+        await self._discard_pipeline()
         err = EngineStepError("decode step failed: {}".format(ex))
         for slot, request in enumerate(self._slot_req):
             if request is not None:
@@ -2240,6 +2527,12 @@ class LLMEngineCore:
             self._slot_guided_key[slot] = request._guided_key
             request._guided_key = None
             self._gstate[slot] = request._gstate0
+        # fresh per-slot config: invalidate the cached device constants and
+        # mark the slot so the next dispatch merges the host value into the
+        # device-chained token/DFA vectors
+        self._sampling_dev = None
+        self._extras_dev = None
+        self._slot_overrides[slot] = True
         has_extras = self._request_has_extras(request)
         self._slot_extra[slot] = has_extras
         if has_extras or self._counts_dev is not None:
@@ -2344,8 +2637,7 @@ class LLMEngineCore:
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
             self._release_guided(slot)
-            if self.paged_cache is not None:
-                self.paged_cache.pool.free(slot)
+            self._free_slot_pages(slot)
             return
         if (
             request._deadline is not None
@@ -2382,16 +2674,16 @@ class LLMEngineCore:
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
             self._release_guided(slot)
-            if self.paged_cache is not None:
-                try:
-                    # chaos seam: an injected raise here models a teardown
-                    # bug that loses the slot's page references — the armed
-                    # KV sanitizer must then fail the drain check, naming
-                    # the leaked pages (tests/test_chaos.py)
+            try:
+                # chaos seam: an injected raise here models a teardown
+                # bug that loses the slot's page references — the armed
+                # KV sanitizer must then fail the drain check, naming
+                # the leaked pages (tests/test_chaos.py)
+                if self.paged_cache is not None:
                     faults.fire("engine.release", request=request)
-                    self.paged_cache.pool.free(slot)  # recycle the slot's pages
-                except faults.InjectedFault:
-                    pass
+                self._free_slot_pages(slot)  # recycle (or quarantine) pages
+            except faults.InjectedFault:
+                pass
 
     def _drain_ready(self, err: BaseException) -> None:
         """Fail every completed-but-uncommitted admission (loop is exiting)."""
@@ -2577,86 +2869,6 @@ class LLMEngineCore:
             pool.truncate(slot, int(lengths0[slot]) + int(appended[slot]))
         return gs_np, accs_np, np.asarray(pending), lp_np
 
-    def _run_paged_chunk(self, active_mask: np.ndarray, sampling,
-                         want_lp: bool = False):
-        """One fused paged-decode chunk (blocking device work; runs in a
-        worker thread). Pre-allocates each active slot's pages for the whole
-        chunk host-side, hands the per-step write coordinates to the scan.
-
-        Returns (chunk tokens [B, n], exhausted_slots): slots whose page
-        allocation failed are excluded from this chunk (their writes hit the
-        null page and their tokens are discarded) and reported back so the
-        loop can fail ONLY those requests — one sequence hitting pool
-        capacity must not take the engine down."""
-        if faults.active():
-            faults.fire(
-                "engine.decode.stall",
-                requests=[r for r in self._slot_req if r is not None],
-            )
-        pool = self.paged_cache.pool
-        n = self.decode_steps
-        lengths0 = pool.lengths().copy()          # pre-extension lengths
-        write_pages = np.zeros((self.max_batch, n), np.int32)   # null page 0
-        write_offsets = np.zeros((self.max_batch, n), np.int32)
-        exhausted = []
-        for slot in np.nonzero(active_mask)[0]:
-            slot = int(slot)
-            start = pool.slot_length(slot)
-            try:
-                pool.extend(slot, n)
-            except MemoryError:
-                exhausted.append(slot)
-                active_mask[slot] = False
-                continue
-            for i, (page, offset) in enumerate(pool.token_coords(slot, start, n)):
-                write_pages[slot, i] = page
-                write_offsets[slot, i] = offset
-        # copy-on-write: extends may have swapped a shared tail page for a
-        # private one; its contents must be duplicated before this chunk's
-        # writes land in it
-        self.paged_cache.apply_pending_cow()
-        page_table = pool.page_table(self._pages_per_seq)
-        use_extras = self._extras_active(active_mask)
-        use_guided = bool(np.any(self._gstate[active_mask] >= 0))
-        gtables = self._guided_device_tables() if use_guided else None
-        # dispatch under the pool lock: admission workers concurrently
-        # enqueue prefix-page gathers against the same (here donated) pools
-        with self.paged_cache.dispatch_lock:
-            (
-                chunk,
-                self.paged_cache.k,
-                self.paged_cache.v,
-                new_counts,
-                lp,
-                gstate_out,
-            ) = self._decode_paged_chunk_jit(
-                self.params,
-                jnp.asarray(self._next_token),
-                self.paged_cache.k,
-                self.paged_cache.v,
-                jnp.asarray(page_table),
-                jnp.asarray(lengths0),
-                jnp.asarray(write_pages),
-                jnp.asarray(write_offsets),
-                sampling,
-                self._next_rng(),
-                jnp.asarray(self._lora_slots) if self._lora_enabled else None,
-                self._batch_extras() if use_extras else None,
-                self._counts_dev if use_extras else None,
-                self._pmask_dev if use_extras else None,
-                gtables,
-                jnp.asarray(self._gstate) if gtables is not None else None,
-                want_lp=want_lp,
-            )
-        if use_extras:
-            self._counts_dev = new_counts
-        if gtables is not None:
-            # np.array (copy): asarray would alias the immutable device
-            # buffer and commit/release paths write rows in place
-            self._gstate = np.array(gstate_out)
-        lp_np = tuple(np.asarray(a) for a in lp) if lp is not None else None
-        return np.asarray(chunk), exhausted, lp_np
-
     async def _run_loop(self) -> None:
         try:
             await self._run_loop_inner()
@@ -2673,6 +2885,19 @@ class LLMEngineCore:
                 # (popped from _pending before stop drained it)
                 self._fail_all(EngineUnavailableError("engine stopped"))
                 self._drain_ready(EngineUnavailableError("engine stopped"))
+            # loop exit: the pipeline dies with the loop — no retire will
+            # ever run, so drop the queue and its deferred frees here,
+            # waiting out still-executing chunks off-thread before their
+            # pages recycle (skipped on hard cancellation = teardown)
+            dropped = list(self._inflight)
+            self._inflight.clear()
+            self._quarantine.clear()
+            self._reset_device_chains()
+            if self.paged_cache is not None and dropped:
+                try:
+                    await asyncio.to_thread(self._wait_chunks, dropped)
+                except BaseException:
+                    pass
             if self.paged_cache is not None:
                 # loop exit = no worker thread alive -> safe to reclaim every
                 # slot whose request was failed out without freeing its pages
@@ -2699,10 +2924,14 @@ class LLMEngineCore:
             # deadline sweep: queued requests expire where they wait
             self._expire_pending()
             # launch admissions for pending requests into reserved free slots
+            # (quarantined slots stay unavailable: an in-flight chunk still
+            # decodes their previous occupant — docs/pipelined_decode.md)
             free = [
                 i
                 for i, r in enumerate(self._slot_req)
-                if r is None and i not in self._admitting
+                if r is None
+                and i not in self._admitting
+                and i not in self._quarantine
             ]
             while free and not self._pending.empty():
                 request = self._pending.get_nowait()
@@ -2742,8 +2971,10 @@ class LLMEngineCore:
             active_mask = np.array([r is not None for r in self._slot_req])
             if self._prefill_gate is not None:
                 # open the gate while decode idles; pace prefills while active
-                self._prefill_gate.set_active(bool(active_mask.any()))
-            if not active_mask.any():
+                self._prefill_gate.set_active(
+                    bool(active_mask.any() or self._inflight)
+                )
+            if not active_mask.any() and not self._inflight:
                 if (
                     self._pending.empty()
                     and self._ready.empty()
@@ -2758,17 +2989,17 @@ class LLMEngineCore:
                 await self._wake.wait()
                 self._wake.clear()
                 continue
-            # one fused decode chunk over the whole slot batch, supervised:
-            # a dispatch exception fails only the affected request(s) and a
-            # watchdog trip (epoch bump) discards the stale results — the
-            # loop itself survives both and keeps serving
+            # pipelined decode over the whole slot batch, supervised: a
+            # dispatch/retire exception fails only the affected request(s)
+            # and a watchdog trip (epoch bump) discards the whole in-flight
+            # queue — the loop itself survives both and keeps serving
             step_epoch = self._recover_epoch
             try:
                 await self._decode_step(active_mask, step_epoch)
             except asyncio.CancelledError:
                 raise
             except Exception as ex:
-                self._handle_step_failure(ex, step_epoch)
+                await self._handle_step_failure(ex, step_epoch)
             # armed sanitizer: audit page accounting after every step —
             # including steps that just went through failure recovery, which
             # is exactly where reclamation bugs hide. A violation raises out
@@ -2776,168 +3007,506 @@ class LLMEngineCore:
             self._sanitize("decode-step")
             await asyncio.sleep(0)  # let HTTP handlers interleave
 
+
+    # -- pipelined decode: dispatch / retire ----------------------------------
+
     async def _decode_step(self, active_mask: np.ndarray, epoch: int) -> None:
-        """One fused decode chunk (spec / paged / dense) + emission. After
-        every dispatch the epoch is re-checked: a watchdog trip while the
-        device call was in flight means the batch was already failed — the
-        results are discarded and the freed state reclaimed."""
-        # reaching a dispatch IS progress: without this, a slow first-chunk
-        # jit compile would read as a stall and trip the watchdog spuriously
-        self._last_progress = time.monotonic()
-        if faults.active():
-            # chaos seam (loop thread, BEFORE any device dispatch, so a
-            # per-request poison never corrupts innocent slots' cache state)
-            faults.fire(
-                "engine.decode",
-                requests=[r for r in self._slot_req if r is not None],
-            )
-        want_lp = any(
-            self._slot_req[s] is not None
-            and self._slot_req[s].logprobs is not None
-            for s in np.nonzero(active_mask)[0]
-        )
-        sampling = SamplingParams(
-            temperature=jnp.asarray(self._temperature),
-            top_k=jnp.asarray(self._top_k),
-            top_p=jnp.asarray(self._top_p),
-        )
-        # speculate when at least one active slot is spec-eligible —
-        # greedy (exact argmax chain) or plain-sampled (rejection
-        # chain); remaining slots ride the same dispatch on the
-        # position-0 path (per-slot gating, VERDICT r3 #5)
+        """One pipelined scheduling step. The in-flight queue fills to
+        ``pipeline_depth - 1`` chunks, then every iteration OVERLAPS the
+        oldest chunk's retirement (device->host readback + token emission,
+        host work) with the next chunk's dispatch, which runs in a worker
+        thread — on backends whose dispatch is asynchronous (TPU) the
+        worker only enqueues; on backends that execute inline (current
+        XLA:CPU) the worker carries the device compute itself. Either way
+        chunk N's emission and chunk N+1's compute proceed concurrently,
+        and the cross-chunk token dependency stays device-resident. At
+        depth 1 this degenerates to the historical serial
+        dispatch->sync->emit loop.
+
+        Speculative chunks already amortize dispatch over k+1 verify
+        positions and stay serial; they drain the pipeline first so the
+        host-side token history they feed from is fully retired."""
         spec_masks = (
             self._spec_eligible_mask(active_mask)
-            if self._speculation
+            if self._speculation and active_mask.any()
             else None
         )
         if spec_masks is not None and bool(
             spec_masks[0].any() or spec_masks[1].any()
         ):
-            spec_mask, sspec_mask = spec_masks
-            # draft-and-verify rounds: device work off-loop, emission on
-            # the loop thread like the plain path
-            if self.cache_mode == "paged":
-                res = await asyncio.to_thread(
-                    self._dispatch_spec_paged_chunk,
-                    active_mask, spec_mask, sspec_mask, sampling,
-                    want_lp,
-                )
-            else:
-                res = await asyncio.to_thread(
-                    self._dispatch_spec_chunk,
-                    active_mask, spec_mask, sspec_mask, sampling,
-                    want_lp,
-                )
-            if epoch != self._recover_epoch:
-                self._finish_recovery()
+            if self._inflight:
+                # drain one chunk per step; commits keep landing between
+                # steps at the loop top, as at any retire boundary
+                await self._retire_oldest()
                 return
-            if res is not None:
-                gs, accs, pending, lp_np = res
-                for r in range(gs.shape[0]):
-                    for slot in np.nonzero(active_mask)[0]:
-                        slot = int(slot)
-                        for i in range(int(accs[r, slot]) + 1):
-                            entry = None
-                            if (
-                                lp_np is not None
-                                and i == 0
-                                and not spec_mask[slot]
-                                and not sspec_mask[slot]
-                            ):
-                                chosen, top_id, top_lp = lp_np
-                                entry = {
-                                    "id": int(gs[r, slot, 0]),
-                                    "logprob": float(chosen[r, slot]),
-                                    "top_ids": top_id[r, slot].tolist(),
-                                    "top_logprobs": top_lp[r, slot].tolist(),
-                                }
-                            self._emit(slot, int(gs[r, slot, i]), entry)
-                for slot in np.nonzero(active_mask)[0]:
-                    self._next_token[slot] = int(pending[slot])
-                if self._prefill_gate is not None:
-                    self._prefill_gate.deposit()
-                self._last_progress = time.monotonic()
-                return
-            # paged pool couldn't hold the speculative over-allocation:
-            # fall through to the plain paged chunk for this iteration
-        if self.cache_mode == "paged":
-            chunk_np, exhausted, lp_np = await asyncio.to_thread(
-                self._run_paged_chunk, active_mask, sampling, want_lp
+            self._reset_device_chains()
+            await self._spec_step(active_mask, spec_masks, epoch)
+            return
+        # fill: depth-1 keeps exactly one dispatch outstanding; deeper
+        # pipelines keep depth-1 chunks queued ahead of the retire stage
+        fill_target = max(1, self.pipeline_depth - 1)
+        dispatch_mask = self._dispatchable_mask(active_mask)
+        while dispatch_mask.any() and len(self._inflight) < fill_target:
+            await self._dispatch_or_recover(dispatch_mask.copy(), epoch)
+            # a dispatch can fail slots host-side (paged pool exhaustion):
+            # drop them from the mask before topping up further
+            active_mask &= np.array([r is not None for r in self._slot_req])
+            dispatch_mask = self._dispatchable_mask(active_mask)
+        if not self._inflight:
+            return
+        # the retiring chunk stays in the deque until its emissions land:
+        # the concurrent dispatch's prep must still count its undelivered
+        # steps (seeded-sampling counters, predictable-finish masking)
+        entry = self._inflight[0]
+        if dispatch_mask.any() and len(self._inflight) < self.pipeline_depth:
+            # steady state: dispatch chunk N+1 (worker thread) while chunk
+            # N retires (loop thread + readback worker) — the overlap that
+            # hides the per-chunk host work behind device compute
+            dispatch_res, retire_res = await asyncio.gather(
+                self._dispatch_async(dispatch_mask.copy(), epoch),
+                self._retire_chunk(entry),
+                return_exceptions=True,
             )
-            if epoch != self._recover_epoch:
-                self._finish_recovery()
-                return
-            for slot in exhausted:
-                self._fail_slot(
-                    slot, MemoryError("kv page pool exhausted for this sequence")
-                )
+            if self._inflight and self._inflight[0] is entry:
+                self._inflight.popleft()
+            # surface failures AFTER both stages settled (no orphaned
+            # worker mutating engine state during recovery). A retire
+            # failure reaching here is batch-wide (per-request retire
+            # faults are isolated inside _retire_chunk) and outranks a
+            # dispatch error: chunk N's tokens are lost for EVERY stream,
+            # so the batch-wide reset must run even when the dispatch also
+            # failed — raising only the dispatch error would silently skip
+            # decode_steps tokens for the surviving requests.
+            if isinstance(retire_res, BaseException):
+                raise retire_res
+            if isinstance(dispatch_res, BaseException):
+                await self._recover_failed_dispatch()
+                raise dispatch_res
         else:
-            use_extras = self._extras_active(active_mask)
-            use_guided = bool(np.any(self._gstate[active_mask] >= 0))
-            gtables = self._guided_device_tables() if use_guided else None
-            chunk, self.cache, new_counts, lp, gstate_out = self._decode_chunk_jit(
-                self.params,
-                jnp.asarray(self._next_token),
-                self.cache,
-                jnp.asarray(active_mask),
-                sampling,
-                self._next_rng(),
-                jnp.asarray(self._lora_slots) if self._lora_enabled else None,
-                self._batch_extras() if use_extras else None,
-                self._counts_dev if use_extras else None,
-                self._pmask_dev if use_extras else None,
-                gtables,
-                jnp.asarray(self._gstate) if gtables is not None else None,
-                want_lp=want_lp,
+            await self._retire_oldest()
+
+    async def _dispatch_or_recover(self, mask: np.ndarray, epoch: int) -> None:
+        """Dispatch with failure recovery, for call sites where no retire
+        runs concurrently (the gather branch recovers after both settle)."""
+        try:
+            await self._dispatch_async(mask, epoch)
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            await self._recover_failed_dispatch()
+            raise
+
+    async def _recover_failed_dispatch(self) -> None:
+        """A dispatch raised after its prep consumed the commit overrides
+        and advanced the RNG, but no chunk landed: retire whatever is still
+        in flight (their results are valid — the failure happened before or
+        instead of a new device program) so the host mirrors are current,
+        then forget the device chains so the next dispatch re-uploads from
+        them. Without this, a poisoned dispatch would leave an innocent
+        freshly-committed slot chaining a stale token."""
+        while self._inflight:
+            await self._retire_oldest()
+        self._reset_device_chains()
+
+    async def _retire_oldest(self) -> None:
+        """Retire the oldest in-flight chunk; it leaves the queue only once
+        its emissions landed (recovery may clear the queue mid-retire)."""
+        entry = self._inflight[0]
+        await self._retire_chunk(entry)
+        if self._inflight and self._inflight[0] is entry:
+            self._inflight.popleft()
+
+    def _dispatchable_mask(self, active_mask: np.ndarray) -> np.ndarray:
+        """Slots worth including in the NEXT chunk: active, and not already
+        guaranteed to finish inside the chunks in flight. A request whose
+        remaining max_new_tokens budget is covered by undelivered in-flight
+        steps will be freed at an earlier retire — dispatching more compute
+        for it is certain waste (stop-token finishes stay unpredictable and
+        may still overshoot by design; their surplus tokens are dropped)."""
+        if not self._inflight and self._dispatching is None:
+            return active_mask
+        pending_steps = np.zeros(self.max_batch, np.int64)
+        for entry in self._inflight:
+            pending_steps += entry.active_mask * self.decode_steps
+        if self._dispatching is not None:
+            pending_steps += self._dispatching[1] * self.decode_steps
+        mask = active_mask.copy()
+        for slot in np.nonzero(active_mask)[0]:
+            request = self._slot_req[slot]
+            if request is not None and (
+                request.produced + pending_steps[slot]
+                >= request.max_new_tokens
+            ):
+                mask[slot] = False
+        return mask
+
+    async def _dispatch_async(self, active_mask: np.ndarray, epoch: int) -> None:
+        """Dispatch one chunk: shared host state is snapshotted on the loop
+        thread (_prepare_dispatch), then the device call runs in a worker
+        thread, possibly concurrently with the previous chunk's retirement.
+        Appends the in-flight entry and fails pool-exhausted slots."""
+        prep = self._prepare_dispatch(active_mask, epoch)
+        # barrier visibility: a slot freed by the concurrent retire stage
+        # must see this chunk before its entry lands in the queue. The
+        # timestamp bounds the watchdog's compile-tolerance grace.
+        self._dispatching = (prep["seq"], active_mask, time.monotonic())
+        try:
+            entry = await asyncio.to_thread(self._dispatch_device, prep)
+        finally:
+            self._dispatching = None
+        if entry.epoch != self._recover_epoch:
+            # the watchdog tripped while this chunk was being dispatched:
+            # it was failed wholesale. Queue the entry so the discard path
+            # waits out ITS device writes too, then reclaim.
+            self._inflight.append(entry)
+            await self._finish_recovery()
+            return
+        self._inflight.append(entry)
+        for slot in entry.exhausted:
+            self._fail_slot(
+                slot, MemoryError("kv page pool exhausted for this sequence")
+            )
+
+    def _prepare_dispatch(self, active_mask: np.ndarray, epoch: int) -> dict:
+        """Loop-thread half of a dispatch: snapshot every piece of shared
+        host state the device call needs (slot table reads, device-constant
+        caches, the chained token/DFA inputs, the RNG draw) so the worker
+        thread never races the concurrently-running retire stage."""
+        self._last_progress = time.monotonic()
+        want_lp = any(
+            self._slot_req[s] is not None
+            and self._slot_req[s].logprobs is not None
+            for s in np.nonzero(active_mask)[0]
+        )
+        use_extras = self._extras_active(active_mask)
+        use_guided = bool(np.any(self._gstate[active_mask] >= 0))
+        gtables = self._guided_device_tables() if use_guided else None
+        tokens = self._chain_input(self._next_token_dev, self._next_token)
+        gstate_in = (
+            self._chain_input(self._gstate_dev, self._gstate)
+            if gtables is not None
+            else None
+        )
+        self._slot_overrides[:] = False
+        self._dispatch_seq += 1
+        return {
+            "seq": self._dispatch_seq,
+            "epoch": epoch,
+            "active_mask": active_mask,
+            # copy: paged pool exhaustion mutates active_mask after this
+            # (a zero-copy alias would flip the device value under the jit)
+            "active_dev": jnp.asarray(active_mask.copy()),
+            "want_lp": want_lp,
+            "use_extras": use_extras,
+            "sampling": self._batch_sampling(),
+            "extras": self._batch_extras() if use_extras else None,
+            "gtables": gtables,
+            "gstate_in": gstate_in,
+            "tokens": tokens,
+            "rng": self._next_rng(),
+            "lora": (
+                jnp.asarray(self._lora_slots.copy())
+                if self._lora_enabled
+                else None
+            ),
+            "requests": [r for r in self._slot_req if r is not None],
+        }
+
+    def _dispatch_device(self, prep: dict) -> "_InFlightChunk":
+        """Worker-thread half of a dispatch: the device program call (plus,
+        on the paged backend, the host page allocation it needs). Only
+        touches state the retire stage never reads: the cache/pool handles,
+        the device-resident chains, and the dispatch histogram."""
+        t0 = time.perf_counter()
+        if faults.active():
+            # chaos seam (BEFORE any device dispatch, so a per-request
+            # poison never corrupts innocent slots' cache state)
+            faults.fire("engine.decode", requests=prep["requests"])
+        active_mask = prep["active_mask"]
+        use_extras = prep["use_extras"]
+        gtables = prep["gtables"]
+        want_lp = prep["want_lp"]
+        exhausted: List[int] = []
+        if self.cache_mode == "paged":
+            chunk, lp, gstate_out = self._dispatch_paged(prep, exhausted)
+        else:
+            chunk, self.cache, new_counts, lp, gstate_out = (
+                self._decode_chunk_jit(
+                    self.params,
+                    prep["tokens"],
+                    self.cache,
+                    prep["active_dev"],
+                    prep["sampling"],
+                    prep["rng"],
+                    prep["lora"],
+                    prep["extras"],
+                    self._counts_dev if use_extras else None,
+                    self._pmask_dev if use_extras else None,
+                    gtables,
+                    prep["gstate_in"],
+                    want_lp=want_lp,
+                )
             )
             if use_extras:
                 self._counts_dev = new_counts
-            # the jit call above blocks the loop thread through any compile;
-            # the watchdog only observes the gap at the await below — mark
-            # progress so compile time is not mistaken for a stall
-            self._last_progress = time.monotonic()
+        # device-resident chaining: the NEXT dispatch reads these without
+        # any host sync (chunk[:, -1] is a lazy slice of the pending output)
+        self._next_token_dev = chunk[:, -1]
+        self._gstate_dev = gstate_out if gtables is not None else None
+        self._last_progress = time.monotonic()
+        self._hist_dispatch.observe((time.perf_counter() - t0) * 1e3)
+        return _InFlightChunk(
+            seq=prep["seq"],
+            epoch=prep["epoch"],
+            active_mask=active_mask,
+            chunk=chunk,
+            gstate=gstate_out if gtables is not None else None,
+            lp=lp,
+            want_lp=want_lp,
+            dispatched_at=t0,
+            exhausted=exhausted,
+        )
 
-            # device sync off-loop (gstate readback included — a
-            # blocking np.array here would stall SSE flushes and
-            # admissions for the whole chunk)
-            def _sync_chunk():
-                if faults.active():
-                    # worker-thread stall seam: wedges THIS dispatch without
-                    # blocking the event loop, so the watchdog can observe it
-                    faults.fire(
-                        "engine.decode.stall",
-                        requests=[r for r in self._slot_req if r is not None],
-                    )
-                return (
-                    np.asarray(chunk),
-                    np.array(gstate_out) if gtables is not None else None,
-                )
-
-            chunk_np, gstate_np = await asyncio.to_thread(_sync_chunk)
-            if epoch != self._recover_epoch:
-                self._finish_recovery()
-                return
-            if gstate_np is not None:
-                self._gstate = gstate_np
-            lp_np = (
-                tuple(np.asarray(a) for a in lp) if lp is not None else None
+    def _dispatch_paged(self, prep: dict, exhausted: List[int]):
+        """Paged half of a chunk dispatch (worker thread). Pre-allocates
+        each active slot's pages for the whole chunk host-side and hands
+        the per-step write coordinates to the scan. Slots whose allocation
+        fails are dropped from the chunk (their device rows write the null
+        page; their tokens are discarded at retire) and reported through
+        ``exhausted`` for the loop thread to fail — one sequence hitting
+        pool capacity must not take the engine down."""
+        active_mask = prep["active_mask"]
+        pool = self.paged_cache.pool
+        n = self.decode_steps
+        lengths0 = pool.lengths().copy()          # pre-extension lengths
+        write_pages = np.zeros((self.max_batch, n), np.int32)   # null page 0
+        write_offsets = np.zeros((self.max_batch, n), np.int32)
+        for slot in np.nonzero(active_mask)[0]:
+            slot = int(slot)
+            start = pool.slot_length(slot)
+            try:
+                pool.extend(slot, n)
+            except MemoryError:
+                active_mask[slot] = False
+                exhausted.append(slot)
+                continue
+            for i, (page, offset) in enumerate(pool.token_coords(slot, start, n)):
+                write_pages[slot, i] = page
+                write_offsets[slot, i] = offset
+        # copy-on-write: extends may have swapped a shared tail page for a
+        # private one; its contents must be duplicated before this chunk's
+        # writes land in it (the copy consumes the in-flight chunk's output
+        # pool handle, so ordering holds by data dependency)
+        self.paged_cache.apply_pending_cow()
+        page_table = pool.page_table(self._pages_per_seq)
+        use_extras = prep["use_extras"]
+        # dispatch under the pool lock: admission workers concurrently
+        # enqueue prefix-page gathers against the same (here donated) pools
+        with self.paged_cache.dispatch_lock:
+            (
+                chunk,
+                self.paged_cache.k,
+                self.paged_cache.v,
+                new_counts,
+                lp,
+                gstate_out,
+            ) = self._decode_paged_chunk_jit(
+                self.params,
+                prep["tokens"],
+                self.paged_cache.k,
+                self.paged_cache.v,
+                jnp.asarray(page_table),
+                jnp.asarray(lengths0),
+                jnp.asarray(write_pages),
+                jnp.asarray(write_offsets),
+                prep["sampling"],
+                prep["rng"],
+                prep["lora"],
+                prep["extras"],
+                self._counts_dev if use_extras else None,
+                self._pmask_dev if use_extras else None,
+                prep["gtables"],
+                prep["gstate_in"],
+                want_lp=prep["want_lp"],
             )
+        if use_extras:
+            self._counts_dev = new_counts
+        return chunk, lp, gstate_out
+
+    def _chain_input(self, dev, host_vec):
+        """Next chunk's [B] input vector: chained from the previous chunk's
+        device output when possible (no host->device upload), with host
+        overrides (slots committed since the last dispatch) merged in.
+
+        Host buffers are snapshot-COPIED before upload: jnp.asarray of a
+        suitably-aligned numpy array is zero-copy on CPU, and these buffers
+        are mutated in place (retire writebacks, commits) while the
+        async-dispatched merge may not have read them yet — an alias there
+        is a rare wrong-token race, observed in the A/B harness."""
+        if dev is None:
+            return jnp.asarray(host_vec.copy())
+        if self._slot_overrides.any():
+            return self._merge_rows_jit(
+                dev,
+                jnp.asarray(host_vec.copy()),
+                jnp.asarray(self._slot_overrides.copy()),
+            )
+        return dev
+
+    async def _retire_chunk(self, entry: "_InFlightChunk") -> None:
+        """Device->host readback + token emission for the OLDEST in-flight
+        chunk, running while the next chunk computes. Every anchor point of
+        the old serial loop re-lands here: slot frees / EOS handling,
+        prefill-gate deposits, the watchdog-epoch check, the quarantine
+        release, and (via the caller) the sanitizer audit — admission
+        commits follow at the next loop top."""
+
+        def _sync():
+            if faults.active():
+                # worker-thread stall seam: wedges THIS retire without
+                # blocking the event loop, so the watchdog can observe it
+                faults.fire(
+                    "engine.decode.stall",
+                    requests=[r for r in self._slot_req if r is not None],
+                )
+            chunk_np = np.asarray(entry.chunk)
+            # np.array (copy): asarray would alias the immutable device
+            # buffer and commit/release paths write rows in place
+            gstate_np = (
+                np.array(entry.gstate) if entry.gstate is not None else None
+            )
+            lp_np = (
+                tuple(np.asarray(a) for a in entry.lp)
+                if entry.lp is not None
+                else None
+            )
+            return chunk_np, gstate_np, lp_np
+
+        t0 = time.perf_counter()
+        ready = getattr(entry.chunk, "is_ready", None)
+        if not faults.active() and ready is not None and ready():
+            # chunk already landed (device ran ahead): the copies are
+            # microseconds — skip the worker-thread hop entirely
+            chunk_np, gstate_np, lp_np = _sync()
+        else:
+            chunk_np, gstate_np, lp_np = await asyncio.to_thread(_sync)
+        if entry.epoch != self._recover_epoch:
+            # the watchdog failed this batch while the pipeline was in
+            # flight: every queued chunk is stale — discard them all and
+            # reclaim (epoch bump covers the whole in-flight queue).
+            # _finish_recovery defers itself while the concurrent dispatch
+            # leg is mid-worker; that leg completes recovery on landing.
+            await self._finish_recovery()
+            return
+        if faults.active():
+            try:
+                # chaos seam: a retire-stage failure (host emission path)
+                # with younger chunks possibly still in flight
+                faults.fire(
+                    "engine.decode.retire",
+                    requests=[r for r in self._slot_req if r is not None],
+                )
+            except faults.InjectedFault as ex:
+                if ex.request is None:
+                    raise  # batch-wide: loop-level step-failure handling
+                self.counters["step_failures"] += 1
+                for slot, request in enumerate(self._slot_req):
+                    if request is ex.request:
+                        self._fail_slot(
+                            slot,
+                            EngineStepError(
+                                "retire failed for this request: {}".format(ex)
+                            ),
+                        )
+                        break
+                # fall through: the rest of the chunk still emits
+        slots = [int(s) for s in np.nonzero(entry.active_mask)[0]]
+        for slot in slots:
+            # host mirrors re-anchor at retire (the device chain moved on
+            # at dispatch); slots committed after this chunk's dispatch are
+            # not in its mask, so fresh state is never clobbered
+            self._next_token[slot] = int(chunk_np[slot, -1])
+            if gstate_np is not None:
+                self._gstate[slot] = int(gstate_np[slot])
         if self._prefill_gate is not None:
             # decode chunk done: grant the next prefill-dispatch budget
             self._prefill_gate.deposit()
-        for slot in np.nonzero(active_mask)[0]:
-            self._next_token[slot] = int(chunk_np[slot, -1])
+        for slot in slots:
             for i, token_id in enumerate(chunk_np[slot]):
                 # _emit frees the slot on finish; the rest of the chunk for
                 # that slot is dropped by the None check inside _emit
-                entry = None
+                lp_entry = None
                 if lp_np is not None:
                     chosen, top_id, top_lp = lp_np
-                    entry = {
+                    lp_entry = {
                         "id": int(token_id),
                         "logprob": float(chosen[slot, i]),
                         "top_ids": top_id[slot, i].tolist(),
                         "top_logprobs": top_lp[slot, i].tolist(),
                     }
-                self._emit(int(slot), int(token_id), entry)
+                self._emit(slot, int(token_id), lp_entry)
+        self._release_quarantine(entry.seq)
         self._last_progress = time.monotonic()
+        self._hist_retire.observe((time.perf_counter() - t0) * 1e3)
+
+    async def _spec_step(self, active_mask: np.ndarray, spec_masks,
+                         epoch: int) -> None:
+        """Serial speculative step (draft-and-verify rounds); the pipeline
+        is already drained when this runs. Unchanged semantics from the
+        pre-pipelining loop."""
+        spec_mask, sspec_mask = spec_masks
+        want_lp = any(
+            self._slot_req[s] is not None
+            and self._slot_req[s].logprobs is not None
+            for s in np.nonzero(active_mask)[0]
+        )
+        sampling = self._batch_sampling()
+        # draft-and-verify rounds: device work off-loop, emission on
+        # the loop thread like the plain path
+        if self.cache_mode == "paged":
+            res = await asyncio.to_thread(
+                self._dispatch_spec_paged_chunk,
+                active_mask, spec_mask, sspec_mask, sampling,
+                want_lp,
+            )
+        else:
+            res = await asyncio.to_thread(
+                self._dispatch_spec_chunk,
+                active_mask, spec_mask, sspec_mask, sampling,
+                want_lp,
+            )
+        if epoch != self._recover_epoch:
+            await self._finish_recovery()
+            return
+        if res is not None:
+            gs, accs, pending, lp_np = res
+            for r in range(gs.shape[0]):
+                for slot in np.nonzero(active_mask)[0]:
+                    slot = int(slot)
+                    for i in range(int(accs[r, slot]) + 1):
+                        entry = None
+                        if (
+                            lp_np is not None
+                            and i == 0
+                            and not spec_mask[slot]
+                            and not sspec_mask[slot]
+                        ):
+                            chosen, top_id, top_lp = lp_np
+                            entry = {
+                                "id": int(gs[r, slot, 0]),
+                                "logprob": float(chosen[r, slot]),
+                                "top_ids": top_id[r, slot].tolist(),
+                                "top_logprobs": top_lp[r, slot].tolist(),
+                            }
+                        self._emit(slot, int(gs[r, slot, i]), entry)
+            for slot in np.nonzero(active_mask)[0]:
+                self._next_token[slot] = int(pending[slot])
+            if self._prefill_gate is not None:
+                self._prefill_gate.deposit()
+            self._last_progress = time.monotonic()
+            return
+        # paged pool couldn't hold the speculative over-allocation: run one
+        # plain (serial) chunk for this iteration instead
+        await self._dispatch_or_recover(active_mask.copy(), epoch)
+        if self._inflight:
+            await self._retire_oldest()

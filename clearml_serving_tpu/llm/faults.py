@@ -17,6 +17,12 @@ Known points (ctx carried with each):
                          device step (``requests`` = active GenRequests);
                          ``match_token`` poisons only the request whose
                          prompt contains that token; ``delay`` = stuck loop.
+- ``engine.decode.retire`` — on the loop thread at chunk retirement, after
+                         the device->host sync and before emission
+                         (``requests``); ``match_token`` fails only the
+                         matched request (the rest of the chunk still
+                         emits), an unmatched raise is a batch-wide retire
+                         failure. Younger chunks may still be in flight.
 - ``engine.admit``     — inside check_admission (``request``); a raise is
                          converted to a load-shed (429).
 - ``engine.pool``      — inside check_admission's KV-pool headroom check; a
@@ -59,6 +65,7 @@ KNOWN_POINTS = frozenset({
     "engine.prefill",
     "engine.decode",
     "engine.decode.stall",
+    "engine.decode.retire",
     "engine.admit",
     "engine.pool",
     "engine.release",

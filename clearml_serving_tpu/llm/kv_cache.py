@@ -338,7 +338,17 @@ class PagedKVCache:
     concurrently enqueue prefix-KV gathers and commit writes — without the
     lock a gather could grab a pool reference that a racing donating dispatch
     has already invalidated. Execution still overlaps; only the (cheap,
-    host-side) enqueue is serialized."""
+    host-side) enqueue is serialized.
+
+    Donation ordering under the pipelined decode loop
+    (docs/pipelined_decode.md): chained chunk dispatches rebind ``k``/``v``
+    to the PENDING outputs of the in-flight chunk, and every later program
+    (the next chunk, CoW copies, commit scatters, prefix gathers) consumes
+    those handles — device-side ordering holds by data dependency, never by
+    host-side waiting. Page FREES are the one thing data flow cannot order:
+    the engine defers a freed slot's ``pool.free`` to the retirement of the
+    newest chunk still writing it (the quarantine barrier), so a page is
+    never re-allocated under an in-flight write."""
 
     # pool-handle rebinds happen only under the dispatch lock (a donating
     # dispatch invalidates the old handle; tpuserve-analyze TPU301)
@@ -387,9 +397,19 @@ class PagedKVCache:
             )
             return jax.lax.dynamic_update_slice(pool, page, (0, 0, dst, 0, 0))
 
+        def _copy_pages(pool, srcs, dsts):
+            # batched CoW: all pending (src, dst) pairs in ONE donated
+            # gather/scatter — the pipelined decode loop applies CoW on the
+            # dispatch path, so per-pair dispatches would put 4 host->device
+            # program launches per shared-tail slot between chunks. Pair
+            # lists pad to (0, 0): writing the reserved null page onto
+            # itself is a no-op by construction.
+            return pool.at[:, :, dsts].set(pool[:, :, srcs])
+
         self._write_pages = jax.jit(_write_pages, donate_argnums=(0,))
         self._write_token = jax.jit(_write_token, donate_argnums=(0,))
         self._copy_page = jax.jit(_copy_page, donate_argnums=(0,))
+        self._copy_pages = jax.jit(_copy_pages, donate_argnums=(0,))
 
     def layer(self, li: int):
         """Per-layer head-major views for ops.paged_attention."""
@@ -401,19 +421,29 @@ class PagedKVCache:
     def apply_pending_cow(self) -> int:
         """Perform the device copies for any host-side copy-on-write page
         swaps (PagePool.extend). MUST run after extending slots and before
-        the writes of the extension land. Returns the number of pages
-        copied."""
+        the writes of the extension land — with pipelined decode this sits
+        on the dispatch path between chained chunks, and ordering holds by
+        data dependency: the copy consumes the in-flight chunk's output
+        pool handle, so it reads post-chunk page contents. Returns the
+        number of pages copied.
+
+        All pending pairs are applied in ONE donated program per pool side
+        (pair count padded to a power-of-two bucket with null-page no-ops,
+        so traces stay bounded)."""
         import jax.numpy as jnp
 
         pairs = self.pool.drain_pending_cow()
         if not pairs:
             return 0
+        bucket = 1
+        while bucket < len(pairs):
+            bucket *= 2
+        padded = pairs + [(0, 0)] * (bucket - len(pairs))
+        srcs = jnp.asarray([s for s, _ in padded], jnp.int32)
+        dsts = jnp.asarray([d for _, d in padded], jnp.int32)
         with self.dispatch_lock:
-            for src, dst in pairs:
-                s = jnp.asarray(src, jnp.int32)
-                d = jnp.asarray(dst, jnp.int32)
-                self.k = self._copy_page(self.k, s, d)
-                self.v = self._copy_page(self.v, s, d)
+            self.k = self._copy_pages(self.k, srcs, dsts)
+            self.v = self._copy_pages(self.v, srcs, dsts)
         return len(pairs)
 
     def _scatter_pages(self, pages: List[int], k_stack, v_stack) -> None:

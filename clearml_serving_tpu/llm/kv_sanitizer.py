@@ -83,9 +83,19 @@ class KVSanitizer:
 
     # -- checks ------------------------------------------------------------
 
-    def check(self, where: str = "step", drained: bool = False) -> None:
-        """Raise KVSanitizerError on the first violated invariant."""
+    def check(self, where: str = "step", drained: bool = False,
+              inflight: int = 0) -> None:
+        """Raise KVSanitizerError on the first violated invariant.
+
+        ``inflight``: decode chunks dispatched but not yet retired (the
+        pipelined engine, docs/pipelined_decode.md). Conservation and
+        free-list invariants hold at EVERY instant — in-flight chunks only
+        defer page frees, they never hide references — but the drain-time
+        "no slot holds pages" rule is meaningful only once the pipeline is
+        empty, so a drained audit with chunks still in flight downgrades to
+        a regular audit rather than misreporting deferred frees as leaks."""
         self.checks += 1
+        drained = drained and int(inflight) == 0
         cache_refs, snap = self._snapshot()
         refs: List[int] = snap["refs"]
         free: List[int] = snap["free"]

@@ -196,6 +196,13 @@ class LLMEngineRequest(BaseEngineRequest):
             spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
             spec_sampling=bool(engine_cfg.get("spec_sampling", True)),
             pipeline_chunk=int(engine_cfg.get("pipeline_chunk", 512)),
+            # decode-pipeline depth (docs/pipelined_decode.md): None defers
+            # to TPUSERVE_PIPELINE_DEPTH (default 2); 1 = serial decode
+            pipeline_depth=(
+                int(engine_cfg["pipeline_depth"])
+                if engine_cfg.get("pipeline_depth")
+                else None
+            ),
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),
             prefix_block=int(engine_cfg.get("prefix_block", 64)),

@@ -1031,7 +1031,11 @@ def build(config: dict) -> SimpleNamespace:
                 (0, jnp.clip(t, 0, m - 1) * chunk, 0),
                 (b, chunk, dim_model),
             )
-            x_in = jnp.concatenate([inj[None], x_buf[:-1]], axis=0)
+            # stage hop expressed as roll+set rather than concat of slices:
+            # concatenate along the pp-SHARDED stage axis has been observed
+            # to miscompile on XLA:CPU (wrong values, not just reordering) —
+            # roll lowers to a clean collective-permute on every backend
+            x_in = jnp.roll(x_buf, 1, axis=0).at[0].set(inj)
             cs = t - jnp.arange(stages, dtype=jnp.int32)         # [stages]
             x_out, kc_new, vc_new = jax.vmap(stage_apply)(
                 layers_st, x_in, kc, vc, cs

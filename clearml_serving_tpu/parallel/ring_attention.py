@@ -23,6 +23,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# jax moved shard_map out of experimental in newer releases and removed the
+# experimental alias; older jaxlibs (this image: 0.4.x) only have the
+# experimental one. Resolve once, newest spelling first.
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _stream_block(q, k, v, o, m, l, mask):
     """One flash-style accumulation step.
@@ -55,10 +62,12 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     b, s_q, h, d = q.shape
 
     # accumulators start as constants; mark them device-varying over the ring
-    # axis so the fori_loop carry type matches the body outputs (JAX vma rules)
-    o = lax.pvary(jnp.zeros((b, s_q, h, d), jnp.float32), axis_name)
-    m = lax.pvary(jnp.full((b, s_q, h), -jnp.inf, jnp.float32), axis_name)
-    l = lax.pvary(jnp.zeros((b, s_q, h), jnp.float32), axis_name)
+    # axis so the fori_loop carry type matches the body outputs (JAX vma
+    # rules). Older jax has no pvary (and no vma typing either) — identity.
+    pvary = getattr(lax, "pvary", lambda x, _axis: x)
+    o = pvary(jnp.zeros((b, s_q, h, d), jnp.float32), axis_name)
+    m = pvary(jnp.full((b, s_q, h), -jnp.inf, jnp.float32), axis_name)
+    l = pvary(jnp.zeros((b, s_q, h), jnp.float32), axis_name)
 
     causal_mask = jnp.where(
         jnp.tril(jnp.ones((s_q, s_q), dtype=bool)), 0.0, -jnp.inf
@@ -110,7 +119,7 @@ def ring_attention(
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -19,13 +19,33 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
-    """NamedSharding pytree matching a llama param pytree."""
+def llama_param_sharding(
+    mesh, params: Dict[str, Any], n_kv_heads: int = None, n_heads: int = None
+) -> Dict[str, Any]:
+    """NamedSharding pytree matching a llama param pytree.
+
+    ``n_kv_heads``/``n_heads`` (optional): when given, attention projections
+    shard over ``tp`` only if the head count divides evenly — a shard
+    boundary INSIDE a head would split the rotate-half RoPE halves across
+    chips (collectives inside rope, and an observed XLA:CPU miscompile of
+    concat-over-a-sharded-axis). Misaligned projections replicate instead.
+    """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
+
+    tp = int(dict(mesh.shape).get("tp", 1))
+
+    def head_tp(heads):
+        # None (caller didn't say) keeps the historical always-shard rule
+        if heads is None or tp <= 1 or int(heads) % tp == 0:
+            return "tp"
+        return None
+
+    q_tp = head_tp(n_heads)
+    kv_tp = head_tp(n_kv_heads)
 
     stacked = isinstance(params["layers"], dict)  # scan_layers: [L, ...] arrays
     # pp: shard the stacked layer dim — each chip stores L/pp layers and XLA
@@ -40,14 +60,14 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
 
     layer_spec = {
         "attn_norm": col(),
-        "wq": col(None, "tp"),
-        "wk": col(None, "tp"),
-        "wv": col(None, "tp"),
+        "wq": col(None, q_tp),
+        "wk": col(None, kv_tp),
+        "wv": col(None, kv_tp),
         # Qwen2-style QKV biases: 1-D over the tp-sharded output dim
-        "bq": col("tp"),
-        "bk": col("tp"),
-        "bv": col("tp"),
-        "wo": col("tp", None),
+        "bq": col(q_tp),
+        "bk": col(kv_tp),
+        "bv": col(kv_tp),
+        "wo": col(q_tp, None),
         "ffn_norm": col(),
         # Gemma-2 extras: post-sublayer norms replicate like the other
         # norms; the per-layer global/local flag is a scalar
@@ -67,10 +87,10 @@ def llama_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         # factor shards its output dim like the base weight (column-parallel
         # targets) and the A factor shards its input dim for the
         # row-parallel targets (wo/w_down); the rank dim never shards
-        "lora_a_wq": col(), "lora_b_wq": col(None, None, "tp"),
-        "lora_a_wk": col(), "lora_b_wk": col(None, None, "tp"),
-        "lora_a_wv": col(), "lora_b_wv": col(None, None, "tp"),
-        "lora_a_wo": col(None, "tp", None), "lora_b_wo": col(),
+        "lora_a_wq": col(), "lora_b_wq": col(None, None, q_tp),
+        "lora_a_wk": col(), "lora_b_wk": col(None, None, kv_tp),
+        "lora_a_wv": col(), "lora_b_wv": col(None, None, kv_tp),
+        "lora_a_wo": col(None, q_tp, None), "lora_b_wo": col(),
         "lora_a_w_gate": col(), "lora_b_w_gate": col(None, None, "tp"),
         "lora_a_w_up": col(), "lora_b_w_up": col(None, None, "tp"),
         "lora_a_w_down": col(None, "tp", None), "lora_b_w_down": col(),
@@ -129,7 +149,9 @@ def batch_sharding(mesh):
     return NamedSharding(mesh, P("dp"))
 
 
-def llama_quantized_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+def llama_quantized_param_sharding(
+    mesh, params: Dict[str, Any], n_kv_heads: int = None, n_heads: int = None
+) -> Dict[str, Any]:
     """NamedSharding pytree for a quantized llama tree (ops/quant.py layouts:
     int8 {"_q8": [..., in, out], "_scale": [..., 1, out]} or int4
     {"_q4": [..., in//2, out], "_scale4": [..., in//group, out]}).
@@ -144,7 +166,9 @@ def llama_quantized_param_sharding(mesh, params: Dict[str, Any]) -> Dict[str, An
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    base = llama_param_sharding(mesh, params)
+    base = llama_param_sharding(
+        mesh, params, n_kv_heads=n_kv_heads, n_heads=n_heads
+    )
 
     def _scale_spec(weight_sharding: "NamedSharding", ndim: int) -> "NamedSharding":
         spec = list(weight_sharding.spec)

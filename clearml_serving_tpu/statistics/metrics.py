@@ -258,12 +258,61 @@ class EngineLifecycleCollector:
             "engine-server gRPC attempts/retries/retry-budget exhaustions",
             labels=["model", "kind"],
         )
+        # pipelined-decode observability (docs/pipelined_decode.md): stage
+        # timing histograms + the live in-flight dispatch queue depth
+        from prometheus_client.core import HistogramMetricFamily
+
+        inflight = GaugeMetricFamily(
+            p + "_pipeline_inflight",
+            "decode chunks dispatched but not yet retired",
+            labels=["model"],
+        )
+        pipe_depth = GaugeMetricFamily(
+            p + "_pipeline_depth",
+            "configured decode pipeline depth (1 = serial)",
+            labels=["model"],
+        )
+        dispatch_ms = HistogramMetricFamily(
+            p + "_step_dispatch_ms",
+            "host time to enqueue one decode chunk (ms)",
+            labels=["model"],
+        )
+        retire_ms = HistogramMetricFamily(
+            p + "_step_retire_ms",
+            "host time to sync + emit one retired chunk (ms)",
+            labels=["model"],
+        )
+
+        def _hist_buckets(snap):
+            """Engine _MsHistogram snapshot -> prometheus cumulative
+            (le, count) pairs + sum."""
+            edges = [str(b) for b in snap.get("buckets", [])] + ["+Inf"]
+            cum, out = 0, []
+            for edge, count in zip(edges, snap.get("counts", [])):
+                cum += count
+                out.append((edge, cum))
+            return out, float(snap.get("sum_ms", 0.0))
+
         any_grpc = False
+        any_pipeline = False
         for key, provider in providers.items():
             try:
                 s = provider() or {}
             except Exception:
                 continue
+            pipe = s.get("pipeline") or {}
+            if pipe:
+                any_pipeline = True
+                if "inflight" in pipe:
+                    inflight.add_metric([key], pipe["inflight"])
+                if "depth" in pipe:
+                    pipe_depth.add_metric([key], pipe["depth"])
+                for fam, field in ((dispatch_ms, "dispatch_ms"),
+                                   (retire_ms, "retire_ms")):
+                    snap = pipe.get(field)
+                    if snap:
+                        buckets, total = _hist_buckets(snap)
+                        fam.add_metric([key], buckets, total)
             if "queue_depth" in s:
                 queue_depth.add_metric([key], s["queue_depth"])
             if "active_slots" in s:
@@ -288,6 +337,11 @@ class EngineLifecycleCollector:
         yield deadlines
         yield trips
         yield failures
+        if any_pipeline:
+            yield inflight
+            yield pipe_depth
+            yield dispatch_ms
+            yield retire_ms
         if any_grpc:
             yield grpc
 

@@ -246,6 +246,10 @@ def test_watchdog_trips_on_stalled_decode_and_recovers(parts):
         )
         await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
         assert engine.is_ready
+        # quiesce the pipelined loop before arming the one-shot stall: a
+        # leftover in-flight chunk's retire would burn the firing while the
+        # engine is idle (no active slots -> no watchdog trip)
+        await engine.wait_drained()
         faults.configure([
             {"point": "engine.decode.stall", "action": "delay",
              "delay": 1.2, "times": 1},
@@ -539,3 +543,149 @@ def test_grpc_injected_fault_exercises_retry_path(monkeypatch):
 
     out = asyncio.run(cli._call_with_retry(ok, b"r", timeout=1.0))
     assert out == b"fine" and len(calls) == 1
+
+
+# -- pipelined decode under chaos (docs/pipelined_decode.md) ------------------
+
+
+def test_watchdog_recovery_with_nonempty_inflight_queue(parts):
+    """Depth-2 pipeline, paged backend, several live requests: a stall at
+    the retire stage trips the watchdog WHILE a younger chunk is still in
+    flight. Recovery must discard the whole in-flight queue under the epoch
+    bump, execute the deferred (quarantined) frees, flip back to ready, and
+    keep page accounting balanced (armed sanitizer) — then serve again."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=2, watchdog_interval=0.3,
+            cache_mode="paged", page_size=4, pipeline_depth=2,
+            eos_token_id=None,  # victims must still be decoding at the stall
+        )
+        assert engine.pipeline_depth == 2
+        assert engine._sanitizer is not None
+        reqs = [
+            GenRequest(prompt_ids=[256, 1 + i], max_new_tokens=2)
+            for i in range(3)
+        ]
+        await asyncio.gather(*(_collect(engine, r) for r in reqs))
+        await engine.wait_drained()
+        victims = [
+            GenRequest(prompt_ids=[256, 40 + i], max_new_tokens=600)
+            for i in range(3)
+        ]
+        tasks = [asyncio.create_task(_collect(engine, v)) for v in victims]
+        # arm the stall only once every victim holds a slot — a victim
+        # still mid-admission at the trip would be committed afterwards
+        # and complete normally
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0 and not all(
+            v.produced >= 1 for v in victims
+        ):
+            await asyncio.sleep(0.01)
+        assert all(v.produced >= 1 for v in victims)
+        faults.configure([
+            {"point": "engine.decode.stall", "action": "delay",
+             "delay": 1.2, "times": 1},
+        ])
+        done, pending = await asyncio.wait(tasks, timeout=15.0)
+        assert not pending
+        errors = [t.exception() for t in tasks]
+        assert all(isinstance(e, EngineStuckError) for e in errors), errors
+        assert engine.counters["watchdog_trips"] >= 1
+        # the pipeline was discarded wholesale
+        t0 = time.monotonic()
+        while not engine.is_ready and time.monotonic() - t0 < 10.0:
+            await asyncio.sleep(0.01)
+        assert engine.is_ready
+        assert not engine._inflight and not engine._quarantine
+        # still serves, and page accounting balances through drain
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 9], max_new_tokens=3)
+        )
+        assert len(out) >= 1
+        await engine.wait_drained()
+        assert engine.paged_cache.pool.free_pages == (
+            engine.paged_cache.pool.num_pages - 1
+        )
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.health()["ready"]
+
+
+def test_retire_fault_isolates_matched_request(parts):
+    """An engine.decode.retire fault matched to one request fails ONLY that
+    request (EngineStepError); the rest of the chunk still emits, the other
+    requests complete, and the paged pool balances at drain."""
+    bundle, params = parts
+    marker = 301
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=2, cache_mode="paged", page_size=4,
+            pipeline_depth=2,
+            eos_token_id=None,  # exact token counts below
+        )
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        await engine.wait_drained()
+        faults.configure([
+            {"point": "engine.decode.retire", "match_token": marker,
+             "times": 1, "message": "retire blew up"},
+        ])
+        poisoned = GenRequest(prompt_ids=[256, marker], max_new_tokens=40)
+        healthy = GenRequest(prompt_ids=[256, 7], max_new_tokens=6)
+        p_task = asyncio.create_task(_collect(engine, poisoned))
+        h_task = asyncio.create_task(_collect(engine, healthy))
+        out_h = await asyncio.wait_for(h_task, timeout=30)
+        with pytest.raises(EngineStepError):
+            await asyncio.wait_for(p_task, timeout=30)
+        assert len(out_h) == 6, "healthy request must emit every token"
+        assert engine.counters["step_failures"] >= 1
+        await engine.wait_drained()
+        assert engine.paged_cache.pool.free_pages == (
+            engine.paged_cache.pool.num_pages - 1
+        )
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.is_ready
+
+
+def test_stop_with_chunks_in_flight_reclaims_pages(parts):
+    """stop() while the depth-2 pipeline holds undelivered chunks: every
+    consumer unblocks with EngineUnavailableError and the loop's exit path
+    reclaims all pages despite the dropped in-flight queue."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, decode_steps=2, cache_mode="paged", page_size=4,
+            pipeline_depth=2,
+            eos_token_id=None,  # long-runners must still be live at stop()
+        )
+        reqs = [
+            GenRequest(prompt_ids=[256, 20 + i], max_new_tokens=10_000)
+            for i in range(2)
+        ]
+        tasks = [asyncio.create_task(_collect(engine, r)) for r in reqs]
+        # let decode reach a pipelined steady state
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0 and not all(
+            r.produced > 2 for r in reqs
+        ):
+            await asyncio.sleep(0.01)
+        engine.stop()
+        for t in tasks:
+            with pytest.raises(EngineUnavailableError):
+                await asyncio.wait_for(t, timeout=15)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0 and not engine._loop_task.done():
+            await asyncio.sleep(0.01)
+        assert engine._loop_task.done()
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1
+        assert not engine._quarantine
+        return engine
+
+    asyncio.run(run())

@@ -848,6 +848,9 @@ def test_cancel_during_prefill_releases_prefix_pin(tiny_engine_parts):
         await _collect(engine, GenRequest(
             prompt_ids=system + [9, 8], max_new_tokens=3
         ))
+        # pipelined loop: the slot's deferred page free lands at the retire
+        # of the last in-flight chunk — sample the baseline at quiescence
+        await engine.wait_drained()
         free0, shared0 = pool.free_pages, pool.shared_pages
         faults.configure([
             {"point": "engine.prefill", "action": "delay", "delay": 0.3,
@@ -861,6 +864,7 @@ def test_cancel_during_prefill_releases_prefix_pin(tiny_engine_parts):
         b.cancel()
         out_b = await asyncio.wait_for(b_task, timeout=30)
         assert out_b == []
+        await engine.wait_drained()
         # pin released, no page leaked: pool refcounts back to baseline
         assert pool.free_pages == free0
         assert pool.shared_pages == shared0

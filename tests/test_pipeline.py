@@ -1,0 +1,187 @@
+"""Pipelined decode (docs/pipelined_decode.md).
+
+Depth-2 double-buffered chunk dispatch with device-resident token chaining
+must be BEHAVIOR-INVISIBLE next to the serial loop: byte-identical greedy
+(and seeded) token streams, correct slot reuse through the quarantine
+barrier, and clean page accounting at drain. These tests pin that contract
+plus the observability surface (in-flight gauge, stage histograms)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm.engine import (
+    GenRequest,
+    LLMEngineCore,
+    _InFlightChunk,
+)
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+def _make(bundle, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", [16, 32])
+    kw.setdefault("eos_token_id", 257)
+    kw.setdefault("decode_steps", 4)
+    return LLMEngineCore(bundle, params, **kw)
+
+
+def _run_group(engine, prompts, **req_kw):
+    """Submit all prompts concurrently, return per-prompt token streams
+    (ordered by prompt index), then wait for full drain so page accounting
+    is final."""
+
+    async def go():
+        async def one(ids):
+            req = GenRequest(prompt_ids=list(ids), **req_kw)
+            return [t async for t in engine.generate(req)]
+
+        outs = await asyncio.gather(*(one(p) for p in prompts))
+        await engine.wait_drained()
+        return outs
+
+    return asyncio.run(go())
+
+
+_PROMPTS = [
+    [256] + [(7 * i + 3 * j) % 250 + 1 for j in range(11)] for i in range(5)
+]
+
+
+def test_pipeline_depth_env_knob(monkeypatch, parts):
+    bundle, params = parts
+    monkeypatch.setenv("TPUSERVE_PIPELINE_DEPTH", "1")
+    assert _make(bundle, params).pipeline_depth == 1
+    monkeypatch.delenv("TPUSERVE_PIPELINE_DEPTH")
+    assert _make(bundle, params).pipeline_depth == 2  # default
+    # explicit kwarg beats the env
+    monkeypatch.setenv("TPUSERVE_PIPELINE_DEPTH", "3")
+    assert _make(bundle, params, pipeline_depth=1).pipeline_depth == 1
+
+
+@pytest.mark.parametrize("cache_mode", ["dense", "paged"])
+def test_greedy_ab_identical_across_depths(parts, cache_mode, monkeypatch):
+    """Greedy, fixed prompts, more requests than slots (so finished slots
+    must be re-admitted through the quarantine barrier): the token streams
+    at depth 1 (serial escape hatch) and depth 2 must be byte-identical —
+    the overshoot chunks' extra tokens are dropped, never emitted."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, params = parts
+    outs = {}
+    for depth in (1, 2):
+        engine = _make(
+            bundle, params, cache_mode=cache_mode, pipeline_depth=depth
+        )
+        outs[depth] = _run_group(
+            engine, _PROMPTS, max_new_tokens=23, temperature=0.0
+        )
+        if cache_mode == "paged":
+            pool = engine.paged_cache.pool
+            # drained: every page back in the pool (no prefix cache here)
+            assert pool.free_pages == pool.num_pages - 1
+        engine.stop()
+    assert outs[1] == outs[2]
+    assert all(len(s) >= 1 for s in outs[2])
+
+
+def test_seeded_sampling_ab_identical_across_depths(parts):
+    """Seeded sampling keys off fold_in(seed, tokens_generated): the
+    pipelined dispatch feeds counters that account for chunks still in
+    flight, so seeded streams must replay identically at any depth."""
+    bundle, params = parts
+    outs = {}
+    for depth in (1, 2):
+        engine = _make(bundle, params, pipeline_depth=depth)
+        outs[depth] = _run_group(
+            engine,
+            _PROMPTS[:3],
+            max_new_tokens=17,
+            temperature=0.9,
+            top_k=40,
+            seed=1234,
+        )
+        engine.stop()
+    assert outs[1] == outs[2]
+
+
+def test_quarantine_defers_free_until_barrier(parts):
+    """A slot freed while a younger chunk still decodes it must stay
+    unavailable (and, on the paged backend, keep its pages) until that
+    chunk retires."""
+    bundle, params = parts
+    engine = _make(bundle, params, cache_mode="paged", max_batch=2)
+    pool = engine.paged_cache.pool
+    req = GenRequest(prompt_ids=[256, 1, 2], max_new_tokens=4)
+    engine._slot_req[0] = req
+    pool.allocate(0, 8)
+    held = pool.free_pages
+    # a younger dispatched-but-unretired chunk still references slot 0
+    entry = _InFlightChunk(
+        seq=7, epoch=0, active_mask=np.array([True, False]), chunk=None
+    )
+    engine._inflight.append(entry)
+    engine._slot_req[0] = None
+    engine._free_slot_pages(0)
+    assert engine._quarantine == {0: 7}
+    assert pool.free_pages == held  # pages NOT freed yet
+    # an older retire must not release it...
+    engine._release_quarantine(6)
+    assert 0 in engine._quarantine
+    # ...the barrier retire does
+    engine._inflight.clear()
+    engine._release_quarantine(7)
+    assert engine._quarantine == {}
+    assert pool.free_pages == pool.num_pages - 1
+
+
+def test_dispatchable_mask_skips_covered_slots(parts):
+    """A request whose remaining max_new_tokens budget is already covered
+    by in-flight chunks is certain to finish at an earlier retire —
+    dispatching more compute for it is pure waste."""
+    bundle, params = parts
+    engine = _make(bundle, params, decode_steps=4)
+    a = GenRequest(prompt_ids=[256, 1], max_new_tokens=6)
+    b = GenRequest(prompt_ids=[256, 2], max_new_tokens=100)
+    a.produced, b.produced = 3, 3
+    engine._slot_req[0], engine._slot_req[1] = a, b
+    active = np.array([True, True])
+    # nothing in flight: both dispatchable
+    assert engine._dispatchable_mask(active).tolist() == [True, True]
+    # one in-flight chunk covering both slots: slot 0 has 6-3=3 tokens left
+    # <= 4 pending steps -> certain to finish; slot 1 keeps going
+    engine._inflight.append(
+        _InFlightChunk(
+            seq=1, epoch=0, active_mask=np.array([True, True]), chunk=None
+        )
+    )
+    assert engine._dispatchable_mask(active).tolist() == [False, True]
+
+
+def test_pipeline_observability(parts):
+    """health() / lifecycle_stats() expose depth, live in-flight count and
+    the dispatch/retire stage histograms the metrics collector exports."""
+    bundle, params = parts
+    engine = _make(bundle, params, pipeline_depth=2)
+    _run_group(engine, _PROMPTS[:2], max_new_tokens=9, temperature=0.0)
+    health = engine.health()
+    assert health["pipeline"]["depth"] == 2
+    assert health["pipeline"]["inflight"] == 0  # drained
+    stats = engine.lifecycle_stats()["pipeline"]
+    assert stats["dispatch_ms"]["count"] > 0
+    assert stats["retire_ms"]["count"] > 0
+    assert stats["dispatch_ms"]["count"] == sum(stats["dispatch_ms"]["counts"])
+    assert stats["retire_ms"]["sum_ms"] >= 0.0
+    engine.stop()

@@ -237,3 +237,58 @@ def test_engine_lifecycle_collector_exports_counters_and_gauges():
         key="m1",
     )
     assert val("engine_queue_depth") == 0
+
+
+def test_engine_pipeline_metrics_exported():
+    """Pipelined-decode observability (docs/pipelined_decode.md): the
+    lifecycle collector exports the in-flight gauge, the configured depth,
+    and the dispatch/retire stage histograms from the provider's
+    ``pipeline`` block — cumulative Prometheus buckets built from the
+    engine's fixed-bucket snapshots."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    snap = {
+        "buckets": [1.0, 2.5, 5.0],
+        "counts": [2, 1, 0, 3],  # last bucket = +Inf overflow
+        "sum_ms": 40.0,
+        "count": 6,
+    }
+    stats = {
+        "queue_depth": 0,
+        "active_slots": 1,
+        "ready": 1,
+        "pipeline": {
+            "depth": 2,
+            "inflight": 1,
+            "dispatch_ms": snap,
+            "retire_ms": {"buckets": [1.0], "counts": [5, 0],
+                          "sum_ms": 2.5, "count": 5},
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_pipeline_inflight") == 1
+    assert val("engine_pipeline_depth") == 2
+    # cumulative histogram semantics: le buckets accumulate, +Inf = count
+    assert val("engine_step_dispatch_ms_bucket", le="1.0") == 2
+    assert val("engine_step_dispatch_ms_bucket", le="2.5") == 3
+    assert val("engine_step_dispatch_ms_bucket", le="5.0") == 3
+    assert val("engine_step_dispatch_ms_bucket", le="+Inf") == 6
+    assert val("engine_step_dispatch_ms_sum") == 40.0
+    assert val("engine_step_retire_ms_bucket", le="+Inf") == 5
+    assert val("engine_step_retire_ms_sum") == 2.5
+    # the in-flight gauge reads live on every scrape
+    stats["pipeline"]["inflight"] = 0
+    assert val("engine_pipeline_inflight") == 0
+    # providers without a pipeline block keep the historical families only
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1}, registry=registry2, key="m2"
+    )
+    assert registry2.get_sample_value(
+        "engine_pipeline_inflight", {"model": "m2"}
+    ) is None
