@@ -166,11 +166,33 @@ def main() -> int:
         emit({"metric": "llm_pipelined_decode_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t2, 1)})
 
+    # -- phase 5: int8 paged KV A/B (docs/paged_kv_quant.md) ----------------
+    # bf16 vs int8 page pools on the real engine, 8B int8 weights: the
+    # int8 pools halve the dominant per-step KV DMA term (ROOFLINE gap #3)
+    # and the pool HBM footprint (gap #2 via capacity) — the step-time and
+    # pool-bytes deltas here are the tentpole's measured evidence
+    t3 = time.time()
+    try:
+        row = bench.run_paged_quant_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "scan_layers": True},
+            batch=16, decode_steps=25, new_tokens=200, prompt_len=128,
+            max_seq_len=1024, quantize="int8",
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t3, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_paged_kv_quant_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t3, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
         "spec_ab_wall_s": round(time.time() - t1, 1),
         "pipeline_ab_wall_s": round(time.time() - t2, 1),
+        "paged_quant_ab_wall_s": round(time.time() - t3, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

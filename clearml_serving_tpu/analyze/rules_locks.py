@@ -61,6 +61,14 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # filter) takes precedence.
     "k": ("dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache")),
     "v": ("dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache")),
+    # int8 paged KV scale pools (docs/paged_kv_quant.md): rebinds follow the
+    # same donation discipline as the data pools
+    "k_scale": (
+        "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
+    ),
+    "v_scale": (
+        "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
+    ),
 }
 
 _MUTATORS = {

@@ -440,9 +440,31 @@ class LLMEngineCore:
         # kept for supervised recovery: a poisoned dense decode step may have
         # consumed (donated) the cache — rebuilding needs the original size
         self._cache_slack = spec_slack
+        # int8 paged KV (docs/paged_kv_quant.md): the same kv_quant knob the
+        # dense cache honors now reaches the paged backend — int8 pools +
+        # per-(token, head) scale pools, dequant inside the paged kernel
+        self._paged_quant = (
+            self.cache_mode == "paged"
+            and bool(bundle.config.get("kv_quant"))
+        )
         if self.cache_mode == "paged":
             from .kv_cache import PagedKVCache
 
+            if self._paged_quant and page_size % 32:
+                # the int8 Pallas tile is (32, 128): misaligned pages route
+                # every TPU decode to the XLA-gather fallback, forfeiting
+                # the halved-DMA win (docs/paged_kv_quant.md). Not an error
+                # — CPU/interpret runs and capacity-only deployments are
+                # legitimate — but it must not be silent.
+                import warnings
+
+                warnings.warn(
+                    "kv_quant=int8 with page_size={} : the int8 paged "
+                    "Pallas kernel needs page_size % 32 == 0 on TPU; this "
+                    "config will use the XLA-gather fallback there (set "
+                    "engine.page_size=32)".format(page_size),
+                    stacklevel=2,
+                )
             # default pool: every slot can hold max_seq_len + one decode chunk
             # (no oversubscription by default; page 0 is the reserved null page).
             # Speculation over-allocates decode_steps*(k+1) tokens per chunk
@@ -458,6 +480,7 @@ class LLMEngineCore:
                 num_pages=total_pages, page_size=page_size,
                 max_slots=self.max_batch,
                 dtype=bundle.config.get("dtype", "bfloat16"),
+                kv_quant=str(bundle.config.get("kv_quant") or ""),
             )
             if mesh is not None:
                 # shard the pools' kv-head dim over tp (pools [L,Hkv,N,P,D]) —
@@ -467,6 +490,15 @@ class LLMEngineCore:
                 pool_sharding = NamedSharding(mesh, P(None, "tp", None, None, None))
                 self.paged_cache.k = jax.device_put(self.paged_cache.k, pool_sharding)
                 self.paged_cache.v = jax.device_put(self.paged_cache.v, pool_sharding)
+                if self._paged_quant:
+                    # scale pools [L, Hkv, N, P] shard the same kv-head dim
+                    scale_sharding = NamedSharding(mesh, P(None, "tp", None, None))
+                    self.paged_cache.k_scale = jax.device_put(
+                        self.paged_cache.k_scale, scale_sharding
+                    )
+                    self.paged_cache.v_scale = jax.device_put(
+                        self.paged_cache.v_scale, scale_sharding
+                    )
             self._pages_per_seq = pages_per_slot
             self.cache = None
         else:
@@ -714,33 +746,50 @@ class LLMEngineCore:
                 # block up to the page size
                 block = -(-int(prefix_block) // page_size) * page_size
                 pool = self.paged_cache.pool
-                page_bytes = 2 * int(
-                    self.paged_cache.k.dtype.itemsize
-                    * bundle.n_layers * bundle.n_kv_heads
-                    * page_size * bundle.head_dim
+                # a cached page's true HBM cost — K+V data planes plus, on
+                # int8 pools, the f32 scale rows that share its lifecycle —
+                # derived from the pools themselves so the budget can't
+                # drift from the layout kv_cache.py owns
+                page_bytes = (
+                    sum(self.paged_cache.pool_bytes().values())
+                    // pool.num_pages
                 )
                 self._prefix = RadixPrefixCache(
                     int(prefix_cache), block, max_bytes=prefix_cache_bytes,
                     max_pages=prefix_cache_pages, pool=pool,
                     page_bytes=page_bytes,
                 )
+                paged_quant = self._paged_quant
 
-                def _gather_pages(kp, vp, pages, plen):
+                def _gather_pages(kp, vp, pages, plen, ksp=None, vsp=None):
                     # shared pages -> dense mini-cache layout [L,1,S,Hkv,D]
                     # (compute input for the tail's prefill_chunk; the pool
                     # pages themselves are mapped by reference at commit).
                     # `pages` is padded with the null page to the bucket's
                     # page count so traces stay bucketed; garbage beyond
-                    # plen is masked by the cache length.
+                    # plen is masked by the cache length. int8 pools also
+                    # gather the scale rows ([L,1,S,Hkv]) — the dense
+                    # mini-cache layout prefill_chunk already consumes
+                    # under kv_quant.
                     sk = kp[:, :, pages]                   # [L,H,NP,P,D]
                     l, h, n, p, d = sk.shape
                     k = jnp.moveaxis(sk.reshape(l, h, n * p, d), 1, 2)[:, None]
                     sv = vp[:, :, pages]
                     v = jnp.moveaxis(sv.reshape(l, h, n * p, d), 1, 2)[:, None]
-                    return {
+                    out = {
                         "k": k, "v": v,
                         "length": jnp.reshape(plen, (1,)).astype(jnp.int32),
                     }
+                    if paged_quant:
+                        sks = ksp[:, :, pages]             # [L,H,NP,P]
+                        out["k_scale"] = jnp.moveaxis(
+                            sks.reshape(l, h, n * p), 1, 2
+                        )[:, None]
+                        svs = vsp[:, :, pages]
+                        out["v_scale"] = jnp.moveaxis(
+                            svs.reshape(l, h, n * p), 1, 2
+                        )[:, None]
+                    return out
 
                 self._gather_pages_jit = jax.jit(_gather_pages)
             else:
@@ -981,6 +1030,9 @@ class LLMEngineCore:
         # verify positions are nearly free, so a mixed batch never forces
         # the engine off the speculative path.
         self._speculation = None
+        # captured as a local for the jitted closures below (TPU201: a jit
+        # closing over self would trace against stale state)
+        paged_quant = self._paged_quant
         if speculation:
             if speculation != "ngram":
                 raise ValueError("speculation must be 'ngram' (got {!r})".format(speculation))
@@ -1016,13 +1068,18 @@ class LLMEngineCore:
                     if gstate is None:
                         gstate = jnp.full((nb,), -1, jnp.int32)
                     if paged:
-                        k_pools, v_pools, page_table, lengths = cachelike
+                        if paged_quant:
+                            (k_pools, v_pools, k_scales, v_scales,
+                             page_table, lengths) = cachelike
+                        else:
+                            k_pools, v_pools, page_table, lengths = cachelike
+                            k_scales = v_scales = None
 
                     def round_body(carry, xs):
                         step_rng, step_off = xs
                         if paged:
-                            (tokbuf, pending, k_pools, v_pools, length,
-                             counts, gstate) = carry
+                            (tokbuf, pending, k_pools, v_pools, k_scales,
+                             v_scales, length, counts, gstate) = carry
                         else:
                             tokbuf, pending, cache, counts, gstate = carry
                             length = cache["length"]                # [B]
@@ -1054,16 +1111,26 @@ class LLMEngineCore:
                         # ---- one verify pass over pending + drafts ----------
                         tokens_in = jnp.concatenate([pending[:, None], drafts], axis=1)
                         if paged:
+                            scale_kw = (
+                                {"k_scales": k_scales, "v_scales": v_scales}
+                                if paged_quant
+                                else {}
+                            )
                             if lora_idx is None:
-                                logits, k_pools, v_pools = bundle.verify_paged(
+                                vout = bundle.verify_paged(
                                     params, tokens_in, k_pools, v_pools,
-                                    page_table, length,
+                                    page_table, length, **scale_kw,
                                 )
                             else:
-                                logits, k_pools, v_pools = bundle.verify_paged(
+                                vout = bundle.verify_paged(
                                     params, tokens_in, k_pools, v_pools,
-                                    page_table, length, lora_idx,
+                                    page_table, length, lora_idx, **scale_kw,
                                 )
+                            if paged_quant:
+                                (logits, k_pools, v_pools, k_scales,
+                                 v_scales) = vout
+                            else:
+                                logits, k_pools, v_pools = vout
                         else:
                             if lora_idx is None:
                                 logits, cache = bundle.verify(params, tokens_in, cache)
@@ -1132,6 +1199,7 @@ class LLMEngineCore:
                         )
                         if paged:
                             carry = (tokbuf, pending, k_pools, v_pools,
+                                     k_scales, v_scales,
                                      new_len.astype(jnp.int32), counts, gstate)
                         else:
                             cache = {**cache, "length": new_len.astype(jnp.int32)}
@@ -1142,7 +1210,7 @@ class LLMEngineCore:
                     steps = jnp.arange(decode_steps, dtype=jnp.int32)
                     if paged:
                         carry0 = (tokbuf, pending, k_pools, v_pools,
-                                  lengths, counts, gstate)
+                                  k_scales, v_scales, lengths, counts, gstate)
                     else:
                         carry0 = (tokbuf, pending, cachelike, counts, gstate)
                     carry, out = jax.lax.scan(round_body, carry0, (rngs, steps))
@@ -1152,8 +1220,12 @@ class LLMEngineCore:
                         (gs, accs), lp = out, None
                     if paged:
                         tokbuf, pending, k_pools, v_pools = carry[:4]
-                        counts, gstate = carry[5], carry[6]
-                        new_cachelike = (k_pools, v_pools)
+                        counts, gstate = carry[7], carry[8]
+                        if paged_quant:
+                            new_cachelike = (k_pools, v_pools, carry[4],
+                                             carry[5])
+                        else:
+                            new_cachelike = (k_pools, v_pools)
                     else:
                         tokbuf, pending, new_cachelike, counts, gstate = carry
                     # gs [rounds, B, k+1], accs [rounds, B]
@@ -1179,33 +1251,49 @@ class LLMEngineCore:
             self._spec_chunk_jit = None
             self._spec_paged_jit = None
 
+        paged_quant = getattr(self, "_paged_quant", False)
+
         def _decode_paged_chunk(
-            params, tokens, k_pools, v_pools, page_table, lengths0,
+            params, tokens, k_pools, v_pools, k_scales, v_scales,
+            page_table, lengths0,
             write_pages, write_offsets, sampling, rng, lora_idx=None,
             extras=None, counts=None, pmask=None, guided=None, gstate=None,
             want_lp=False,
         ):
             """Paged-cache variant of the fused decode chunk. Page/offset
             write coordinates for every step come pre-computed from the host
-            page allocator (write_pages/offsets: [B, steps])."""
+            page allocator (write_pages/offsets: [B, steps]).
+            ``k_scales``/``v_scales`` are the int8 pools' dequant scale
+            pools (None on bf16 pools), chained through the scan like the
+            data pools."""
             nb = tokens.shape[0]
             active = jnp.asarray(
                 lengths0 > 0
             )  # paged slots with content; inactive rows count nothing
 
             def body(carry, xs):
-                tokens, k_pools, v_pools, counts, step, gstate = carry
+                (tokens, k_pools, v_pools, k_scales, v_scales, counts,
+                 step, gstate) = carry
                 step_rng, wp, wo = xs
+                scale_kw = (
+                    {"k_scales": k_scales, "v_scales": v_scales}
+                    if paged_quant
+                    else {}
+                )
                 if lora_idx is None:
-                    logits, k_pools, v_pools = bundle.decode_paged(
+                    out = bundle.decode_paged(
                         params, tokens, k_pools, v_pools, page_table,
-                        lengths0 + step, wp, wo,
+                        lengths0 + step, wp, wo, **scale_kw,
                     )
                 else:
-                    logits, k_pools, v_pools = bundle.decode_paged(
+                    out = bundle.decode_paged(
                         params, tokens, k_pools, v_pools, page_table,
-                        lengths0 + step, wp, wo, lora_idx,
+                        lengths0 + step, wp, wo, lora_idx, **scale_kw,
                     )
+                if paged_quant:
+                    logits, k_pools, v_pools, k_scales, v_scales = out
+                else:
+                    logits, k_pools, v_pools = out
                 logits = logits.astype(jnp.float32)
                 if guided is not None:
                     logits = _guided_mask(logits, gstate, guided)
@@ -1228,24 +1316,38 @@ class LLMEngineCore:
                 if guided is not None:
                     gstate = _guided_advance(gstate, sampled, active, guided)
                 out = (sampled, _lp_of(lp_src, sampled, nb)) if want_lp else sampled
-                return (sampled, k_pools, v_pools, counts, step + 1, gstate), out
+                return (
+                    (sampled, k_pools, v_pools, k_scales, v_scales, counts,
+                     step + 1, gstate),
+                    out,
+                )
 
             rngs = jax.random.split(rng, decode_steps)
             if gstate is None:
                 gstate = jnp.full((nb,), -1, jnp.int32)
-            (_, k_pools, v_pools, counts, _, gstate), out = jax.lax.scan(
+            (
+                (_, k_pools, v_pools, k_scales, v_scales, counts, _, gstate),
+                out,
+            ) = jax.lax.scan(
                 body,
-                (tokens, k_pools, v_pools, counts, jnp.int32(0), gstate),
+                (tokens, k_pools, v_pools, k_scales, v_scales, counts,
+                 jnp.int32(0), gstate),
                 (rngs, write_pages.T, write_offsets.T),
             )
             if want_lp:
                 toks, (chosen, top_id, top_lp) = out
                 lp = (chosen.T, jnp.swapaxes(top_id, 0, 1), jnp.swapaxes(top_lp, 0, 1))
-                return toks.T, k_pools, v_pools, counts, lp, gstate
-            return out.T, k_pools, v_pools, counts, None, gstate
+                return (toks.T, k_pools, v_pools, k_scales, v_scales, counts,
+                        lp, gstate)
+            return (out.T, k_pools, v_pools, k_scales, v_scales, counts,
+                    None, gstate)
 
         self._decode_paged_chunk_jit = jax.jit(
-            _decode_paged_chunk, donate_argnums=(2, 3),
+            _decode_paged_chunk,
+            # donate the data pools (2, 3) and, on int8 pools, the scale
+            # pools (4, 5) — donating a None arg is rejected by jax, so the
+            # tuple is built per backend
+            donate_argnums=(2, 3, 4, 5) if self._paged_quant else (2, 3),
             static_argnames=("want_lp",),
         )
         self._sample_jit = sample_tokens
@@ -1258,7 +1360,8 @@ class LLMEngineCore:
         self._sanitizer = None
         if self.paged_cache is not None and kv_sanitizer.enabled():
             self._sanitizer = kv_sanitizer.KVSanitizer(
-                self.paged_cache.pool, self._prefix
+                self.paged_cache.pool, self._prefix,
+                paged_cache=self.paged_cache,
             )
 
     def _sanitize(self, where: str, drained: bool = False) -> None:
@@ -1785,6 +1888,19 @@ class LLMEngineCore:
         engine is stopped or the watchdog is mid-recovery."""
         return not self._stopped and not self._recovering
 
+    def _kv_pool_snapshot(self):
+        """Paged-pool capacity block shared by health() and
+        lifecycle_stats() (docs/paged_kv_quant.md): bytes split by kind so
+        the int8 win shows up on a dashboard. None on the dense backend."""
+        if self.paged_cache is None:
+            return None
+        return dict(
+            self.paged_cache.pool_bytes(),
+            dtype=self.paged_cache.pool_dtype,
+            num_pages=self.paged_cache.pool.num_pages,
+            page_size=self.paged_cache.pool.page_size,
+        )
+
     def health(self) -> dict:
         return {
             "ready": self.is_ready,
@@ -1798,6 +1914,7 @@ class LLMEngineCore:
                 "depth": self.pipeline_depth,
                 "inflight": len(self._inflight),
             },
+            "kv_pool": self._kv_pool_snapshot(),
         }
 
     def lifecycle_stats(self) -> dict:
@@ -1822,6 +1939,7 @@ class LLMEngineCore:
                 "dispatch_ms": self._hist_dispatch.snapshot(),
                 "retire_ms": self._hist_retire.snapshot(),
             },
+            "kv_pool": self._kv_pool_snapshot(),
         }
 
     @property
@@ -2470,10 +2588,16 @@ class LLMEngineCore:
             pages = list(hit["pages"])
             padded = pages + [0] * (bucket // page_size - len(pages))
             with self.paged_cache.dispatch_lock:
+                scale_args = (
+                    (self.paged_cache.k_scale, self.paged_cache.v_scale)
+                    if self._paged_quant
+                    else ()
+                )
                 cache = self._gather_pages_jit(
                     self.paged_cache.k, self.paged_cache.v,
                     jnp.asarray(padded, jnp.int32),
                     jnp.asarray(prefix_len, jnp.int32),
+                    *scale_args,
                 )
             last_logits, cache = self._prefill_tail(
                 cache, ids, prefix_len, lora_arr
@@ -2591,9 +2715,21 @@ class LLMEngineCore:
         """Route the prefilled prompt KV into the active cache backend."""
         if self.cache_mode == "paged":
             hit = request._prefix_hit if request is not None else None
+            # int8 pools: the prefill mini cache already holds quantized K/V
+            # plus per-token scales (the dense kv_quant layout); the scatter
+            # carries the scale stacks [L, S, Hkv] beside the int8 pages
+            def _scales(lo, hi):
+                if not self._paged_quant:
+                    return ()
+                return (
+                    mini_cache["k_scale"][:, 0, lo:hi],
+                    mini_cache["v_scale"][:, 0, lo:hi],
+                )
+
             if hit is not None:
                 # prefix-cache hit: shared pages map into the slot's page
-                # table BY REFERENCE; only the tail's KV is scattered
+                # table BY REFERENCE (scale rows ride the same page ids);
+                # only the tail's KV (+ scales) is scattered
                 prefix_len = hit["len"]
                 request._prefix_hit = None
                 try:
@@ -2602,6 +2738,7 @@ class LLMEngineCore:
                         mini_cache["k"][:, 0, prefix_len:n_tokens],
                         mini_cache["v"][:, 0, prefix_len:n_tokens],
                         n_tokens,
+                        *_scales(prefix_len, n_tokens),
                     )
                 finally:
                     # the slot holds its own refs now; drop the lookup pin
@@ -2610,7 +2747,9 @@ class LLMEngineCore:
                 # mini_cache k/v: [L,1,bucket,Hkv,D] -> stacked [L,S,Hkv,D]
                 k_stack = mini_cache["k"][:, 0, :n_tokens]
                 v_stack = mini_cache["v"][:, 0, :n_tokens]
-                self.paged_cache.write_prompt(slot, k_stack, v_stack, n_tokens)
+                self.paged_cache.write_prompt(
+                    slot, k_stack, v_stack, n_tokens, *_scales(0, n_tokens)
+                )
             if self._prefix is not None and request is not None:
                 # zero-copy store: the tree takes references on this slot's
                 # own pages (shared prefix blocks walk existing nodes; only
@@ -2840,23 +2979,39 @@ class LLMEngineCore:
             active_mask, spec_mask, sspec_mask, sampling
         )
         with self.paged_cache.dispatch_lock:
-            (tokbuf, pending, (k_pools, v_pools), gs, accs, new_counts,
-             gstate_out, lp) = self._spec_paged_jit(
-                self.params,
-                jnp.asarray(self._tokbuf),
-                jnp.asarray(self._next_token),
-                (
+            # pool handles read under the lock: a racing donating dispatch
+            # would invalidate a handle grabbed outside it
+            if self._paged_quant:
+                cachelike = (
+                    self.paged_cache.k,
+                    self.paged_cache.v,
+                    self.paged_cache.k_scale,
+                    self.paged_cache.v_scale,
+                    jnp.asarray(page_table),
+                    jnp.asarray(lengths0),
+                )
+            else:
+                cachelike = (
                     self.paged_cache.k,
                     self.paged_cache.v,
                     jnp.asarray(page_table),
                     jnp.asarray(lengths0),
-                ),
+                )
+            (tokbuf, pending, new_pools, gs, accs, new_counts,
+             gstate_out, lp) = self._spec_paged_jit(
+                self.params,
+                jnp.asarray(self._tokbuf),
+                jnp.asarray(self._next_token),
+                cachelike,
                 *tail,
                 want_lp=want_lp,
                 with_sspec=bool(sspec_mask.any()),
             )
-            self.paged_cache.k = k_pools
-            self.paged_cache.v = v_pools
+            self.paged_cache.k = new_pools[0]
+            self.paged_cache.v = new_pools[1]
+            if self._paged_quant:
+                self.paged_cache.k_scale = new_pools[2]
+                self.paged_cache.v_scale = new_pools[3]
         lp_np = self._spec_commit_state(
             tokbuf, new_counts, gstate_out, lp, use_extras, gtables
         )
@@ -3307,6 +3462,8 @@ class LLMEngineCore:
                 chunk,
                 self.paged_cache.k,
                 self.paged_cache.v,
+                new_k_scale,
+                new_v_scale,
                 new_counts,
                 lp,
                 gstate_out,
@@ -3315,6 +3472,8 @@ class LLMEngineCore:
                 prep["tokens"],
                 self.paged_cache.k,
                 self.paged_cache.v,
+                self.paged_cache.k_scale,
+                self.paged_cache.v_scale,
                 jnp.asarray(page_table),
                 jnp.asarray(lengths0),
                 jnp.asarray(write_pages),
@@ -3329,6 +3488,9 @@ class LLMEngineCore:
                 prep["gstate_in"],
                 want_lp=prep["want_lp"],
             )
+            if self._paged_quant:
+                self.paged_cache.k_scale = new_k_scale
+                self.paged_cache.v_scale = new_v_scale
         if use_extras:
             self._counts_dev = new_counts
         return chunk, lp, gstate_out

@@ -655,11 +655,17 @@ def json_schema_to_regex(schema: dict, depth: int = 4) -> str:
         t == "object"
         and not schema.get("properties")
         and not schema.get("required")
+        and schema.get("additionalProperties") is not False
     ):
         # no declared properties = ANY object (JSON Schema), not the empty
         # object: lower to a bounded any-object like json_object mode.
-        # (additionalProperties constraints are not modeled — documented
-        # subset limitation.)
+        # With an explicit `additionalProperties: false` the schema instead
+        # falls through to the declared-properties branch, whose empty
+        # member list lowers to exactly `{}` — the closed-object semantics
+        # OpenAI strict tool calling pins (llm/tools.tool_call_schema).
+        # (additionalProperties is otherwise not modeled — documented
+        # subset limitation; a declared-properties object is already
+        # closed over its declared members by construction.)
         _arr, obj = _json_container_regexes(json_value_regex(min(depth, 2)))
         return obj
     if t == "object" or "properties" in schema:

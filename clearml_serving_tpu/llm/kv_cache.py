@@ -21,6 +21,18 @@ before the next write lands.
 Device layout per layer:   k_pool/v_pool [Hkv, num_pages, page_size, D]
 (head-major — the layout ops/paged_attention.py's kernel tiles over)
 Host bookkeeping:          free-page stack + per-slot page lists + refcounts
+
+int8 paged KV (``kv_quant="int8"``, docs/paged_kv_quant.md): the K/V pools
+store int8 and each side gains a SCALE pool ``[L, Hkv, num_pages, P]`` f32
+holding the per-(token, head) symmetric dequant scales (the same
+quantization as models/llama._kv_store on the dense path). A page id
+indexes BOTH its data plane and its scale row — one lifecycle: every write
+(prompt scatter, per-token append), every copy-on-write duplication, and
+every free/share/refcount operation covers the scale row by construction,
+because the scale pools are addressed by the same page ids the PagePool
+hands out. Pool HBM per token-head drops from 2·D bytes (bf16) to D + 4
+(int8 + f32 scale) — 1.94x at D=128 — which doubles the page budget the
+radix prefix cache can hold.
 """
 
 from __future__ import annotations
@@ -352,7 +364,7 @@ class PagedKVCache:
 
     # pool-handle rebinds happen only under the dispatch lock (a donating
     # dispatch invalidates the old handle; tpuserve-analyze TPU301)
-    __guarded_by__ = {"dispatch_lock": ("k", "v")}
+    __guarded_by__ = {"dispatch_lock": ("k", "v", "k_scale", "v_scale")}
 
     def __init__(
         self,
@@ -364,28 +376,46 @@ class PagedKVCache:
         page_size: int = 16,
         max_slots: int = 8,
         dtype="bfloat16",
+        kv_quant: str = "",
     ):
         import jax
         import jax.numpy as jnp
 
+        if kv_quant not in ("", "int8"):
+            raise ValueError(
+                "kv_quant must be '' or 'int8' (got {!r})".format(kv_quant)
+            )
+        self.kv_quant = kv_quant
         self.pool = PagePool(num_pages, page_size, max_slots)
         self.n_layers = n_layers
         shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
-        self.k = jnp.zeros(shape, jnp.dtype(dtype))
-        self.v = jnp.zeros(shape, jnp.dtype(dtype))
+        pool_dtype = jnp.int8 if kv_quant else jnp.dtype(dtype)
+        self.k = jnp.zeros(shape, pool_dtype)
+        self.v = jnp.zeros(shape, pool_dtype)
+        # int8: per-(token, head) f32 dequant scales, page-id addressed so
+        # a page and its scale row share one lifecycle (module docstring)
+        if kv_quant:
+            self.k_scale = jnp.zeros(shape[:-1], jnp.float32)
+            self.v_scale = jnp.zeros(shape[:-1], jnp.float32)
+        else:
+            self.k_scale = None
+            self.v_scale = None
         self.dispatch_lock = threading.Lock()
 
         def _write_pages(pool, chunks, pages):
-            # chunks [NP, L, Hkv, P, D], pages [NP] -> scatter all pages in ONE
-            # dispatch (a per-page Python loop would put O(prompt/page_size)
-            # host->device roundtrips on the TTFT-critical prefill path)
-            chunks = jnp.moveaxis(chunks, 0, 2)          # [L, Hkv, NP, P, D]
+            # chunks [NP, L, Hkv, P, D] (or [NP, L, Hkv, P] for scale pools),
+            # pages [NP] -> scatter all pages in ONE dispatch (a per-page
+            # Python loop would put O(prompt/page_size) host->device
+            # roundtrips on the TTFT-critical prefill path)
+            chunks = jnp.moveaxis(chunks, 0, 2)          # [L, Hkv, NP, P(, D)]
             return pool.at[:, :, pages].set(chunks)
 
         def _write_token(pool, kv, page, offset):
-            # kv [L, Hkv, D] -> pool[:, :, page, offset]
+            # kv [L, Hkv, D] -> pool[:, :, page, offset]; scale pools drop
+            # the trailing D (kv [L, Hkv] -> [L, Hkv, N, P] pool)
+            idx = (0, 0, page, offset) + (0,) * (pool.ndim - 4)
             return jax.lax.dynamic_update_slice(
-                pool, kv[:, :, None, None], (0, 0, page, offset, 0)
+                pool, kv[:, :, None, None], idx
             )
 
         def _copy_page(pool, src, dst):
@@ -414,6 +444,22 @@ class PagedKVCache:
     def layer(self, li: int):
         """Per-layer head-major views for ops.paged_attention."""
         return self.k[li], self.v[li]
+
+    @property
+    def has_scales(self) -> bool:
+        return self.k_scale is not None
+
+    def pool_bytes(self) -> Dict[str, int]:
+        """Device HBM held by the pools, split by kind (observability:
+        statistics/metrics.py exports these as engine_kv_pool_bytes)."""
+        scale = 0
+        if self.k_scale is not None:
+            scale = int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return {"kv": int(self.k.nbytes) + int(self.v.nbytes), "scale": scale}
+
+    @property
+    def pool_dtype(self) -> str:
+        return str(self.k.dtype)
 
     def max_pages_per_seq(self, max_seq_len: int) -> int:
         return self.pool.pages_needed(max_seq_len)
@@ -444,45 +490,84 @@ class PagedKVCache:
         with self.dispatch_lock:
             self.k = self._copy_pages(self.k, srcs, dsts)
             self.v = self._copy_pages(self.v, srcs, dsts)
+            if self.k_scale is not None:
+                # scale rows share the page lifecycle: a CoW'd page carries
+                # its dequant scales to the private copy in the same batch
+                self.k_scale = self._copy_pages(self.k_scale, srcs, dsts)
+                self.v_scale = self._copy_pages(self.v_scale, srcs, dsts)
         return len(pairs)
 
-    def _scatter_pages(self, pages: List[int], k_stack, v_stack) -> None:
+    def _require_scales(self, k_scales, v_scales) -> None:
+        """Fail fast when the caller's scale operands disagree with the
+        pool layout: an int8 pool written without scales would silently
+        dequantize with stale rows; scales against a bf16 pool mean the
+        caller quantized for the wrong backend."""
+        if self.kv_quant and (k_scales is None or v_scales is None):
+            raise ValueError(
+                "int8 KV pools need k_scales/v_scales alongside every write"
+            )
+        if not self.kv_quant and (k_scales is not None or v_scales is not None):
+            raise ValueError("scale operands given but the pools are not int8")
+
+    def _scatter_pages(self, pages: List[int], k_stack, v_stack,
+                       k_scales=None, v_scales=None) -> None:
         """Scatter token KV (stacked [L, S, Hkv, D], S <= len(pages)*P) into
-        the given pages via the donated jitted page write."""
+        the given pages via the donated jitted page write. int8 pools also
+        take the per-token scales ([L, S, Hkv]) for the same positions."""
         import jax.numpy as jnp
 
+        self._require_scales(k_scales, v_scales)
         page_size = self.pool.page_size
         n_pages = len(pages)
-        k_hm = jnp.moveaxis(jnp.asarray(k_stack), 2, 1)  # [L, Hkv, S, D]
-        v_hm = jnp.moveaxis(jnp.asarray(v_stack), 2, 1)
         pad_to = n_pages * page_size
-        k_hm = jnp.pad(k_hm, ((0, 0), (0, 0), (0, pad_to - k_hm.shape[2]), (0, 0)))
-        v_hm = jnp.pad(v_hm, ((0, 0), (0, 0), (0, pad_to - v_hm.shape[2]), (0, 0)))
-        l, hkv, _, d = k_hm.shape
-        # [L,Hkv,NP*P,D] -> [NP, L, Hkv, P, D]
-        k_chunks = k_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
-        v_chunks = v_hm.reshape(l, hkv, n_pages, page_size, d).transpose(2, 0, 1, 3, 4)
+
+        def to_chunks(stack, ndim5):
+            # [L, S, Hkv(, D)] -> [NP, L, Hkv, P(, D)]
+            hm = jnp.moveaxis(jnp.asarray(stack), 2, 1)   # [L, Hkv, S(, D)]
+            pad = ((0, 0), (0, 0), (0, pad_to - hm.shape[2]))
+            if ndim5:
+                pad = pad + ((0, 0),)
+            hm = jnp.pad(hm, pad)
+            shape = hm.shape[:2] + (n_pages, page_size) + hm.shape[3:]
+            perm = (2, 0, 1, 3, 4) if ndim5 else (2, 0, 1, 3)
+            return hm.reshape(shape).transpose(perm)
+
+        k_chunks = to_chunks(k_stack, True)
+        v_chunks = to_chunks(v_stack, True)
         page_ids = jnp.asarray(pages, jnp.int32)
         with self.dispatch_lock:
             self.k = self._write_pages(self.k, k_chunks, page_ids)
             self.v = self._write_pages(self.v, v_chunks, page_ids)
+            if self.kv_quant:
+                self.k_scale = self._write_pages(
+                    self.k_scale, to_chunks(k_scales, False), page_ids
+                )
+                self.v_scale = self._write_pages(
+                    self.v_scale, to_chunks(v_scales, False), page_ids
+                )
 
-    def write_prompt(self, slot: int, k_stack, v_stack, length: int) -> None:
+    def write_prompt(self, slot: int, k_stack, v_stack, length: int,
+                     k_scales=None, v_scales=None) -> None:
         """Scatter a prefilled prompt's KV (stacked [L, S, Hkv, D]) into this
-        slot's pages via donated jitted writes."""
+        slot's pages via donated jitted writes (plus [L, S, Hkv] scales on
+        int8 pools)."""
         self.pool.free(slot)
         self.pool.allocate(slot, length)
-        self._scatter_pages(self.pool.slot_pages(slot), k_stack, v_stack)
+        self._scatter_pages(
+            self.pool.slot_pages(slot), k_stack, v_stack, k_scales, v_scales
+        )
 
     def write_prompt_shared(
         self, slot: int, shared_pages: List[int], prefix_len: int,
         k_tail, v_tail, length: int,
+        k_scales_tail=None, v_scales_tail=None,
     ) -> None:
         """Prefix-cache hit admission: map ``shared_pages`` (holding the
         first ``prefix_len`` tokens, page-aligned) into the slot's page table
-        BY REFERENCE — zero KV copies for the shared run — then scatter only
-        the tail's KV ([L, length - prefix_len, Hkv, D]) into freshly
-        allocated pages."""
+        BY REFERENCE — zero KV copies for the shared run (on int8 pools the
+        shared pages' scale rows come along for free: same page ids) — then
+        scatter only the tail's KV ([L, length - prefix_len, Hkv, D], plus
+        tail scales on int8 pools) into freshly allocated pages."""
         if prefix_len % self.pool.page_size:
             raise ValueError(
                 "shared prefix length {} is not page-aligned".format(prefix_len)
@@ -491,12 +576,17 @@ class PagedKVCache:
         self.pool.map_shared(slot, shared_pages, prefix_len)
         tail_pages = self.pool.allocate(slot, length)
         if tail_pages:
-            self._scatter_pages(tail_pages, k_tail, v_tail)
+            self._scatter_pages(
+                tail_pages, k_tail, v_tail, k_scales_tail, v_scales_tail
+            )
 
-    def append_token(self, slot: int, k_token, v_token) -> None:
-        """Append one token's KV (stacked [L, Hkv, D]) to the slot."""
+    def append_token(self, slot: int, k_token, v_token,
+                     k_scale=None, v_scale=None) -> None:
+        """Append one token's KV (stacked [L, Hkv, D]; [L, Hkv] scales on
+        int8 pools) to the slot."""
         import jax.numpy as jnp
 
+        self._require_scales(k_scale, v_scale)
         length = self.pool.slot_length(slot)
         self.pool.extend(slot, 1)
         self.apply_pending_cow()
@@ -504,3 +594,10 @@ class PagedKVCache:
         with self.dispatch_lock:
             self.k = self._write_token(self.k, jnp.asarray(k_token), page, offset)
             self.v = self._write_token(self.v, jnp.asarray(v_token), page, offset)
+            if self.kv_quant:
+                self.k_scale = self._write_token(
+                    self.k_scale, jnp.asarray(k_scale), page, offset
+                )
+                self.v_scale = self._write_token(
+                    self.v_scale, jnp.asarray(v_scale), page, offset
+                )

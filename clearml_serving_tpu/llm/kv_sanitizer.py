@@ -23,6 +23,11 @@ asserts, after every decode step and at drain:
 5. **At drain** (no active requests, no admissions in flight) — no slot
    holds pages, no pins remain, and every surviving reference belongs to
    the prefix cache. Anything else is a leaked page, reported BY ID.
+6. **Scale-row lifecycle** (int8 paged KV, docs/paged_kv_quant.md) — the
+   per-(token, head) scale pools must address exactly the allocator's
+   pages (one scale row per page id per side), so every page operation
+   (write, CoW, share, free) covers its scale rows by construction; drain
+   leak reports name the stranded scale rows beside the pages.
 
 Failures raise :class:`KVSanitizerError` (an AssertionError subclass: armed
 test suites fail closed) with a diagnostic naming the offending pages.
@@ -65,9 +70,15 @@ class KVSanitizer:
     operations — each of which preserves the invariants — never inside one.
     """
 
-    def __init__(self, pool, prefix_cache=None):
+    def __init__(self, pool, prefix_cache=None, paged_cache=None):
         self.pool = pool
         self.prefix = prefix_cache
+        # the PagedKVCache (optional): int8 pools carry per-page scale rows
+        # whose lifecycle is the page id itself — the audit verifies the
+        # scale pools stay shape-consistent with the page allocator (a
+        # drifted page axis would dequantize every page with the wrong
+        # rows), and leak reports name the scale rows leaked alongside.
+        self.paged_cache = paged_cache
         self.checks = 0     # observability: how many audits ran
         self.failures = 0
 
@@ -110,6 +121,26 @@ class KVSanitizer:
                 "KV sanitizer [{}]: {}".format(where, message),
                 where=where, pages=pages,
             )
+
+        # (0) scale-pool/page-pool consistency (int8 pools): a page id must
+        # address a scale row in BOTH scale pools — shape drift would make
+        # every dequant read the wrong row, silently
+        pc = self.paged_cache
+        quantized = pc is not None and getattr(pc, "has_scales", False)
+        if quantized:
+            for name in ("k_scale", "v_scale"):
+                sp = getattr(pc, name)
+                if sp.shape[2] != self.pool.num_pages or (
+                    sp.shape[3] != self.pool.page_size
+                ):
+                    fail(
+                        "{} pool shape {} does not address the page pool "
+                        "({} pages x {} tokens): pages and scale rows no "
+                        "longer share a lifecycle".format(
+                            name, tuple(sp.shape), self.pool.num_pages,
+                            self.pool.page_size,
+                        )
+                    )
 
         # slot-table occurrences per page (a page CAN legally appear in
         # several slots — shared prefix mapped into multiple page tables)
@@ -197,11 +228,20 @@ class KVSanitizer:
                     "slot {} -> pages {}".format(slot, pages)
                     for slot, pages in sorted(held.items())
                 )
+                leaked_pages = sorted(
+                    p for pages in held.values() for p in pages
+                )
+                scale_note = (
+                    " (each leaked page also strands its k/v scale rows "
+                    "{})".format(leaked_pages)
+                    if quantized
+                    else ""
+                )
                 fail(
-                    "leaked pages at drain (no live requests): {}".format(
-                        detail
+                    "leaked pages at drain (no live requests): {}{}".format(
+                        detail, scale_note
                     ),
-                    pages=sorted(p for pages in held.values() for p in pages),
+                    pages=leaked_pages,
                 )
             if pins:
                 fail(

@@ -82,7 +82,13 @@ class LLMEngineRequest(BaseEngineRequest):
         cfg_overrides = dict(lora_overrides)
         if engine_cfg.get("kv_quant"):
             # int8 KV cache: a serving-time build knob like lora, so it can
-            # be set per endpoint without touching the stored bundle config
+            # be set per endpoint without touching the stored bundle config.
+            # Honored by BOTH cache backends: the dense cache stores
+            # int8+scales in its buffers, and the paged backend allocates
+            # int8 page pools with per-page scale rows and dequantizes
+            # inside the Pallas decode kernel (docs/paged_kv_quant.md) —
+            # so `engine.cache: paged` endpoints get the halved KV HBM the
+            # b>=32 roofline configs need.
             cfg_overrides["kv_quant"] = str(engine_cfg["kv_quant"])
 
         if self._model_local_path:
@@ -182,7 +188,18 @@ class LLMEngineRequest(BaseEngineRequest):
             decode_steps=int(engine_cfg.get("decode_steps", 4)),
             quantize=engine_cfg.get("quantize"),
             cache_mode=engine_cfg.get("cache", "dense"),
-            page_size=int(engine_cfg.get("page_size", 16)),
+            # int8 paged pools default to 32-token pages: the int8 Pallas
+            # tile is (32, 128), so 16-token pages would silently route
+            # every TPU decode to the XLA-gather fallback and forfeit the
+            # halved-DMA win (docs/paged_kv_quant.md); an explicit
+            # engine.page_size still wins
+            page_size=int(
+                engine_cfg.get("page_size")
+                or (32 if (
+                    engine_cfg.get("kv_quant")
+                    and engine_cfg.get("cache", "dense") == "paged"
+                ) else 16)
+            ),
             num_pages=int(engine_cfg["num_pages"]) if engine_cfg.get("num_pages") else None,
             long_prefill_threshold=engine_cfg.get("long_prefill_threshold"),
             long_bucket_step=engine_cfg.get("long_bucket_step"),

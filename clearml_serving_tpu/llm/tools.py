@@ -109,12 +109,28 @@ def tool_call_schema(
         raise ValueError(
             "tool_choice names unknown tool {!r}".format(forced_name)
         )
+    def arguments_schema(params: Dict[str, Any]) -> Dict[str, Any]:
+        # OpenAI strict-function-calling semantics: the arguments object is
+        # exactly the declared parameters. A declared-properties object is
+        # already closed by the DFA lowering (only declared members can be
+        # emitted); pinning additionalProperties: false extends that to the
+        # propertyless case, which would otherwise lower to "any object" —
+        # unbounded free-form members that a constrained decode could
+        # wander in until max_tokens instead of closing the call.
+        out = dict(params)
+        out.setdefault("additionalProperties", False)
+        # a bare `parameters: {}` has no "type" key either: without it the
+        # DFA lowering would skip both object branches and fall through to
+        # "any JSON value", un-closing the object the line above closed
+        out.setdefault("type", "object")
+        return out
+
     variants = [
         {
             "type": "object",
             "properties": {
                 "name": {"const": t["name"]},
-                "arguments": t["parameters"],
+                "arguments": arguments_schema(t["parameters"]),
             },
             "required": ["name", "arguments"],
         }

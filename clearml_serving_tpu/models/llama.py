@@ -1205,19 +1205,28 @@ def build(config: dict) -> SimpleNamespace:
     def decode_paged(
         params,
         tokens,        # [B] int32
-        k_pools,       # [L, Hkv, N, P, D]
+        k_pools,       # [L, Hkv, N, P, D] (int8 under kv_quant)
         v_pools,       # [L, Hkv, N, P, D]
         page_table,    # [B, PP] int32
         lengths,       # [B] int32 tokens present BEFORE this step
         write_page,    # [B] int32 page id for the new token
         write_offset,  # [B] int32 offset within that page
         lora_idx=None,  # [B] int32 adapter index per slot (None = base)
+        *,
+        k_scales=None,  # [L, Hkv, N, P] f32 scale pools (kv_quant only)
+        v_scales=None,
     ):
         """One decode step over paged KV: writes the new token's K/V into the
         pools (scatter by (page, offset)), then attends via
-        ops.paged_attention. Returns (logits [B, vocab], k_pools, v_pools)."""
+        ops.paged_attention. Returns (logits [B, vocab], k_pools, v_pools) —
+        plus the updated scale pools when ``kv_quant`` is on: the new
+        token's K/V quantize through the dense path's _kv_store and the
+        per-(token, head) scales scatter beside the int8 pages; dequant
+        happens inside the attention kernel."""
         from ..ops.paged_attention import paged_attention
 
+        if kv_quant and k_scales is None:
+            raise ValueError("kv_quant decode_paged needs k_scales/v_scales")
         b = tokens.shape[0]
         positions = lengths[:, None]                               # [B, 1]
         cos, sin = _rope(positions, head_dim, theta, rope_scaling)
@@ -1226,60 +1235,87 @@ def build(config: dict) -> SimpleNamespace:
         # family query_scale override folds into q before the kernel
         q_prescale = query_scale * (head_dim ** 0.5)
 
-        def layer_body(x, layer, k_pool_l, v_pool_l):
-            """One layer on its own pool slice [Hkv, N, P, D]; returns the
-            updated pool slice (scatter of the new token's K/V)."""
+        def layer_body(x, layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l):
+            """One layer on its own pool slice [Hkv, N, P, D] (+ [Hkv, N, P]
+            scale slices under kv_quant); returns the updated slices
+            (scatter of the new token's K/V and scales)."""
             stash = []
 
             def attn_fn(layer_, h):
                 q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # q [B,1,H,D]
+                k_q, k_s = _kv_store(k)                            # [B,1,Hkv(,D)]
+                v_q, v_s = _kv_store(v)
                 # index tuple (:, wp, wo): the advanced indices are
                 # CONTIGUOUS, so the broadcast dim [B] lands after the sliced
                 # head dim -> set() takes [Hkv, B, D].
-                k_hm = k[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
-                v_hm = v[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
+                k_hm = k_q[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
+                v_hm = v_q[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
                 k_p = k_pool_l.at[:, write_page, write_offset].set(k_hm)
                 v_p = v_pool_l.at[:, write_page, write_offset].set(v_hm)
-                stash.append((k_p, v_p))
+                scale_kw = {}
+                if kv_quant:
+                    # scale rows scatter at the same (page, offset) the int8
+                    # values took — one lifecycle per page id
+                    k_sp = k_sc_l.at[:, write_page, write_offset].set(
+                        k_s[:, 0].transpose(1, 0)
+                    )
+                    v_sp = v_sc_l.at[:, write_page, write_offset].set(
+                        v_s[:, 0].transpose(1, 0)
+                    )
+                    stash.append((k_p, v_p, k_sp, v_sp))
+                    scale_kw = {"k_scale": k_sp, "v_scale": v_sp}
+                else:
+                    stash.append((k_p, v_p))
                 q_grouped = q[:, 0].reshape(b, n_kv, group, head_dim)
                 if q_prescale != 1.0:
                     q_grouped = q_grouped * jnp.asarray(q_prescale, q_grouped.dtype)
                 attn = paged_attention(
-                    q_grouped, k_p, v_p, page_table, lengths + 1
+                    q_grouped, k_p, v_p, page_table, lengths + 1, **scale_kw
                 )                                                  # [B,Hkv,G,D]
                 return attn.reshape(b, 1, n_heads * head_dim).astype(x.dtype)
 
             x = _block(layer, x, attn_fn, lora_idx)
-            k_pool_l, v_pool_l = stash[0]
-            return x, k_pool_l, v_pool_l
+            return (x,) + stash[0]
 
+        if kv_quant:
+            xs_all = (params["layers"], k_pools, v_pools, k_scales, v_scales)
+        else:
+            xs_all = (params["layers"], k_pools, v_pools)
         if scan_layers:
             def scan_body(x, xs):
-                layer, k_pool_l, v_pool_l = xs
-                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pool_l, v_pool_l)
-                return x, (k_pool_l, v_pool_l)
+                layer = xs[0]
+                pools = xs[1:] if kv_quant else xs[1:] + (None, None)
+                out = layer_body(x, layer, *pools)
+                return out[0], out[1:]
 
-            x, (k_pools, v_pools) = jax.lax.scan(
-                scan_body, x, (params["layers"], k_pools, v_pools)
-            )
+            x, new_pools = jax.lax.scan(scan_body, x, xs_all)
         else:
-            new_k, new_v = [], []
+            per_layer = []
             for li, layer in enumerate(params["layers"]):
-                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pools[li], v_pools[li])
-                new_k.append(k_pool_l)
-                new_v.append(v_pool_l)
-            k_pools = jnp.stack(new_k)
-            v_pools = jnp.stack(new_v)
-        return _logits(params, x)[:, 0], k_pools, v_pools
+                tup = tuple(a[li] for a in xs_all[1:])
+                if not kv_quant:
+                    tup = tup + (None, None)
+                out = layer_body(x, layer, *tup)
+                x = out[0]
+                per_layer.append(out[1:])
+            new_pools = tuple(
+                jnp.stack([bufs[j] for bufs in per_layer])
+                for j in range(len(per_layer[0]))
+            )
+        logits = _logits(params, x)[:, 0]
+        return (logits,) + tuple(new_pools)
 
     def verify_paged(
         params,
         tokens,        # [B, S] int32: pending token + S-1 drafts
-        k_pools,       # [L, Hkv, N, P, D]
+        k_pools,       # [L, Hkv, N, P, D] (int8 under kv_quant)
         v_pools,       # [L, Hkv, N, P, D]
         page_table,    # [B, PP] int32
         lengths,       # [B] int32 tokens present BEFORE this chunk
         lora_idx=None,
+        *,
+        k_scales=None,  # [L, Hkv, N, P] f32 scale pools (kv_quant only)
+        v_scales=None,
     ):
         """Speculative verification over paged KV (vLLM spec-decode on a
         paged cache). Same contract as :func:`verify`: logits at ALL S
@@ -1295,7 +1331,11 @@ def build(config: dict) -> SimpleNamespace:
         device-side value). Attention gathers each sequence's table to a
         dense [cap] run — capacity bandwidth, like the XLA-gather decode
         fallback — and reuses ``_attend`` so query_scale/softcap families
-        verify exactly like they decode."""
+        verify exactly like they decode. Under ``kv_quant`` the chunk's K/V
+        quantize before the scatter and the gather dequantizes with the
+        scale pools (returned updated, like decode_paged)."""
+        if kv_quant and k_scales is None:
+            raise ValueError("kv_quant verify_paged needs k_scales/v_scales")
         b, s = tokens.shape
         pp = page_table.shape[1]
         page = k_pools.shape[3]
@@ -1312,16 +1352,23 @@ def build(config: dict) -> SimpleNamespace:
             t_idx < (positions[:, :, None] + 1), 0.0, -jnp.inf
         ).astype(jnp.float32)[:, None]                             # [B,1,S,cap]
 
-        def layer_body(x, layer, k_pool_l, v_pool_l):
+        def layer_body(x, layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l):
             stash = []
 
             def attn_fn(layer_, h):
                 q, k, v = _qkv(layer_, h, cos, sin, lora_idx)      # k,v [B,S,Hkv,D]
-                k_hm = k.transpose(2, 0, 1, 3).astype(k_pool_l.dtype)
-                v_hm = v.transpose(2, 0, 1, 3).astype(v_pool_l.dtype)
+                k_q, k_s = _kv_store(k)
+                v_q, v_s = _kv_store(v)
+                k_hm = k_q.transpose(2, 0, 1, 3).astype(k_pool_l.dtype)
+                v_hm = v_q.transpose(2, 0, 1, 3).astype(v_pool_l.dtype)
                 k_p = k_pool_l.at[:, wp, wo].set(k_hm)
                 v_p = v_pool_l.at[:, wp, wo].set(v_hm)
-                stash.append((k_p, v_p))
+                if kv_quant:
+                    k_sp = k_sc_l.at[:, wp, wo].set(k_s.transpose(2, 0, 1))
+                    v_sp = v_sc_l.at[:, wp, wo].set(v_s.transpose(2, 0, 1))
+                    stash.append((k_p, v_p, k_sp, v_sp))
+                else:
+                    stash.append((k_p, v_p))
                 # [Hkv, B, PP, P, D] -> [B, cap, Hkv, D] (table order IS
                 # sequence-position order)
                 kg = k_p[:, page_table].transpose(1, 2, 3, 0, 4).reshape(
@@ -1330,35 +1377,51 @@ def build(config: dict) -> SimpleNamespace:
                 vg = v_p[:, page_table].transpose(1, 2, 3, 0, 4).reshape(
                     b, cap, n_kv, head_dim
                 )
+                if kv_quant:
+                    # dequant the gathered run with its scale rows ([B, cap,
+                    # Hkv]), f32 math like the dense path's _kv_load
+                    ksg = k_sp[:, page_table].transpose(1, 2, 3, 0).reshape(
+                        b, cap, n_kv
+                    )
+                    vsg = v_sp[:, page_table].transpose(1, 2, 3, 0).reshape(
+                        b, cap, n_kv
+                    )
+                    kg = kg.astype(jnp.float32) * ksg[..., None]
+                    vg = vg.astype(jnp.float32) * vsg[..., None]
                 return _attend(q, kg.astype(q.dtype), vg.astype(q.dtype), mask)
 
             # dropless MoE like verify(): capacity dropping would make the
             # accept chain depend on batch occupancy
             x = _block(layer, x, attn_fn, lora_idx,
                        ffn_kwargs={"dropless": True})
-            k_pool_l, v_pool_l = stash[0]
-            return x, k_pool_l, v_pool_l
+            return (x,) + stash[0]
 
+        if kv_quant:
+            xs_all = (params["layers"], k_pools, v_pools, k_scales, v_scales)
+        else:
+            xs_all = (params["layers"], k_pools, v_pools)
         if scan_layers:
             def scan_body(x, xs):
-                layer, k_pool_l, v_pool_l = xs
-                x, k_pool_l, v_pool_l = layer_body(x, layer, k_pool_l, v_pool_l)
-                return x, (k_pool_l, v_pool_l)
+                layer = xs[0]
+                pools = xs[1:] if kv_quant else xs[1:] + (None, None)
+                out = layer_body(x, layer, *pools)
+                return out[0], out[1:]
 
-            x, (k_pools, v_pools) = jax.lax.scan(
-                scan_body, x, (params["layers"], k_pools, v_pools)
-            )
+            x, new_pools = jax.lax.scan(scan_body, x, xs_all)
         else:
-            new_k, new_v = [], []
+            per_layer = []
             for li, layer in enumerate(params["layers"]):
-                x, k_pool_l, v_pool_l = layer_body(
-                    x, layer, k_pools[li], v_pools[li]
-                )
-                new_k.append(k_pool_l)
-                new_v.append(v_pool_l)
-            k_pools = jnp.stack(new_k)
-            v_pools = jnp.stack(new_v)
-        return _logits(params, x), k_pools, v_pools
+                tup = tuple(a[li] for a in xs_all[1:])
+                if not kv_quant:
+                    tup = tup + (None, None)
+                out = layer_body(x, layer, *tup)
+                x = out[0]
+                per_layer.append(out[1:])
+            new_pools = tuple(
+                jnp.stack([bufs[j] for bufs in per_layer])
+                for j in range(len(per_layer[0]))
+            )
+        return (_logits(params, x),) + tuple(new_pools)
 
     def prepare_params(params):
         """Adapt a loaded param pytree to this build's layout: under
@@ -1451,16 +1514,12 @@ def build(config: dict) -> SimpleNamespace:
         max_loras=max_loras,
         # the paged kernel has no score soft-capping; the engine refuses
         # cache=paged for such models (alt_window is covered by the existing
-        # sliding_window guard)
+        # sliding_window guard). kv_quant="int8" is supported on BOTH cache
+        # backends since the int8 paged pools landed (docs/paged_kv_quant.md).
         paged_unsupported_reason=(
             "attention logit softcapping (Gemma-2) is not supported by the "
             "paged decode kernel; use engine.cache=dense"
             if attn_softcap
-            else (
-                "kv_quant applies to the dense cache only; use "
-                "engine.cache=dense"
-                if kv_quant
-                else None
-            )
+            else None
         ),
     )

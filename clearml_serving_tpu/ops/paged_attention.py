@@ -54,6 +54,36 @@ same kernel BEFORE the fixes). Mosaic portability notes baked into the
 kernel: never insert a
 minor dim on an i1 vector (build masks via 2-D i32 iota compares), and DMA
 slices must be lane-aligned (D % 128 == 0 gates the Pallas path).
+
+int8 paged KV (r4, docs/paged_kv_quant.md): pools may store int8 with a
+per-(token, head) f32 scale pool ``[Hkv, N, P]`` beside each side —
+``k_scale``/``v_scale`` operands. The kernel streams the int8 pages through
+the SAME manual double-buffered DMA plan (half the bytes of bf16: the
+dominant decode DMA term), and dequantization fuses into the flash update
+next to the MXU:
+
+- K side: the dot runs on the raw int8 block cast to the compute dtype
+  (int8 -> bf16 is LOSSLESS: 8-bit mantissa covers [-127, 127]) and the
+  f32 scores multiply by ``k_scale`` per key column — algebraically the
+  dequantized matmul, without materializing a dequantized [PB*P, D] tile.
+- V side: the f32 probs multiply by ``v_scale`` per value row before the
+  PV dot — same fusion.
+
+Scales do NOT ride the per-page DMA plan: an f32 scale row is [P] (16-64
+lanes), and Mosaic requires DMA slices tile-aligned — the same constraint
+that gates D % 128 would reject every scale-row copy. Instead the tiny
+scale vectors (4 bytes per token-head vs 128+ data bytes) are pre-gathered
+by XLA into a lane-aligned [B, Hkv, 1, PP*P] operand that the grid
+pipeline DMAs into VMEM like any blocked input. The gather reads scale
+rows at table capacity rather than live length; that dead traffic is
+bounded by scale_bytes/kv_bytes = 4/D of the int8 stream (~3% at D=128).
+
+Alignment gates for the int8 path: D % 128 == 0 (unchanged) and
+page_size % 32 == 0 on hardware — the int8 tile is (32, 128), so a 16-row
+page plane cannot be sliced out of an int8 pool (bf16's 16-sublane tile
+could). Misaligned int8 shapes (including the default 16-token pages)
+route to the XLA gather, exactly like D=64 does today; interpret=True
+exercises the kernel on any shape.
 """
 
 from __future__ import annotations
@@ -74,11 +104,17 @@ except Exception:  # pragma: no cover
 
 # ----------------------------------------------------------------- reference
 
-def paged_attention_xla(q, k_pool, v_pool, page_table, lengths):
+def paged_attention_xla(q, k_pool, v_pool, page_table, lengths,
+                        k_scale=None, v_scale=None):
     """Reference implementation in plain XLA ops (also the CPU fallback).
 
     q: [B, Hkv, G, D]; pools: [Hkv, N, P, D]; page_table: [B, PP];
     lengths: [B] -> out [B, Hkv, G, D].
+
+    ``k_scale``/``v_scale`` ([Hkv, N, P] f32) dequantize int8 pools: the
+    per-(token, head) symmetric scales of models/llama._kv_store. Dequant
+    happens in f32 and casts to the query dtype before the attention math,
+    mirroring the dense path's _kv_load, so XLA fuses it into the gather.
     """
     b, hkv, g, d = q.shape
     _, n, p, _ = k_pool.shape
@@ -86,6 +122,11 @@ def paged_attention_xla(q, k_pool, v_pool, page_table, lengths):
     # gather pages -> [Hkv, B, PP, P, D] -> [B, T, Hkv, D]-equivalent einsum order
     k = k_pool[:, page_table].reshape(hkv, b, pp * p, d)
     v = v_pool[:, page_table].reshape(hkv, b, pp * p, d)
+    if k_scale is not None:
+        ks = k_scale[:, page_table].reshape(hkv, b, pp * p, 1)
+        vs = v_scale[:, page_table].reshape(hkv, b, pp * p, 1)
+        k = (k.astype(jnp.float32) * ks).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs).astype(q.dtype)
     t_idx = jnp.arange(pp * p, dtype=jnp.int32)[None]
     valid = t_idx < lengths[:, None]                          # [B, T]
     scores = jnp.einsum(
@@ -109,19 +150,29 @@ def _paged_attention_kernel(
     # scalar prefetch
     page_table_ref,    # [B, PP] int32 (SMEM)
     lengths_ref,       # [B] int32 (SMEM)
-    # blocks
-    q_ref,             # [1, 1, G, D] VMEM
-    k_hbm,             # [Hkv, N, P, D] ANY (stays in HBM)
-    v_hbm,             # [Hkv, N, P, D] ANY
-    out_ref,           # [1, 1, G, D] VMEM
-    # scratch
-    k_buf,             # [2, PB*P, D] VMEM (double-buffered page blocks)
-    v_buf,             # [2, PB*P, D] VMEM
-    sems,              # [2, PB, 2] DMA semaphores (slot, page-in-block, k/v)
-    *,
+    # then, positionally (in_specs order):
+    #   q_ref            [1, 1, G, D] VMEM
+    #   k_hbm            [Hkv, N, P, D] ANY (stays in HBM)
+    #   v_hbm            [Hkv, N, P, D] ANY
+    #   k_scale_ref      [1, 1, 1, PP*P] f32 VMEM   (quantized=True only:
+    #   v_scale_ref      [1, 1, 1, PP*P] f32 VMEM    pre-gathered per-token
+    #                    scales in sequence order — module docstring)
+    #   out_ref          [1, 1, G, D] VMEM
+    # scratch:
+    #   k_buf            [2, PB*P, D] VMEM (double-buffered page blocks)
+    #   v_buf            [2, PB*P, D] VMEM
+    #   sems             [2, PB, 2] DMA semaphores (slot, page-in-block, k/v)
+    *refs,
     page_size: int,
     pages_per_block: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        (q_ref, k_hbm, v_hbm, k_scale_ref, v_scale_ref,
+         out_ref, k_buf, v_buf, sems) = refs
+    else:
+        q_ref, k_hbm, v_hbm, out_ref, k_buf, v_buf, sems = refs
+        k_scale_ref = v_scale_ref = None
     b = pl.program_id(0)
     h = pl.program_id(1)
     g, d = q_ref.shape[2], q_ref.shape[3]
@@ -174,14 +225,29 @@ def _paged_attention_kernel(
                 start_block(i + 1, jax.lax.rem(i + 1, 2))
 
             wait_block(i, slot)
-            # K/V feed the MXU in pool dtype (bf16) with f32 accumulation
+            # K/V feed the MXU in pool dtype (bf16) with f32 accumulation.
+            # int8 pools (quantized): the block feeds the dot as raw int8
+            # cast to the output compute dtype — int8 -> bf16 is lossless —
+            # and the per-token scales fold into the f32 scores/probs, so
+            # dequant fuses into the flash update without materializing a
+            # dequantized tile (module docstring).
             q = q_ref[0, 0]                                     # [G, D]
             k = k_buf[slot]                                     # [PB*P, D]
             v = v_buf[slot]
+            if quantized:
+                op_dtype = out_ref.dtype
+                k = k.astype(op_dtype)
+                v = v.astype(op_dtype)
             scores = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * (d ** -0.5)                                     # [G, PB*P]
+            if quantized:
+                # scale rows of pages past length come from the gathered
+                # null-page padding: finite garbage, masked right below
+                k_s = k_scale_ref[0, 0, :, pl.ds(i * block_tokens,
+                                                 block_tokens)]  # [1, PB*P]
+                scores = scores * k_s
             token_ids = (
                 i * block_tokens
                 + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -190,6 +256,8 @@ def _paged_attention_kernel(
             scores = jnp.where(valid, scores, -jnp.inf)
             # rows past length were never DMA'd: their buffer bytes are
             # arbitrary (NaN/inf poisons 0*v), so zero them before the matmul.
+            # (int8 garbage is always finite, but the zeroing also keeps the
+            # masked rows from polluting the scaled-probs matmul below.)
             # Mask built as a 2-D i32 iota compare: Mosaic cannot insert a
             # minor dim on an i1 vector (bool[:, None] fails to compile).
             row_ids = i * block_tokens + jax.lax.broadcasted_iota(
@@ -202,9 +270,18 @@ def _paged_attention_kernel(
             probs = jnp.exp(scores - m_new[:, None])            # [G, PB*P]
             probs = jnp.where(valid, probs, 0.0)
             correction = jnp.exp(m_prev - m_new)                # [G]
+            # the softmax denominator sums the UNSCALED probs; v_scale
+            # belongs only to the PV product
             l_new = l_prev * correction + jnp.sum(probs, axis=1)
+            pv = probs
+            if quantized:
+                # V dequant folded into the probs (per value row); probs are
+                # zero past length, so garbage scales multiply into zeros
+                v_s = v_scale_ref[0, 0, :, pl.ds(i * block_tokens,
+                                                 block_tokens)]  # [1, PB*P]
+                pv = probs * v_s
             acc_new = acc_prev * correction[:, None] + jax.lax.dot_general(
-                probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             return m_new, l_new, acc_new
@@ -223,6 +300,7 @@ def _paged_attention_kernel(
 
 def paged_attention(
     q, k_pool, v_pool, page_table, lengths, *,
+    k_scale=None, v_scale=None,
     pages_per_block: int = 32, interpret: bool = False,
 ):
     """Pallas paged decode attention (falls back to XLA off-TPU).
@@ -230,42 +308,87 @@ def paged_attention(
     Shapes as in :func:`paged_attention_xla` (head-major pools).
     ``pages_per_block``: pages flash-processed per MXU block (DMA'd together,
     double-buffered against the previous block's compute).
+    ``k_scale``/``v_scale`` ([Hkv, N, P] f32): per-(token, head) dequant
+    scales for int8 pools (required when the pools are int8); dequant fuses
+    into the in-kernel flash update (module docstring).
     """
+    quantized = k_scale is not None
+    if jnp.issubdtype(k_pool.dtype, jnp.signedinteger) and not quantized:
+        raise ValueError(
+            "int8 KV pools need k_scale/v_scale operands (per-token dequant)"
+        )
     if not _PALLAS_OK:
-        return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
+        return paged_attention_xla(
+            q, k_pool, v_pool, page_table, lengths, k_scale, v_scale
+        )
     on_tpu = jax.devices()[0].platform == "tpu"
     if not on_tpu and not interpret:
-        return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
+        return paged_attention_xla(
+            q, k_pool, v_pool, page_table, lengths, k_scale, v_scale
+        )
+    # Mosaic requires DMA slices tile-aligned: a [P, D] page plane with
+    # D < 128 cannot be sliced out of the pool (measured on v5e: D=64
+    # fails "slice shape along dimension 3 must be aligned to tiling"),
+    # and a page_size off the sublane tile would misalign the k_buf/v_buf
+    # destination offsets (j*P). The sublane tile is dtype-dependent: 16
+    # for bf16 pools, 32 for int8 (module docstring) — so the int8 path
+    # needs 32-token pages on hardware. Known-misaligned shapes route to
+    # the XLA gather instead of failing at compile time; Llama-class heads
+    # (D=128) take the kernel.
+    min_sublane = 32 if k_pool.dtype.itemsize == 1 else 16
     if on_tpu and not interpret and (
-        q.shape[-1] % 128 != 0 or k_pool.shape[2] % 16 != 0
+        q.shape[-1] % 128 != 0 or k_pool.shape[2] % min_sublane != 0
     ):
-        # Mosaic requires DMA slices tile-aligned: a [P, D] page plane with
-        # D < 128 cannot be sliced out of the pool (measured on v5e: D=64
-        # fails "slice shape along dimension 3 must be aligned to tiling"),
-        # and a page_size off the 16-sublane bf16 tile would misalign the
-        # k_buf/v_buf destination offsets (j*P). Known-misaligned shapes
-        # route to the XLA gather instead of failing at compile time;
-        # Llama-class heads (D=128, 16-token pages) take the kernel.
-        return paged_attention_xla(q, k_pool, v_pool, page_table, lengths)
+        return paged_attention_xla(
+            q, k_pool, v_pool, page_table, lengths, k_scale, v_scale
+        )
 
     b, hkv, g, d = q.shape
     _, n, page_size, _ = k_pool.shape
     pages_per_seq = page_table.shape[1]
     pb = max(1, min(pages_per_block, pages_per_seq))
+    cap = pages_per_seq * page_size
 
     kernel = functools.partial(
         _paged_attention_kernel,
         page_size=page_size,
         pages_per_block=pb,
+        quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda b, h, pt, ln: (b, h, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),   # K pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),   # V pool stays in HBM
+    ]
+    inputs = [q, k_pool, v_pool]
+    if quantized:
+        # pre-gather the tiny scale vectors into sequence order (XLA-side:
+        # scale rows are not tile-aligned for the per-page DMA plan — see
+        # module docstring); the grid pipeline DMAs each row into VMEM.
+        # [Hkv, N, P] -> [Hkv, B, PP, P] -> [B, Hkv, 1, PP*P], padded up to
+        # a block-token multiple: the kernel slices fixed block_tokens-wide
+        # windows, and when pages_per_seq % pb != 0 the last window would
+        # run past cap — dynamic-slice CLAMPING would then silently feed
+        # valid tokens the wrong rows' scales.
+        block_tokens = pb * page_size
+        cap_pad = -(-cap // block_tokens) * block_tokens
+        pad = ((0, 0), (0, 0), (0, 0), (0, cap_pad - cap))
+
+        def gather(scale):
+            seq = jnp.moveaxis(
+                scale[:, page_table].reshape(hkv, b, cap), 0, 1
+            ).reshape(b, hkv, 1, cap)
+            return jnp.pad(seq, pad)
+
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, cap_pad), lambda b, h, pt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, cap_pad), lambda b, h, pt, ln: (b, h, 0, 0)),
+        ]
+        inputs += [gather(k_scale), gather(v_scale)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # page_table, lengths
         grid=(b, hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda b, h, pt, ln: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),   # K pool stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),   # V pool stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d), lambda b, h, pt, ln: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, pb * page_size, d), k_pool.dtype),
@@ -278,4 +401,4 @@ def paged_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         interpret=interpret,
-    )(page_table, lengths, q, k_pool, v_pool)
+    )(page_table, lengths, *inputs)

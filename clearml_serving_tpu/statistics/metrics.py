@@ -282,6 +282,20 @@ class EngineLifecycleCollector:
             "host time to sync + emit one retired chunk (ms)",
             labels=["model"],
         )
+        # paged KV pool capacity (docs/paged_kv_quant.md): bytes split by
+        # kind (kv = data planes, scale = int8 dequant scale rows) plus an
+        # info gauge carrying the pool dtype — the int8 capacity win is a
+        # dashboard line, not a code comment
+        kv_pool_bytes = GaugeMetricFamily(
+            p + "_kv_pool_bytes",
+            "device HBM held by the paged KV pools, by kind",
+            labels=["model", "kind"],
+        )
+        kv_pool_dtype = GaugeMetricFamily(
+            p + "_kv_pool_dtype",
+            "info gauge (always 1): storage dtype of the paged KV pools",
+            labels=["model", "dtype"],
+        )
 
         def _hist_buckets(snap):
             """Engine _MsHistogram snapshot -> prometheus cumulative
@@ -295,11 +309,20 @@ class EngineLifecycleCollector:
 
         any_grpc = False
         any_pipeline = False
+        any_kv_pool = False
         for key, provider in providers.items():
             try:
                 s = provider() or {}
             except Exception:
                 continue
+            kv_pool = s.get("kv_pool") or {}
+            if kv_pool:
+                any_kv_pool = True
+                for kind in ("kv", "scale"):
+                    if kind in kv_pool:
+                        kv_pool_bytes.add_metric([key, kind], kv_pool[kind])
+                if kv_pool.get("dtype"):
+                    kv_pool_dtype.add_metric([key, str(kv_pool["dtype"])], 1)
             pipe = s.get("pipeline") or {}
             if pipe:
                 any_pipeline = True
@@ -342,6 +365,9 @@ class EngineLifecycleCollector:
             yield pipe_depth
             yield dispatch_ms
             yield retire_ms
+        if any_kv_pool:
+            yield kv_pool_bytes
+            yield kv_pool_dtype
         if any_grpc:
             yield grpc
 

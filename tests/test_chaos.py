@@ -194,6 +194,88 @@ def test_paged_poison_recovery_conserves_pages(parts):
     assert pool.free_pages == pool.num_pages - 1
 
 
+def test_paged_poison_recovery_conserves_pages_int8(parts):
+    """int8 paged KV (docs/paged_kv_quant.md) under chaos: poison recovery
+    with int8 pools + radix shared-prefix reuse + copy-on-write, audited by
+    the armed sanitizer (scale rows share the page lifecycle, so a clean
+    page balance proves the scale pools balanced too)."""
+    bundle, _ = parts
+    qbundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32",
+                  "kv_quant": "int8"}
+    )
+    params = parts[1]
+    marker = 311
+    shared = [256] + [(i * 3 + 1) % 250 for i in range(31)]
+
+    async def run():
+        engine = _make_engine(
+            qbundle, params, decode_steps=1, cache_mode="paged",
+            page_size=16, prefill_buckets=[32, 64],
+            prefix_cache=8, prefix_block=16,
+            # eos disabled: the pin-induced CoW below needs request A still
+            # decoding when the pin lands (a sampled 257 would race it)
+            eos_token_id=None,
+        )
+        assert engine._sanitizer is not None, "TPUSERVE_SANITIZE did not arm"
+        assert engine.paged_cache.pool_dtype == "int8"
+        # cold admission stores the shared prefix; the next two map it by
+        # reference and their first decode write CoWs the shared tail page
+        await _collect(
+            engine, GenRequest(prompt_ids=shared, max_new_tokens=2)
+        )
+        a = GenRequest(prompt_ids=shared + [5], max_new_tokens=10)
+        a_task = asyncio.create_task(_collect(engine, a))
+        while a.produced < 2:
+            await asyncio.sleep(0.01)
+        # force a copy-on-write under live int8 decode: pin A's tail page
+        # (an ACCOUNTED transient ref, like an in-flight admission holds) so
+        # the next mid-page extend must give the slot a private copy — data
+        # plane AND scale rows (kv_cache.apply_pending_cow)
+        pool = engine.paged_cache.pool
+        a_slot = next(
+            (s for s, r in enumerate(engine._slot_req) if r is a), None
+        )
+        assert a_slot is not None, "request A left its slot before the pin"
+        pinned = [pool.slot_pages(a_slot)[-1]]
+        pool.pin_pages(pinned)
+        while pool.cow_events < 1 and a.produced < 8:
+            await asyncio.sleep(0.01)
+        pool.unpin_pages(pinned)
+        faults.configure([
+            {"point": "engine.decode", "action": "raise",
+             "match_token": marker, "times": 1, "message": "poisoned step"},
+        ])
+        b = GenRequest(prompt_ids=shared + [marker], max_new_tokens=10)
+        with pytest.raises(EngineStepError):
+            await _collect(engine, b)
+        out_a = await a_task
+        assert len(out_a) >= 1
+        t0 = time.monotonic()
+        while (
+            engine._loop_task is not None
+            and not engine._loop_task.done()
+            and time.monotonic() - t0 < 10.0
+        ):
+            await asyncio.sleep(0.01)
+        if engine._loop_task is not None and engine._loop_task.done():
+            assert engine._loop_task.exception() is None
+        return engine
+
+    engine = asyncio.run(run())
+    stats = engine._sanitizer.stats()
+    assert stats["checks"] > 0 and stats["failures"] == 0
+    assert engine._prefix.hits >= 1          # shared-prefix reuse happened
+    assert engine.paged_cache.pool.cow_events >= 1  # CoW exercised
+    pool = engine.paged_cache.pool
+    # at drain: only the radix cache may keep pages; every page it holds is
+    # accounted (the sanitizer's drain audit proved conservation already)
+    assert pool.free_pages == (
+        pool.num_pages - 1 - engine._prefix.cached_pages
+    )
+    engine.stop()
+
+
 def test_deliberate_leak_is_caught_with_named_pages(parts):
     """Acceptance: a seeded teardown bug (engine.release fault swallows the
     page free) must fail CLOSED — the sanitizer's drain audit raises

@@ -212,6 +212,96 @@ def test_write_prompt_shared_scatters_only_tail():
         cache2.write_prompt_shared(0, [1], 3, tail, tail, 6)
 
 
+# -- int8 pools: a page and its scale rows share one lifecycle ----------------
+
+
+def _int8_cache(**kw):
+    kw.setdefault("n_layers", 1)
+    kw.setdefault("n_kv_heads", 1)
+    kw.setdefault("head_dim", 2)
+    kw.setdefault("num_pages", 8)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_slots", 2)
+    return PagedKVCache(dtype="float32", kv_quant="int8", **kw)
+
+
+def test_int8_cow_copies_scale_rows_with_the_page():
+    """Copy-on-write on int8 pools must duplicate the page's scale rows in
+    the same batch as its data — a private copy dequantizing with the old
+    shared page's scales would corrupt every token in it."""
+    cache = _int8_cache()
+    pool = cache.pool
+    k = np.clip(np.arange(6 * 2, dtype=np.float32), 0, 126).reshape(1, 6, 1, 2)
+    k_q = k.astype(np.int8)
+    k_s = (0.25 + np.arange(6, dtype=np.float32)).reshape(1, 6, 1)
+    cache.write_prompt(0, k_q, k_q, 6, k_s, k_s * 2.0)
+    pages = pool.slot_pages(0)
+    pool.ref_pages([pages[1]])            # share the tail page
+    pool.extend(0, 1)
+    assert pool.cow_events == 1
+    assert cache.apply_pending_cow() == 1
+    new_tail = pool.slot_pages(0)[1]
+    np.testing.assert_array_equal(
+        np.asarray(cache.k[0, 0, new_tail]), np.asarray(cache.k[0, 0, pages[1]])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache.k_scale[0, 0, new_tail]),
+        np.asarray(cache.k_scale[0, 0, pages[1]]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cache.v_scale[0, 0, new_tail]),
+        np.asarray(cache.v_scale[0, 0, pages[1]]),
+    )
+
+
+def test_int8_write_prompt_shared_scatters_tail_scales():
+    """Shared-prefix admission on int8 pools: prefix scale rows ride the
+    shared page ids untouched; only the tail's scales scatter."""
+    cache = _int8_cache()
+    pool = cache.pool
+    k = np.arange(8 * 2, dtype=np.float32).reshape(1, 8, 1, 2).astype(np.int8)
+    s = (1.0 + np.arange(8, dtype=np.float32)).reshape(1, 8, 1)
+    cache.write_prompt(0, k, k, 8, s, s)
+    shared = pool.slot_pages(0)
+    pool.ref_pages(shared)
+    before = np.asarray(cache.k_scale[0, 0, shared[0]]).copy()
+    tail = np.full((1, 3, 1, 2), 7, np.int8)
+    tail_s = np.full((1, 3, 1), 0.5, np.float32)
+    # int8 pools refuse a shared-tail scatter without its scales
+    with pytest.raises(ValueError):
+        cache.write_prompt_shared(1, shared, 8, tail, tail, 11)
+    cache.write_prompt_shared(1, shared, 8, tail, tail, 11, tail_s, tail_s)
+    np.testing.assert_array_equal(
+        np.asarray(cache.k_scale[0, 0, shared[0]]), before
+    )
+    own = pool.slot_pages(1)[2]
+    np.testing.assert_array_equal(
+        np.asarray(cache.k_scale[0, 0, own, :3]), tail_s[0, :, 0]
+    )
+
+
+def test_int8_sanitizer_checks_scale_shape_and_names_scale_rows():
+    """Invariant 6: a scale pool whose page axis drifted from the allocator
+    fails the audit; drain leaks name the stranded scale rows."""
+    cache = _int8_cache()
+    pool = cache.pool
+    san = KVSanitizer(pool, paged_cache=cache)
+    san.check("step")  # consistent: passes
+    # leak: a slot abandons pages -> drain audit names pages AND scale rows
+    pool.allocate(0, 8)
+    with pytest.raises(KVSanitizerError) as err:
+        san.check("drain", drained=True)
+    assert "scale rows" in str(err.value)
+    pool.free(0)
+    # shape drift: scale pool no longer addresses the allocator's pages
+    import jax.numpy as jnp
+
+    cache.k_scale = jnp.zeros((1, 1, 4, 4), jnp.float32)
+    with pytest.raises(KVSanitizerError) as err:
+        san.check("step")
+    assert "lifecycle" in str(err.value)
+
+
 # -- transient pins (prefix-cache lookup accounting) --------------------------
 
 

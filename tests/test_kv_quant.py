@@ -103,7 +103,11 @@ def _engine(bundle, params, **kw):
 def _gen(engine, prompt, n=8, **req_kw):
     async def run():
         req = GenRequest(prompt_ids=list(prompt), max_new_tokens=n, **req_kw)
-        return [t async for t in engine.generate(req)]
+        out = [t async for t in engine.generate(req)]
+        # wait out in-flight pipelined chunks so page accounting is final
+        # before the paged assertions below
+        await engine.wait_drained()
+        return out
 
     return asyncio.run(run())
 
@@ -152,7 +156,64 @@ def test_prefix_cache_composes_with_kv_quant(parts):
     assert second == want
 
 
-def test_paged_cache_rejects_kv_quant(parts):
+def test_paged_engine_accepts_kv_quant(parts):
+    """The paged backend serves kv_quant=int8 (int8 page pools + per-page
+    scale rows, docs/paged_kv_quant.md): greedy streams match the dense
+    int8 engine byte for byte — both quantize identically via _kv_store
+    and dequantize in f32 before attending."""
     _, qbundle, params = parts
-    with pytest.raises(ValueError):
-        _engine(qbundle, params, cache_mode="paged")
+    prompt = [5, 9, 2, 17, 33]
+    paged = _engine(qbundle, params, cache_mode="paged")
+    assert paged.paged_cache.pool_dtype == "int8"
+    assert paged.paged_cache.has_scales
+    a = _gen(paged, prompt)
+    pool = paged.paged_cache.pool
+    assert pool.free_pages == pool.num_pages - 1  # drained: no leaked pages
+    paged.stop()
+    dense = _engine(qbundle, params, cache_mode="dense")
+    b = _gen(dense, prompt)
+    dense.stop()
+    assert a == b and len(a) == 8
+
+
+def test_paged_speculation_exact_under_kv_quant(parts):
+    """Greedy n-gram speculation over int8 paged pools stays token-identical
+    to the plain int8 paged chunk (verify_paged quantizes/dequantizes with
+    the same scale pools the decode kernel reads)."""
+    _, qbundle, params = parts
+    prompt = [5, 9, 2, 17, 5, 9, 2]
+    plain = _engine(qbundle, params, cache_mode="paged")
+    want = _gen(plain, prompt)
+    plain.stop()
+    spec = _engine(
+        qbundle, params, cache_mode="paged",
+        speculation="ngram", spec_k=2, spec_ngram=2,
+    )
+    got = _gen(spec, prompt)
+    spec.stop()
+    assert got == want
+
+
+def test_paged_prefix_cache_composes_with_kv_quant(parts, monkeypatch):
+    """Radix shared-prefix reuse over int8 pools: shared pages carry their
+    scale rows by page id, so warm admissions must replay the cold stream
+    exactly — audited by the armed KV sanitizer (scale-row lifecycle)."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    _, qbundle, params = parts
+    prompt = [(i * 5 + 1) % 256 for i in range(40)]
+    plain = _engine(
+        qbundle, params, cache_mode="paged", max_seq_len=160,
+        prefill_buckets=[32, 64],
+    )
+    want = _gen(plain, prompt, n=6)
+    plain.stop()
+    cached = _engine(
+        qbundle, params, cache_mode="paged", max_seq_len=160,
+        prefill_buckets=[32, 64], prefix_cache=4, prefix_block=16,
+    )
+    first = _gen(cached, prompt, n=6)
+    second = _gen(cached, prompt, n=6)
+    assert cached._prefix.hits >= 1
+    cached.stop()
+    assert first == want
+    assert second == want

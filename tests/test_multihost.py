@@ -30,7 +30,13 @@ sys.path.insert(0, {repo!r})
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    # jax >= 0.4.x with the explicit knob; absent it the stripped-env
+    # default is already ONE cpu device (the parent removed conftest's
+    # XLA_FLAGS), which is exactly what each worker wants
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 coordinator, pid = sys.argv[1], int(sys.argv[2])
@@ -142,7 +148,13 @@ sys.path.insert(0, {repo!r})
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:
+    # jax >= 0.4.x with the explicit knob; absent it the stripped-env
+    # default is already ONE cpu device (the parent removed conftest's
+    # XLA_FLAGS), which is exactly what each worker wants
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 coordinator, pid, state_root, service_id = (

@@ -378,11 +378,19 @@ def test_best_of_returns_top_ranked(llm_served):
 def test_best_of_ranking_is_by_cumulative_logprob(llm_served):
     """best_of=3, n=1 with user logprobs on: the returned choice's summed
     token logprobs must be >= every discarded candidate's (verified by
-    re-running the same seeds as plain n=3)."""
+    re-running the same seeds as plain n=3).
+
+    EOS is suppressed via logit_bias so every candidate runs to max_tokens:
+    the server ranks by vLLM cumulative_logprob, which INCLUDES the
+    finishing token's entry, while the response's token_logprobs exclude a
+    terminating EOS — a candidate that stops early would make the two
+    metrics diverge (its visible partial sum overstates its cumulative),
+    and whether one stops early shifts with the backend's sampling stream."""
 
     async def fn(client):
         body = {"model": "tiny_llm", "prompt": "go", "max_tokens": 6,
-                "temperature": 1.0, "seed": 11, "logprobs": 0}
+                "temperature": 1.0, "seed": 11, "logprobs": 0,
+                "logit_bias": {"257": -100}}  # ByteTokenizer EOS
         best = await client.post(
             "/serve/openai/v1/completions", json=dict(body, n=1, best_of=3))
         assert best.status == 200, await best.text()

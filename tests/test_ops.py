@@ -67,6 +67,79 @@ def test_paged_attention_single_token_sequence():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def _quantize_pool(pool):
+    """Per-(token, head) symmetric int8 like models/llama._kv_store."""
+    x = np.asarray(pool, np.float32)
+    absmax = np.abs(x).max(-1)
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(x / scale[..., None]), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale)
+
+
+def test_paged_attention_int8_xla_matches_dequantized_dense():
+    """The int8 XLA reference == running the bf16 reference over the
+    eagerly dequantized pools (the scale operands ARE the dequant)."""
+    q, k_pool, v_pool, page_table, lengths = _random_paged_setup(jax.random.PRNGKey(3))
+    k8, ks = _quantize_pool(k_pool)
+    v8, vs = _quantize_pool(v_pool)
+    out = paged_attention_xla(q, k8, v8, page_table, lengths, ks, vs)
+    kd = k8.astype(jnp.float32) * ks[..., None]
+    vd = v8.astype(jnp.float32) * vs[..., None]
+    ref = paged_attention_xla(q, kd, vd, page_table, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_int8_pallas_interpret_matches_xla():
+    """Tentpole parity gate (tier-1): the Pallas int8 kernel — in-kernel
+    dequant fused into the flash update — must match the XLA int8 gather
+    reference to (better than) bf16 epsilon in interpret mode, including
+    ragged lengths and an empty row."""
+    q, k_pool, v_pool, page_table, lengths = _random_paged_setup(jax.random.PRNGKey(4))
+    k8, ks = _quantize_pool(k_pool)
+    v8, vs = _quantize_pool(v_pool)
+    lengths = jnp.asarray([int(lengths[0]), 13, 0], jnp.int32)
+    ref = paged_attention_xla(q, k8, v8, page_table, lengths, ks, vs)
+    for pb in (1, 2, 32):
+        out = paged_attention(
+            q, k8, v8, page_table, lengths, k_scale=ks, v_scale=vs,
+            pages_per_block=pb, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_paged_attention_int8_partial_last_block_scales():
+    """pages_per_seq NOT a multiple of pages_per_block, with live tokens in
+    the final partial block: the kernel's fixed-width scale-window slices
+    must not clamp into earlier rows (the gathered scales pad up to a
+    block-token multiple). Regression for the r5 review finding."""
+    q, k_pool, v_pool, page_table, lengths = _random_paged_setup(
+        jax.random.PRNGKey(6), pages_per_seq=6, page_size=8
+    )
+    k8, ks = _quantize_pool(k_pool)
+    v8, vs = _quantize_pool(v_pool)
+    # lengths reach into the 6-page (48-token) capacity's final block when
+    # pb=4 (block = 32 tokens): tokens 33..47 live in the partial block
+    lengths = jnp.asarray([47, 35, 48], jnp.int32)
+    ref = paged_attention_xla(q, k8, v8, page_table, lengths, ks, vs)
+    out = paged_attention(
+        q, k8, v8, page_table, lengths, k_scale=ks, v_scale=vs,
+        pages_per_block=4, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_paged_attention_int8_requires_scales():
+    q, k_pool, v_pool, page_table, lengths = _random_paged_setup(jax.random.PRNGKey(5))
+    k8, _ = _quantize_pool(k_pool)
+    v8, _ = _quantize_pool(v_pool)
+    with pytest.raises(ValueError):
+        paged_attention(q, k8, v8, page_table, lengths, interpret=True)
+
+
 class TestPagePool:
     def test_alloc_free_cycle(self):
         pool = PagePool(num_pages=10, page_size=4, max_slots=3)
@@ -140,6 +213,52 @@ def test_paged_kv_cache_roundtrip():
     gathered = gathered.transpose(1, 2, 0, 3).reshape(-1, 2, 8)[:7]
     np.testing.assert_allclose(gathered[:6], np.asarray(k_stack[0]))
     np.testing.assert_allclose(gathered[6], np.asarray(k_new[0]))
+
+
+def test_paged_kv_cache_int8_roundtrip():
+    """int8 pools: prompt scatter + per-token append store int8 values with
+    their scale rows; dequantizing page-by-page recovers the source K/V to
+    int8 precision (|err| <= scale/2 per element)."""
+    cache = PagedKVCache(
+        n_layers=2, n_kv_heads=2, head_dim=8, num_pages=8, page_size=4,
+        max_slots=2, dtype="float32", kv_quant="int8",
+    )
+    assert cache.has_scales and cache.pool_dtype == "int8"
+    assert cache.k_scale.shape == (2, 2, 8, 4)
+    rng = np.random.default_rng(7)
+    length = 6
+    k_src = rng.normal(size=(2, length, 2, 8)).astype(np.float32)
+    v_src = k_src + 0.5
+
+    def store(x):  # [L, S, Hkv, D] -> (int8, scale [L, S, Hkv])
+        absmax = np.abs(x).max(-1)
+        scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.round(x / scale[..., None]), -127, 127).astype(np.int8)
+        return jnp.asarray(q), jnp.asarray(scale)
+
+    k_q, k_s = store(k_src)
+    v_q, v_s = store(v_src)
+    # scale operands are mandatory on int8 pools
+    with pytest.raises(ValueError):
+        cache.write_prompt(0, k_q, v_q, length)
+    cache.write_prompt(0, k_q, v_q, length, k_s, v_s)
+
+    k_tok = rng.normal(size=(2, 2, 8)).astype(np.float32)
+    kt_q, kt_s = store(k_tok[:, None])  # [L,1,Hkv,D] -> squeeze below
+    cache.append_token(
+        0, kt_q[:, 0], kt_q[:, 0], kt_s[:, 0], kt_s[:, 0]
+    )
+    assert cache.pool.slot_length(0) == 7
+
+    table = cache.pool.page_table(cache.max_pages_per_seq(16))
+    k_l0 = np.asarray(cache.k[0][:, table[0]])          # [Hkv, PP, P, D] int8
+    s_l0 = np.asarray(cache.k_scale[0][:, table[0]])    # [Hkv, PP, P]
+    deq = (k_l0.astype(np.float32) * s_l0[..., None])
+    deq = deq.transpose(1, 2, 0, 3).reshape(-1, 2, 8)[:7]
+    np.testing.assert_allclose(deq[:6], k_src[0], atol=np.abs(k_src).max() / 127)
+    np.testing.assert_allclose(
+        deq[6], k_tok[0], atol=np.abs(k_tok).max() / 127
+    )
 
 
 def test_quantize_roundtrip():

@@ -30,6 +30,18 @@ def parts():
     return bundle, params
 
 
+@pytest.fixture(scope="module")
+def qparts(parts):
+    """Same weights behind an int8-KV build (kv_quant applies to the cache,
+    not the params, so the trees are interchangeable)."""
+    bundle, params = parts
+    qbundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32",
+                  "kv_quant": "int8"}
+    )
+    return qbundle, params
+
+
 def _make(bundle, params, **kw):
     kw.setdefault("max_batch", 2)
     kw.setdefault("max_seq_len", 128)
@@ -92,6 +104,30 @@ def test_greedy_ab_identical_across_depths(parts, cache_mode, monkeypatch):
             pool = engine.paged_cache.pool
             # drained: every page back in the pool (no prefix cache here)
             assert pool.free_pages == pool.num_pages - 1
+        engine.stop()
+    assert outs[1] == outs[2]
+    assert all(len(s) >= 1 for s in outs[2])
+
+
+def test_greedy_ab_identical_across_depths_int8_paged(qparts, monkeypatch):
+    """docs/paged_kv_quant.md acceptance: with kv_quant=int8 on the PAGED
+    backend (int8 page pools + in-kernel dequant), greedy streams must stay
+    byte-identical between TPUSERVE_PIPELINE_DEPTH 1 and 2 — the scale
+    pools chain through the pipelined dispatches exactly like the data
+    pools, audited by the armed KV sanitizer."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    qbundle, params = qparts
+    outs = {}
+    for depth in (1, 2):
+        engine = _make(
+            qbundle, params, cache_mode="paged", pipeline_depth=depth
+        )
+        assert engine.paged_cache.pool_dtype == "int8"
+        outs[depth] = _run_group(
+            engine, _PROMPTS, max_new_tokens=23, temperature=0.0
+        )
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1
         engine.stop()
     assert outs[1] == outs[2]
     assert all(len(s) >= 1 for s in outs[2])

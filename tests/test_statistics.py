@@ -292,3 +292,72 @@ def test_engine_pipeline_metrics_exported():
     assert registry2.get_sample_value(
         "engine_pipeline_inflight", {"model": "m2"}
     ) is None
+
+
+def test_engine_kv_pool_metrics_exported():
+    """Paged-pool capacity observability (docs/paged_kv_quant.md): the
+    lifecycle collector exports engine_kv_pool_bytes{kind=kv|scale} and the
+    engine_kv_pool_dtype info gauge from the provider's ``kv_pool`` block —
+    the int8 halving must be visible on a dashboard, live against a real
+    engine's lifecycle_stats()."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "kv_pool": {
+            "kv": 1024, "scale": 256, "dtype": "int8",
+            "num_pages": 8, "page_size": 16,
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_kv_pool_bytes", kind="kv") == 1024
+    assert val("engine_kv_pool_bytes", kind="scale") == 256
+    assert val("engine_kv_pool_dtype", dtype="int8") == 1
+    # dense-backend providers (kv_pool None) export no pool families
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "kv_pool": None},
+        registry=registry2, key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_kv_pool_bytes", {"model": "m2", "kind": "kv"}
+    ) is None
+
+    # end to end against a REAL int8 paged engine's lifecycle_stats
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32",
+                  "kv_quant": "int8"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16], eos_token_id=None, cache_mode="paged",
+    )
+    try:
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+        expect = engine.paged_cache.pool_bytes()
+        assert registry3.get_sample_value(
+            "engine_kv_pool_bytes", {"model": "llm", "kind": "kv"}
+        ) == expect["kv"]
+        assert registry3.get_sample_value(
+            "engine_kv_pool_bytes", {"model": "llm", "kind": "scale"}
+        ) == expect["scale"]
+        assert expect["scale"] > 0
+        assert registry3.get_sample_value(
+            "engine_kv_pool_dtype", {"model": "llm", "dtype": "int8"}
+        ) == 1
+    finally:
+        engine.stop()

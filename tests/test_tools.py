@@ -94,6 +94,31 @@ def test_tool_call_schema_shapes():
         tool_call_schema(tools, "nope")
 
 
+def test_tool_call_schema_closes_argument_objects():
+    """OpenAI strict-tool-call semantics: argument schemas pin
+    additionalProperties: false AND type: object (a bare `parameters: {}`
+    has neither key), and the guided lowering turns the closed propertyless
+    object into exactly `{}` — a free-form object would let a constrained
+    decode wander until max_tokens instead of finishing the call."""
+    import re as _re
+
+    from clearml_serving_tpu.llm.guided import json_schema_to_regex
+
+    bare = {
+        "type": "function",
+        "function": {"name": "noop", "parameters": {}},
+    }
+    schema = tool_call_schema(validate_tools([bare]), None)
+    args = schema["properties"]["arguments"]
+    assert args["additionalProperties"] is False
+    assert args["type"] == "object"
+    pattern = _re.compile(json_schema_to_regex(args) + r"\Z")
+    assert pattern.match("{}")
+    assert pattern.match("{ }")
+    assert not pattern.match('{"surprise": 1}')
+    assert not pattern.match("42")
+
+
 def test_parse_tool_calls_formats():
     names = ["get_weather", "get_time"]
     # bare llama-3-style JSON, `arguments` or `parameters`
