@@ -723,6 +723,22 @@ def _pipeline_ab_smoke() -> None:
     print(json.dumps(row))
 
 
+def _loadtest(smoke: bool) -> None:
+    """``--loadtest [--smoke]``: SLO-aware-scheduling loadtest — open-loop
+    Poisson mixed-trace replay against the real engine with priority
+    classes, the preemptible batch lane, the brownout controller and the
+    armed KV sanitizer (benchmarks/slo_loadtest.py; docs/slo_scheduling.md).
+    Emits per-class p50/p99 TTFT + goodput vs offered-load curves and
+    updates benchmarks/LOADTEST_cpu.json."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks import slo_loadtest
+
+    row = slo_loadtest.run(smoke=smoke)
+    print(json.dumps(row))
+
+
 def _subprocess_env():
     """Env for child python processes that should reach the TPU.
 
@@ -806,6 +822,13 @@ if __name__ == "__main__":
         os.environ.get("BENCH_SCENARIO") == "paged_quant_ab"
     ):
         _paged_quant_ab_smoke()
+    elif "--loadtest" in sys.argv or (
+        os.environ.get("BENCH_SCENARIO") == "loadtest"
+    ):
+        _loadtest(
+            "--smoke" in sys.argv
+            or os.environ.get("BENCH_LOADTEST_SMOKE", "") in ("1", "true")
+        )
     else:
         try:
             main()

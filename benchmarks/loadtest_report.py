@@ -180,7 +180,12 @@ def main():
             max(64, args.n // 10),
             min(16, args.c),
         )
-        out_path = REPO / "benchmarks" / "LOADTEST_{}.json".format(args.platform)
+        # LOADTEST_<platform>.json now belongs to the SLO loadtest harness
+        # (benchmarks/slo_loadtest.py, `bench.py --loadtest`); this router-
+        # overhead report keeps its own artifact under a _router_ name
+        out_path = REPO / "benchmarks" / "LOADTEST_router_{}.json".format(
+            args.platform
+        )
         out_path.write_text(json.dumps(report, indent=2) + "\n")
         print(json.dumps(report))
     finally:

@@ -187,12 +187,44 @@ def main() -> int:
         emit({"metric": "llm_paged_kv_quant_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t3, 1)})
 
+    # -- phase 6: SLO loadtest CPU smoke (docs/slo_scheduling.md) -----------
+    # fast sanity of the scheduling stack — priority classes, preemptible
+    # batch lane, brownout — in a SUBPROCESS with the CPU backend forced
+    # (this process is bound to the axon/TPU platform; the loadtest drives
+    # the real engine end to end and must not contend for the chip). The
+    # child updates benchmarks/LOADTEST_cpu.json.
+    import subprocess
+
+    t4 = time.time()
+    try:
+        env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+        out = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--loadtest", "--smoke"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=str(REPO),
+        )
+        lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+        if out.returncode == 0 and lines:
+            row = json.loads(lines[-1])
+            row["wall_s"] = round(time.time() - t4, 1)
+            emit(row)
+            successes += 1
+        else:
+            emit({"metric": "llm_slo_loadtest_cpusmoke",
+                  "error": "rc={}: {}".format(
+                      out.returncode, (out.stderr or "").strip()[-300:]),
+                  "wall_s": round(time.time() - t4, 1)})
+    except Exception as ex:
+        emit({"metric": "llm_slo_loadtest_cpusmoke", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t4, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
         "spec_ab_wall_s": round(time.time() - t1, 1),
         "pipeline_ab_wall_s": round(time.time() - t2, 1),
         "paged_quant_ab_wall_s": round(time.time() - t3, 1),
+        "loadtest_wall_s": round(time.time() - t4, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

@@ -38,7 +38,9 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.decode.stall",
     "engine.decode.retire",
     "engine.admit",
+    "engine.admit.class",
     "engine.pool",
+    "engine.preempt",
     "engine.release",
     "grpc.call",
 })

@@ -69,6 +69,10 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "v_scale": (
         "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
     ),
+    # SLO scheduler pending-queue state (engine._ClassedPendingQueue,
+    # docs/slo_scheduling.md): per-class heaps + starvation counters
+    "_heaps": ("_lock", None),
+    "_starve": ("_lock", None),
 }
 
 _MUTATORS = {

@@ -71,15 +71,31 @@ class DeadlineExceededError(RequestError):
 
 
 class EngineOverloadedError(RequestError):
-    """Shed at admission: the pending queue or KV pool is saturated.
+    """Shed at admission: the pending queue or KV pool is saturated, or the
+    class-aware scheduler / brownout controller dropped the request
+    (docs/slo_scheduling.md).
 
     429 (not 503): the server is healthy, the CLIENT should back off and
-    retry — the Retry-After hint sizes the backoff.
+    retry — the Retry-After hint sizes the backoff. The engine derives it
+    from the observed admission drain rate, so deep queues advertise long
+    backoffs instead of a constant. ``shed_class`` names the priority class
+    the shed was booked under (surfaced in the JSON payload as ``class``).
     """
 
     status = 429
     code = "overloaded"
     default_retry_after = 1.0
+
+    def __init__(self, message: str, *, retry_after: Optional[float] = None,
+                 shed_class: Optional[str] = None):
+        super().__init__(message, retry_after=retry_after)
+        self.shed_class = shed_class
+
+    def payload(self) -> dict:
+        out = super().payload()
+        if self.shed_class:
+            out["class"] = self.shed_class
+        return out
 
 
 class EngineUnavailableError(RequestError):

@@ -32,6 +32,7 @@ VllmPreprocessRequest (reference preprocess_service.py:619-1348).
 from __future__ import annotations
 
 import asyncio
+import heapq
 import itertools
 import os
 import threading
@@ -91,6 +92,12 @@ class GenRequest:
     # grammar constraint (llm/guided.py GuidedSpec); compiled at admission,
     # enforced on device inside the decode scan
     guided: Optional[Any] = None
+    # SLO class (docs/slo_scheduling.md): "interactive" | "batch" |
+    # "best_effort". Strict class order across the per-class pending queues,
+    # EDF within a class; under overload best_effort sheds first, then
+    # batch, and batch-lane slots are preemptible when interactive work is
+    # queued. Endpoint-level default via aux engine.default_priority.
+    priority: str = "interactive"
     # engine-internal: combined-table DFA state after the first token
     _gstate0: int = -1
     _guided_key: Optional[str] = None
@@ -126,6 +133,22 @@ class GenRequest:
     _queue_deadline: Optional[float] = None
     _ttft_deadline: Optional[float] = None
     _deadline: Optional[float] = None
+    # engine-internal (preemptible batch lane): tokens emitted since the
+    # last (re)admission — a preempted request's full token history is
+    # prompt_ids + _gen_ids, which becomes the resume prompt so the radix
+    # prefix cache replays the generated-so-far KV with near-zero prefill
+    _gen_ids: List[int] = field(default_factory=list)
+    # times this request was preempted (bounded by the engine's preemption
+    # budget: an exhausted budget makes the request immune, so batch work
+    # still finishes under sustained interactive pressure)
+    _preempt_count: int = 0
+    # engine-internal (paged prefix cache): eviction pin on the preempted
+    # history's radix run, held from preemption until the resume admission's
+    # lookup (prefix_cache.pin_run) — without it, pool pressure while the
+    # request waits in the queue can evict exactly the KV the preemption
+    # promised to replay. Every queue-exit path must release it
+    # (engine._release_resume_pin)
+    _resume_pin: Optional[Any] = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -229,11 +252,25 @@ class _PrefillGate:
     """
 
     def __init__(self, segments_per_chunk: int = 2, stall_timeout: float = 2.0):
-        self._spc = max(1, int(segments_per_chunk))
+        self._spc_cfg = max(1, int(segments_per_chunk))
+        self._spc = self._spc_cfg
         self._stall_timeout = float(stall_timeout)
         self._cond = threading.Condition()
         self._permits = self._spc
         self._active = False
+
+    def set_budget(self, segments_per_chunk: Optional[int]) -> None:
+        """Brownout override of the per-chunk prefill budget (stage >= 3
+        shrinks it to 1 so decode slots drain ahead of new admissions);
+        ``None`` restores the configured value."""
+        with self._cond:
+            self._spc = (
+                max(1, int(segments_per_chunk))
+                if segments_per_chunk
+                else self._spc_cfg
+            )
+            self._permits = min(self._permits, self._spc)
+            self._cond.notify_all()
 
     def set_active(self, active: bool) -> None:
         """Loop thread: decode has (in)active slots; inactive opens the gate."""
@@ -252,8 +289,19 @@ class _PrefillGate:
             self._permits = self._spc
             self._cond.notify_all()
 
-    def acquire(self) -> None:
-        """Admission thread: blocks (boundedly) before one prefill dispatch."""
+    def acquire(self, bypass: bool = False) -> None:
+        """Admission thread: blocks (boundedly) before one prefill dispatch.
+
+        ``bypass`` (SINGLE-dispatch interactive admissions,
+        docs/slo_scheduling.md): skip the pacing — the gate exists to keep
+        multi-segment prefill trains from queueing ahead of decode chunks;
+        a one-dispatch admission cannot train, and parking that
+        first-token-critical enqueue behind a batch resume's permit is
+        priority inversion at the device queue. Multi-segment interactive
+        prefills stay paced: their segment train hurts co-resident
+        inter-token latency exactly like a batch one."""
+        if bypass:
+            return
         with self._cond:
             if not self._active:
                 return
@@ -265,6 +313,193 @@ class _PrefillGate:
             if self._permits > 0:
                 self._permits -= 1
             # timed out with no permit: proceed — starvation bound
+
+
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+_CLASS_RANK = {c: i for i, c in enumerate(PRIORITY_CLASSES)}
+
+
+class _ClassedPendingQueue:
+    """Per-class pending queues replacing the single `_pending` FIFO
+    (docs/slo_scheduling.md): strict class order across classes
+    (interactive > batch > best_effort), earliest-deadline-first within a
+    class (requests without a deadline order FIFO after every deadlined
+    one), and a starvation floor — a lower class that waited through
+    ``floor`` consecutive higher-class pops takes the next pop, so batch
+    work keeps trickling through sustained interactive load.
+
+    Production callers all run on the engine's event-loop thread, but the
+    structure is internally locked (tests and the watchdog's deadline sweep
+    may observe it from elsewhere)."""
+
+    __guarded_by__ = {"_lock": ("_heaps", "_starve")}
+
+    def __init__(self, starvation_floor: int = 8):
+        self._heaps: Dict[str, list] = {c: [] for c in PRIORITY_CLASSES}
+        self._seq = itertools.count()
+        self._floor = max(1, int(starvation_floor))
+        # consecutive higher-class pops each class sat through while
+        # non-empty; reset when the class pops
+        self._starve = {c: 0 for c in PRIORITY_CLASSES}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(request: "GenRequest") -> float:
+        d = request._deadline
+        return d if d is not None else float("inf")
+
+    def put_nowait(self, request: "GenRequest") -> None:
+        cls = getattr(request, "priority", None) or "interactive"
+        if cls not in self._heaps:
+            cls = "interactive"
+        with self._lock:
+            heapq.heappush(
+                self._heaps[cls], (self._key(request), next(self._seq), request)
+            )
+
+    def _pop_class(self, cls: str) -> "GenRequest":  # tpuserve: ignore[TPU301] lock held by caller
+        _, _, request = heapq.heappop(self._heaps[cls])
+        self._starve[cls] = 0
+        return request
+
+    def get_nowait(self) -> "GenRequest":
+        with self._lock:
+            # starvation floor first: a class that waited through `floor`
+            # higher-class pops gets this one (lowest starved class wins —
+            # it has, by construction, waited the longest)
+            for cls in reversed(PRIORITY_CLASSES):
+                if self._heaps[cls] and self._starve[cls] >= self._floor:
+                    return self._pop_class(cls)
+            for i, cls in enumerate(PRIORITY_CLASSES):
+                if self._heaps[cls]:
+                    for lower in PRIORITY_CLASSES[i + 1:]:
+                        if self._heaps[lower]:
+                            self._starve[lower] += 1
+                    return self._pop_class(cls)
+        raise asyncio.QueueEmpty
+
+    def qsize(self) -> int:
+        with self._lock:
+            return sum(len(h) for h in self._heaps.values())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def depths(self) -> Dict[str, int]:
+        """Per-class queue depths (lifecycle_stats / Prometheus)."""
+        with self._lock:
+            return {c: len(h) for c, h in self._heaps.items()}
+
+    def waiting(self, cls: str) -> int:
+        """LIVE queued requests of ``cls`` — cancelled/failed entries stay
+        heap-resident until a pop discards them, and preempting a batch
+        slot for a dead interactive request would burn its preemption
+        budget for nobody (the admission pop just drops the corpse)."""
+        with self._lock:
+            return sum(
+                1
+                for e in self._heaps.get(cls, ())
+                if not e[2].cancelled and e[2].error is None
+            )
+
+    def requests(self) -> List["GenRequest"]:
+        """Snapshot of every queued request (deadline sweeps)."""
+        with self._lock:
+            return [e[2] for h in self._heaps.values() for e in h]
+
+    def shed_lowest(self, above: str) -> Optional["GenRequest"]:
+        """Remove and return the lowest-class, latest-deadline queued
+        request whose class is STRICTLY lower priority than ``above``
+        (None when there is none): the class-aware shed path evicts it to
+        make room for a higher-class arrival — best-effort sheds first,
+        then batch."""
+        above_rank = _CLASS_RANK.get(above, 0)
+        with self._lock:
+            for cls in reversed(PRIORITY_CLASSES):
+                if _CLASS_RANK[cls] <= above_rank:
+                    return None
+                heap = self._heaps[cls]
+                # mid-stream requests (preempted resumes: produced > 0,
+                # consumer attached) are immune — shedding one turns an
+                # in-progress 200/SSE response into a mid-stream 429 and
+                # throws away its committed KV; with only resumes queued
+                # the ARRIVAL sheds at the door instead
+                live = [
+                    e for e in heap
+                    if not e[2].cancelled and e[2].error is None
+                    and e[2].produced == 0
+                ]
+                if not live:
+                    continue
+                victim = max(live, key=lambda e: (e[0], e[1]))
+                heap.remove(victim)
+                heapq.heapify(heap)
+                return victim[2]
+        return None
+
+    def pop_all(self) -> List["GenRequest"]:
+        """Drain every queued request (engine stop)."""
+        with self._lock:
+            out = [e[2] for h in self._heaps.values() for e in h]
+            for h in self._heaps.values():
+                h.clear()
+            return out
+
+
+class _BrownoutController:
+    """Staged overload degradation with hysteresis (docs/slo_scheduling.md).
+
+    A pressure score in [0, ~2] (max over queue-depth, pool-headroom,
+    deadline-hit and watchdog signals) drives the stage:
+
+    - stage 0: normal operation;
+    - stage 1: speculative decoding disabled (verify slack pressure off the
+      pool, fewer wasted positions per dispatch);
+    - stage 2: + batch-class ``max_new_tokens`` capped (long batch decodes
+      release their slots early);
+    - stage 3: + prefill admission budget shrunk to one segment per decode
+      chunk and best-effort traffic shed at the door.
+
+    Raising is immediate (the overload response must be fast). Lowering
+    requires the score to fall below the stage's DOWN threshold — strictly
+    below its UP threshold, the hysteresis band — AND a minimum dwell since
+    the last change, so a score oscillating across a threshold cannot flap
+    the stage."""
+
+    UP = (0.70, 0.85, 0.95)
+    DOWN = (0.50, 0.65, 0.80)
+
+    def __init__(self, dwell: float = 2.0):
+        self.dwell = float(dwell)
+        self.stage = 0
+        self.score = 0.0
+        self.signals: Dict[str, float] = {}
+        self.transitions = 0
+        self._changed_at = float("-inf")
+
+    def update(self, score: float, signals: Optional[dict] = None,
+               now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        self.score = float(score)
+        if signals is not None:
+            self.signals = dict(signals)
+        target_up = 0
+        for i, threshold in enumerate(self.UP):
+            if self.score >= threshold:
+                target_up = i + 1
+        if target_up > self.stage:
+            self.stage = target_up
+            self.transitions += 1
+            self._changed_at = now
+        elif (
+            self.stage > 0
+            and self.score < self.DOWN[self.stage - 1]
+            and now - self._changed_at >= self.dwell
+        ):
+            self.stage -= 1
+            self.transitions += 1
+            self._changed_at = now
+        return self.stage
 
 
 class LLMEngineCore:
@@ -314,6 +549,21 @@ class LLMEngineCore:
         # decode pipeline depth (None -> TPUSERVE_PIPELINE_DEPTH env, default
         # 2); 1 restores the serial dispatch->sync->emit loop
         pipeline_depth: Optional[int] = None,
+        # -- SLO-aware scheduling (docs/slo_scheduling.md) -----------------
+        # preemptible batch lane: under slot pressure with interactive work
+        # queued, batch-class slots are preempted at a chunk boundary (their
+        # generated-so-far KV committed into the radix prefix cache) and
+        # requeued; preempt_budget bounds preemptions per request
+        preempt_batch: bool = True,
+        preempt_budget: int = 2,
+        # starvation floor: a lower class that waited through this many
+        # higher-class queue pops takes the next pop
+        starvation_floor: int = 8,
+        # brownout controller: None -> enabled iff admission control is on
+        # (max_pending set); explicit True/False overrides
+        brownout: Optional[bool] = None,
+        brownout_batch_cap: int = 32,   # stage>=2 batch max_new_tokens cap
+        brownout_dwell: float = 2.0,    # min seconds between stage drops
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -540,7 +790,9 @@ class LLMEngineCore:
         self._bias_dev = None     # [B, V] float32 dense logit bias
         self._pmask_dev = None    # [B, V] bool prompt-token mask
 
-        self._pending: "asyncio.Queue[GenRequest]" = asyncio.Queue()
+        # per-class pending queues (strict class order, EDF within a class,
+        # starvation floor) — docs/slo_scheduling.md
+        self._pending = _ClassedPendingQueue(starvation_floor)
         self._loop_task: Optional[asyncio.Task] = None
         # -- request-lifecycle hardening state ----------------------------
         self.max_pending = int(max_pending) if max_pending else None
@@ -564,7 +816,27 @@ class LLMEngineCore:
             "deadline_total": 0,
             "watchdog_trips": 0,
             "step_failures": 0,
+            "preemptions": 0,
         }
+        # -- SLO-aware scheduling state (docs/slo_scheduling.md) ----------
+        # per-(reason, class) shed counters backing engine_sheds_total
+        self._class_sheds: Dict[str, Dict[str, int]] = {}
+        # recent admission-commit timestamps: the observed drain rate turns
+        # a 429's Retry-After from a constant into queue_depth / rate
+        self._admit_times: Deque[float] = deque(maxlen=32)
+        self._admit_count = 0
+        self._preempt = bool(preempt_batch)
+        self._preempt_budget = max(0, int(preempt_budget))
+        self._brownout = (
+            _BrownoutController(dwell=brownout_dwell)
+            if (brownout if brownout is not None else max_pending is not None)
+            else None
+        )
+        self._brownout_batch_cap = max(1, int(brownout_batch_cap))
+        self._brownout_checked = 0.0
+        # (t, deadline_hits, watchdog_trips, admits) snapshot anchoring the
+        # pressure window's deadline/watchdog rates
+        self._pressure_window: Optional[tuple] = None
         self._rng = jax.random.PRNGKey(rng_seed)
         self._rng_lock = threading.Lock()
         self._step_counter = itertools.count()
@@ -1381,6 +1653,12 @@ class LLMEngineCore:
                     len(request.prompt_ids), self.max_seq_len
                 )
             )
+        if request.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                "priority must be one of {} (got {!r})".format(
+                    "/".join(PRIORITY_CLASSES), request.priority
+                )
+            )
         if request.adapter and request.adapter not in self._adapter_index:
             raise ValueError(
                 "unknown lora adapter {!r} (loaded: {})".format(
@@ -1758,23 +2036,75 @@ class LLMEngineCore:
                 "request budget {}s already elapsed at submission".format(tot),
                 stage="total",
             )
+        cls = (
+            request.priority
+            if request.priority in PRIORITY_CLASSES
+            else "interactive"
+        )
+        self._update_brownout()
         try:
             faults.fire("engine.admit", request=request)
         except faults.InjectedFault as ex:
-            self.counters["sheds_queue"] += 1
+            self._count_shed("queue", cls)
             raise EngineOverloadedError(
-                "admission shed (injected): {}".format(ex)
+                "admission shed (injected): {}".format(ex),
+                retry_after=self._retry_after_hint(),
+                shed_class=cls,
             ) from ex
+        try:
+            # class-aware admission seam: chaos forces a class-policy shed
+            # regardless of queue state
+            faults.fire("engine.admit.class", request=request)
+        except faults.InjectedFault as ex:
+            self._count_shed("class", cls)
+            raise EngineOverloadedError(
+                "admission shed by class policy (injected): {}".format(ex),
+                retry_after=self._retry_after_hint(),
+                shed_class=cls,
+            ) from ex
+        if (
+            self._brownout is not None
+            and self._brownout.stage >= 3
+            and cls == "best_effort"
+        ):
+            # deepest brownout stage: best-effort traffic sheds at the door
+            # so interactive + batch keep the engine's remaining headroom
+            self._count_shed("brownout", cls)
+            raise EngineOverloadedError(
+                "brownout stage {}: best-effort traffic shed".format(
+                    self._brownout.stage
+                ),
+                retry_after=self._retry_after_hint(),
+                shed_class=cls,
+            )
         if (
             self.max_pending is not None
             and self._pending.qsize() + reserve >= self.max_pending
         ):
-            self.counters["sheds_queue"] += 1
-            raise EngineOverloadedError(
-                "pending queue full ({} waiting, bound {})".format(
-                    self._pending.qsize() + reserve, self.max_pending
+            # class-aware shedding: evict a strictly-lower-class queued
+            # request (best-effort first, then batch) to make room for a
+            # higher-class arrival; only a queue with nothing lower sheds
+            # the arrival itself
+            victim = self._pending.shed_lowest(cls)
+            if victim is not None:
+                self._release_resume_pin(victim)
+                self._count_shed("queue", victim.priority)
+                victim.error = EngineOverloadedError(
+                    "shed from the queue by a higher-priority admission",
+                    retry_after=self._retry_after_hint(),
+                    shed_class=victim.priority,
                 )
-            )
+                victim.cancelled = True  # admission pop skips it
+                victim.out_queue.put_nowait(_FINISHED)
+            else:
+                self._count_shed("queue", cls)
+                raise EngineOverloadedError(
+                    "pending queue full ({} waiting, bound {})".format(
+                        self._pending.qsize() + reserve, self.max_pending
+                    ),
+                    retry_after=self._retry_after_hint(),
+                    shed_class=cls,
+                )
         # KV-pool headroom: only enforced when admission control is
         # configured (max_pending set) — with unbounded admission the
         # historical queue-until-pages-free behavior stands
@@ -1794,12 +2124,264 @@ class LLMEngineCore:
             except faults.InjectedFault:
                 saturated = True
             if saturated:
-                self.counters["sheds_pool"] += 1
+                self._count_shed("pool", cls)
                 raise EngineOverloadedError(
                     "kv page pool saturated ({} free pages)".format(
                         pool.free_pages
-                    )
+                    ),
+                    retry_after=self._retry_after_hint(),
+                    shed_class=cls,
                 )
+
+    def _count_shed(self, reason: str, cls: str) -> None:
+        """Book one shed under both the legacy totals (sheds_queue /
+        sheds_pool) and the per-(reason, class) table backing
+        ``engine_sheds_total{reason,class}``."""
+        if reason == "pool":
+            self.counters["sheds_pool"] += 1
+        else:
+            self.counters["sheds_queue"] += 1
+        per = self._class_sheds.setdefault(reason, {})
+        per[cls] = per.get(cls, 0) + 1
+
+    def _retry_after_hint(self, ahead: Optional[int] = None) -> float:
+        """Seconds until the queue has likely drained enough for a retry to
+        land, derived from the OBSERVED admission drain rate (commits/s over
+        the recent window) instead of a constant: hint = (depth ahead + 1) /
+        rate, clamped to [0.5, 60]. With no drain observed yet the fallback
+        still grows with depth, so deep queues never advertise a 1 s retry."""
+        if ahead is None:
+            ahead = self._pending.qsize()
+        times = self._admit_times
+        rate = None
+        if len(times) >= 2:
+            # anchor the span at NOW, not at the last commit: a wedged loop
+            # would otherwise advertise the rate of a historical burst
+            # forever, inviting clients to hammer a non-draining engine
+            span = time.monotonic() - times[0]
+            if span > 0:
+                rate = (len(times) - 1) / span
+        if rate:
+            hint = (ahead + 1) / rate
+        else:
+            hint = 1.0 + 0.25 * ahead
+        return min(60.0, max(0.5, hint))
+
+    # -- brownout controller (docs/slo_scheduling.md) ---------------------
+
+    def _pressure_score(self) -> tuple:
+        """(score, signals): overload pressure in [0, ~2] as the max over
+        queue depth vs the admission bound, paged-pool occupancy, and the
+        deadline-hit / watchdog rates over a sliding ~5 s window."""
+        signals: Dict[str, float] = {}
+        if self.max_pending:
+            signals["queue"] = min(
+                2.0, self._pending.qsize() / float(self.max_pending)
+            )
+        if self.paged_cache is not None:
+            pool = self.paged_cache.pool
+            usable = max(1, pool.num_pages - 1)  # page 0 is the null page
+            headroom = pool.free_pages
+            if self._prefix is not None:
+                # budget-retained prefix-cache pages are reclaimable on
+                # demand (leaf-LRU eviction frees them when allocation
+                # needs room): counting them as occupancy would read a
+                # warm-but-idle cache as permanent overload and pin the
+                # brownout stage high with zero traffic. (Transiently
+                # optimistic about pinned preempted-history runs, which
+                # unpin at their resume's admission.)
+                headroom += self._prefix.cached_pages
+            signals["pool"] = max(0.0, (usable - headroom) / usable)
+        c = self.counters
+        deadlines = (
+            c["deadline_queue"] + c["deadline_ttft"] + c["deadline_total"]
+        )
+        now = time.monotonic()
+        win = self._pressure_window
+        if win is not None:
+            d_dead = deadlines - win[1]
+            d_trips = c["watchdog_trips"] - win[2]
+            d_admit = self._admit_count - win[3]
+            if d_dead + d_admit >= 4:
+                # minimum-volume floor: one expired request against zero
+                # admissions is a ratio of 1.0 — a single misbehaving
+                # client (e.g. submitting already-elapsed budgets) must
+                # not slam an idle engine into stage-3 brownout
+                signals["deadline"] = d_dead / float(d_dead + d_admit)
+            if d_trips > 0:
+                signals["watchdog"] = 1.0
+        if win is None or now - win[0] >= 5.0:
+            self._pressure_window = (
+                now, deadlines, c["watchdog_trips"], self._admit_count
+            )
+        return max(signals.values(), default=0.0), signals
+
+    def _update_brownout(self) -> None:
+        """Feed the pressure score into the brownout controller (throttled;
+        called from the loop top and from check_admission so the stage stays
+        live even while the loop sits in a long chunk) and apply the
+        stage's side effects that live outside the hot path."""
+        controller = self._brownout
+        if controller is None:
+            return
+        now = time.monotonic()
+        if now - self._brownout_checked < 0.1:
+            return
+        self._brownout_checked = now
+        score, signals = self._pressure_score()
+        prev = controller.stage
+        stage = controller.update(score, signals, now)
+        if stage != prev and self._prefill_gate is not None:
+            # stage 3 shrinks the prefill admission budget to one segment
+            # per decode chunk; dropping below restores the configured value
+            self._prefill_gate.set_budget(1 if stage >= 3 else None)
+
+    def _brownout_snapshot(self) -> Optional[dict]:
+        if self._brownout is None:
+            return None
+        return {
+            "stage": self._brownout.stage,
+            "score": round(self._brownout.score, 4),
+            "signals": {
+                k: round(v, 4) for k, v in self._brownout.signals.items()
+            },
+        }
+
+    def _effective_max_new(self, request: GenRequest) -> int:
+        """Brownout stage >= 2 caps batch-lane generation length so long
+        batch decodes release their slots early; the cap lifts with the
+        stage (a capped request already past the cap finishes at its next
+        emission)."""
+        if (
+            self._brownout is not None
+            and self._brownout.stage >= 2
+            and request.priority != "interactive"
+        ):
+            return min(request.max_new_tokens, self._brownout_batch_cap)
+        return request.max_new_tokens
+
+    # -- preemptible batch lane (docs/slo_scheduling.md) ------------------
+
+    def _maybe_preempt(self) -> None:
+        """Loop-thread, chunk boundary: under slot pressure with interactive
+        work queued, preempt batch-lane slots — one per queued interactive
+        request that has no free slot waiting for it. Each victim's
+        generated-so-far KV is committed into the radix prefix cache by page
+        reference first, so its re-admission replays the whole history with
+        near-zero prefill; the freed slots go through the normal
+        quarantine/pipeline-barrier machinery before reuse."""
+        if not self._preempt:
+            return
+        want = self._pending.waiting("interactive")
+        if want <= 0:
+            return
+        # quarantined-but-unowned slots count as free HERE: they become
+        # admissible the moment their pipeline barrier retires (within one
+        # chunk), and preempting another batch slot because the one just
+        # freed hasn't cleared quarantine yet would double-preempt per
+        # interactive arrival at pipeline depth >= 2
+        free = sum(
+            1
+            for i, r in enumerate(self._slot_req)
+            if r is None and i not in self._admitting
+        )
+        need = want - free
+        while need > 0:
+            victim_slot = None
+            victim_key = None
+            for slot, request in enumerate(self._slot_req):
+                if request is None or request.priority == "interactive":
+                    continue
+                if request.cancelled or request.produced < 1:
+                    continue
+                if request._preempt_count >= self._preempt_budget:
+                    continue  # budget exhausted: immune (starvation floor)
+                # resume replays through a fresh prefill of prompt+generated:
+                # exact only for plain sampling — grammar states, penalties,
+                # seeds-with-counters and logprob streams do not survive the
+                # round trip, so those slots are never victims
+                if request.guided is not None or self._gstate[slot] >= 0:
+                    continue
+                if (
+                    self._request_has_extras(request)
+                    or request.logprobs is not None
+                ):
+                    continue
+                key = (
+                    _CLASS_RANK[request.priority],      # lowest class first
+                    request._deadline
+                    if request._deadline is not None
+                    else float("inf"),                   # latest deadline
+                    -request.produced,                   # least progress
+                )
+                if victim_key is None or key > victim_key:
+                    victim_slot, victim_key = slot, key
+            if victim_slot is None or not self._preempt_slot(victim_slot):
+                return
+            need -= 1
+
+    def _preempt_slot(self, slot: int) -> bool:
+        """Preempt the batch-lane request in ``slot`` at a chunk boundary:
+        commit its generated-so-far KV into the radix prefix cache, free the
+        slot (quarantined while in-flight chunks still reference it), and
+        requeue the request with its full token history as the resume
+        prompt. The consumer's stream is untouched — resume continues
+        emitting into the same out_queue. Returns False when an injected
+        ``engine.preempt`` fault aborted the preemption (nothing leaks: the
+        radix store alone is the same store every admission commit runs)."""
+        request = self._slot_req[slot]
+        if request is None:
+            return False
+        history = list(request.prompt_ids) + [int(t) for t in request._gen_ids]
+        if self.paged_cache is not None and self._prefix is not None:
+            # store the final-KV prefix by reference to this slot's pages.
+            # Only block-aligned WHOLE pages are stored and the stored run
+            # ends at/below len(history)-1 — the last emitted token's KV is
+            # not written yet, and in-flight chunks only write at/after it,
+            # so every stored page is immutable from here on.
+            self._prefix.store_pages(
+                history, self._slot_lora(request),
+                self.paged_cache.pool.slot_pages(slot),
+            )
+        try:
+            faults.fire("engine.preempt", request=request)
+        except faults.InjectedFault:
+            # chaos seam, mid-commit: a failure here ABORTS the preemption.
+            # The request keeps decoding in its slot; the radix store above
+            # is identical to a normal admission-commit store (refcounted,
+            # CoW-protected), so no page leaks and no state is torn.
+            return False
+        self.counters["preemptions"] += 1
+        request._preempt_count += 1
+        request.prompt_ids = history
+        request._gen_ids = []
+        if self._prefix is not None and self.paged_cache is not None:
+            # hold the stored run against eviction until the resume's
+            # lookup: the whole point of the commit above is a near-zero
+            # prefill on re-admission, and queue-time pool pressure must
+            # not LRU it away (the resume would then recompile a fresh
+            # full-length prefill on the serving loop). A prior leg's pin
+            # is impossible here: it was released at this leg's admission
+            request._resume_pin = self._prefix.pin_run(
+                history, self._slot_lora(request)
+            )
+        # the queue-wait budget restarts for the resume leg: the request
+        # already proved admissible once, and expiring it for time spent
+        # GENERATING would punish the preempted class twice
+        qt = (
+            request.queue_timeout
+            if request.queue_timeout is not None
+            else self._queue_timeout
+        )
+        request._queue_deadline = (
+            time.monotonic() + qt if qt is not None else None
+        )
+        self._slot_req[slot] = None
+        self._release_guided(slot)  # no-op for victims; kept for symmetry
+        self._free_slot_pages(slot)
+        self._pending.put_nowait(request)
+        self._wake_loop()
+        return True
 
     def _resolve_deadlines(self, request: GenRequest) -> None:
         """Pin the request's monotonic deadlines at submission (per-request
@@ -1833,7 +2415,7 @@ class LLMEngineCore:
         self._resolve_deadlines(request)
         request.prompt_len = len(request.prompt_ids)
         request.out_queue = asyncio.Queue()
-        await self._pending.put(request)
+        self._pending.put_nowait(request)
         self._ensure_loop()
         self._wake_loop()
         try:
@@ -1858,8 +2440,8 @@ class LLMEngineCore:
         self._stopped = True
         err = EngineUnavailableError("engine stopped")
         self._fail_all(err)
-        while not self._pending.empty():
-            request = self._pending.get_nowait()
+        for request in self._pending.pop_all():
+            self._release_resume_pin(request)
             request.error = err
             request.out_queue.put_nowait(_FINISHED)
         self._wake_loop()  # unblock an idle loop so its cleanup runs
@@ -1908,6 +2490,9 @@ class LLMEngineCore:
             "recovering": self._recovering,
             "active_slots": self.active_slots,
             "queue_depth": self._pending.qsize(),
+            "queue_depths": self._pending.depths(),
+            "preemptions": self.counters["preemptions"],
+            "brownout": self._brownout_snapshot(),
             "watchdog_trips": self.counters["watchdog_trips"],
             "step_failures": self.counters["step_failures"],
             "pipeline": {
@@ -1923,9 +2508,16 @@ class LLMEngineCore:
         c = self.counters
         return {
             "queue_depth": self._pending.qsize(),
+            "queue_depths": self._pending.depths(),
             "active_slots": self.active_slots,
             "ready": int(self.is_ready),
             "sheds": {"queue": c["sheds_queue"], "pool": c["sheds_pool"]},
+            "sheds_by_class": {
+                reason: dict(per)
+                for reason, per in self._class_sheds.items()
+            },
+            "preemptions": c["preemptions"],
+            "brownout": self._brownout_snapshot(),
             "deadlines": {
                 "queue": c["deadline_queue"],
                 "ttft": c["deadline_ttft"],
@@ -2202,11 +2794,11 @@ class LLMEngineCore:
         """Fail queued requests whose queue-wait or total deadline elapsed.
         Runs on the loop thread (each iteration) and from the watchdog (so
         queued requests expire even while the loop is wedged)."""
-        queue = getattr(self._pending, "_queue", None)
+        queue = self._pending.requests()
         if not queue:
             return
         now = time.monotonic()
-        for request in list(queue):
+        for request in queue:
             if request.cancelled or request.error is not None:
                 continue
             err = None
@@ -2366,6 +2958,11 @@ class LLMEngineCore:
         lora_arr = jnp.asarray([lora_i], jnp.int32) if self._lora_enabled else None
         # automatic prefix caching: a stored block-aligned prefix of this
         # prompt (same adapter) skips straight to its remainder
+        # single-dispatch interactive admissions skip the prefill gate's
+        # pacing (a first-token-critical lone enqueue must not park behind
+        # a batch resume's permit — docs/slo_scheduling.md); multi-segment
+        # interactive trains stay paced like any other
+        gate_bypass = request.priority == "interactive"
         prefix_result = None
         if self._prefix is not None and not use_ring:
             if self.cache_mode == "paged":
@@ -2373,7 +2970,9 @@ class LLMEngineCore:
                     ids, lora_arr, lora_i, request
                 )
             else:
-                prefix_result = self._prefix_admission(ids, lora_arr, lora_i)
+                prefix_result = self._prefix_admission(
+                    ids, lora_arr, lora_i, gate_bypass
+                )
         c = self._chunked
         # the chunked mini cache must be a multiple of C: a final chunk
         # overflowing the bucket would be CLAMPED backward by
@@ -2415,7 +3014,9 @@ class LLMEngineCore:
                 )
                 if self._prefill_gate is not None:
                     # pace the segment train against decode chunks so the
-                    # device queue interleaves instead of bursting
+                    # device queue interleaves instead of bursting (chunked
+                    # admissions are multi-segment by construction: no
+                    # single-dispatch bypass here)
                     self._prefill_gate.acquire()
                 last_logits, cache = fn(
                     self.params,
@@ -2435,7 +3036,7 @@ class LLMEngineCore:
             else:
                 prefill_fn = self._prefill_jit
             if self._prefill_gate is not None:
-                self._prefill_gate.acquire()
+                self._prefill_gate.acquire(bypass=gate_bypass)
             last_logits, mini_cache = prefill_fn(
                 self.params, jnp.asarray(tokens), seq_lens, template, lora_arr
             )
@@ -2516,7 +3117,8 @@ class LLMEngineCore:
             return None
         return bucket
 
-    def _prefill_tail(self, cache, ids, prefix_len: int, lora_arr):
+    def _prefill_tail(self, cache, ids, prefix_len: int, lora_arr,
+                      gate_bypass: bool = False):
         """Prefill only the non-shared tail of ``ids`` through the donating
         prefill_chunk, attending over the prefix KV already in ``cache``.
         The cache is owned by this admission, so every segment may donate it
@@ -2525,12 +3127,15 @@ class LLMEngineCore:
         c2 = self._prefix_chunk
         last_logits = None
         starts = list(range(prefix_len, len(ids), c2))
+        # the single-dispatch bypass only applies to a one-segment tail: a
+        # longer train is paced exactly like a chunked cold prefill
+        gate_bypass = gate_bypass and len(starts) == 1
         for si, s in enumerate(starts):
             seg = ids[s : s + c2]
             seg_tokens = np.zeros((1, c2), np.int32)
             seg_tokens[0, : len(seg)] = seg
             if self._prefill_gate is not None:
-                self._prefill_gate.acquire()
+                self._prefill_gate.acquire(bypass=gate_bypass)
             last_logits, cache = self._prefill_chunk_jit(
                 self.params,
                 jnp.asarray(seg_tokens),
@@ -2542,7 +3147,8 @@ class LLMEngineCore:
             )
         return last_logits, cache
 
-    def _prefix_admission(self, ids, lora_arr, lora_i):
+    def _prefix_admission(self, ids, lora_arr, lora_i,
+                          gate_bypass: bool = False):
         """Dense prefix-cache hit path: assemble the tree's block run into a
         mini cache and prefill only the remainder through prefill_chunk.
         Returns (last_logits, mini_cache) or None (miss / doesn't fit)."""
@@ -2562,7 +3168,8 @@ class LLMEngineCore:
         cache = self._assemble_prefix_jit(
             template, hit["bufs"], jnp.asarray(prefix_len, jnp.int32)
         )
-        return self._prefill_tail(cache, ids, prefix_len, lora_arr)
+        return self._prefill_tail(cache, ids, prefix_len, lora_arr,
+                                  gate_bypass)
 
     def _prefix_admission_paged(self, ids, lora_arr, lora_i, request):
         """Paged prefix-cache hit path. The shared pages are PINNED by the
@@ -2600,7 +3207,8 @@ class LLMEngineCore:
                     *scale_args,
                 )
             last_logits, cache = self._prefill_tail(
-                cache, ids, prefix_len, lora_arr
+                cache, ids, prefix_len, lora_arr,
+                gate_bypass=request.priority == "interactive",
             )
         except BaseException:
             self._prefix.release(hit)
@@ -2615,11 +3223,26 @@ class LLMEngineCore:
         if hit is not None and self._prefix is not None:
             self._prefix.release(hit)
 
+    def _release_resume_pin(self, request: GenRequest) -> None:
+        """Drop the eviction pin a preemption took on the request's stored
+        history (prefix_cache.pin_run). Called once the resume's admission
+        lookup ran (the hit holds its own page pins from there) or when the
+        request leaves the queue without admission (shed, expired,
+        cancelled, engine stop). No-op otherwise."""
+        pin, request._resume_pin = request._resume_pin, None
+        if pin is not None and self._prefix is not None:
+            self._prefix.unpin_run(pin)
+
     def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache, first_lp=None) -> None:
         """Loop-thread-only: route the prefilled KV into the shared cache and
         activate the slot. Never runs concurrently with a decode chunk."""
-        self._insert_prefill(slot, mini_cache, request.prompt_len, request)
+        self._insert_prefill(slot, mini_cache, len(request.prompt_ids), request)
         self._slot_req[slot] = request
+        # admission-drain bookkeeping: the Retry-After hint derives from the
+        # rate these commits land at
+        self._admit_times.append(time.monotonic())
+        self._admit_count += 1
+        request._gen_ids = []  # resume leg: history now lives in prompt_ids
         self._next_token[slot] = first_id
         if self._tokbuf is not None:
             # speculation history invariant: row holds the prompt plus every
@@ -2689,6 +3312,7 @@ class LLMEngineCore:
             )
         except Exception as ex:
             # a failed admission fails only its own request
+            self._release_resume_pin(request)
             self._deref_guided_request(request)
             self._release_prefix_hit(request)
             request.error = ex
@@ -2696,6 +3320,9 @@ class LLMEngineCore:
             self._admitting.discard(slot)
             self._wake_loop()
             return
+        # the prefill's prefix lookup ran (hit or miss): the preemption-era
+        # eviction pin on the stored history has done its job
+        self._release_resume_pin(request)
         if self._stopped:
             self._deref_guided_request(request)
             self._release_prefix_hit(request)
@@ -2715,6 +3342,29 @@ class LLMEngineCore:
         """Route the prefilled prompt KV into the active cache backend."""
         if self.cache_mode == "paged":
             hit = request._prefix_hit if request is not None else None
+            page_size = self.paged_cache.pool.page_size
+
+            # loop-thread compile discipline: slice the mini cache with a
+            # DYNAMIC start and a PAGE-MULTIPLE static size, so the eager
+            # slice (and everything _scatter_pages derives from it) compiles
+            # once per (bucket, page-count), not once per token length —
+            # an exact [lo:hi] slice recompiled for every novel prompt/tail
+            # length ON THE COMMIT PATH (measured 80-200 ms stalls of every
+            # active stream under the preemptible lane's arbitrary-length
+            # resume prompts). Rows past `count` land in scatter positions
+            # the slot's length bookkeeping already treats as dead.
+            def _tail(buf, start, count):
+                import jax.lax as lax
+
+                padded = -(-count // page_size) * page_size
+                if start + padded > buf.shape[2]:
+                    # bucket not a page multiple (exotic config): exact
+                    # slice, at per-length compile cost
+                    padded = count
+                return lax.dynamic_slice_in_dim(
+                    buf, jnp.asarray(start, jnp.int32), padded, axis=2
+                )[:, 0]
+
             # int8 pools: the prefill mini cache already holds quantized K/V
             # plus per-token scales (the dense kv_quant layout); the scatter
             # carries the scale stacks [L, S, Hkv] beside the int8 pages
@@ -2722,8 +3372,8 @@ class LLMEngineCore:
                 if not self._paged_quant:
                     return ()
                 return (
-                    mini_cache["k_scale"][:, 0, lo:hi],
-                    mini_cache["v_scale"][:, 0, lo:hi],
+                    _tail(mini_cache["k_scale"], lo, hi - lo),
+                    _tail(mini_cache["v_scale"], lo, hi - lo),
                 )
 
             if hit is not None:
@@ -2735,8 +3385,10 @@ class LLMEngineCore:
                 try:
                     self.paged_cache.write_prompt_shared(
                         slot, hit["pages"], prefix_len,
-                        mini_cache["k"][:, 0, prefix_len:n_tokens],
-                        mini_cache["v"][:, 0, prefix_len:n_tokens],
+                        _tail(mini_cache["k"], prefix_len,
+                              n_tokens - prefix_len),
+                        _tail(mini_cache["v"], prefix_len,
+                              n_tokens - prefix_len),
                         n_tokens,
                         *_scales(prefix_len, n_tokens),
                     )
@@ -2745,10 +3397,12 @@ class LLMEngineCore:
                     self._prefix.release(hit)
             else:
                 # mini_cache k/v: [L,1,bucket,Hkv,D] -> stacked [L,S,Hkv,D]
-                k_stack = mini_cache["k"][:, 0, :n_tokens]
-                v_stack = mini_cache["v"][:, 0, :n_tokens]
                 self.paged_cache.write_prompt(
-                    slot, k_stack, v_stack, n_tokens, *_scales(0, n_tokens)
+                    slot,
+                    _tail(mini_cache["k"], 0, n_tokens),
+                    _tail(mini_cache["v"], 0, n_tokens),
+                    n_tokens,
+                    *_scales(0, n_tokens),
                 )
             if self._prefix is not None and request is not None:
                 # zero-copy store: the tree takes references on this slot's
@@ -2798,6 +3452,10 @@ class LLMEngineCore:
             # appended BEFORE the token is queued (see GenRequest contract)
             request.logprob_entries.append(lp)
         request.produced += 1
+        if request.priority != "interactive":
+            # preemptible lane: track emitted tokens so a preemption can
+            # fold them into the resume prompt (docs/slo_scheduling.md)
+            request._gen_ids.append(int(token_id))
         if request.first_token_at is None:
             request.first_token_at = time.time()  # client-observable TTFT
         request.out_queue.put_nowait(token_id)
@@ -2807,7 +3465,7 @@ class LLMEngineCore:
         total_len = request.prompt_len + request.produced
         if (
             token_id in stop_ids
-            or request.produced >= request.max_new_tokens
+            or request.produced >= self._effective_max_new(request)
             or total_len >= self.max_seq_len
         ):
             request.out_queue.put_nowait(_FINISHED)
@@ -3078,6 +3736,12 @@ class LLMEngineCore:
         while not self._stopped:
             # deadline sweep: queued requests expire where they wait
             self._expire_pending()
+            # SLO scheduling (docs/slo_scheduling.md): refresh the brownout
+            # stage from the pressure signals, then — under slot pressure
+            # with interactive work queued — preempt one batch-lane slot at
+            # this chunk boundary before admissions run
+            self._update_brownout()
+            self._maybe_preempt()
             # launch admissions for pending requests into reserved free slots
             # (quarantined slots stay unavailable: an in-flight chunk still
             # decodes their previous occupant — docs/pipelined_decode.md)
@@ -3091,6 +3755,7 @@ class LLMEngineCore:
             while free and not self._pending.empty():
                 request = self._pending.get_nowait()
                 if request.cancelled:
+                    self._release_resume_pin(request)
                     request.out_queue.put_nowait(_FINISHED)
                     continue
                 slot = free.pop(0)
@@ -3103,9 +3768,18 @@ class LLMEngineCore:
                 )
                 self._admission_tasks.add(task)
                 task.add_done_callback(self._admission_tasks.discard)
-            # commit finished prefills (loop thread; between decode chunks)
+            # commit finished prefills (loop thread; between decode chunks).
+            # Interactive commits land first: a commit IS the first token,
+            # so class order holds at this boundary too, not just at the
+            # queue pop (docs/slo_scheduling.md)
+            ready_batch = []
             while not self._ready.empty():
-                request, slot, first_id, mini_cache, first_lp = self._ready.get_nowait()
+                ready_batch.append(self._ready.get_nowait())
+            if len(ready_batch) > 1:
+                ready_batch.sort(
+                    key=lambda item: _CLASS_RANK.get(item[0].priority, 0)
+                )
+            for request, slot, first_id, mini_cache, first_lp in ready_batch:
                 self._admitting.discard(slot)
                 if request.cancelled:
                     self._deref_guided_request(request)
@@ -3183,7 +3857,12 @@ class LLMEngineCore:
         host-side token history they feed from is fully retired."""
         spec_masks = (
             self._spec_eligible_mask(active_mask)
-            if self._speculation and active_mask.any()
+            if self._speculation
+            and active_mask.any()
+            # brownout stage 1+ parks speculation: the verify slack's page
+            # over-allocation and the k wasted positions per reject are
+            # exactly the headroom an overloaded engine no longer has
+            and (self._brownout is None or self._brownout.stage < 1)
             else None
         )
         if spec_masks is not None and bool(
@@ -3290,7 +3969,7 @@ class LLMEngineCore:
             request = self._slot_req[slot]
             if request is not None and (
                 request.produced + pending_steps[slot]
-                >= request.max_new_tokens
+                >= self._effective_max_new(request)
             ):
                 mask[slot] = False
         return mask

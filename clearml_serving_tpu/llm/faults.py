@@ -25,8 +25,19 @@ Known points (ctx carried with each):
                          failure. Younger chunks may still be in flight.
 - ``engine.admit``     — inside check_admission (``request``); a raise is
                          converted to a load-shed (429).
+- ``engine.admit.class`` — inside check_admission's class-aware admission
+                         path (``request``); a raise forces a class-policy
+                         shed (429 with the request's priority class in the
+                         payload) regardless of queue state.
 - ``engine.pool``      — inside check_admission's KV-pool headroom check; a
                          raise simulates pool exhaustion.
+- ``engine.preempt``   — on the loop thread mid-preemption, AFTER the
+                         victim's generated-so-far KV was committed into the
+                         radix prefix cache and BEFORE its slot is freed /
+                         the request requeued (``request``); a raise aborts
+                         the preemption — the armed KV sanitizer must stay
+                         green (the store alone is a normal admission-commit
+                         store, so nothing may leak).
 - ``engine.release``   — at paged-slot teardown, before the slot's pages are
                          freed (``request``); a raise simulates a teardown
                          bug that LEAKS the slot's pages — the KV sanitizer
@@ -67,7 +78,9 @@ KNOWN_POINTS = frozenset({
     "engine.decode.stall",
     "engine.decode.retire",
     "engine.admit",
+    "engine.admit.class",
     "engine.pool",
+    "engine.preempt",
     "engine.release",
     "grpc.call",
 })

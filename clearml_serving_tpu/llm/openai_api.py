@@ -57,6 +57,9 @@ class LLMEngineRequest(BaseEngineRequest):
         # aux engine.chat block (reference vLLM chat_settings:
         # examples/vllm/preprocess.py:14-33): response_role etc.
         self._chat_cfg: Dict[str, Any] = {}
+        # endpoint-level SLO class default (docs/slo_scheduling.md): aux
+        # engine.default_priority; a request body `priority` overrides it
+        self._default_priority = "interactive"
         super().__init__(*args, **kwargs)
 
     # -- loading --------------------------------------------------------------
@@ -66,7 +69,7 @@ class LLMEngineRequest(BaseEngineRequest):
 
         from ..engines.jax_engine import enable_persistent_compilation_cache, load_bundle
         from .. import models
-        from .engine import LLMEngineCore
+        from .engine import LLMEngineCore, PRIORITY_CLASSES
 
         enable_persistent_compilation_cache()
         aux = self.endpoint.auxiliary_cfg if isinstance(self.endpoint.auxiliary_cfg, dict) else {}
@@ -248,7 +251,29 @@ class LLMEngineRequest(BaseEngineRequest):
             watchdog_interval=self._lifecycle_knob(
                 engine_cfg, "watchdog_interval", 30.0
             ),
+            # SLO-aware scheduling (docs/slo_scheduling.md): preemptible
+            # batch lane + brownout controller; aux engine.* knobs override
+            preempt_batch=bool(engine_cfg.get("preemption", True)),
+            preempt_budget=int(engine_cfg.get("preempt_budget", 2)),
+            starvation_floor=int(engine_cfg.get("starvation_floor", 8)),
+            brownout=(
+                bool(engine_cfg["brownout"])
+                if "brownout" in engine_cfg
+                else None
+            ),
+            brownout_batch_cap=int(engine_cfg.get("brownout_batch_cap", 32)),
+            brownout_dwell=float(engine_cfg.get("brownout_dwell", 2.0)),
         )
+        self._default_priority = str(
+            engine_cfg.get("default_priority", "interactive")
+        )
+        if self._default_priority not in PRIORITY_CLASSES:
+            # fail at ENDPOINT LOAD: a typo'd default would otherwise 422
+            # every request that omits an explicit body priority
+            raise ValueError(
+                "aux engine.default_priority must be one of {}: got {!r}"
+                .format("/".join(PRIORITY_CLASSES), self._default_priority)
+            )
         self._model_name = self.endpoint.serving_url
         if self.engine._prefix is not None:
             # hit rate / shared pages / CoW visible from day one on the same
@@ -415,6 +440,12 @@ class LLMEngineRequest(BaseEngineRequest):
                 float(body["ttft_timeout"])
                 if body.get("ttft_timeout") is not None
                 else None
+            ),
+            # SLO class: body `priority` wins, else the endpoint's aux
+            # engine.default_priority (docs/slo_scheduling.md); the engine's
+            # validate() rejects unknown values with a 422
+            priority=str(
+                body.get("priority") or self._default_priority
             ),
         )
         # vLLM `return_tokens_as_token_ids`: logprob token strings become

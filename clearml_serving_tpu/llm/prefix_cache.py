@@ -50,6 +50,7 @@ class _Node:
 
     __slots__ = (
         "parent", "edge", "children", "bufs", "pages", "nbytes", "last_used",
+        "pinned",
     )
 
     def __init__(self, parent: Optional["_Node"], edge: Tuple[int, ...]):
@@ -60,6 +61,9 @@ class _Node:
         self.pages: Optional[List[int]] = None       # paged payload
         self.nbytes = 0
         self.last_used = 0
+        # pin_run() holds: eviction must not drop this node (the engine
+        # promised a preempted request its history replays from the cache)
+        self.pinned = 0
 
 
 class RadixPrefixCache:
@@ -321,6 +325,35 @@ class RadixPrefixCache:
                 depth += self.block
             self._evict_over_budget()
 
+    def pin_run(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
+        """Protect the stored run for ``ids`` from eviction until
+        unpin_run(). The preemptible batch lane relies on this: a preempted
+        request's generated-so-far KV is stored here with the PROMISE that
+        its re-admission replays near-zero prefill — without the pin, pool
+        pressure while it waits in the queue can LRU-evict exactly those
+        nodes, and the resume silently degrades to a full prefill of an
+        arbitrary-length prompt (a fresh XLA compile per length, measured
+        80-200 ms stalls on the serving loop). Returns an opaque handle for
+        unpin_run(), or None when nothing is stored for ``ids``."""
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            if depth < self.block:
+                return None
+            nodes = self._path_nodes(node)
+            for n in nodes:
+                n.pinned += 1
+            return {"nodes": nodes, "len": depth}
+
+    def unpin_run(self, handle: Optional[Dict[str, Any]]) -> None:
+        """Release a pin_run() hold; eviction deferred by the pin (the cache
+        may sit over budget while pins are held) runs now."""
+        if not handle:
+            return
+        with self._lock:
+            for n in handle.pop("nodes", ()):
+                n.pinned = max(0, n.pinned - 1)
+            self._evict_over_budget()
+
     # -- eviction ------------------------------------------------------------
 
     def _over_budget(self) -> bool:
@@ -337,9 +370,14 @@ class RadixPrefixCache:
         that slot frees (the pool's refcount is the single source of
         truth)."""
         while self._over_budget():
-            if not self._leaf_nodes:
+            # pinned leaves (preempted-request histories awaiting resume)
+            # are never victims; their ancestors are not leaves while they
+            # live, so a whole pinned run survives. All leaves pinned =
+            # tolerate the overage until unpin_run() re-runs eviction.
+            candidates = [n for n in self._leaf_nodes if not n.pinned]
+            if not candidates:
                 return
-            victim = min(self._leaf_nodes, key=lambda n: n.last_used)
+            victim = min(candidates, key=lambda n: n.last_used)
             self._leaf_nodes.discard(victim)
             parent = victim.parent
             parent.children.pop(victim.edge, None)

@@ -305,6 +305,14 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
         not_ready = sorted(
             url for url, h in engines.items() if not h.get("ready")
         )
+        # brownout summary (docs/slo_scheduling.md): a browned-out engine is
+        # still READY — it is shedding load by policy, not failing — but
+        # operators and load balancers watching /ready should see the stage
+        brownout = {
+            url: (h.get("brownout") or {}).get("stage", 0)
+            for url, h in engines.items()
+            if (h.get("brownout") or {}).get("stage")
+        }
         draining = app["lifecycle"]["draining"]
         if draining or not_ready:
             return web.json_response(
@@ -312,6 +320,7 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
                     "status": "draining" if draining else "not_ready",
                     "instance": _instance_id(processor),
                     "not_ready": not_ready,
+                    "brownout": brownout,
                     "engines": engines,
                 },
                 status=503,
@@ -321,6 +330,7 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
             {
                 "status": "ready",
                 "instance": _instance_id(processor),
+                "brownout": brownout,
                 "engines": engines,
             }
         )

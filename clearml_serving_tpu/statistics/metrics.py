@@ -221,10 +221,14 @@ class EngineLifecycleCollector:
         with self._lock:
             providers = dict(self._providers)
         p = self._prefix
+        # per-class queue depth (docs/slo_scheduling.md): one series per
+        # priority class plus class="all" for the total; providers that
+        # report only a plain queue_depth int emit class="all"
         queue_depth = GaugeMetricFamily(
             p + "_queue_depth",
-            "requests waiting in the engine's admission queue",
-            labels=["model"],
+            "requests waiting in the engine's admission queue, by priority "
+            "class (class=\"all\" = total)",
+            labels=["model", "class"],
         )
         active_slots = GaugeMetricFamily(
             p + "_active_slots", "decode slots currently generating",
@@ -235,8 +239,26 @@ class EngineLifecycleCollector:
             "watchdog recovery in progress)", labels=["model"],
         )
         sheds = CounterMetricFamily(
-            p + "_sheds_total", "admissions shed at the front door",
-            labels=["model", "reason"],
+            p + "_sheds_total",
+            "admissions shed at the front door, by reason and priority "
+            "class (class=\"all\" = legacy per-reason totals)",
+            labels=["model", "reason", "class"],
+        )
+        preemptions = CounterMetricFamily(
+            p + "_preemptions_total",
+            "batch-lane slots preempted for queued interactive work "
+            "(docs/slo_scheduling.md)", labels=["model"],
+        )
+        brownout_stage = GaugeMetricFamily(
+            p + "_brownout_stage",
+            "staged-degradation level (0 = normal; 1 spec decode off; 2 + "
+            "batch token cap; 3 + prefill budget shrunk and best-effort "
+            "shed)", labels=["model"],
+        )
+        brownout_score = GaugeMetricFamily(
+            p + "_brownout_score",
+            "overload pressure score driving the brownout stage",
+            labels=["model"],
         )
         deadlines = CounterMetricFamily(
             p + "_deadline_hits_total",
@@ -310,6 +332,7 @@ class EngineLifecycleCollector:
         any_grpc = False
         any_pipeline = False
         any_kv_pool = False
+        any_slo = False
         for key, provider in providers.items():
             try:
                 s = provider() or {}
@@ -336,14 +359,31 @@ class EngineLifecycleCollector:
                     if snap:
                         buckets, total = _hist_buckets(snap)
                         fam.add_metric([key], buckets, total)
+            qd_classes = s.get("queue_depths")
+            if isinstance(qd_classes, dict):
+                for cls_name, v in qd_classes.items():
+                    queue_depth.add_metric([key, str(cls_name)], v)
             if "queue_depth" in s:
-                queue_depth.add_metric([key], s["queue_depth"])
+                queue_depth.add_metric([key, "all"], s["queue_depth"])
             if "active_slots" in s:
                 active_slots.add_metric([key], s["active_slots"])
             if "ready" in s:
                 ready.add_metric([key], s["ready"])
+            by_class = s.get("sheds_by_class")
+            if isinstance(by_class, dict):
+                for reason, per in by_class.items():
+                    for cls_name, v in (per or {}).items():
+                        sheds.add_metric([key, str(reason), str(cls_name)], v)
             for reason, v in (s.get("sheds") or {}).items():
-                sheds.add_metric([key, reason], v)
+                sheds.add_metric([key, reason, "all"], v)
+            if "preemptions" in s:
+                any_slo = True
+                preemptions.add_metric([key], s["preemptions"])
+            brown = s.get("brownout")
+            if isinstance(brown, dict):
+                any_slo = True
+                brownout_stage.add_metric([key], brown.get("stage", 0))
+                brownout_score.add_metric([key], brown.get("score", 0.0))
             for stage, v in (s.get("deadlines") or {}).items():
                 deadlines.add_metric([key, stage], v)
             if "watchdog_trips" in s:
@@ -360,6 +400,10 @@ class EngineLifecycleCollector:
         yield deadlines
         yield trips
         yield failures
+        if any_slo:
+            yield preemptions
+            yield brownout_stage
+            yield brownout_score
         if any_pipeline:
             yield inflight
             yield pipe_depth

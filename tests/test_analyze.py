@@ -409,10 +409,12 @@ def test_configure_rejects_unknown_point():
 
 
 def test_guarded_by_declarations_match_project_registry():
+    from clearml_serving_tpu.llm.engine import _ClassedPendingQueue
     from clearml_serving_tpu.llm.kv_cache import PagedKVCache, PagePool
     from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
 
-    for cls in (PagePool, PagedKVCache, RadixPrefixCache):
+    for cls in (PagePool, PagedKVCache, RadixPrefixCache,
+                _ClassedPendingQueue):
         for lock, attrs in cls.__guarded_by__.items():
             for attr in attrs:
                 entry = rules_locks.PROJECT_REGISTRY.get(attr)

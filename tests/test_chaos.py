@@ -734,6 +734,129 @@ def test_retire_fault_isolates_matched_request(parts):
     assert engine.is_ready
 
 
+def test_injected_class_shed(parts):
+    """The engine.admit.class seam forces a class-policy shed: structured
+    429 carrying the request's priority class, booked under reason
+    'class'."""
+    bundle, params = parts
+    engine = _make_engine(bundle, params)
+    faults.configure([{"point": "engine.admit.class", "times": 1}])
+    with pytest.raises(EngineOverloadedError) as ei:
+        engine.check_admission(
+            GenRequest(prompt_ids=[256], max_new_tokens=1, priority="batch")
+        )
+    assert ei.value.shed_class == "batch"
+    assert engine._class_sheds["class"]["batch"] == 1
+    engine.check_admission(
+        GenRequest(prompt_ids=[256], max_new_tokens=1, priority="batch")
+    )
+    engine.stop()
+
+
+# -- preemptible batch lane under chaos (docs/slo_scheduling.md) --------------
+
+
+def test_preempt_fault_mid_commit_aborts_without_leaking_pages(parts):
+    """An engine.preempt fault fires mid-preemption — AFTER the victim's
+    generated-so-far KV was committed into the radix cache, BEFORE the slot
+    free/requeue. The preemption must abort cleanly: the victim keeps
+    decoding, a later retry succeeds, and page accounting stays balanced
+    under the armed sanitizer (the radix store alone is a normal
+    admission-commit store)."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(
+            bundle, params, max_batch=1, decode_steps=2, cache_mode="paged",
+            page_size=16, prefix_cache=64, prefix_block=16,
+            prefill_buckets=[32, 64], eos_token_id=None,
+        )
+        assert engine._sanitizer is not None, "TPUSERVE_SANITIZE did not arm"
+        batch = GenRequest(
+            prompt_ids=[256] + [(i * 3 + 1) % 250 for i in range(16)],
+            max_new_tokens=30, priority="batch",
+        )
+        b_task = asyncio.create_task(_collect(engine, batch))
+        while batch.produced < 4:
+            await asyncio.sleep(0.005)
+        # the FIRST preemption attempt dies mid-commit; the retry (next
+        # chunk boundary) must succeed
+        faults.configure([{"point": "engine.preempt", "times": 1}])
+        hi = GenRequest(prompt_ids=[256, 9], max_new_tokens=2)
+        out_hi = await asyncio.wait_for(_collect(engine, hi), timeout=60)
+        assert len(out_hi) >= 1
+        out_b = await asyncio.wait_for(b_task, timeout=60)
+        assert len(out_b) == 30
+        await engine.wait_drained()
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["preemptions"] >= 1, "retry never preempted"
+    stats = engine._sanitizer.stats()
+    assert stats["checks"] > 0 and stats["failures"] == 0
+    pool = engine.paged_cache.pool
+    assert pool.free_pages == (
+        pool.num_pages - 1 - engine._prefix.cached_pages
+    )
+    engine.stop()
+
+
+def test_seeded_interactive_stream_identical_across_batch_preemption(parts):
+    """Acceptance (ISSUE 6): a SEEDED interactive stream must be
+    byte-identical whether or not a batch neighbor was preempted — seeded
+    sampling keys on (seed, tokens-generated) per slot, so scheduler
+    decisions about neighbors must never leak into the stream."""
+    bundle, params = parts
+    seed_req = dict(
+        prompt_ids=[256, 11, 12, 13], max_new_tokens=16, temperature=0.9,
+        seed=1234,
+    )
+
+    def make_engine():
+        return _make_engine(
+            bundle, params, max_batch=2, decode_steps=2, cache_mode="paged",
+            page_size=16, prefix_cache=64, prefix_block=16,
+            prefill_buckets=[16, 32], eos_token_id=None,
+        )
+
+    async def alone():
+        engine = make_engine()
+        out = await _collect(engine, GenRequest(**seed_req))
+        await engine.wait_drained()
+        engine.stop()
+        return out
+
+    async def with_preempted_neighbors():
+        engine = make_engine()
+        victims = [
+            GenRequest(
+                prompt_ids=[256, 40 + i, 41], max_new_tokens=40,
+                priority="batch",
+            )
+            for i in range(2)
+        ]
+        tasks = [asyncio.create_task(_collect(engine, v)) for v in victims]
+        while not all(v.produced >= 2 for v in victims):
+            await asyncio.sleep(0.005)
+        # both slots busy with batch work: the seeded interactive request
+        # forces a preemption
+        out = await asyncio.wait_for(
+            _collect(engine, GenRequest(**seed_req)), timeout=60
+        )
+        for t in tasks:
+            await asyncio.wait_for(t, timeout=60)
+        await engine.wait_drained()
+        return engine, out
+
+    expected = asyncio.run(alone())
+    engine, got = asyncio.run(with_preempted_neighbors())
+    assert engine.counters["preemptions"] >= 1, "no neighbor was preempted"
+    assert got == expected, "seeded stream diverged across preemption"
+    stats = engine._sanitizer.stats()
+    assert stats["checks"] > 0 and stats["failures"] == 0
+    engine.stop()
+
+
 def test_stop_with_chunks_in_flight_reclaims_pages(parts):
     """stop() while the depth-2 pipeline holds undelivered chunks: every
     consumer unblocks with EngineUnavailableError and the loop's exit path

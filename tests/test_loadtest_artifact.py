@@ -1,0 +1,71 @@
+"""Tier-1 guard on the SLO loadtest artifact (benchmarks/LOADTEST_cpu.json).
+
+The artifact is the committed evidence for the ISSUE 6 headline claim (at
+>= 2x saturation: bounded interactive p99 TTFT, smooth batch goodput
+degradation, sanitizer-clean preemptions). This test pins its SCHEMA — the
+battery's phase 6 and `bench.py --loadtest --smoke` both regenerate it, and
+a drifting shape would silently break the ROOFLINE.md methodology and any
+dashboards reading it. It does NOT re-run the loadtest (tier-1 stays fast);
+the committed numbers themselves are asserted only for internal
+consistency, not re-measured.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from benchmarks.slo_loadtest import (  # noqa: E402
+    CLASS_KEYS,
+    CLASSES,
+    HEADLINE_KEYS,
+    LOAD_KEYS,
+    SCHEMA_KEYS,
+    TRACES,
+)
+
+
+def _artifact():
+    return json.loads((REPO / "benchmarks" / "LOADTEST_cpu.json").read_text())
+
+
+def test_artifact_schema():
+    row = _artifact()
+    assert SCHEMA_KEYS <= set(row), "missing top-level keys"
+    assert row["metric"].startswith("llm_slo_loadtest")
+    assert set(row["mix"]) == {t["name"] for t in TRACES}
+    assert {"p50", "p99", "samples"} <= set(row["unloaded_ttft_ms"])
+    assert len(row["loads"]) >= 3, "sweep needs 0.5x/1x/2x points"
+    for load in row["loads"]:
+        assert LOAD_KEYS <= set(load)
+        assert set(load["classes"]) == set(CLASSES)
+        for cls in CLASSES:
+            assert CLASS_KEYS <= set(load["classes"][cls]), cls
+    assert HEADLINE_KEYS <= set(row["headline"])
+
+
+def test_artifact_internal_consistency():
+    row = _artifact()
+    loads = sorted(row["loads"], key=lambda l: l["x_saturation"])
+    assert loads[-1]["x_saturation"] >= 2.0, "no >=2x overload point"
+    head = row["headline"]
+    # the committed artifact must carry a PASSING headline: bounded
+    # interactive tail, no batch cliff, sanitizer-clean preemptions
+    assert head["ttft_within_bound"] is True
+    assert head["batch_no_cliff"] is True
+    assert head["preemptions_total"] >= 10
+    assert head["sanitizer_violations"] == 0
+    assert head["sanitizer_checks"] > 0
+    # headline fields restate the curves they were derived from
+    at_2x = loads[-1]["classes"]["interactive"]
+    assert head["interactive_p99_ttft_at_2x_ms"] == at_2x["ttft_p99_ms"]
+    assert head["batch_goodput_curve_tok_s"] == [
+        l["classes"]["batch"]["goodput_tok_s"] for l in row["loads"]
+    ]
+    # per-class accounting adds up
+    for load in row["loads"]:
+        for cls in CLASSES:
+            c = load["classes"][cls]
+            assert c["completed"] + c["shed"] + c["errors"] <= c["requests"]

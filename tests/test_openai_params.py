@@ -734,3 +734,80 @@ def test_suffix_rejected(llm_served):
         return r.status
 
     assert _run(llm_served, fn) == 422
+
+
+def test_priority_class_route_level(llm_served):
+    """SLO classes (docs/slo_scheduling.md): body `priority` reaches the
+    engine (unknown values 422 before streaming), and the endpoint-level
+    aux engine.default_priority fills it in when absent."""
+
+    async def fn(client):
+        bad = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(priority="vip"),
+        )
+        ok = await client.post(
+            "/serve/openai/v1/chat/completions",
+            json=_chat_body(priority="batch", max_tokens=2),
+        )
+        return bad.status, ok.status
+
+    bad_status, ok_status = _run(llm_served, fn)
+    assert bad_status == 422
+    assert ok_status == 200
+
+    # endpoint default plumbs through the request builder; an explicit
+    # body priority wins over it
+    proc = llm_served._engine_processor_lookup["tiny_llm"]
+    assert proc._default_priority == "interactive"
+    proc._default_priority = "batch"
+    try:
+        req = proc._gen_request_from_body({"max_tokens": 2}, [1, 2, 3])
+        assert req.priority == "batch"
+        req = proc._gen_request_from_body(
+            {"max_tokens": 2, "priority": "best_effort"}, [1, 2, 3]
+        )
+        assert req.priority == "best_effort"
+    finally:
+        proc._default_priority = "interactive"
+
+
+def test_default_priority_typo_fails_at_endpoint_load(tmp_path):
+    """aux engine.default_priority is validated when the endpoint LOADS: a
+    typo'd value must fail fast there, not 422 every request that omits an
+    explicit body priority."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="badprio"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_prio",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "default_priority": "Interactive",  # typo'd case
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "bad_prio", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    # the router surfaces the load failure with the CONFIG error (naming
+    # the knob), and the endpoint never registers — not a per-request 422
+    # that would misdirect debugging at the request body
+    assert status == 422 and "default_priority" in text, (status, text)
+    assert "bad_prio" not in mrp._engine_processor_lookup

@@ -186,6 +186,44 @@ def test_paged_eviction_never_frees_live_slot_pages():
     assert pool.free_pages == free_before + len(pages0)
 
 
+def test_pin_run_protects_stored_run_from_eviction():
+    """The preemptible batch lane's contract (docs/slo_scheduling.md): a
+    pinned run (a preempted request's stored history) survives LRU eviction
+    under budget pressure; unpinning re-enables eviction."""
+    cache, pool = _paged_cache(max_nodes=1)
+    pool.allocate(0, 6)
+    pages0 = pool.slot_pages(0)
+    cache.store_pages([1, 2, 3, 4, 5, 6], 0, pages0)
+    pin = cache.pin_run([1, 2, 3, 4, 5, 6], 0)
+    assert pin is not None and pin["len"] == 4
+    # over max_nodes with the only other leaf pinned: the NEW store's own
+    # nodes are the eviction candidates, the pinned run survives
+    pool.allocate(1, 6)
+    cache.store_pages([7, 8, 9, 10, 11, 12], 0, pool.slot_pages(1))
+    # (5-token query: the final token always computes live, so a 4-token
+    # query can match at most 0)
+    assert cache.match_len([1, 2, 3, 4, 9]) == 4, "pinned run was evicted"
+    # a resume-style lookup still hits and pins pages as usual
+    hit = cache.lookup_pages([1, 2, 3, 4, 9, 9], 0)
+    assert hit is not None and hit["len"] == 4
+    cache.release(hit)
+    # unpin: deferred eviction brings the tree back under budget, and the
+    # previously pinned run is evictable again
+    cache.unpin_run(pin)
+    assert len(cache) <= 1
+    pool.allocate(2, 6)
+    cache.store_pages([13, 14, 15, 16, 17, 18], 0, pool.slot_pages(2))
+    assert cache.match_len([1, 2, 3, 4, 9]) == 0, (
+        "unpinned run must be evictable"
+    )
+
+
+def test_pin_run_miss_returns_none_and_unpin_tolerates_it():
+    cache, pool = _paged_cache()
+    assert cache.pin_run([1, 2, 3], 0) is None  # nothing stored
+    cache.unpin_run(None)  # no-op by contract
+
+
 # -- engine (dense backend) ---------------------------------------------------
 
 

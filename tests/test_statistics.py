@@ -207,18 +207,20 @@ def test_engine_lifecycle_collector_exports_counters_and_gauges():
     def val(name, **labels):
         return registry.get_sample_value(name, {"model": "m1", **labels})
 
-    assert val("engine_queue_depth") == 3
+    # plain queue_depth ints land under class="all" (legacy providers);
+    # per-class series come from a queue_depths dict (see the SLO test)
+    assert val("engine_queue_depth", **{"class": "all"}) == 3
     assert val("engine_active_slots") == 2
     assert val("engine_ready") == 1
-    assert val("engine_sheds_total", reason="queue") == 4
-    assert val("engine_sheds_total", reason="pool") == 1
+    assert val("engine_sheds_total", reason="queue", **{"class": "all"}) == 4
+    assert val("engine_sheds_total", reason="pool", **{"class": "all"}) == 1
     assert val("engine_deadline_hits_total", stage="ttft") == 1
     assert val("engine_watchdog_trips_total") == 1
     assert val("engine_step_failures_total") == 2
 
     # gauges move on the next scrape (read live, not pushed)
     stats["queue_depth"] = 7
-    assert val("engine_queue_depth") == 7
+    assert val("engine_queue_depth", **{"class": "all"}) == 7
 
     # the gRPC client's retry stats ride the same collector
     from clearml_serving_tpu.engines.grpc_client import grpc_lifecycle_stats
@@ -236,7 +238,7 @@ def test_engine_lifecycle_collector_exports_counters_and_gauges():
         lambda: {"queue_depth": 0, "active_slots": 0}, registry=registry,
         key="m1",
     )
-    assert val("engine_queue_depth") == 0
+    assert val("engine_queue_depth", **{"class": "all"}) == 0
 
 
 def test_engine_pipeline_metrics_exported():
@@ -292,6 +294,123 @@ def test_engine_pipeline_metrics_exported():
     assert registry2.get_sample_value(
         "engine_pipeline_inflight", {"model": "m2"}
     ) is None
+
+
+def test_engine_slo_metrics_exported():
+    """SLO-scheduling observability (docs/slo_scheduling.md): per-class
+    queue depths, per-(reason, class) sheds, the preemption counter and the
+    brownout stage/score gauges — from a synthetic provider AND end to end
+    against a real engine's lifecycle_stats()."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 5,
+        "queue_depths": {"interactive": 3, "batch": 2, "best_effort": 0},
+        "sheds": {"queue": 3, "pool": 0},
+        "sheds_by_class": {
+            "queue": {"best_effort": 2, "batch": 1},
+            "brownout": {"best_effort": 4},
+        },
+        "preemptions": 6,
+        "brownout": {"stage": 2, "score": 0.91, "signals": {"queue": 0.91}},
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_queue_depth", **{"class": "interactive"}) == 3
+    assert val("engine_queue_depth", **{"class": "batch"}) == 2
+    assert val("engine_queue_depth", **{"class": "all"}) == 5
+    assert val("engine_sheds_total", reason="queue",
+               **{"class": "best_effort"}) == 2
+    assert val("engine_sheds_total", reason="brownout",
+               **{"class": "best_effort"}) == 4
+    assert val("engine_preemptions_total") == 6
+    assert val("engine_brownout_stage") == 2
+    assert val("engine_brownout_score") == 0.91
+    # the stage gauge reads live on the next scrape
+    stats["brownout"]["stage"] = 0
+    assert val("engine_brownout_stage") == 0
+
+    # providers without the SLO block keep the historical families only
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1}, registry=registry2, key="m2"
+    )
+    assert registry2.get_sample_value(
+        "engine_preemptions_total", {"model": "m2"}
+    ) is None
+
+    # end to end against a REAL engine with admission control (brownout
+    # enabled by default when max_pending is set)
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.errors import EngineOverloadedError
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16], eos_token_id=None, max_pending=1,
+    )
+    try:
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+
+        async def run():
+            a = GenRequest(prompt_ids=[1, 2], max_new_tokens=10_000)
+            agen = engine.generate(a)
+            await agen.__anext__()  # A holds a slot
+            b = GenRequest(
+                prompt_ids=[1, 3], max_new_tokens=2, priority="batch"
+            )
+            b_task = asyncio.create_task(
+                engine.generate(b).__anext__()
+            )
+            while engine._pending.qsize() < 1:
+                await asyncio.sleep(0.005)
+            # queue at the bound: a best_effort arrival sheds
+            c = GenRequest(
+                prompt_ids=[1, 4], max_new_tokens=2, priority="best_effort"
+            )
+            try:
+                async for _ in engine.generate(c):
+                    pass
+            except EngineOverloadedError:
+                pass
+            b_task.cancel()
+            try:
+                await b_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await agen.aclose()
+
+        asyncio.run(run())
+
+        def rval(name, **labels):
+            return registry3.get_sample_value(name, {"model": "llm", **labels})
+
+        # per-class depths export live (batch request parked or drained by
+        # now — the family exists with all three classes)
+        for cls in ("interactive", "batch", "best_effort"):
+            assert rval("engine_queue_depth", **{"class": cls}) is not None
+        assert rval(
+            "engine_sheds_total", reason="queue", **{"class": "best_effort"}
+        ) == 1
+        assert rval("engine_preemptions_total") == 0
+        assert rval("engine_brownout_stage") is not None
+    finally:
+        engine.stop()
 
 
 def test_engine_kv_pool_metrics_exported():
