@@ -218,6 +218,27 @@ def main() -> int:
         emit({"metric": "llm_slo_loadtest_cpusmoke", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t4, 1)})
 
+    # -- phase 7: w4a16 fused dequant-matmul A/B (docs/w4a16.md) ------------
+    # int4-fused vs int4-XLA-dequant vs int8 on the real engine, 8B decode
+    # shapes (random quantized trees — full precision never materializes on
+    # the chip): the fused kernel's step-time delta over the XLA route and
+    # the quartered weight-read bytes are the tentpole's measured evidence
+    t5 = time.time()
+    try:
+        row = bench.run_int4_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "kv_quant": "int8"},
+            batch=16, decode_steps=25, new_tokens=200, prompt_len=128,
+            max_seq_len=1024, from_bf16=False,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t5, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_int4_weight_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t5, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
@@ -225,6 +246,7 @@ def main() -> int:
         "pipeline_ab_wall_s": round(time.time() - t2, 1),
         "paged_quant_ab_wall_s": round(time.time() - t3, 1),
         "loadtest_wall_s": round(time.time() - t4, 1),
+        "int4_ab_wall_s": round(time.time() - t5, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

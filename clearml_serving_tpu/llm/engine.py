@@ -518,6 +518,9 @@ class LLMEngineCore:
         rng_seed: int = 0,
         decode_steps: int = 4,
         quantize: Optional[str] = None,
+        # canonical name for the weight-quantization knob (docs/w4a16.md);
+        # ``quantize`` stays as the historical alias
+        weight_quant: Optional[str] = None,
         cache_mode: str = "dense",
         page_size: int = 16,
         num_pages: Optional[int] = None,
@@ -638,16 +641,56 @@ class LLMEngineCore:
         # scan_layers — so XLA fuses dequant next to each consumer matmul and
         # weights at rest stay int8 (HBM ~halves) or group-int4 (~quarters;
         # the decode path is weight-read bound, so bytes saved are tok/s).
+        if weight_quant and quantize and weight_quant != quantize:
+            raise ValueError(
+                "weight_quant={!r} conflicts with the legacy quantize={!r} "
+                "alias; set only one".format(weight_quant, quantize)
+            )
+        quantize = weight_quant or quantize
         self._quantized = False
-        if quantize in ("int8", "int4"):
+        self.weight_quant = ""
+        # offline-quantized bundles (scripts/quantize_ckpt.py) arrive
+        # already packed: detect BEFORE quantizing so a redundant (or
+        # mismatched) weight_quant knob becomes a no-op (or a clear error)
+        # instead of quantize_llama_params choking on the packed dicts —
+        # and so TP sharding picks the quantized specs / stats report the
+        # real weight format when no knob is set at all.
+        from ..ops.quant import detect_weight_quant
+
+        pre = detect_weight_quant(params)
+        if quantize and quantize not in ("int8", "int4"):
+            raise ValueError(
+                "unsupported weight_quant mode {!r} (expected 'int8' or "
+                "'int4')".format(quantize)
+            )
+        if pre and quantize and pre != quantize:
+            raise ValueError(
+                "weight_quant={!r} requested but the bundle is already "
+                "{}-quantized (scripts/quantize_ckpt.py output); drop the "
+                "knob or quantize from the original full-precision "
+                "checkpoint".format(quantize, pre)
+            )
+        if pre:
+            self._quantized = True
+            self.weight_quant = pre
+        elif quantize:
             from ..ops.quant import quantize_llama_params
 
             params = quantize_llama_params(
                 params, bits=4 if quantize == "int4" else 8
             )
             self._quantized = True
-        elif quantize:
-            raise ValueError("unsupported quantize mode {!r}".format(quantize))
+            self.weight_quant = quantize
+        # weight-tree HBM footprint (global bytes; per-chip is 1/tp under a
+        # mesh) — the decode roofline's dominant bytes/step term, surfaced
+        # through lifecycle_stats()/health() and bench.py --int4-ab
+        import jax as _jax
+
+        self._weight_bytes = int(sum(
+            leaf.nbytes
+            for leaf in _jax.tree.leaves(params)
+            if hasattr(leaf, "nbytes")
+        ))
 
         if mesh is not None:
             from ..parallel.sharding import (
@@ -2500,6 +2543,10 @@ class LLMEngineCore:
                 "inflight": len(self._inflight),
             },
             "kv_pool": self._kv_pool_snapshot(),
+            "weights": {
+                "quant": self.weight_quant or "none",
+                "bytes": self._weight_bytes,
+            },
         }
 
     def lifecycle_stats(self) -> dict:
@@ -2532,6 +2579,10 @@ class LLMEngineCore:
                 "retire_ms": self._hist_retire.snapshot(),
             },
             "kv_pool": self._kv_pool_snapshot(),
+            "weights": {
+                "quant": self.weight_quant or "none",
+                "bytes": self._weight_bytes,
+            },
         }
 
     @property

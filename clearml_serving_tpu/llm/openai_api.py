@@ -76,6 +76,38 @@ class LLMEngineRequest(BaseEngineRequest):
         engine_cfg = dict(aux.get("engine") or {})
         self._chat_cfg = dict(engine_cfg.get("chat") or {})
 
+        # weight quantization (docs/w4a16.md): aux engine.weight_quant
+        # ("quantize" stays as the legacy alias) selects int8 per-channel or
+        # int4 group-quantized weights; int4 decode matmuls route through
+        # the Pallas fused dequant-matmul (ops/fused_matmul.py). Validated
+        # at ENDPOINT LOAD like default_priority: a typo'd value must fail
+        # fast naming the knob, not surface as a per-request error after
+        # the endpoint looked healthy.
+        weight_quant = engine_cfg.get(
+            "weight_quant", engine_cfg.get("quantize")
+        )
+        legacy = engine_cfg.get("quantize")
+        if (
+            engine_cfg.get("weight_quant") and legacy
+            and engine_cfg["weight_quant"] != legacy
+        ):
+            # same fail-fast contract as the engine kwargs: a config that
+            # spells the knob both ways with different values must not
+            # silently pick one
+            raise ValueError(
+                "aux engine.weight_quant={!r} conflicts with the legacy "
+                "engine.quantize={!r} alias; set only one".format(
+                    engine_cfg["weight_quant"], legacy
+                )
+            )
+        if weight_quant in ("", None):
+            weight_quant = None
+        elif str(weight_quant) not in ("int8", "int4"):
+            raise ValueError(
+                "aux engine.weight_quant must be 'int8' or 'int4': got "
+                "{!r}".format(weight_quant)
+            )
+
         # multi-LoRA (reference vLLM knob `lora_modules`,
         # preprocess_service.py:740-767): aux engine.lora = {"modules":
         # {name: adapter_dir}, "rank": r?, "targets": [...]?, "max_loras": n?}
@@ -189,7 +221,7 @@ class LLMEngineRequest(BaseEngineRequest):
             mesh=mesh,
             eos_token_id=self.tokenizer.eos_token_id,
             decode_steps=int(engine_cfg.get("decode_steps", 4)),
-            quantize=engine_cfg.get("quantize"),
+            weight_quant=weight_quant,
             cache_mode=engine_cfg.get("cache", "dense"),
             # int8 paged pools default to 32-token pages: the int8 Pallas
             # tile is (32, 128), so 16-token pages would silently route

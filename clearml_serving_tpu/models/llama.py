@@ -457,6 +457,28 @@ def build(config: dict) -> SimpleNamespace:
             return dequantize_int4(w["_q4"], w["_scale4"], dtype)
         return w
 
+    # w4a16 serving (docs/w4a16.md): decode-shaped matmuls on int4 leaves
+    # route through the Pallas fused dequant-matmul — packed nibbles stream
+    # HBM->VMEM and unpack next to the MXU, so the HBM weight read is
+    # structurally 4-bit instead of fusion-dependent. cfg int4_fused=False
+    # pins the XLA inline-dequant path (the A/B arm bench.py measures
+    # against); misaligned shapes, prefill-sized M, and non-TPU backends
+    # fall back to that same path inside the wrapper, byte-identically.
+    int4_fused = bool(cfg.get("int4_fused", True))
+
+    def _mm(container, name, x):
+        """``x @ weight`` with quantization-aware routing. The ONE place a
+        plain projection matmul touches its (possibly quantized) weight —
+        MoE expert einsums and the tied-embedding lm_head keep the _w
+        accessor (different contraction shapes; fallback matrix in
+        docs/w4a16.md)."""
+        w = container[name]
+        if int4_fused and isinstance(w, dict) and "_q4" in w:
+            from ..ops.fused_matmul import fused_int4_matmul
+
+            return fused_int4_matmul(x, w["_q4"], w["_scale4"], dtype=dtype)
+        return x @ _w(container, name)
+
     def _visible_w(q_pos, t_pos, window):
         """Causal visibility (key position t, query position q): t <= q,
         windowed to q - W < t when ``window`` is set. The ONE place the
@@ -501,9 +523,9 @@ def build(config: dict) -> SimpleNamespace:
 
     def _qkv(layer, x, cos, sin, lora_idx=None):
         b, s, _ = x.shape
-        q = _with_lora(layer, "wq", x, x @ _w(layer, "wq"), lora_idx)
-        k = _with_lora(layer, "wk", x, x @ _w(layer, "wk"), lora_idx)
-        v = _with_lora(layer, "wv", x, x @ _w(layer, "wv"), lora_idx)
+        q = _with_lora(layer, "wq", x, _mm(layer, "wq", x), lora_idx)
+        k = _with_lora(layer, "wk", x, _mm(layer, "wk", x), lora_idx)
+        v = _with_lora(layer, "wv", x, _mm(layer, "wv", x), lora_idx)
         if attn_bias:  # Qwen2-style QKV biases (kept full precision)
             q = q + layer["bq"]
             k = k + layer["bk"]
@@ -514,7 +536,7 @@ def build(config: dict) -> SimpleNamespace:
         return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
 
     def _oproj(layer, attn, lora_idx=None):
-        return _with_lora(layer, "wo", attn, attn @ _w(layer, "wo"), lora_idx)
+        return _with_lora(layer, "wo", attn, _mm(layer, "wo", attn), lora_idx)
 
     def _attend(q, k, v, mask):
         """q: [B,S,Hq,D]; k,v: [B,T,Hkv,D]; mask: [B,1,S,T] additive."""
@@ -533,10 +555,10 @@ def build(config: dict) -> SimpleNamespace:
         return out.reshape(b, s, n_heads * head_dim)
 
     def _ffn_dense(layer, x, lora_idx=None):
-        gate = _with_lora(layer, "w_gate", x, x @ _w(layer, "w_gate"), lora_idx)
-        up = _with_lora(layer, "w_up", x, x @ _w(layer, "w_up"), lora_idx)
+        gate = _with_lora(layer, "w_gate", x, _mm(layer, "w_gate", x), lora_idx)
+        up = _with_lora(layer, "w_up", x, _mm(layer, "w_up", x), lora_idx)
         h = _act(gate) * up
-        return _with_lora(layer, "w_down", h, h @ _w(layer, "w_down"), lora_idx)
+        return _with_lora(layer, "w_down", h, _mm(layer, "w_down", h), lora_idx)
 
     def _moe_routing(layer, tokens):
         router_logits = (
@@ -627,8 +649,10 @@ def build(config: dict) -> SimpleNamespace:
 
     def _logits(params, x):
         x = _rms_norm(x, params["final_norm"], eps, norm_offset)
-        head = _w(params, "lm_head") if "lm_head" in params else params["embed"].T
-        out = (x @ head).astype(jnp.float32)
+        if "lm_head" in params:
+            out = _mm(params, "lm_head", x).astype(jnp.float32)
+        else:
+            out = (x @ params["embed"].T).astype(jnp.float32)
         if final_softcap:
             out = _softcap(out, final_softcap)
         return out

@@ -1,0 +1,277 @@
+"""w4a16 fused dequant-matmul: Pallas TPU kernel + XLA reference.
+
+Decode is weight-read bound (ROOFLINE gap #3): every step streams the whole
+projection stack out of HBM for a handful of activation rows. int4 group
+quantization (ops/quant.py: packed ``_q4`` uint8 [K//2, N] + per-(group,
+out-channel) ``_scale4`` f32 [K//group, N]) stores those bytes at a quarter
+of bf16 — but the saving is only real if the *HBM read* is 4-bit. The
+existing XLA path (``dequantize_int4`` inlined in the consumer matmul) keeps
+weights at rest int4, yet XLA materializes a bf16 operand tile between the
+unpack and the dot; whether the read stays 4-bit is fusion-dependent. This
+kernel makes it structural, the same way the int8 KV path did for page reads
+(ops/paged_attention.py, docs/paged_kv_quant.md):
+
+- **Packed tiles stream HBM -> VMEM raw.** The uint8 ``_q4`` operand stays in
+  HBM (``memory_space=ANY``); the kernel issues manual double-buffered async
+  copies of one quantization group's packed rows per step — group g+1's DMA
+  flies while group g's dot runs on the MXU. The bf16 weight never exists in
+  HBM, so the weight-bytes term is exactly K/2 * N.
+- **Group scales stay VMEM-resident.** The tiny ``_scale4`` rows ([G, BN] f32
+  per grid step, ~1/64 of the packed bytes at group 128) ride the grid
+  pipeline into VMEM once and are read per group from there — they never join
+  the per-group DMA plan (an f32 row is not tile-alignable for Mosaic DMA,
+  the same constraint that keeps KV scale rows out of the page DMAs).
+- **Unpack + scale fuse into the MXU contraction.** Nibbles unpack by
+  splitting the contraction over byte lanes instead of interleaving sublanes
+  (Mosaic cannot cheaply re-interleave rows): byte row j of the packed tile
+  holds unpacked rows 2j (low nibble) and 2j+1 (high), so with the activation
+  columns pre-split XLA-side into x_even/x_odd the group's partial product is
+  ``x_even @ (lo - 8) + x_odd @ (hi - 8)``. Within one quantization group the
+  scale depends only on the output channel, so it folds into the f32
+  accumulation *after* the dot — one multiply per output element per group,
+  never a dequantized [rows, N] tile write.
+
+Alignment gates (hardware; ``interpret=True`` runs any shape for parity
+tests — misaligned/odd shapes fall back to the XLA reference, exactly like
+the paged kernel's D%128 gate):
+
+- N % 128 == 0 and a block width in {512, 256, 128} dividing N (lane tiling);
+- packed rows per group % 32 == 0, i.e. group % 64 == 0 (uint8 sublane tile
+  is 32 — INT4_GROUP=128 gives 64-row packed group tiles);
+- groups must divide K evenly with an even group size (nibble pairs must not
+  straddle a group boundary);
+- flattened activation rows M <= 256 (x lives whole in VMEM — decode /
+  speculative-verify shapes; prefill's M = B*S takes the XLA path, where the
+  matmul is compute-bound and operand materialization is amortized anyway).
+
+The XLA fallback is byte-identical to the pre-kernel path (``x @
+dequantize_int4(...)``), so routing every int4 matmul through
+:func:`fused_int4_matmul` changes nothing on ineligible shapes or backends.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .quant import dequantize_int4
+
+try:  # pallas is TPU-oriented; tolerate exotic builds without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+# flattened activation rows the kernel accepts: x ([M, K] bf16) must sit
+# whole in VMEM next to the double-buffered weight tiles. 256 rows x 14336
+# (llama3-8b w_down) x 2B = 7 MB — the decode/verify shapes this kernel
+# exists for are far below it.
+MAX_FUSED_ROWS = 256
+
+_BLOCK_N_CANDIDATES = (512, 256, 128)
+
+
+def int4_matmul_xla(x, packed, scale, dtype=None):
+    """Reference: the exact pre-kernel path (``models/llama._w`` inline
+    dequant) — unpack+scale in XLA, fused into the consumer matmul by the
+    compiler. Byte-identical to what routing through the fused wrapper
+    replaces, so fallback shapes reproduce historical streams bit for bit."""
+    return x @ dequantize_int4(packed, scale, dtype or x.dtype)
+
+
+def int4_kernel_unsupported_reason(
+    x, packed, scale, *, interpret: bool = False
+) -> Optional[str]:
+    """Why (x, packed, scale) cannot take the Pallas kernel — None if it can.
+
+    Shape/layout gates only; the caller separately requires a TPU backend
+    (or ``interpret=True``). Split out so tests can assert the routing
+    matrix without touching a device."""
+    if not _PALLAS_OK:
+        return "pallas unavailable in this jax build"
+    if packed.ndim != 2 or scale.ndim != 2:
+        return "kernel takes 2-D packed/scale (got {}D/{}D); stacked trees " \
+               "route per layer inside the scan".format(packed.ndim, scale.ndim)
+    if packed.dtype != jnp.uint8:
+        return "packed weights must be uint8 nibbles"
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return "activations must be floating point"
+    if x.shape[-1] != packed.shape[0] * 2:
+        return "K mismatch: x has {} columns, packed holds {} rows".format(
+            x.shape[-1], packed.shape[0] * 2
+        )
+    k2, n = packed.shape
+    ng = scale.shape[0]
+    if scale.shape[1] != n:
+        return "scale output dim {} != weight output dim {}".format(
+            scale.shape[1], n
+        )
+    k = 2 * k2
+    if ng < 1 or k % ng:
+        return "{} scale groups do not divide K={}".format(ng, k)
+    group_k = k // ng
+    if group_k % 2:
+        return "odd group size {} (nibble pairs straddle groups)".format(group_k)
+    m = 1
+    for d in x.shape[:-1]:
+        m *= int(d)
+    if m == 0:
+        return "empty activation batch"
+    if m > MAX_FUSED_ROWS:
+        return "M={} activation rows exceed the VMEM-resident cap {} " \
+               "(prefill-shaped; XLA path)".format(m, MAX_FUSED_ROWS)
+    if interpret:
+        return None
+    # hardware tiling gates (mirrors paged_attention's D%128/sublane gates)
+    gp = group_k // 2
+    if gp % 32:
+        return "packed group tile {} rows is not sublane-aligned " \
+               "(uint8 tile is 32; need group % 64 == 0)".format(gp)
+    if n % 128 or not any(n % bn == 0 for bn in _BLOCK_N_CANDIDATES):
+        return "N={} is not lane-tileable (need N % 128 == 0)".format(n)
+    return None
+
+
+def _pick_block_n(n: int, interpret: bool) -> int:
+    for bn in _BLOCK_N_CANDIDATES:
+        if n % bn == 0:
+            return bn
+    # interpret mode runs any shape: a single full-width block
+    assert interpret
+    return n
+
+
+def _w4a16_kernel(
+    # positionally (in_specs order):
+    #   xe_ref     [M, K//2] VMEM   activation columns 0,2,4,... (low nibbles)
+    #   xo_ref     [M, K//2] VMEM   activation columns 1,3,5,... (high nibbles)
+    #   scale_ref  [G, BN] f32 VMEM resident group scales for this N block
+    #   w_hbm      [K//2, N] uint8 ANY (stays in HBM; manual DMA)
+    #   out_ref    [M, BN] VMEM
+    # scratch:
+    #   w_buf      [2, GP, BN] uint8 VMEM (double-buffered packed group tiles)
+    #   sems       [2] DMA semaphores (one per slot)
+    xe_ref,
+    xo_ref,
+    scale_ref,
+    w_hbm,
+    out_ref,
+    w_buf,
+    sems,
+    *,
+    gp: int,
+    ng: int,
+    bn: int,
+):
+    i = pl.program_id(0)
+    m = xe_ref.shape[0]
+
+    def _copy(g, slot):
+        return pltpu.make_async_copy(
+            w_hbm.at[pl.ds(g * gp, gp), pl.ds(i * bn, bn)],
+            w_buf.at[slot],
+            sems.at[slot],
+        )
+
+    _copy(0, 0).start()
+
+    def body(g, acc):
+        slot = jax.lax.rem(g, 2)
+
+        @pl.when(g + 1 < ng)
+        def _prefetch():
+            _copy(g + 1, jax.lax.rem(g + 1, 2)).start()
+
+        _copy(g, slot).wait()
+        # Unpack next to the MXU: nibble -> signed level in [-8, 7], cast to
+        # the compute dtype (exact: 4-bit ints are representable in bf16).
+        # No scale multiply here — within a group the scale is per output
+        # channel only, so it rides the f32 accumulation below instead of
+        # touching every weight element.
+        w = w_buf[slot].astype(jnp.int32)                    # [GP, BN]
+        op_dtype = xe_ref.dtype
+        lo = ((w & 0xF) - 8).astype(op_dtype)                # rows 2j
+        hi = ((w >> 4) - 8).astype(op_dtype)                 # rows 2j+1
+        xe_g = xe_ref[:, pl.ds(g * gp, gp)]                  # [M, GP]
+        xo_g = xo_ref[:, pl.ds(g * gp, gp)]
+        part = jax.lax.dot_general(
+            xe_g, lo, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + jax.lax.dot_general(
+            xo_g, hi, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                    # [M, BN] f32
+        srow = scale_ref[pl.ds(g, 1), :]                     # [1, BN] f32
+        return acc + part * srow
+
+    acc = jax.lax.fori_loop(0, ng, body, jnp.zeros((m, bn), jnp.float32))
+    out_ref[...] = acc.astype(out_ref.dtype)
+
+
+def fused_int4_matmul(
+    x, packed, scale, *, dtype=None, interpret: bool = False
+):
+    """``x [..., K] @ dequant(packed [K//2, N], scale [G, N]) -> [..., N]``.
+
+    The Pallas fused path runs on TPU (or under ``interpret=True``) for
+    aligned decode-shaped operands; everything else takes
+    :func:`int4_matmul_xla`, which is byte-identical to the historical
+    inline-dequant path. ``dtype`` pins the dequant/compute dtype for the
+    fallback (the model's activation dtype); the kernel output is always
+    ``x.dtype``, which equals it at every model call site.
+    """
+    reason = int4_kernel_unsupported_reason(x, packed, scale, interpret=interpret)
+    if reason is not None:
+        return int4_matmul_xla(x, packed, scale, dtype)
+    if not interpret and jax.devices()[0].platform != "tpu":
+        return int4_matmul_xla(x, packed, scale, dtype)
+
+    k2, n = packed.shape
+    k = 2 * k2
+    ng = scale.shape[0]
+    gp = (k // ng) // 2
+    bn = _pick_block_n(n, interpret)
+
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    # pre-split activation columns by nibble position so the kernel's two
+    # dots contract against the low/high planes without sublane interleaves
+    xe = x2[:, 0::2]
+    xo = x2[:, 1::2]
+    # pad rows up to the f32 sublane tile; Mosaic would mask these anyway,
+    # padding keeps the block shape conservative across toolchain versions
+    m_pad = -(-m // 8) * 8
+    if m_pad != m:
+        pad = ((0, m_pad - m), (0, 0))
+        xe = jnp.pad(xe, pad)
+        xo = jnp.pad(xo, pad)
+
+    kernel = functools.partial(_w4a16_kernel, gp=gp, ng=ng, bn=bn)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((m_pad, k2), lambda i: (0, 0)),
+            pl.BlockSpec((m_pad, k2), lambda i: (0, 0)),
+            pl.BlockSpec((ng, bn), lambda i: (0, i)),
+            pl.BlockSpec(memory_space=pl.ANY),   # packed weight stays in HBM
+        ],
+        out_specs=pl.BlockSpec((m_pad, bn), lambda i: (0, i)),
+        scratch_shapes=[
+            pltpu.VMEM((2, gp, bn), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), x.dtype),
+        interpret=interpret,
+    )(xe, xo, scale.astype(jnp.float32), packed)
+    return out[:m].reshape(x.shape[:-1] + (n,))

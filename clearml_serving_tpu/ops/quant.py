@@ -95,7 +95,32 @@ def dequantize_int4(
     return shaped.reshape(qf.shape).astype(dtype)
 
 
-def quantize_llama_params(params: Dict[str, Any], bits: int = 8) -> Dict[str, Any]:
+def detect_weight_quant(params: Any) -> str:
+    """"int4"/"int8" when the pytree already holds packed quantized leaves
+    (e.g. a bundle written by scripts/quantize_ckpt.py), else "". Lets the
+    engine pick the quantized TP sharding specs and report the right
+    weight_quant without re-deriving it from config."""
+    if isinstance(params, dict):
+        if "_q4" in params:
+            return "int4"
+        if "_q8" in params:
+            return "int8"
+        for value in params.values():
+            found = detect_weight_quant(value)
+            if found:
+                return found
+        return ""
+    if isinstance(params, (list, tuple)):
+        for value in params:
+            found = detect_weight_quant(value)
+            if found:
+                return found
+    return ""
+
+
+def quantize_llama_params(
+    params: Dict[str, Any], bits: int = 8, group: int = INT4_GROUP
+) -> Dict[str, Any]:
     """Quantize every projection matrix of a llama param pytree to int8 (or
     group-int4 with ``bits=4``); norms/embeddings stay bf16. Serve by calling
     `dequant_llama_params` INSIDE the jitted step function (see
@@ -120,7 +145,7 @@ def quantize_llama_params(params: Dict[str, Any], bits: int = 8) -> Dict[str, An
                     # axis=-2 is the input (reduction) dim for both plain
                     # [in, out] matrices and scan_layers-stacked [L, in, out]
                     if bits == 4:
-                        qv, s = quantize_int4(value, axis=-2)
+                        qv, s = quantize_int4(value, axis=-2, group=group)
                         out[key] = {"_q4": qv, "_scale4": s}
                     else:
                         qv, s = quantize_int8(value, axis=-2)

@@ -190,9 +190,14 @@ def llama_quantized_param_sharding(
                 # both tensors keep the weight spec: packed K//2 and the
                 # K//group scale rows shard along the input axis the same
                 # way the unpacked K rows do (contiguous division). The
-                # scale's group count can be too coarse to split (e.g. the
-                # single-group K<group fallback) — replicate its input axis
-                # then, like the int8 scale.
+                # scale's group count can be too coarse to split: the
+                # single-group K<group fallback replicates its input axis
+                # (exact — one per-channel scale serves every shard), but a
+                # MULTI-group scale that doesn't divide means some shard
+                # boundary lands INSIDE a 128-row quantization group — each
+                # shard would dequantize part of that group with the wrong
+                # scale row. That must be a loud config error, not silently
+                # wrong logits.
                 scale4 = param_node["_scale4"]
                 spec = list(shard_node.spec)
                 spec += [None] * (scale4.ndim - len(spec))
@@ -202,8 +207,24 @@ def llama_quantized_param_sharding(
                 for ax in axes:
                     if ax is not None:
                         ways *= mesh.shape[ax]
-                if ent is not None and scale4.shape[-2] % ways != 0:
+                ng = int(scale4.shape[-2])
+                if ent is not None and ng == 1:
                     sspec = _scale_spec(shard_node, scale4.ndim)
+                elif ent is not None and ng % ways != 0:
+                    k_rows = int(param_node["_q4"].shape[-2]) * 2
+                    raise ValueError(
+                        "mesh axis {axes} (degree {ways}) splits the int4 "
+                        "quantization groups of a {k}-row weight ({ng} "
+                        "groups of {gk} rows) across shards — per-shard "
+                        "dequant would apply the wrong scale rows. Set the "
+                        "aux mesh.tp (parallel/mesh.py) to a divisor of "
+                        "{ng}, or serve this model with "
+                        "engine.weight_quant=int8 (per-channel scales shard "
+                        "at any degree).".format(
+                            axes=[a for a in axes if a is not None],
+                            ways=ways, k=k_rows, ng=ng, gk=k_rows // ng,
+                        )
+                    )
                 else:
                     sspec = shard_node
                 return {"_q4": shard_node, "_scale4": sspec}

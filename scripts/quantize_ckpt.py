@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Offline checkpoint quantizer: bf16 llama bundle -> packed int8/int4 tree.
+
+Converts a native jax bundle directory (model_config.json + params.msgpack,
+engines/jax_engine.py) into the packed quantized layouts of ops/quant.py:
+
+    int8: per-output-channel symmetric  {"_q8", "_scale"}
+    int4: group-quantized w4a16 (AWQ/GPTQ-style)  {"_q4", "_scale4"}
+
+The output is a normal bundle: load it with the usual endpoint config and
+the engine detects the packed tree (ops/quant.detect_weight_quant), so no
+``engine.weight_quant`` override is needed — quantization cost is paid once
+offline instead of at every endpoint load, and the full-precision weights
+never have to fit in serving-host memory again. int4 decode matmuls then
+route through the Pallas fused dequant-matmul (ops/fused_matmul.py,
+docs/w4a16.md).
+
+Usage:
+    python scripts/quantize_ckpt.py SRC_BUNDLE DST_BUNDLE [--bits 4]
+                                    [--group 128] [--dry-run]
+
+``--group`` (int4 only) must keep the fused kernel's alignment gates in
+mind: group % 64 == 0 shapes take the kernel on hardware; anything else
+still serves via the XLA fallback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+
+    return int(sum(
+        leaf.nbytes for leaf in jax.tree.leaves(tree) if hasattr(leaf, "nbytes")
+    ))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Quantize a bf16 llama bundle to packed int8/int4."
+    )
+    parser.add_argument("src", help="source bundle dir (model_config.json)")
+    parser.add_argument("dst", help="output bundle dir (created)")
+    parser.add_argument("--bits", type=int, default=4, choices=(4, 8))
+    parser.add_argument(
+        "--group", type=int, default=None,
+        help="int4 scale-group size in input rows (default {}; group %% 64 "
+             "== 0 keeps the fused TPU kernel eligible)".format(128),
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="quantize in memory and print the byte savings without "
+             "writing the output bundle",
+    )
+    args = parser.parse_args(argv)
+
+    from clearml_serving_tpu.utils.files import read_json
+
+    src = Path(args.src)
+    meta = read_json(src / "model_config.json")
+    if not meta:
+        parser.error("not a native jax bundle (missing model_config.json): "
+                     "{}".format(src))
+    if meta.get("arch") != "llama":
+        parser.error(
+            "quantize_ckpt handles llama-family bundles (got arch={!r})"
+            .format(meta.get("arch"))
+        )
+
+    from clearml_serving_tpu.engines.jax_engine import load_bundle, save_bundle
+    from clearml_serving_tpu.ops import quant
+
+    bundle, params = load_bundle(src)
+    already = quant.detect_weight_quant(params)
+    if already:
+        parser.error(
+            "bundle is already {}-quantized; quantize from the original "
+            "full-precision checkpoint".format(already)
+        )
+    group = args.group if args.group is not None else quant.INT4_GROUP
+    before = _tree_bytes(params)
+    qparams = quant.quantize_llama_params(params, bits=args.bits, group=group)
+    after = _tree_bytes(qparams)
+    if not args.dry_run:
+        save_bundle(Path(args.dst), meta["arch"],
+                    dict(meta.get("config") or {}), qparams)
+    print(
+        "{verb} {src} -> {dst}: int{bits}{grp}, {before:.1f} MB -> "
+        "{after:.1f} MB ({ratio:.2f}x)".format(
+            verb="would quantize (dry run)" if args.dry_run else "quantized",
+            src=src, dst=args.dst, bits=args.bits,
+            grp=" (group {})".format(group) if args.bits == 4 else "",
+            before=before / 2**20, after=after / 2**20,
+            ratio=before / max(after, 1),
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -464,6 +464,32 @@ def test_cross_module_pool_handle_rebind_needs_dispatch_lock():
 # -- the tier-1 gate ----------------------------------------------------------
 
 
+def test_fused_matmul_module_is_covered_and_clean():
+    """The w4a16 kernel module (ops/fused_matmul.py, PR 7) sits inside the
+    analyzer's walk with zero findings — and the jit-boundary rules really
+    apply to it: grafting a TPU201-style stale-trace closure into its
+    source is flagged at the right file."""
+    path = os.path.join(PKG_DIR, "ops", "fused_matmul.py")
+    assert analyze_paths([path]) == []
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    bad = source + textwrap.dedent(
+        """
+
+        class _KernelHolder:
+            def __init__(self):
+                self.block_n = 512
+
+                def go(v):
+                    return v * self.block_n  # closure over self: stale trace
+
+                self._go = jax.jit(go)
+        """
+    )
+    found = [f.code for f in analyze_source(bad, path)]
+    assert "TPU201" in found
+
+
 def test_first_party_tree_has_zero_findings():
     """Acceptance: the committed tree is clean. Any new violation (or a
     deleted ignore annotation) fails this test with the rule and file:line."""

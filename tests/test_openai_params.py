@@ -811,3 +811,122 @@ def test_default_priority_typo_fails_at_endpoint_load(tmp_path):
     # that would misdirect debugging at the request body
     assert status == 422 and "default_priority" in text, (status, text)
     assert "bad_prio" not in mrp._engine_processor_lookup
+
+
+def test_weight_quant_typo_fails_at_endpoint_load(tmp_path):
+    """aux engine.weight_quant (docs/w4a16.md) is validated when the
+    endpoint LOADS, like default_priority: a typo'd value fails fast with
+    the knob's name and the endpoint never registers — the engine would
+    otherwise reject it only after the (possibly long) bundle load, with a
+    message that doesn't say which aux key to fix."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="badwq"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_wq",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "weight_quant": "int-4",  # typo'd
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "bad_wq", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "weight_quant" in text, (status, text)
+    assert "bad_wq" not in mrp._engine_processor_lookup
+
+
+def test_weight_quant_conflicting_alias_fails_at_endpoint_load(tmp_path):
+    """A config spelling the knob BOTH ways with different values must not
+    silently pick one — same fail-fast contract as the engine kwargs."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="dupwq"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="dup_wq",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "weight_quant": "int4",
+                    "quantize": "int8",  # conflicting legacy alias
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "dup_wq", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "conflicts" in text, (status, text)
+    assert "dup_wq" not in mrp._engine_processor_lookup
+
+
+def test_weight_quant_int4_endpoint_serves(tmp_path):
+    """A weightless-preset endpoint with engine.weight_quant=int4 loads,
+    serves greedily, and reports the packed weight tree through the
+    engine's lifecycle stats (the quantize alias spells the same knob)."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="wq4"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="tiny_w4",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "weight_quant": "int4",
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "tiny_w4", "prompt": [1, 2, 3], "max_tokens": 4},
+        )
+        return r.status, await r.json()
+
+    status, body = _run(mrp, fn)
+    assert status == 200 and body["choices"][0]["text"] is not None
+    engine = mrp._engine_processor_lookup["tiny_w4"].engine
+    assert engine.weight_quant == "int4"
+    stats = engine.lifecycle_stats()["weights"]
+    assert stats["quant"] == "int4" and stats["bytes"] > 0
