@@ -116,6 +116,33 @@ RULES: Dict[str, Tuple[str, str]] = {
         "add the point (with a docstring entry) to llm/faults.py "
         "KNOWN_POINTS so chaos specs can target it",
     ),
+    "TPU501": (
+        "worker-reachable code mutates thread-affine state (declared via "
+        "`__affine_to__`; affine state has no lock on purpose — exactly one "
+        "thread owns it)",
+        "move the mutation to the owning thread (hand results back through "
+        "a snapshot/queue), or annotate the protocol-serialized site with "
+        "`# tpuserve: ignore[TPU501] reason`",
+    ),
+    "TPU502": (
+        "cross-thread handoff of a mutable host buffer without a copy "
+        "(`jnp.asarray` of a numpy array is zero-copy on CPU; a late device "
+        "read races in-place mutation — the PR-4 wrong-token race)",
+        "snapshot at the handoff: `jnp.asarray(self._buf.copy())`",
+    ),
+    "TPU503": (
+        "`await` while holding a synchronous lock (coroutines needing the "
+        "lock deadlock against the suspended holder; worker threads convoy)",
+        "release the lock before awaiting, or use `asyncio.Lock` with "
+        "`async with`",
+    ),
+    "TPU504": (
+        "lock-helper (`lock held by caller`) called without the declared "
+        "lock lexically held — a TPU301 scope ignore is a hole this rule "
+        "closes across the call graph",
+        "wrap the call in `with <receiver>.<lock>:`, or annotate the "
+        "call site with `# tpuserve: ignore[TPU504] reason`",
+    ),
 }
 
 
@@ -151,6 +178,18 @@ class Finding:
 
     def sort_key(self):
         return (self.path, self.line, self.col, self.code)
+
+    def as_dict(self) -> Dict[str, object]:
+        """Stable machine-readable shape for `--format json` (one object per
+        line): CI diff annotators key on rule/file/line."""
+        return {
+            "rule": self.code,
+            "file": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fix": self.hint,
+        }
 
 
 # -- inline escape hatch ------------------------------------------------------
@@ -239,7 +278,7 @@ def analyze_source(
     select: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
     """All findings for one module's source text (ignores already applied)."""
-    from . import rules_async, rules_errors, rules_jit, rules_locks
+    from . import rules_async, rules_errors, rules_jit, rules_locks, rules_threads
 
     try:
         tree = ast.parse(source, filename=path)
@@ -252,7 +291,8 @@ def analyze_source(
             )
         ]
     findings: List[Finding] = []
-    for mod in (rules_async, rules_jit, rules_locks, rules_errors):
+    for mod in (rules_async, rules_jit, rules_locks, rules_errors,
+                rules_threads):
         findings.extend(mod.check(tree, path, source))
     ignores = _scope_ignores(tree, _ignore_map(source))
     findings = _filter_ignored(findings, ignores)

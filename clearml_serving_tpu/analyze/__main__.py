@@ -8,6 +8,7 @@ and prints the per-rule table so the offending invariant is obvious.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from collections import Counter
@@ -41,6 +42,12 @@ def main(argv=None) -> int:
         "--quiet", action="store_true",
         help="suppress per-finding output; only the summary table",
     )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="json = one finding object per line "
+        "(rule/file/line/col/message/fix) for CI diff annotation; "
+        "exit codes are identical to human output",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -56,6 +63,12 @@ def main(argv=None) -> int:
         else None
     )
     findings = analyze_paths(paths, select=select)
+    if args.format == "json":
+        # machine output: findings only, nothing else on stdout — a clean
+        # tree prints zero lines and exits 0
+        for finding in findings:
+            print(json.dumps(finding.as_dict(), sort_keys=True))
+        return 1 if findings else 0
     if not args.quiet:
         for finding in findings:
             print(finding.render())

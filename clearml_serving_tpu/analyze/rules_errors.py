@@ -37,6 +37,9 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.decode",
     "engine.decode.stall",
     "engine.decode.retire",
+    "engine.dispatch.prepare",
+    "engine.watchdog",
+    "engine.drain",
     "engine.admit",
     "engine.admit.class",
     "engine.pool",

@@ -505,6 +505,25 @@ class _BrownoutController:
 class LLMEngineCore:
     """Slot-based continuous batching over a dense per-slot KV cache."""
 
+    # thread-affinity registry (tpuserve-analyze TPU501,
+    # docs/static_analysis.md): this state has NO lock on purpose — exactly
+    # one thread owns it. "loop" = the asyncio event-loop thread (handlers,
+    # the decode loop, the watchdog task); "worker" = asyncio.to_thread
+    # dispatch/readback/prefill workers. The pipeline queue, quarantine
+    # map, slot table, and host token/DFA mirrors are loop-owned (workers
+    # receive snapshots via the prep dict and hand results back through the
+    # retire stage); the device-resident chains are worker-owned (the
+    # dispatch worker is the only stage running device programs; the loop
+    # resets them only at protocol-serialized points, annotated at the
+    # definition sites).
+    __affine_to__ = {
+        "loop": (
+            "_inflight", "_quarantine", "_dispatching", "_slot_req",
+            "_admitting", "_next_token", "_gstate", "_slot_overrides",
+        ),
+        "worker": ("_next_token_dev", "_gstate_dev"),
+    }
+
     def __init__(
         self,
         bundle,
@@ -2648,6 +2667,13 @@ class LLMEngineCore:
             return
 
     def _watchdog_trip(self, interval: float) -> None:
+        if faults.active():
+            # yield-point seam: a trip is about to bump the epoch and fail
+            # the in-flight batch (chaos + interleaving-explorer boundary)
+            faults.fire(
+                "engine.watchdog",
+                requests=[r for r in self._slot_req if r is not None],
+            )
         self.counters["watchdog_trips"] += 1
         self._recovering = True
         self._recover_epoch += 1
@@ -2774,7 +2800,7 @@ class LLMEngineCore:
             except Exception:
                 pass  # failed execution: nothing more will be written
 
-    def _reset_device_chains(self) -> None:
+    def _reset_device_chains(self) -> None:  # tpuserve: ignore[TPU501] pipeline drained/discarded: no dispatch worker is live when the loop resets the chains
         """Forget the device-resident token/DFA chains; the next dispatch
         re-uploads from the host mirrors."""
         self._next_token_dev = None
@@ -3591,16 +3617,20 @@ class LLMEngineCore:
             jnp.asarray(sspec_mask),
             sampling,
             self._next_rng(),
-            jnp.asarray(self._lora_slots) if self._lora_enabled else None,
+            # host mirrors snapshot-COPIED at the thread handoff: the spec
+            # dispatch runs on a worker thread and jnp.asarray is zero-copy
+            # aliasing on CPU (tpuserve-analyze TPU502; same rationale as
+            # _chain_input)
+            jnp.asarray(self._lora_slots.copy()) if self._lora_enabled else None,
             self._batch_extras() if use_extras else None,
             self._counts_dev if use_extras else None,
             self._pmask_dev if use_extras else None,
             gtables,
-            jnp.asarray(self._gstate) if gtables is not None else None,
+            jnp.asarray(self._gstate.copy()) if gtables is not None else None,
         )
         return args, use_extras, gtables
 
-    def _spec_commit_state(self, tokbuf, new_counts, gstate_out, lp,
+    def _spec_commit_state(self, tokbuf, new_counts, gstate_out, lp,  # tpuserve: ignore[TPU501] serial spec path: the loop is suspended awaiting this worker call and commits land at loop tops, so no loop-thread mutator runs concurrently
                            use_extras, gtables):
         if use_extras:
             self._counts_dev = new_counts
@@ -3630,8 +3660,10 @@ class LLMEngineCore:
         (tokbuf, pending, self.cache, gs, accs, new_counts, gstate_out,
          lp) = self._spec_chunk_jit(
             self.params,
-            jnp.asarray(self._tokbuf),
-            jnp.asarray(self._next_token),
+            # copies: worker-thread upload of loop-owned host mirrors
+            # (tpuserve-analyze TPU502)
+            jnp.asarray(self._tokbuf.copy()),
+            jnp.asarray(self._next_token.copy()),
             self.cache,
             *tail,
             want_lp=want_lp,
@@ -3709,8 +3741,10 @@ class LLMEngineCore:
             (tokbuf, pending, new_pools, gs, accs, new_counts,
              gstate_out, lp) = self._spec_paged_jit(
                 self.params,
-                jnp.asarray(self._tokbuf),
-                jnp.asarray(self._next_token),
+                # copies: worker-thread upload of loop-owned host mirrors
+                # (tpuserve-analyze TPU502)
+                jnp.asarray(self._tokbuf.copy()),
+                jnp.asarray(self._next_token.copy()),
                 cachelike,
                 *tail,
                 want_lp=want_lp,
@@ -3862,6 +3896,10 @@ class LLMEngineCore:
                 ):
                     # drained: nothing owns pages but the prefix cache —
                     # anything else is a leak the sanitizer names by id
+                    if faults.active():
+                        # yield-point seam: the drained boundary, before
+                        # the leak audit
+                        faults.fire("engine.drain")
                     self._sanitize("drain", drained=True)
                     return  # drained; a new generate() restarts the loop
                 # idle but admissions in flight: sleep until a prefill lands
@@ -4074,6 +4112,15 @@ class LLMEngineCore:
         )
         self._slot_overrides[:] = False
         self._dispatch_seq += 1
+        if faults.active():
+            # yield-point seam (docs/static_analysis.md, interleaving
+            # explorer): the loop-thread snapshot is complete, the
+            # worker-thread device call has not started — the boundary the
+            # PR-4 host-buffer aliasing race lived on
+            faults.fire(
+                "engine.dispatch.prepare",
+                requests=[r for r in self._slot_req if r is not None],
+            )
         return {
             "seq": self._dispatch_seq,
             "epoch": epoch,

@@ -43,9 +43,30 @@ Known points (ctx carried with each):
                          bug that LEAKS the slot's pages — the KV sanitizer
                          (llm/kv_sanitizer.py, TPUSERVE_SANITIZE=1) must
                          catch it at drain.
+- ``engine.dispatch.prepare`` — on the loop thread at the end of
+                         ``_prepare_dispatch`` (``requests``): the shared
+                         host state is snapshotted, the worker-thread device
+                         call has not started. The boundary where the PR-4
+                         host-buffer aliasing window sat; the interleaving
+                         explorer (llm/schedule_explorer.py) permutes thread
+                         orderings at exactly this class of seam.
+- ``engine.watchdog``  — at the top of a watchdog trip, before the epoch
+                         bump and in-flight request failure (``requests``);
+                         ``delay`` = slow trip, ``raise`` = the watchdog
+                         task dies until the next request restarts it.
+- ``engine.drain``     — on the loop thread at the drained boundary, before
+                         the drained sanitizer audit; a raise fails the loop
+                         through the structured step-failure path.
 - ``grpc.call``        — before each gRPC attempt (``attempt``); set
                          ``grpc_code`` ("UNAVAILABLE"/"DEADLINE_EXCEEDED")
                          to exercise the transient-retry path.
+
+The three ``engine.dispatch.prepare``/``engine.watchdog``/``engine.drain``
+points double as the engine's YIELD-POINT SEAMS for the deterministic
+interleaving explorer (llm/schedule_explorer.py): together with the
+existing dispatch/retire/preempt points they mark every thread-ownership
+boundary of the pipelined loop, and the explorer's scenario seam labels
+must stay a subset of this registry (test_schedule_explorer pins that).
 
 Every point a production call site fires MUST be listed in
 :data:`KNOWN_POINTS`: the static analyzer (``tpuserve-analyze`` TPU403)
@@ -77,6 +98,9 @@ KNOWN_POINTS = frozenset({
     "engine.decode",
     "engine.decode.stall",
     "engine.decode.retire",
+    "engine.dispatch.prepare",
+    "engine.watchdog",
+    "engine.drain",
     "engine.admit",
     "engine.admit.class",
     "engine.pool",

@@ -360,7 +360,12 @@ class PagedKVCache:
     host-side waiting. Page FREES are the one thing data flow cannot order:
     the engine defers a freed slot's ``pool.free`` to the retirement of the
     newest chunk still writing it (the quarantine barrier), so a page is
-    never re-allocated under an in-flight write."""
+    never re-allocated under an in-flight write. The barrier protocol is
+    modelled and explored across seeded interleavings by
+    llm/schedule_explorer.py's ``quarantine_barrier`` scenario
+    (``--mutate drop_quarantine`` demonstrates the corruption a missing
+    barrier causes); the thread-ownership side is machine-checked by
+    tpuserve-analyze TPU501 via the engine's ``__affine_to__``."""
 
     # pool-handle rebinds happen only under the dispatch lock (a donating
     # dispatch invalidates the old handle; tpuserve-analyze TPU301)

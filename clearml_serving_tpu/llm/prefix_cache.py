@@ -334,7 +334,12 @@ class RadixPrefixCache:
         nodes, and the resume silently degrades to a full prefill of an
         arbitrary-length prompt (a fresh XLA compile per length, measured
         80-200 ms stalls on the serving loop). Returns an opaque handle for
-        unpin_run(), or None when nothing is stored for ``ids``."""
+        unpin_run(), or None when nothing is stored for ``ids``.
+
+        Pin/unpin balance across every queue-exit path is audited by the
+        KV sanitizer's drain check and explored under seeded thread
+        interleavings by llm/schedule_explorer.py's ``pin_balance``
+        scenario (``--mutate drop_unpin`` models a lost release)."""
         with self._lock:
             node, depth = self._walk(ids, lora)
             if depth < self.block:

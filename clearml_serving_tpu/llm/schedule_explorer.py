@@ -1,0 +1,620 @@
+"""Deterministic interleaving explorer: permute thread schedules at the
+engine's yield-point seams and prove the concurrency invariants hold under
+EVERY explored ordering.
+
+tpuserve-analyze's TPU5xx rules (analyze/rules_threads.py) are the static
+half of the race net; this module is the dynamic half, mirroring how PR 3
+paired the AST rules with the runtime KV sanitizer. The static pass has
+documented blind spots — cross-module calls, dynamic dispatch, buffers
+renamed through parameters — and exactly those are covered here: scenarios
+model the engine's cross-thread protocols (the PR-4 host-buffer handoff,
+the quarantine barrier, preemption pin balance, chain reset on failed
+dispatch, lock-guarded refcounts) over the REAL primitives (PagePool, the
+KV sanitizer) with explicit yield points, and a seeded scheduler explores
+K interleavings per scenario.
+
+How it works
+------------
+
+- Scenario threads are real ``threading.Thread``\\ s, but exactly ONE runs
+  at any instant: each thread parks at every :meth:`ScenarioContext.
+  yield_point` call and the scheduler hands the run token to a thread
+  chosen by a seeded ``random.Random`` — so a schedule is a reproducible
+  sequence of (thread, seam) steps, replayable from its seed.
+- Yield-point labels are the engine's fault seams (``engine.dispatch.
+  prepare``, ``engine.decode``, ``engine.decode.retire``, ...):
+  :data:`YIELD_POINTS` must stay a subset of ``faults.KNOWN_POINTS``
+  (test_schedule_explorer pins it), so the same seam vocabulary drives
+  chaos specs, the analyzer's TPU403 registry, and this explorer.
+- Invariants are asserted inside and after every schedule; a failure
+  raises :class:`ScheduleViolation` carrying the scenario, seed, and the
+  full schedule trace — the interleaving IS the repro.
+
+Mutation self-test
+------------------
+
+Each scenario carries a seeded defect (:data:`MUTATIONS`): dropping the
+PR-4 buffer copy, the quarantine barrier, a preemption unpin, the chain
+reset, or a lock acquisition. ``self_test()`` proves the net has no holes:
+with the mutation armed the explorer must CATCH it within K schedules;
+without it, all K schedules must stay green. ``scripts/tier1.sh`` runs
+``--smoke`` (clean sweep + self-test at small K, fixed seed) with the
+other static checks.
+
+CLI::
+
+    python -m clearml_serving_tpu.llm.schedule_explorer                # full sweep
+    python -m clearml_serving_tpu.llm.schedule_explorer --scenario pin_balance
+    python -m clearml_serving_tpu.llm.schedule_explorer --mutate drop_unpin
+    python -m clearml_serving_tpu.llm.schedule_explorer --self-test
+    python -m clearml_serving_tpu.llm.schedule_explorer --smoke        # CI gate
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "YIELD_POINTS",
+    "MUTATIONS",
+    "SCENARIOS",
+    "ScheduleViolation",
+    "ScenarioContext",
+    "explore",
+    "self_test",
+]
+
+# seam vocabulary: every engine-boundary label a scenario may park on.
+# MUST stay a subset of llm/faults.py KNOWN_POINTS — the engine fires these
+# as fault points at the same boundaries, so chaos specs, tpuserve-analyze
+# TPU403, and the explorer share one registry.
+YIELD_POINTS = frozenset({
+    "engine.dispatch.prepare",   # loop snapshot done, worker not started
+    "engine.decode",             # dispatch worker device call
+    "engine.decode.retire",      # loop-thread readback/emission
+    "engine.prefill",            # admission worker
+    "engine.preempt",            # mid-preemption commit boundary
+    "engine.watchdog",           # trip: epoch bump + in-flight failure
+    "engine.drain",              # drained boundary before the leak audit
+    "engine.release",            # slot teardown before page frees
+})
+
+# internal (non-engine) park labels the scheduler also accepts
+_INTERNAL_LABELS = frozenset({"lock-wait"})
+
+_STEP_TIMEOUT = 30.0   # a parked thread that never resumes = harness bug
+_MAX_STEPS = 4000      # livelock guard (cooperative spins are bounded)
+
+
+class ScheduleViolation(AssertionError):
+    """A concurrency invariant failed under an explored interleaving.
+    Carries the scenario, the schedule seed, and the (thread, seam) trace —
+    enough to replay the exact ordering."""
+
+    def __init__(self, message: str, *, scenario: str = "", seed: int = 0,
+                 trace: Optional[List[str]] = None):
+        super().__init__(message)
+        self.scenario = scenario
+        self.seed = seed
+        self.trace = list(trace or [])
+
+
+class _SceneThread:
+    __slots__ = ("name", "fn", "thread", "go", "done", "error")
+
+    def __init__(self, name: str, fn: Callable[[], None]):
+        self.name = name
+        self.fn = fn
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()
+        self.done = False
+        self.error: Optional[BaseException] = None
+
+
+class ScenarioContext:
+    """One schedule's worth of deterministic scheduling state. Scenario
+    bodies spawn threads, park at yield points, and query seeded defects;
+    ``run()`` drives the interleaving chosen by the seeded RNG."""
+
+    def __init__(self, rng: random.Random, mutations: frozenset = frozenset(),
+                 *, scenario: str = "", seed: int = 0):
+        self._rng = rng
+        self._mutations = frozenset(mutations)
+        self.scenario = scenario
+        self.seed = seed
+        self._threads: List[_SceneThread] = []
+        self._handback = threading.Event()
+        self._tls = threading.local()
+        self._holders: Dict[str, _SceneThread] = {}
+        self.trace: List[str] = []
+
+    # -- scenario surface --------------------------------------------------
+
+    def mutating(self, name: str) -> bool:
+        """True when the named seeded defect is armed for this run."""
+        return name in self._mutations
+
+    def spawn(self, fn: Callable[[], None], name: str) -> None:
+        self._threads.append(_SceneThread(name, fn))
+
+    def yield_point(self, label: str) -> None:
+        """Park the calling scenario thread at a seam; the scheduler decides
+        who runs next. Labels must come from the shared seam vocabulary."""
+        if label not in YIELD_POINTS and label not in _INTERNAL_LABELS:
+            raise ValueError(
+                "unknown yield point {!r} (known: {})".format(
+                    label, ", ".join(sorted(YIELD_POINTS))
+                )
+            )
+        st = getattr(self._tls, "st", None)
+        if st is None:
+            return  # called off a scenario thread (setup code): no-op
+        self.trace.append("{}:{}".format(st.name, label))
+        self._handback.set()
+        if not st.go.wait(_STEP_TIMEOUT):
+            raise RuntimeError("scheduler never resumed {}".format(st.name))
+        st.go.clear()
+
+    @contextmanager
+    def critical(self, name: str = "lock"):
+        """Cooperative mutex: models a lock at yield-point granularity
+        without real-lock deadlocks against parked holders (the waiter
+        parks instead of blocking, so the scheduler can run the holder)."""
+        me = getattr(self._tls, "st", None)
+        while self._holders.get(name) not in (None, me):
+            self.yield_point("lock-wait")
+        self._holders[name] = me
+        try:
+            yield
+        finally:
+            self._holders.pop(name, None)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _body(self, st: _SceneThread) -> None:
+        self._tls.st = st
+        if not st.go.wait(_STEP_TIMEOUT):
+            st.error = RuntimeError("never scheduled")
+            st.done = True
+            self._handback.set()
+            return
+        st.go.clear()
+        try:
+            st.fn()
+        except BaseException as ex:
+            st.error = ex
+        finally:
+            st.done = True
+            self._handback.set()
+
+    def run(self) -> None:
+        """Drive every spawned thread to completion under one seeded
+        interleaving; re-raises the first scenario-thread error."""
+        for st in self._threads:
+            st.thread = threading.Thread(
+                target=self._body, args=(st,), daemon=True,
+                name="explorer-{}".format(st.name),
+            )
+            st.thread.start()
+        steps = 0
+        while any(not st.done for st in self._threads):
+            runnable = sorted(
+                (st for st in self._threads if not st.done),
+                key=lambda s: s.name,
+            )
+            chosen = self._rng.choice(runnable)
+            self._handback.clear()
+            chosen.go.set()
+            if not self._handback.wait(_STEP_TIMEOUT):
+                raise RuntimeError(
+                    "schedule wedged at step {} (thread {})".format(
+                        steps, chosen.name
+                    )
+                )
+            steps += 1
+            if steps > _MAX_STEPS:
+                raise RuntimeError("livelock: {} steps".format(steps))
+        for st in self._threads:
+            st.thread.join(_STEP_TIMEOUT)
+        for st in sorted(self._threads, key=lambda s: s.name):
+            if st.error is not None:
+                self._stamp(st.error)
+                raise st.error
+
+    def _stamp(self, ex: BaseException) -> None:
+        """Attach the replay coordinates (scenario, seed, schedule trace)
+        to an escaping violation so it is a self-contained repro."""
+        if isinstance(ex, ScheduleViolation):
+            ex.scenario = ex.scenario or self.scenario
+            ex.seed = ex.seed or self.seed
+            ex.trace = ex.trace or list(self.trace)
+
+
+# -- scenarios ----------------------------------------------------------------
+#
+# Each models one cross-thread protocol of the pipelined engine over the
+# REAL primitives where the invariant lives (PagePool refcounts, the KV
+# sanitizer), with a seeded defect that must be caught. Keep bodies small:
+# a scenario is a protocol spec, not an engine re-implementation.
+
+
+def _pool(num_pages: int = 5, page_size: int = 4, max_slots: int = 2):
+    from .kv_cache import PagePool
+
+    return PagePool(num_pages, page_size, max_slots)
+
+
+def scenario_host_buffer_handoff(ctx: ScenarioContext) -> None:
+    """The PR-4 race class: _prepare_dispatch snapshots the loop-owned
+    next-token mirror for the dispatch worker; jnp.asarray is zero-copy on
+    CPU, so WITHOUT the .copy() the worker's late read can observe the
+    retire stage's in-place writeback. Mutation ``drop_buffer_copy`` skips
+    the snapshot copy."""
+    next_token = np.array([11, 12, 13, 14], np.int64)   # loop-owned mirror
+    handoff: Dict[str, Any] = {}
+    result: Dict[str, Any] = {}
+
+    def loop_thread():
+        # _prepare_dispatch: snapshot the chained tokens at the handoff
+        snap = (
+            next_token                      # seeded defect: aliasing handoff
+            if ctx.mutating("drop_buffer_copy")
+            else next_token.copy()
+        )
+        handoff["expect"] = next_token.tolist()
+        handoff["tokens"] = snap
+        ctx.yield_point("engine.dispatch.prepare")
+        # retire writeback re-anchors the host mirror in place — the
+        # worker may not have consumed the handoff yet
+        next_token[:] = [91, 92, 93, 94]
+        ctx.yield_point("engine.decode.retire")
+
+    def worker_thread():
+        while "tokens" not in handoff:
+            ctx.yield_point("engine.decode")
+        ctx.yield_point("engine.decode")    # device reads lazily
+        result["consumed"] = list(np.asarray(handoff["tokens"]))
+
+    ctx.spawn(loop_thread, "loop")
+    ctx.spawn(worker_thread, "worker")
+    ctx.run()
+    if result["consumed"] != handoff["expect"]:
+        raise ScheduleViolation(
+            "worker consumed mutated host buffer {} (snapshot was {}): the "
+            "handoff aliased a loop-owned mirror".format(
+                result["consumed"], handoff["expect"]
+            )
+        )
+
+
+def scenario_quarantine_barrier(ctx: ScenarioContext) -> None:
+    """A slot freed at retire N is quarantined until every older in-flight
+    chunk retires: its pages must never be re-allocated under a pending
+    device write (docs/pipelined_decode.md). Mutation ``drop_quarantine``
+    frees immediately, modelling a missing barrier."""
+    from .kv_sanitizer import KVSanitizer
+
+    pool = _pool(num_pages=5, page_size=4, max_slots=2)  # 4 usable pages
+    pool.allocate(0, 16)                 # slot 0 owns the whole pool
+    inflight_pages = pool.slot_pages(0)  # a younger chunk still writes these
+    state: Dict[str, Any] = {"retired": False, "clobbered": []}
+    quarantine: List[int] = []
+
+    def loop_retire():
+        # slot 0's request finished at this retire; a younger chunk is
+        # still in flight against its pages
+        if ctx.mutating("drop_quarantine"):
+            pool.free(0)                 # seeded defect: no barrier
+        else:
+            quarantine.append(0)         # deferred to the barrier retire
+        ctx.yield_point("engine.decode.retire")
+        while not state["retired"]:
+            ctx.yield_point("engine.decode.retire")
+        # barrier passed: deferred frees execute now
+        for slot in quarantine:
+            pool.free(slot)
+
+    def loop_admit():
+        ctx.yield_point("engine.prefill")
+        try:
+            pool.allocate(1, 8)          # needs recycled pages to succeed
+        except MemoryError:
+            pass                         # barrier held: admission sheds
+        ctx.yield_point("engine.prefill")
+
+    def worker_chunk():
+        ctx.yield_point("engine.decode")
+        # the in-flight chunk's device writes land: every target page must
+        # still belong to slot 0 (or its quarantine), never to slot 1
+        owned_elsewhere = set(pool.slot_pages(1))
+        state["clobbered"] = [p for p in inflight_pages if p in owned_elsewhere]
+        state["retired"] = True
+        ctx.yield_point("engine.decode")
+
+    ctx.spawn(loop_retire, "loop-retire")
+    ctx.spawn(loop_admit, "loop-admit")
+    ctx.spawn(worker_chunk, "worker")
+    ctx.run()
+    if state["clobbered"]:
+        raise ScheduleViolation(
+            "in-flight chunk wrote pages {} already re-allocated to slot 1 "
+            "(quarantine barrier violated)".format(state["clobbered"])
+        )
+    pool.free(1)
+    KVSanitizer(pool).check("quarantine-barrier", drained=True)
+
+
+def scenario_pin_balance(ctx: ScenarioContext) -> None:
+    """Preemption/prefix-hit pins must balance: every pin_pages has a
+    matching unpin on every queue-exit path, or the armed sanitizer's drain
+    audit reports pins outliving the requests that took them. Mutation
+    ``drop_unpin`` models a lost release on one path."""
+    from .kv_sanitizer import KVSanitizer
+    from .prefix_cache import RadixPrefixCache
+
+    pool = _pool(num_pages=9, page_size=4, max_slots=2)
+    cache = RadixPrefixCache(block=4, pool=pool, page_bytes=8)
+    ids = list(range(8))
+    pool.allocate(0, 8)
+    cache.store_pages(ids, 0, pool.slot_pages(0))   # cache refs the prefix
+    sanitizer = KVSanitizer(pool, prefix_cache=cache)
+
+    def admission():
+        # prefix-cache hit: lookup_pages pins on the caller's behalf; the
+        # slot mapping takes its own refs; the transient pin MUST release
+        hit = cache.lookup_pages(ids)
+        ctx.yield_point("engine.prefill")
+        pool.map_shared(1, hit["pages"], hit["len"])
+        ctx.yield_point("engine.prefill")
+        if not ctx.mutating("drop_unpin"):   # seeded defect: lost release
+            cache.release(hit)
+
+    def loop_free():
+        # the storing slot finishes concurrently; cache refs + the pin must
+        # keep the shared pages alive through the free
+        ctx.yield_point("engine.decode.retire")
+        pool.free(0)
+        ctx.yield_point("engine.release")
+
+    ctx.spawn(admission, "admit")
+    ctx.spawn(loop_free, "loop")
+    ctx.run()
+    # conservation holds mid-protocol under every interleaving...
+    sanitizer.check("pin-balance")
+    # ...and at drain only the prefix cache may keep references
+    pool.free(1)
+    sanitizer.check("pin-balance", drained=True)
+
+
+def scenario_stale_chain_commit(ctx: ScenarioContext) -> None:
+    """A failed dispatch must reset the device-resident token chains before
+    the next dispatch, or a freshly committed slot chains the dead chunk's
+    stale token (engine._recover_failed_dispatch). Mutation
+    ``drop_chain_reset`` skips the reset."""
+    chain: Dict[str, Any] = {"dev": None}    # device-resident next-token
+    host = np.array([5], np.int64)           # loop-owned host mirror
+    state: Dict[str, Any] = {"failed": False}
+
+    def worker_dispatch():
+        # dispatch 1: chains its (about to be discarded) output on device,
+        # then fails before any chunk lands
+        chain["dev"] = 77
+        ctx.yield_point("engine.decode")
+        state["failed"] = True
+
+    def loop():
+        ctx.yield_point("engine.dispatch.prepare")
+        while not state["failed"]:
+            ctx.yield_point("engine.decode.retire")
+        # recovery: forget the chains so the next dispatch re-uploads
+        if not ctx.mutating("drop_chain_reset"):  # seeded defect
+            chain["dev"] = None
+        # a fresh commit lands on the loop thread
+        host[0] = 42
+        ctx.yield_point("engine.prefill")
+        # next dispatch chains device state when present, host otherwise
+        token = chain["dev"] if chain["dev"] is not None else int(host[0])
+        if token != 42:
+            raise ScheduleViolation(
+                "fresh commit chained stale token {} instead of 42 "
+                "(device chains not reset after the failed dispatch)".format(
+                    token
+                )
+            )
+
+    ctx.spawn(worker_dispatch, "worker")
+    ctx.spawn(loop, "loop")
+    ctx.run()
+
+
+def scenario_refcount_lock(ctx: ScenarioContext) -> None:
+    """Lock-guarded refcount discipline (the TPU301/TPU504 invariant, run
+    dynamically): two threads bump a shared refcount through a
+    read-modify-write that parks mid-update. Without the critical section
+    (mutation ``drop_lock``) an interleaving loses updates."""
+    refs = [0, 0]
+    rounds = 3
+
+    def bump(name: str):
+        def body():
+            for _ in range(rounds):
+                if ctx.mutating("drop_lock"):   # seeded defect: no lock
+                    value = refs[1]
+                    ctx.yield_point("engine.decode")
+                    refs[1] = value + 1
+                else:
+                    with ctx.critical("_lock"):
+                        value = refs[1]
+                        ctx.yield_point("engine.decode")
+                        refs[1] = value + 1
+                ctx.yield_point("engine.decode.retire")
+        return body
+
+    ctx.spawn(bump("loop"), "loop")
+    ctx.spawn(bump("worker"), "worker")
+    ctx.run()
+    if refs[1] != 2 * rounds:
+        raise ScheduleViolation(
+            "refcount {} != {} after {} bumps per thread: lost update "
+            "without the lock".format(refs[1], 2 * rounds, rounds)
+        )
+
+
+SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
+    "host_buffer_handoff": scenario_host_buffer_handoff,
+    "quarantine_barrier": scenario_quarantine_barrier,
+    "pin_balance": scenario_pin_balance,
+    "stale_chain_commit": scenario_stale_chain_commit,
+    "refcount_lock": scenario_refcount_lock,
+}
+
+# seeded defect -> the scenario that must catch it (self_test proves each)
+MUTATIONS: Dict[str, str] = {
+    "drop_buffer_copy": "host_buffer_handoff",
+    "drop_quarantine": "quarantine_barrier",
+    "drop_unpin": "pin_balance",
+    "drop_chain_reset": "stale_chain_commit",
+    "drop_lock": "refcount_lock",
+}
+
+
+def explore(scenario: str, schedules: int = 16, seed: int = 0,
+            mutate: Optional[str] = None) -> Dict[str, Any]:
+    """Run ``schedules`` seeded interleavings of one scenario; returns a
+    report with every violation's schedule index, message, and trace.
+    Deterministic: (scenario, seed, schedule index) fully determine the
+    interleaving."""
+    if scenario not in SCENARIOS:
+        raise ValueError(
+            "unknown scenario {!r} (known: {})".format(
+                scenario, ", ".join(sorted(SCENARIOS))
+            )
+        )
+    if mutate is not None and mutate not in MUTATIONS:
+        raise ValueError(
+            "unknown mutation {!r} (known: {})".format(
+                mutate, ", ".join(sorted(MUTATIONS))
+            )
+        )
+    from .kv_sanitizer import KVSanitizerError
+
+    mutations = frozenset({mutate}) if mutate else frozenset()
+    violations = []
+    for i in range(schedules):
+        rng = random.Random("{}:{}:{}".format(scenario, seed, i))
+        ctx = ScenarioContext(rng, mutations, scenario=scenario, seed=seed)
+        try:
+            SCENARIOS[scenario](ctx)
+        except (ScheduleViolation, KVSanitizerError) as ex:
+            ctx._stamp(ex)
+            # the armed KV sanitizer is part of the net: its invariant
+            # failures count as caught violations, with the schedule trace
+            violations.append({
+                "schedule": i,
+                "seed": seed,
+                "message": str(ex),
+                "trace": list(ctx.trace),
+            })
+    return {
+        "scenario": scenario,
+        "schedules": schedules,
+        "seed": seed,
+        "mutate": mutate,
+        "violations": violations,
+    }
+
+
+def self_test(schedules: int = 16, seed: int = 0) -> Dict[str, Any]:
+    """Prove the net has no holes: every seeded defect must be CAUGHT
+    within ``schedules`` interleavings of its scenario, and every scenario
+    must stay green without one. Returns {"ok": bool, "detail": {...}}."""
+    detail: Dict[str, Any] = {}
+    ok = True
+    for mutation, scenario in sorted(MUTATIONS.items()):
+        caught = bool(
+            explore(scenario, schedules, seed, mutate=mutation)["violations"]
+        )
+        detail["mutation:{}".format(mutation)] = (
+            "caught" if caught else "MISSED"
+        )
+        ok = ok and caught
+    for scenario in sorted(SCENARIOS):
+        clean = not explore(scenario, schedules, seed)["violations"]
+        detail["clean:{}".format(scenario)] = "green" if clean else "VIOLATED"
+        ok = ok and clean
+    return {"ok": ok, "schedules": schedules, "seed": seed, "detail": detail}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        prog="python -m clearml_serving_tpu.llm.schedule_explorer",
+        description="deterministic interleaving explorer "
+        "(docs/static_analysis.md)",
+    )
+    parser.add_argument("--scenario", default=None,
+                        help="one scenario (default: all)")
+    parser.add_argument("--schedules", type=int, default=16,
+                        help="interleavings per scenario (K)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--mutate", default=None,
+                        help="arm one seeded defect (see --list)")
+    parser.add_argument("--self-test", action="store_true",
+                        help="every seeded defect caught + clean runs green")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI gate: clean sweep + self-test at small K")
+    parser.add_argument("--list", action="store_true",
+                        help="print scenarios and mutations")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print("scenario  {}".format(name))
+        for name, scenario in sorted(MUTATIONS.items()):
+            print("mutation  {:<18} -> {}".format(name, scenario))
+        return 0
+
+    if args.smoke:
+        report = self_test(schedules=max(4, min(args.schedules, 8)),
+                           seed=args.seed)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    if args.self_test:
+        report = self_test(schedules=args.schedules, seed=args.seed)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    names = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    rc = 0
+    for name in names:
+        report = explore(name, args.schedules, args.seed, mutate=args.mutate)
+        status = (
+            "VIOLATED ({} of {})".format(
+                len(report["violations"]), report["schedules"]
+            )
+            if report["violations"]
+            else "green ({} schedules)".format(report["schedules"])
+        )
+        print("{:<22} {}".format(name, status))
+        for violation in report["violations"]:
+            print("  schedule {}: {}".format(
+                violation["schedule"], violation["message"]
+            ))
+            print("    trace: {}".format(" -> ".join(violation["trace"])))
+        if report["violations"]:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

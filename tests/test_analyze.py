@@ -405,6 +405,232 @@ def test_configure_rejects_unknown_point():
     faults.clear()
 
 
+# -- TPU501-504: thread-affinity discipline -----------------------------------
+
+_AFFINE_DECL = """
+    import asyncio
+    class Engine:
+        __affine_to__ = {"loop": ("_chunks",)}
+"""
+
+
+def test_tpu501_worker_mutation_of_loop_affine_state():
+    src = _AFFINE_DECL + """
+        def _worker(self):
+            self._chunks.append(1)
+        async def step(self):
+            await asyncio.to_thread(self._worker)
+    """
+    assert codes(src) == ["TPU501"]
+
+
+def test_tpu501_loop_mutation_is_fine():
+    src = _AFFINE_DECL + """
+        async def step(self):
+            self._chunks.append(1)
+    """
+    assert codes(src) == []
+
+
+def test_tpu501_thread_target_is_a_worker_root():
+    src = _AFFINE_DECL + """
+        import threading
+        def _daemon(self):
+            self._chunks.clear()
+        async def launch(self):
+            threading.Thread(target=self._daemon).start()
+    """
+    assert codes(src) == ["TPU501"]
+
+
+def test_tpu501_uncontexted_function_fails_open():
+    # a function never reached from a thread root has no context: the pass
+    # fails open (documented blind spot) instead of guessing
+    src = _AFFINE_DECL + """
+        def orphan(self):
+            self._chunks.append(1)
+    """
+    assert codes(src) == []
+
+
+def test_tpu501_def_line_ignore():
+    src = _AFFINE_DECL + """
+        def _worker(self):  # tpuserve: ignore[TPU501] protocol-serialized: loop awaits this call
+            self._chunks.append(1)
+        async def step(self):
+            await asyncio.to_thread(self._worker)
+    """
+    assert codes(src) == []
+
+
+def test_tpu501_context_propagates_through_calls():
+    # the mutation sits two intra-module calls below the worker root
+    src = _AFFINE_DECL + """
+        def _inner(self):
+            self._chunks.append(1)
+        def _outer(self):
+            self._inner()
+        async def step(self):
+            await asyncio.to_thread(self._outer)
+    """
+    assert codes(src) == ["TPU501"]
+
+
+_HANDOFF_DECL = """
+    import asyncio
+    import jax.numpy as jnp
+    class Engine:
+"""
+
+
+def test_tpu502_uncopied_host_buffer_in_worker():
+    src = _HANDOFF_DECL + """
+        def _dispatch(self):
+            return jnp.asarray(self._next_token)
+        async def step(self):
+            await asyncio.to_thread(self._dispatch)
+    """
+    assert codes(src) == ["TPU502"]
+
+
+def test_tpu502_copy_at_the_handoff_is_fine():
+    src = _HANDOFF_DECL + """
+        def _dispatch(self):
+            return jnp.asarray(self._next_token.copy())
+        async def step(self):
+            await asyncio.to_thread(self._dispatch)
+    """
+    assert codes(src) == []
+
+
+def test_tpu502_needs_cross_thread_structure():
+    # a module with no worker roots has no handoff to race: local uploads
+    # of attributes are the single-threaded norm elsewhere in the tree
+    src = """
+        import jax.numpy as jnp
+        class Engine:
+            async def step(self):
+                return jnp.asarray(self._next_token)
+    """
+    assert codes(src) == []
+
+
+def test_tpu502_locals_are_fine():
+    src = _HANDOFF_DECL + """
+        def _dispatch(self, prep):
+            return jnp.asarray(prep["tokens"])
+        async def step(self):
+            await asyncio.to_thread(self._dispatch, {})
+    """
+    assert codes(src) == []
+
+
+def test_tpu502_ignore_comment():
+    src = _HANDOFF_DECL + """
+        def _dispatch(self):
+            return jnp.asarray(self._frozen_table)  # tpuserve: ignore[TPU502] written once at init
+        async def step(self):
+            await asyncio.to_thread(self._dispatch)
+    """
+    assert codes(src) == []
+
+
+def test_tpu503_await_under_sync_lock():
+    src = """
+        import asyncio
+        class Engine:
+            async def step(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+    """
+    assert codes(src) == ["TPU503"]
+
+
+def test_tpu503_async_with_is_fine():
+    src = """
+        import asyncio
+        class Engine:
+            async def step(self):
+                async with self._alock:
+                    await asyncio.sleep(0)
+    """
+    assert codes(src) == []
+
+
+def test_tpu503_nested_coroutine_does_not_inherit_lock():
+    # a coroutine DEFINED under the with runs later, without the lock
+    src = """
+        import asyncio
+        class Engine:
+            def build(self):
+                with self._lock:
+                    async def later():
+                        await asyncio.sleep(0)
+                    return later
+    """
+    assert codes(src) == []
+
+
+def test_tpu503_await_after_release_is_fine():
+    src = """
+        import asyncio
+        class Engine:
+            async def step(self):
+                with self._lock:
+                    self.n += 1
+                await asyncio.sleep(0)
+    """
+    assert codes(src) == []
+
+
+_HELPER_DECL = """
+    import asyncio
+    import threading
+    class Pool:
+        __guarded_by__ = {"_lock": ("_table",)}
+        def _grow_locked(self, x):  # tpuserve: ignore[TPU301] lock held by caller
+            self._table.append(x)
+"""
+
+
+def test_tpu504_helper_called_without_the_lock():
+    src = _HELPER_DECL + """
+        async def handler(self, x):
+            self._grow_locked(x)
+    """
+    assert codes(src) == ["TPU504"]
+
+
+def test_tpu504_helper_called_under_the_lock_is_fine():
+    src = _HELPER_DECL + """
+        async def handler(self, x):
+            with self._lock:
+                self._grow_locked(x)
+    """
+    assert codes(src) == []
+
+
+def test_tpu504_helper_chain_inside_annotated_helper_is_fine():
+    # a helper calling a sibling helper is itself a lock-held context
+    src = _HELPER_DECL + """
+        def _grow_two_locked(self, x):  # tpuserve: ignore[TPU301] lock held by caller
+            self._grow_locked(x)
+            self._grow_locked(x)
+        async def handler(self, x):
+            with self._lock:
+                self._grow_two_locked(x)
+    """
+    assert codes(src) == []
+
+
+def test_tpu504_ignore_with_reason():
+    src = _HELPER_DECL + """
+        async def handler(self, x):
+            self._grow_locked(x)  # tpuserve: ignore[TPU504] single-threaded startup path
+    """
+    assert codes(src) == []
+
+
 # -- registry / catalog consistency -------------------------------------------
 
 
@@ -424,11 +650,31 @@ def test_guarded_by_declarations_match_project_registry():
                 )
 
 
+def test_affine_declarations_match_affinity_registry():
+    from clearml_serving_tpu.analyze import rules_threads
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+    from clearml_serving_tpu.serving.model_request_processor import (
+        ModelRequestProcessor,
+    )
+
+    for cls in (LLMEngineCore, ModelRequestProcessor):
+        for thread, attrs in cls.__affine_to__.items():
+            for attr in attrs:
+                entry = rules_threads.AFFINITY_REGISTRY.get(attr)
+                assert entry is not None and entry[0] == thread, (
+                    "{}.{} declared {}-affine but the analyzer's "
+                    "AFFINITY_REGISTRY disagrees".format(
+                        cls.__name__, attr, thread
+                    )
+                )
+
+
 def test_every_emitted_code_is_in_the_catalog():
     # fixture sources above exercise every rule; RULES must describe each
     # (TPU000 = unparseable file, emitted by the driver itself)
     for code in ("TPU000", "TPU101", "TPU102", "TPU103", "TPU104", "TPU201",
-                 "TPU202", "TPU203", "TPU301", "TPU401", "TPU402", "TPU403"):
+                 "TPU202", "TPU203", "TPU301", "TPU401", "TPU402", "TPU403",
+                 "TPU501", "TPU502", "TPU503", "TPU504"):
         assert code in RULES
 
 
@@ -507,6 +753,86 @@ def test_deleting_an_ignore_annotation_fails_the_tree():
     assert stripped != source, "expected ignore annotations in kv_cache.py"
     found = [f.code for f in analyze_source(stripped, path)]
     assert "TPU301" in found
+
+
+def test_mutation_dropped_buffer_copy_is_caught_statically():
+    """Seeded defect (acceptance): stripping the PR-4-style snapshot copies
+    from the engine's spec-path thread handoffs resurfaces as TPU502."""
+    path = os.path.join(PKG_DIR, "llm", "engine.py")
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    mutated = source.replace(
+        "jnp.asarray(self._tokbuf.copy())", "jnp.asarray(self._tokbuf)"
+    )
+    assert mutated != source, "expected spec-path snapshot copies in engine.py"
+    found = [f.code for f in analyze_source(mutated, path)]
+    assert "TPU502" in found
+    # the committed tree (with the copies) is clean
+    assert "TPU502" not in [f.code for f in analyze_source(source, path)]
+
+
+def test_mutation_dropped_lock_is_caught_statically():
+    """Seeded defect (acceptance): stripping the pool's lock acquisitions
+    resurfaces as TPU301 — the static half of the dropped-lock net (the
+    interleaving explorer's refcount_lock scenario is the dynamic half)."""
+    path = os.path.join(PKG_DIR, "llm", "kv_cache.py")
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    mutated = source.replace("with self._lock:", "if True:")
+    assert mutated != source
+    found = [f.code for f in analyze_source(mutated, path)]
+    assert "TPU301" in found
+
+
+def test_mutation_offthread_affinity_annotation_is_load_bearing():
+    """Deleting the serial-spec-path TPU501 annotation resurfaces the
+    worker-thread mutation of loop-affine state it documents."""
+    path = os.path.join(PKG_DIR, "llm", "engine.py")
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    marker = (
+        "# tpuserve: ignore[TPU501] serial spec path: the loop is suspended "
+        "awaiting this worker call and commits land at loop tops, so no "
+        "loop-thread mutator runs concurrently"
+    )
+    mutated = source.replace(marker, "")
+    assert mutated != source, "expected the _spec_commit_state annotation"
+    found = [f.code for f in analyze_source(mutated, path)]
+    assert "TPU501" in found
+
+
+def test_cli_json_format(tmp_path):
+    import json
+
+    # clean file -> exit 0, EMPTY stdout (CI counts lines)
+    good = tmp_path / "good.py"
+    good.write_text("async def f():\n    return 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "clearml_serving_tpu.analyze",
+         "--format", "json", str(good)],
+        capture_output=True, text=True, cwd=PKG_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.strip() == ""
+    # violations -> exit 1, one JSON object per line with the stable keys
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n    time.sleep(2)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "clearml_serving_tpu.analyze",
+         "--format", "json", str(bad)],
+        capture_output=True, text=True, cwd=PKG_ROOT,
+    )
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 2
+    for line in lines:
+        obj = json.loads(line)
+        assert obj["rule"] == "TPU101"
+        assert obj["file"].endswith("bad.py")
+        assert isinstance(obj["line"], int) and obj["line"] in (3, 4)
+        assert "fix" in obj and "message" in obj and "col" in obj
 
 
 def test_cli_exit_codes_and_output(tmp_path):

@@ -894,3 +894,49 @@ def test_stop_with_chunks_in_flight_reclaims_pages(parts):
         return engine
 
     asyncio.run(run())
+
+
+def test_dispatch_prepare_seam_fails_batch_structurally(parts):
+    """The engine.dispatch.prepare yield-point seam (interleaving-explorer
+    boundary, docs/static_analysis.md) is a live fault point: a raise-once
+    spec there fails the in-flight batch with a structured error and the
+    engine keeps serving — armed sanitizer balancing the books."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, decode_steps=1)
+        await _collect(engine, GenRequest(prompt_ids=[256, 1], max_new_tokens=2))
+        faults.configure([
+            {"point": "engine.dispatch.prepare", "action": "raise",
+             "times": 1, "message": "prep seam"},
+        ])
+        with pytest.raises(EngineStepError):
+            await _collect(
+                engine, GenRequest(prompt_ids=[256, 2], max_new_tokens=8)
+            )
+        out = await _collect(
+            engine, GenRequest(prompt_ids=[256, 3], max_new_tokens=4)
+        )
+        assert len(out) >= 1
+        return engine
+
+    engine = asyncio.run(run())
+    assert engine.counters["step_failures"] == 1
+
+
+def test_drain_seam_fires_at_the_drained_boundary(parts):
+    """engine.drain fires exactly once per drain, at the boundary the
+    drained sanitizer audit runs on."""
+    bundle, params = parts
+
+    async def run():
+        engine = _make_engine(bundle, params, decode_steps=1)
+        spec = faults.FaultSpec(point="engine.drain", action="delay",
+                                delay=0.0, times=-1)
+        faults.configure([spec])
+        await _collect(engine, GenRequest(prompt_ids=[256, 4], max_new_tokens=2))
+        await engine.wait_drained()
+        return spec.fired
+
+    fired = asyncio.run(run())
+    assert fired >= 1

@@ -1,0 +1,186 @@
+"""Deterministic interleaving explorer (llm/schedule_explorer.py): scenario
+sweeps stay green under every explored schedule, every seeded defect is
+caught (the mutation self-test — acceptance criterion for the race net),
+schedules replay deterministically from their seed, and the seam vocabulary
+stays in lockstep with the faults registry the engine and the analyzer
+share."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from clearml_serving_tpu.llm import faults
+from clearml_serving_tpu.llm.schedule_explorer import (
+    MUTATIONS,
+    SCENARIOS,
+    YIELD_POINTS,
+    ScenarioContext,
+    ScheduleViolation,
+    explore,
+    self_test,
+)
+
+K = 12          # schedules per scenario: small enough for tier-1, large
+SEED = 0        # enough that every seeded defect is caught at this seed
+
+
+# -- seam vocabulary ----------------------------------------------------------
+
+
+def test_yield_points_are_registered_fault_points():
+    """The explorer's seams ARE the engine's fault points: one registry
+    drives chaos specs, analyzer TPU403, and schedule exploration."""
+    assert YIELD_POINTS <= faults.KNOWN_POINTS
+
+
+def test_new_engine_seams_accept_chaos_specs():
+    for point in ("engine.dispatch.prepare", "engine.watchdog", "engine.drain"):
+        faults.configure([{"point": point, "action": "delay", "delay": 0.0}])
+    faults.clear()
+
+
+def test_unknown_yield_point_is_rejected():
+    import random
+
+    ctx = ScenarioContext(random.Random(0))
+
+    def bad():
+        ctx.yield_point("engine.not.a.seam")
+
+    ctx.spawn(bad, "t")
+    with pytest.raises(ValueError, match="unknown yield point"):
+        ctx.run()
+
+
+# -- clean sweeps -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_scenario_green_under_every_explored_schedule(scenario):
+    report = explore(scenario, schedules=K, seed=SEED)
+    assert report["violations"] == [], report
+
+
+# -- determinism --------------------------------------------------------------
+
+
+def test_schedules_replay_deterministically():
+    a = explore("refcount_lock", schedules=6, seed=3, mutate="drop_lock")
+    b = explore("refcount_lock", schedules=6, seed=3, mutate="drop_lock")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # different seeds explore different interleavings
+    c = explore("refcount_lock", schedules=6, seed=4, mutate="drop_lock")
+    assert json.dumps(a, sort_keys=True) != json.dumps(c, sort_keys=True)
+
+
+# -- mutation self-test (acceptance) ------------------------------------------
+
+
+@pytest.mark.parametrize("mutation", sorted(MUTATIONS))
+def test_seeded_defect_is_caught(mutation):
+    """Each seeded defect — dropped PR-4 buffer copy, dropped quarantine
+    barrier, dropped unpin, dropped chain reset, dropped lock — must be
+    CAUGHT within K explored schedules, proving the net has no hole for
+    that defect class."""
+    report = explore(MUTATIONS[mutation], schedules=K, seed=SEED,
+                     mutate=mutation)
+    assert report["violations"], (
+        "mutation {!r} survived {} schedules of {}".format(
+            mutation, K, MUTATIONS[mutation]
+        )
+    )
+    # the violation carries an actionable repro: message + schedule trace
+    first = report["violations"][0]
+    assert first["trace"], first
+    assert all(":" in step for step in first["trace"])
+
+
+def test_self_test_report():
+    report = self_test(schedules=K, seed=SEED)
+    assert report["ok"], report["detail"]
+    assert all(
+        v in ("caught", "green") for v in report["detail"].values()
+    ), report["detail"]
+
+
+# -- the PR-4 regression scenario ---------------------------------------------
+
+
+def test_host_buffer_aliasing_race_class_regression():
+    """The exact race class PR 4 fixed by hand (zero-copy jnp.asarray of a
+    live-mutated host mirror): with the snapshot copy every interleaving is
+    clean; with the copy dropped the explorer finds an interleaving where
+    the worker observes the retire stage's writeback."""
+    clean = explore("host_buffer_handoff", schedules=K, seed=SEED)
+    assert clean["violations"] == []
+    raced = explore("host_buffer_handoff", schedules=K, seed=SEED,
+                    mutate="drop_buffer_copy")
+    assert raced["violations"]
+    assert "mutated host buffer" in raced["violations"][0]["message"]
+
+
+def test_pin_balance_violation_is_the_armed_sanitizer():
+    """The pin-balance net is the REAL KV sanitizer: the dropped unpin is
+    reported as pins outliving drain, same as in production arming."""
+    report = explore("pin_balance", schedules=K, seed=SEED,
+                     mutate="drop_unpin")
+    assert report["violations"]
+    assert "pins outlived drain" in report["violations"][0]["message"]
+
+
+# -- guards -------------------------------------------------------------------
+
+
+def test_unknown_scenario_and_mutation_are_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        explore("nope", schedules=1)
+    with pytest.raises(ValueError, match="unknown mutation"):
+        explore("pin_balance", schedules=1, mutate="nope")
+
+
+def test_violation_inside_thread_surfaces_with_replay_coordinates():
+    import random
+
+    ctx = ScenarioContext(random.Random(0), scenario="fixture", seed=9)
+
+    def bad():
+        ctx.yield_point("engine.decode")
+        raise ScheduleViolation("boom")
+
+    ctx.spawn(bad, "t")
+    with pytest.raises(ScheduleViolation, match="boom") as info:
+        ctx.run()
+    assert ctx.trace == ["t:engine.decode"]
+    # the escaping violation is a self-contained repro
+    assert info.value.scenario == "fixture"
+    assert info.value.seed == 9
+    assert info.value.trace == ["t:engine.decode"]
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_smoke_and_mutate_exit_codes(tmp_path):
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a mutated run must exit non-zero (a violation was found) and print
+    # the schedule trace
+    proc = subprocess.run(
+        [sys.executable, "-m", "clearml_serving_tpu.llm.schedule_explorer",
+         "--scenario", "stale_chain_commit", "--schedules", "4",
+         "--mutate", "drop_chain_reset"],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale token" in proc.stdout and "trace:" in proc.stdout
+    # the clean run of the same scenario exits zero
+    proc = subprocess.run(
+        [sys.executable, "-m", "clearml_serving_tpu.llm.schedule_explorer",
+         "--scenario", "stale_chain_commit", "--schedules", "4"],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "green" in proc.stdout
