@@ -445,6 +445,201 @@ def run_pipeline_ab(
     }
 
 
+def run_ragged_ab(
+    cfg: dict,
+    *,
+    batch: int = 4,
+    decode_steps: int = 2,
+    new_tokens: int = 48,
+    decode_prompt_len: int = 12,
+    admit_prompt_len: int = 160,
+    step_token_budget: int = 32,
+    chunk: int = 8,
+    max_seq_len: int = 512,
+    cache_mode: str = "paged",
+    page_size: int = 16,
+    repeats: int = 3,
+) -> dict:
+    """Ragged-vs-two-dispatch A/B on the REAL engine
+    (docs/ragged_attention.md): ``batch-1`` short-prompt requests decode
+    continuously; once every stream is flowing, ONE long-prompt request is
+    admitted. The legacy arm runs the historical two-dispatch scheduler
+    (chunked prefill paced by the prefill gate); the ragged arm runs the
+    token-budget scheduler, whose mixed launches carry the admission as
+    chunk rows BESIDE the decode rows.
+
+    Headline: ``decode_stall_ms`` — the worst inter-token gap any live
+    decode stream sees inside the admission window (submit .. first token
+    of the admitted request). Two-dispatch serializes the admission's
+    prefill dispatches against decode chunks on one device queue, so the
+    gap grows with the prompt; ragged bounds it near one mixed-step time.
+    Also reports the admitted request's TTFT, per-arm TTFT p50/p99 across
+    all requests, token-weighted batch occupancy, tok/s, and stream
+    byte-identity across the arms (greedy; both arms chunk EVERY prompt —
+    full prefill differs from chunked numerically under kv_quant)."""
+    import asyncio
+
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    decode_prompts = [
+        [(7 * i + 3 + j) % 250 + 1 for j in range(decode_prompt_len)]
+        for i in range(batch - 1)
+    ]
+    admit_prompt = [(11 * j + 5) % 250 + 1 for j in range(admit_prompt_len)]
+    buckets = sorted({
+        max(16, decode_prompt_len),
+        min(max_seq_len, 1 << (admit_prompt_len - 1).bit_length()),
+    })
+
+    def measure(mode: str):
+        extra = (
+            dict(chunked_prefill_size=chunk)
+            if mode == "two_dispatch"
+            else dict(scheduler="ragged", step_token_budget=step_token_budget)
+        )
+        engine = LLMEngineCore(
+            bundle, params,
+            max_batch=batch,
+            max_seq_len=max_seq_len,
+            prefill_buckets=buckets,
+            eos_token_id=None,      # fixed work per stream
+            decode_steps=decode_steps,
+            cache_mode=cache_mode,
+            page_size=page_size,
+            **extra,
+        )
+        stamps: dict = {}
+        occupancy: list = []
+
+        async def one(key, ids, n):
+            req = GenRequest(
+                prompt_ids=list(ids), max_new_tokens=n, temperature=0.0
+            )
+            out = []
+            stamps[key] = {"submit": time.perf_counter(), "tokens": []}
+            async for tok in engine.generate(req):
+                stamps[key]["tokens"].append(time.perf_counter())
+                occupancy.append(engine.active_slots)
+                out.append(tok)
+            return out
+
+        async def group():
+            decode_tasks = [
+                asyncio.create_task(one(i, p, new_tokens))
+                for i, p in enumerate(decode_prompts)
+            ]
+            # wait until every decode stream is live before admitting
+            while not all(
+                len(stamps.get(i, {}).get("tokens", ())) >= 2
+                for i in range(len(decode_prompts))
+            ):
+                await asyncio.sleep(0.002)
+            t_admit = time.perf_counter()
+            long_out = await one("admit", admit_prompt, new_tokens // 2)
+            outs = [await t for t in decode_tasks]
+            await engine.wait_drained()
+            return outs + [long_out], t_admit
+
+        # warmup group: compile every trace (prefill buckets, ragged step
+        # variants, decode chunk) so the measured windows time scheduling,
+        # not XLA compiles. Then ``repeats`` measured groups — the stall /
+        # TTFT metrics take the MEDIAN across groups so one scheduler hiccup
+        # on a noisy host cannot write the headline.
+        asyncio.run(group())
+        stalls, admit_ttfts, ttft_lists, tok_rates, occs = [], [], [], [], []
+        outs = None
+        for _ in range(max(1, repeats)):
+            stamps.clear()
+            occupancy.clear()
+            t0 = time.perf_counter()
+            outs, t_admit = asyncio.run(group())
+            wall = time.perf_counter() - t0
+            t_first_long = stamps["admit"]["tokens"][0]
+            # worst inter-token gap any decode stream saw inside the
+            # admission window (including the wait from the window's edges
+            # to the neighboring emissions)
+            stall = 0.0
+            for i in range(len(decode_prompts)):
+                ts = stamps[i]["tokens"]
+                if not ts:
+                    continue
+                points = [t_admit] + [
+                    t for t in ts if t_admit <= t <= t_first_long
+                ] + [min(t_first_long, ts[-1])]
+                for a, b in zip(points, points[1:]):
+                    if b > a:
+                        stall = max(stall, b - a)
+            stalls.append(stall)
+            admit_ttfts.append(t_first_long - stamps["admit"]["submit"])
+            ttft_lists.append(sorted(
+                s["tokens"][0] - s["submit"]
+                for s in stamps.values()
+                if s["tokens"]
+            ))
+            tok_rates.append(sum(len(o) for o in outs) / wall)
+            occs.append(sum(occupancy) / max(1, len(occupancy)))
+        engine.stop()
+
+        def med(xs):
+            return sorted(xs)[len(xs) // 2]
+
+        ttfts = ttft_lists[stalls.index(med(stalls))]
+
+        def pct(p):
+            return ttfts[min(len(ttfts) - 1, int(p * (len(ttfts) - 1)))]
+
+        return {
+            "outs": outs,
+            "decode_stall_ms": round(med(stalls) * 1e3, 3),
+            "admit_ttft_ms": round(med(admit_ttfts) * 1e3, 3),
+            "ttft_p50_ms": round(pct(0.50) * 1e3, 3),
+            "ttft_p99_ms": round(pct(0.99) * 1e3, 3),
+            "occupancy": round(med(occs), 3),
+            "tok_s": round(med(tok_rates), 2),
+        }
+
+    legacy = measure("two_dispatch")
+    ragged = measure("ragged")
+    identical = legacy.pop("outs") == ragged.pop("outs")
+    return {
+        "metric": "llm_ragged_scheduler_ab",
+        # headline: how much of the admission-window decode stall the
+        # ragged scheduler removes
+        "value": round(
+            (1.0 - (
+                ragged["decode_stall_ms"]
+                / max(1e-9, legacy["decode_stall_ms"])
+            )) * 100.0,
+            2,
+        ),
+        "unit": "% decode-stall reduction during admission (ragged vs "
+                "two-dispatch)",
+        "two_dispatch": legacy,
+        "ragged": ragged,
+        "identical_tokens": identical,
+        "batch": batch,
+        "decode_steps": decode_steps,
+        "new_tokens": new_tokens,
+        "admit_prompt_len": admit_prompt_len,
+        "step_token_budget": step_token_budget,
+        "chunked_prefill_size": chunk,
+        "cache": cache_mode,
+        "cpus": os.cpu_count() or 1,
+        "note": (
+            "two-dispatch admission prefill runs in a worker thread but "
+            "shares the device (and on CPU, the core) with decode chunks; "
+            "ragged carries it as chunk rows of the decode launch itself"
+        ),
+    }
+
+
 def run_paged_quant_ab(
     cfg: dict,
     *,
@@ -950,6 +1145,40 @@ def _int4_ab_smoke() -> None:
     print(json.dumps(row))
 
 
+def _ragged_ab_smoke() -> None:
+    """CPU smoke for ``--ragged-ab`` (acceptance: byte-identical streams
+    across schedulers and a STRICTLY smaller decode stall during a
+    concurrent long-prompt admission — the ISSUE-9 headline). Updates
+    benchmarks/RAGGED_AB_cpu.json (asserted by tier-1). Knobs:
+    BENCH_RAGGED_BATCH / BENCH_RAGGED_TOKENS / BENCH_RAGGED_BUDGET /
+    BENCH_RAGGED_ADMIT / BENCH_RAGGED_CACHE."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    row = run_ragged_ab(
+        {"preset": "llama-tiny", "dtype": "float32"},
+        batch=int(os.environ.get("BENCH_RAGGED_BATCH", 3)),
+        new_tokens=int(os.environ.get("BENCH_RAGGED_TOKENS", 64)),
+        step_token_budget=int(os.environ.get("BENCH_RAGGED_BUDGET", 24)),
+        admit_prompt_len=int(os.environ.get("BENCH_RAGGED_ADMIT", 224)),
+        cache_mode=os.environ.get("BENCH_RAGGED_CACHE", "paged"),
+        max_seq_len=256,
+    )
+    row["metric"] += "_cpusmoke"
+    row["platform"] = "cpu"
+    artifact = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "RAGGED_AB_cpu.json",
+    )
+    with open(artifact, "w") as f:
+        json.dump(row, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(row))
+
+
 def _paged_quant_ab_smoke() -> None:
     """CPU smoke for ``--paged-quant-ab`` (acceptance: >= 1.8x pool-bytes
     reduction at equal page budget, no step-time regression, Pallas int8
@@ -1094,6 +1323,10 @@ if __name__ == "__main__":
         os.environ.get("BENCH_SCENARIO") == "pipeline_ab"
     ):
         _pipeline_ab_smoke()
+    elif "--ragged-ab" in sys.argv or (
+        os.environ.get("BENCH_SCENARIO") == "ragged_ab"
+    ):
+        _ragged_ab_smoke()
     elif "--paged-quant-ab" in sys.argv or (
         os.environ.get("BENCH_SCENARIO") == "paged_quant_ab"
     ):

@@ -239,6 +239,32 @@ def main() -> int:
         emit({"metric": "llm_int4_weight_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t5, 1)})
 
+    # -- phase 8: ragged scheduler A/B (docs/ragged_attention.md) -----------
+    # mixed prefill+decode single-launch scheduler vs the two-dispatch path
+    # on 8B decode shapes: decode stall during a long admission, occupancy,
+    # stream byte-identity. The ragged Pallas kernel engages on TPU (D=128,
+    # page 16/32); the CPU smoke artifact is covered by battery consumers
+    # running bench.py --ragged-ab off-chip.
+    t6 = time.time()
+    try:
+        row = bench.run_ragged_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "kv_quant": "int8"},
+            batch=16, decode_steps=4, new_tokens=96,
+            decode_prompt_len=64, admit_prompt_len=768,
+            step_token_budget=256, max_seq_len=1024, cache_mode="paged",
+            # int8 paged tile is (32, 128): 16-token pages would route the
+            # ragged kernel to the XLA gather (docs/paged_kv_quant.md)
+            page_size=32,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t6, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_ragged_scheduler_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t6, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
@@ -247,6 +273,7 @@ def main() -> int:
         "paged_quant_ab_wall_s": round(time.time() - t3, 1),
         "loadtest_wall_s": round(time.time() - t4, 1),
         "int4_ab_wall_s": round(time.time() - t5, 1),
+        "ragged_ab_wall_s": round(time.time() - t6, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

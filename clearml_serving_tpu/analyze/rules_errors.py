@@ -42,6 +42,7 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.drain",
     "engine.admit",
     "engine.admit.class",
+    "engine.admit.budget",
     "engine.pool",
     "engine.preempt",
     "engine.release",

@@ -95,6 +95,9 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "_next_token": (LOOP, ("self", "engine")),
     "_gstate": (LOOP, ("self", "engine")),
     "_slot_overrides": (LOOP, None),
+    # ragged scheduler job list (docs/ragged_attention.md): the loop opens,
+    # shares out, and retires jobs; dispatch workers only read plan dicts
+    "_prefill_jobs": (LOOP, ("self", "engine")),
     # device-resident cross-chunk chains: written by the dispatch worker
     # (the only stage that runs device programs); the loop resets them only
     # at protocol-serialized points (annotated at the definition site)

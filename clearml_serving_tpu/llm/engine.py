@@ -177,31 +177,34 @@ def _env_pipeline_depth() -> int:
 
 
 class _MsHistogram:
-    """Host-side fixed-bucket millisecond histogram for scrape-time export
+    """Host-side fixed-bucket histogram for scrape-time export
     (statistics.metrics turns snapshots into Prometheus histograms). One
     writer at a time (the dispatch worker / retire stage); snapshot()
-    copies under the GIL so scrapes never see torn lists."""
+    copies under the GIL so scrapes never see torn lists. The default
+    bucket set is millisecond-scaled; callers may pass their own (the
+    ragged scheduler's budget-utilization ratios use a [0, 1] grid)."""
 
     BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 1000.0)
 
-    def __init__(self):
-        self.counts = [0] * (len(self.BUCKETS) + 1)
+    def __init__(self, buckets=None):
+        self.buckets = tuple(buckets) if buckets is not None else self.BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)
         self.total_ms = 0.0
         self.n = 0
 
     def observe(self, ms: float) -> None:
-        for i, edge in enumerate(self.BUCKETS):
+        for i, edge in enumerate(self.buckets):
             if ms <= edge:
                 break
         else:
-            i = len(self.BUCKETS)
+            i = len(self.buckets)
         self.counts[i] += 1
         self.total_ms += float(ms)
         self.n += 1
 
     def snapshot(self) -> dict:
         return {
-            "buckets": list(self.BUCKETS),
+            "buckets": list(self.buckets),
             "counts": list(self.counts),
             "sum_ms": self.total_ms,
             "count": self.n,
@@ -226,6 +229,28 @@ class _InFlightChunk:
     # paged backend: slots dropped from this chunk because the pool could
     # not hold their page extension (failed by the loop thread on landing)
     exhausted: List[int] = field(default_factory=list)
+
+
+# ragged scheduler (docs/ragged_attention.md): stage-3 brownout shrinks the
+# per-step admission share to roughly one minimal chunk instead of the
+# legacy gate's one-segment-per-chunk budget
+_RAGGED_BROWNOUT_CHUNK = 16
+
+
+@dataclass(eq=False)  # identity semantics: jobs live in (and leave) lists
+class _RaggedJob:
+    """One admission riding the ragged scheduler (docs/ragged_attention.md):
+    the request's prompt prefills in budget-bounded chunk rows of the
+    loop's ragged launches, writing straight into its reserved slot's KV
+    (no mini cache, no separate prefill dispatch). ``pos`` is the next
+    unprefilled prompt index (a radix prefix hit starts it past the shared
+    run); the slot stays reserved via ``engine._admitting`` until the final
+    chunk's commit or a failure path frees it."""
+
+    request: GenRequest
+    slot: int
+    pos: int = 0
+    started_at: float = field(default_factory=time.monotonic)
 
 
 class _PrefillGate:
@@ -520,6 +545,7 @@ class LLMEngineCore:
         "loop": (
             "_inflight", "_quarantine", "_dispatching", "_slot_req",
             "_admitting", "_next_token", "_gstate", "_slot_overrides",
+            "_prefill_jobs",
         ),
         "worker": ("_next_token_dev", "_gstate_dev"),
     }
@@ -571,6 +597,17 @@ class LLMEngineCore:
         # decode pipeline depth (None -> TPUSERVE_PIPELINE_DEPTH env, default
         # 2); 1 restores the serial dispatch->sync->emit loop
         pipeline_depth: Optional[int] = None,
+        # -- ragged scheduling (docs/ragged_attention.md) ------------------
+        # "ragged": admissions ride the decode loop as budget-bounded
+        # prefill-chunk rows of ONE mixed launch per iteration (token-budget
+        # admission replaces the prefill gate); "two_dispatch" (default):
+        # the historical separate prefill/decode dispatches. None defers to
+        # TPUSERVE_SCHEDULER.
+        scheduler: Optional[str] = None,
+        # ragged mode: max tokens (decode rows + prefill-chunk rows) per
+        # launch; must exceed max_batch so admissions always make progress.
+        # None -> TPUSERVE_STEP_TOKEN_BUDGET, default max(128, 4*max_batch)
+        step_token_budget: Optional[int] = None,
         # -- SLO-aware scheduling (docs/slo_scheduling.md) -----------------
         # preemptible batch lane: under slot pressure with interactive work
         # queued, batch-class slots are preempted at a chunk boundary (their
@@ -606,6 +643,44 @@ class LLMEngineCore:
         if cache_mode not in ("dense", "paged"):
             raise ValueError("cache_mode must be 'dense' or 'paged'")
         self.cache_mode = cache_mode
+        # -- ragged scheduling (docs/ragged_attention.md) ------------------
+        # resolved EARLY: the dense cache slack and the prefill gate both
+        # depend on the scheduler choice
+        sched = (
+            scheduler
+            if scheduler is not None
+            else os.environ.get("TPUSERVE_SCHEDULER", "") or "two_dispatch"
+        )
+        if sched not in ("two_dispatch", "ragged"):
+            raise ValueError(
+                "scheduler must be 'two_dispatch' or 'ragged' (got {!r})"
+                .format(sched)
+            )
+        self._ragged = sched == "ragged"
+        if self._ragged and (
+            getattr(bundle, "forward_ragged", None) is None
+            or getattr(bundle, "forward_ragged_dense", None) is None
+        ):
+            raise ValueError(
+                "scheduler='ragged' needs a model bundle with "
+                "forward_ragged/forward_ragged_dense surfaces"
+            )
+        if step_token_budget is None:
+            raw = os.environ.get("TPUSERVE_STEP_TOKEN_BUDGET", "")
+            step_token_budget = int(raw) if raw else None
+        self._step_token_budget = (
+            int(step_token_budget)
+            if step_token_budget is not None
+            else max(128, 4 * self.max_batch)
+        )
+        if self._ragged and self._step_token_budget <= self.max_batch:
+            # every decode row costs one budget token; a budget at or below
+            # max_batch could starve admissions forever
+            raise ValueError(
+                "step_token_budget ({}) must exceed max_batch ({}) so "
+                "prefill chunks always fit beside a full decode batch"
+                .format(self._step_token_budget, self.max_batch)
+            )
         self._buckets = sorted(
             b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
         ) or [max_seq_len]
@@ -749,6 +824,18 @@ class LLMEngineCore:
         spec_slack = (
             self.decode_steps * (max(1, int(spec_k)) + 1) if speculation else 0
         )
+        # ragged dense steps write each row's whole C-token chunk window at
+        # its start position (pad tail included, overwritten before it is
+        # ever visible) — the buffer needs chunk-window-wide slack past
+        # max_seq_len or dynamic_update_slice would CLAMP the window
+        # backward over live KV at the sequence edge (the same hazard the
+        # spec slack covers). C buckets to the next power of two of the
+        # step's widest chunk, which can EXCEED the budget (budget 24 ->
+        # C 32), so the slack covers the bucketed bound, not the budget.
+        if self._ragged and cache_mode == "dense":
+            spec_slack = max(
+                spec_slack, 1 << (self._step_token_budget - 1).bit_length()
+            )
         # kept for supervised recovery: a poisoned dense decode step may have
         # consumed (donated) the cache — rebuilding needs the original size
         self._cache_slack = spec_slack
@@ -879,6 +966,7 @@ class LLMEngineCore:
             "watchdog_trips": 0,
             "step_failures": 0,
             "preemptions": 0,
+            "ragged_steps": 0,
         }
         # -- SLO-aware scheduling state (docs/slo_scheduling.md) ----------
         # per-(reason, class) shed counters backing engine_sheds_total
@@ -929,7 +1017,10 @@ class LLMEngineCore:
         self._gstate = np.full(self.max_batch, -1, np.int32)
         self._slot_guided_key: List[Optional[str]] = [None] * self.max_batch
         self._guided_dirty = False
-        # decode-first prefill pacing (None/0 disables the policy)
+        # decode-first prefill pacing (None/0 disables the policy). The
+        # ragged scheduler REPLACES the gate outright: admission pacing is
+        # the per-step token budget, and there are no standalone prefill
+        # dispatches left to pace (docs/ragged_attention.md)
         self._prefill_gate = (
             _PrefillGate(
                 int(prefill_segments_per_decode),
@@ -939,9 +1030,23 @@ class LLMEngineCore:
                     else {}
                 ),
             )
-            if prefill_segments_per_decode
+            if (prefill_segments_per_decode and not self._ragged)
             else None
         )
+        # -- ragged scheduler state (docs/ragged_attention.md) -------------
+        # in-progress chunked admissions, consumed by the loop in order
+        # (class order held by the admission pop); loop-affine
+        self._prefill_jobs: List[_RaggedJob] = []
+        # admissions whose worker-thread prep (grammar compile) finished,
+        # waiting for the loop to open their job
+        self._ragged_ready: "asyncio.Queue" = asyncio.Queue()
+        # per-step token-budget utilization (used / budget) and per-phase
+        # row counters, exported as engine_step_token_budget_utilization /
+        # engine_step_rows{phase} (statistics/metrics.py)
+        self._hist_budget = _MsHistogram(
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+        )
+        self._step_rows = {"prefill": 0, "decode": 0}
         self._wake: Optional[asyncio.Event] = None
 
         # -- pipelined decode (docs/pipelined_decode.md) -------------------
@@ -1685,6 +1790,130 @@ class LLMEngineCore:
             static_argnames=("want_lp",),
         )
         self._sample_jit = sample_tokens
+
+        # -- ragged mixed prefill+decode step (docs/ragged_attention.md) ---
+        # ONE launch per loop iteration: every decode row advances one token
+        # while prefill rows process budget-bounded prompt chunks, all
+        # through bundle.forward_ragged / forward_ragged_dense. Decode-row
+        # sampling mirrors the plain chunk body exactly (guided mask ->
+        # penalized sample -> count -> DFA advance), which is what keeps
+        # ragged streams byte-identical to the two-dispatch path; finishing
+        # prefill rows return their raw last-token logits for the loop's
+        # host-side first-token sampling (the same code the legacy
+        # admission path runs).
+        if self._ragged:
+
+            def _sample_rows(logits, mask, sampling, rng, extras, counts,
+                             pmask, guided, gstate, want_lp):
+                nb = logits.shape[0]
+                if gstate is None:
+                    gstate = jnp.full((nb,), -1, jnp.int32)
+                masked = logits
+                if guided is not None:
+                    masked = _guided_mask(masked, gstate, guided)
+                if extras is None:
+                    sampled = sample_tokens(masked, sampling, rng)
+                    lp_src = masked
+                else:
+                    sampled = sample_tokens(
+                        masked, sampling, rng, extras, counts, pmask
+                    )
+                    lp_src = (
+                        penalize_logits(masked, extras, counts, pmask)
+                        if want_lp
+                        else masked
+                    )
+                    counts = counts.at[jnp.arange(nb), sampled].add(
+                        mask.astype(jnp.int32)
+                    )
+                if guided is not None:
+                    gstate = _guided_advance(gstate, sampled, mask, guided)
+                lp = _lp_of(lp_src, sampled, nb) if want_lp else None
+                return sampled, counts, lp, gstate
+
+            if cache_mode == "paged":
+
+                def _ragged_paged_step(params, tokens, tok_pos, tok_row,
+                                       tok_valid, row_last, k_pools, v_pools,
+                                       k_scales, v_scales, page_table,
+                                       kv_lens, row_starts, row_lens,
+                                       write_page, write_offset, block_rows,
+                                       block_q0, decode_mask, sampling, rng,
+                                       lora_idx=None, extras=None,
+                                       counts=None, pmask=None, guided=None,
+                                       gstate=None, want_lp=False):
+                    scale_kw = (
+                        {"k_scales": k_scales, "v_scales": v_scales}
+                        if paged_quant
+                        else {}
+                    )
+                    out = bundle.forward_ragged(
+                        params, tokens, tok_pos, tok_row, tok_valid,
+                        row_last, k_pools, v_pools, page_table, kv_lens,
+                        row_starts, row_lens, write_page, write_offset,
+                        block_rows, block_q0, lora_idx, **scale_kw,
+                    )
+                    if paged_quant:
+                        logits, k_pools, v_pools, k_scales, v_scales = out
+                    else:
+                        logits, k_pools, v_pools = out
+                    raw = logits.astype(jnp.float32)
+                    sampled, counts, lp, gstate = _sample_rows(
+                        raw, decode_mask, sampling, rng, extras, counts,
+                        pmask, guided, gstate, want_lp,
+                    )
+                    return (sampled, raw, k_pools, v_pools, k_scales,
+                            v_scales, counts, lp, gstate)
+
+                self._ragged_paged_jit = jax.jit(
+                    _ragged_paged_step,
+                    donate_argnums=(
+                        (6, 7, 8, 9) if self._paged_quant else (6, 7)
+                    ),
+                    static_argnames=("want_lp",),
+                )
+                self._ragged_dense_jit = None
+            else:
+
+                def _ragged_dense_step(params, tokens, start, last_rel,
+                                       row_active, cache, decode_mask,
+                                       sampling, rng, lora_idx=None,
+                                       extras=None, counts=None, pmask=None,
+                                       guided=None, gstate=None,
+                                       want_lp=False):
+                    logits, cache = bundle.forward_ragged_dense(
+                        params, tokens, start, last_rel, row_active, cache,
+                        lora_idx,
+                    )
+                    raw = logits.astype(jnp.float32)
+                    sampled, counts, lp, gstate = _sample_rows(
+                        raw, decode_mask, sampling, rng, extras, counts,
+                        pmask, guided, gstate, want_lp,
+                    )
+                    return sampled, raw, cache, counts, lp, gstate
+
+                self._ragged_dense_jit = jax.jit(
+                    _ragged_dense_step,
+                    donate_argnums=(5,),
+                    static_argnames=("want_lp",),
+                )
+                self._ragged_paged_jit = None
+            # static flat-token capacity per launch: ONE trace per
+            # (platform, extras/guided/lp variant). On TPU each row's
+            # segment aligns to the kernel's q block (worst-case alignment
+            # waste = one block per row); off-TPU the XLA reference needs
+            # no alignment and rows pack densely. The q-block size is the
+            # KERNEL'S constant — the layout the engine builds and the
+            # grid forward_ragged launches must share one contract, not
+            # two constants that happen to agree.
+            from ..ops.paged_attention import _RAGGED_QB
+
+            self._ragged_on_tpu = jax.devices()[0].platform == "tpu"
+            qb = _RAGGED_QB if self._ragged_on_tpu else 1
+            self._ragged_qb = qb
+            budget = self._step_token_budget
+            waste = self.max_batch * (qb - 1) if qb > 1 else 0
+            self._ragged_tpad = -(-(budget + waste) // qb) * qb
 
         # runtime KV/refcount sanitizer (llm/kv_sanitizer.py): armed via
         # TPUSERVE_SANITIZE=1 (tests arm it for the chaos + paged suites).
@@ -2561,6 +2790,17 @@ class LLMEngineCore:
                 "depth": self.pipeline_depth,
                 "inflight": len(self._inflight),
             },
+            "scheduler": "ragged" if self._ragged else "two_dispatch",
+            "ragged": (
+                {
+                    "step_token_budget": self._step_token_budget,
+                    "effective_budget": self._effective_token_budget(),
+                    "prefill_jobs": len(self._prefill_jobs),
+                    "steps": self.counters["ragged_steps"],
+                }
+                if self._ragged
+                else None
+            ),
             "kv_pool": self._kv_pool_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
@@ -2597,6 +2837,22 @@ class LLMEngineCore:
                 "dispatch_ms": self._hist_dispatch.snapshot(),
                 "retire_ms": self._hist_retire.snapshot(),
             },
+            # ragged token-budget scheduler (docs/ragged_attention.md):
+            # per-step budget utilization + per-phase row counters backing
+            # engine_step_token_budget_utilization / engine_step_rows
+            "scheduler": "ragged" if self._ragged else "two_dispatch",
+            "ragged": (
+                {
+                    "step_token_budget": self._step_token_budget,
+                    "effective_budget": self._effective_token_budget(),
+                    "prefill_jobs": len(self._prefill_jobs),
+                    "steps": self.counters["ragged_steps"],
+                    "budget_utilization": self._hist_budget.snapshot(),
+                    "step_rows": dict(self._step_rows),
+                }
+                if self._ragged
+                else None
+            ),
             "kv_pool": self._kv_pool_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
@@ -2845,6 +3101,10 @@ class LLMEngineCore:
         for slot, request in enumerate(self._slot_req):
             if request is not None:
                 self._fail_slot(slot, err)
+        if self._prefill_jobs:
+            # a batch-wide ragged failure poisons the very launch the jobs'
+            # chunks rode — their KV progress is suspect; fail them too
+            self._abort_ragged_jobs(err)
         self._reset_device_state()
         self._last_progress = time.monotonic()
 
@@ -3125,6 +3385,16 @@ class LLMEngineCore:
                 ids, lora_i,
                 {k: v for k, v in mini_cache.items() if k != "length"},
             )
+        first_id, first_lp = self._first_token_from_logits(request, last_logits)
+        return first_id, mini_cache, first_lp
+
+    def _first_token_from_logits(self, request: GenRequest, last_logits):
+        """Sample a request's FIRST token from its prefill logits [1, V]:
+        grammar-constrain, apply the request's sampling extras, walk the
+        guided DFA host-side, and build the first logprob entry. Shared by
+        the legacy admission worker (_prefill_device) and the ragged
+        scheduler's finishing-chunk commit — the two paths sampling through
+        ONE function is what makes their first tokens byte-identical."""
         sp = SamplingParams(
             temperature=jnp.asarray([request.temperature], jnp.float32),
             top_k=jnp.asarray([request.top_k], jnp.int32),
@@ -3133,10 +3403,16 @@ class LLMEngineCore:
         logits32 = last_logits.astype(jnp.float32)
         gentry = None
         if request.guided is not None:
-            # compile/register the grammar (slow part; we're in the
-            # admission worker thread) and constrain the FIRST token here —
-            # subsequent tokens are constrained inside the decode scan
-            gentry = self._ensure_grammar(request)
+            # compile/register the grammar (slow part; on the legacy path
+            # we're in the admission worker thread — the ragged path
+            # compiled it there already and only refetches its entry) and
+            # constrain the FIRST token here — subsequent tokens are
+            # constrained inside the decode scan
+            if request._guided_key is not None:
+                with self._guided_lock:
+                    gentry = self._grammars.get(request._guided_key)
+            if gentry is None:
+                gentry = self._ensure_grammar(request)
             row = self._gmask_np[gentry["start"]]
             allowed = np.unpackbits(row, bitorder="little")[: self._vocab] > 0
             logits32 = jnp.where(
@@ -3177,7 +3453,7 @@ class LLMEngineCore:
                 "top_ids": np.asarray(tid)[0].tolist(),
                 "top_logprobs": np.asarray(tlp)[0].tolist(),
             }
-        return first_id, mini_cache, first_lp
+        return first_id, first_lp
 
     def _prefix_bucket(self, prefix_len: int, n_tokens: int) -> Optional[int]:
         """Mini-cache bucket covering the prefix plus the tail's segment
@@ -3314,6 +3590,15 @@ class LLMEngineCore:
         """Loop-thread-only: route the prefilled KV into the shared cache and
         activate the slot. Never runs concurrently with a decode chunk."""
         self._insert_prefill(slot, mini_cache, len(request.prompt_ids), request)
+        self._activate_slot(request, slot, first_id, first_lp)
+
+    def _activate_slot(self, request: GenRequest, slot: int, first_id: int,
+                       first_lp=None) -> None:
+        """Slot activation shared by the legacy commit and the ragged
+        scheduler's finishing-chunk commit (whose KV is already in place —
+        it was written slot-resident, chunk by chunk): per-slot sampling /
+        extras / guided mirrors, admission bookkeeping, and the first
+        token's emission."""
         self._slot_req[slot] = request
         # admission-drain bookkeeping: the Retry-After hint derives from the
         # rate these commits land at
@@ -3767,6 +4052,645 @@ class LLMEngineCore:
             pool.truncate(slot, int(lengths0[slot]) + int(appended[slot]))
         return gs_np, accs_np, np.asarray(pending), lp_np
 
+    # -- ragged scheduler: token-budget admission (docs/ragged_attention.md) --
+
+    async def _ragged_admission_task(self, request: GenRequest, slot: int) -> None:
+        """Ragged-mode admission: no standalone prefill dispatch — the
+        prompt rides the loop's ragged launches as budget-bounded chunk
+        rows. Only worker-thread-worthy host prep runs here (a grammar
+        compile can take seconds); the slot stays reserved via _admitting
+        until the final chunk's commit or a failure path releases it."""
+
+        def prep():
+            if faults.active():
+                # the same chaos seam the legacy admission worker fires
+                # (delay = slow admission, raise = failed admission)
+                faults.fire("engine.prefill", request=request)
+            if request.guided is not None:
+                self._ensure_grammar(request)
+
+        try:
+            await asyncio.to_thread(prep)
+        except Exception as ex:
+            self._release_resume_pin(request)
+            self._deref_guided_request(request)
+            request.error = ex
+            request.out_queue.put_nowait(_FINISHED)
+            self._admitting.discard(slot)
+            self._wake_loop()
+            return
+        if self._stopped:
+            self._release_resume_pin(request)
+            self._deref_guided_request(request)
+            request.error = EngineUnavailableError("engine stopped")
+            request.out_queue.put_nowait(_FINISHED)
+            self._admitting.discard(slot)
+            return
+        await self._ragged_ready.put((request, slot))
+        self._wake_loop()
+        if self._loop_task is None or self._loop_task.done():
+            # loop died between prep and hand-off: nobody will open the job
+            self._drain_ragged_ready(
+                EngineUnavailableError("engine loop exited")
+            )
+
+    def _drain_ragged_ready(self, err: BaseException) -> None:
+        """Fail every prepped-but-unopened ragged admission (loop exiting)."""
+        while not self._ragged_ready.empty():
+            request, slot = self._ragged_ready.get_nowait()
+            self._admitting.discard(slot)
+            self._release_resume_pin(request)
+            self._deref_guided_request(request)
+            request.error = err
+            request.out_queue.put_nowait(_FINISHED)
+
+    def _start_ragged_job(self, request: GenRequest, slot: int):
+        """Loop-thread: open a ragged admission job for a prepped request.
+        Paged radix prefix hits map their shared pages into the slot's
+        table by reference HERE (zero KV copies; the tail then prefills
+        through chunk rows — the prefix-cache tail-chunk path). Dense
+        ragged mode skips prefix reuse: there is no mini cache to assemble
+        stored buffers into (documented limitation)."""
+        pos = 0
+        try:
+            if self.cache_mode == "paged" and self._prefix is not None:
+                lora_i = self._slot_lora(request)
+                hit = self._prefix.lookup_pages(request.prompt_ids, lora_i)
+                if hit is not None:
+                    plen = hit["len"]
+                    page_size = self.paged_cache.pool.page_size
+                    if (
+                        0 < plen < len(request.prompt_ids)
+                        and plen % page_size == 0
+                    ):
+                        self.paged_cache.pool.map_shared(
+                            slot, list(hit["pages"]), plen
+                        )
+                        pos = plen
+                        self._prefix.release(hit)
+                    else:
+                        # whole-prompt or misaligned hit: recompute cold
+                        # (at least one tail token must produce logits)
+                        self._prefix.release(hit)
+                        self._prefix.uncount_hit(hit)
+        except Exception as ex:
+            self._release_resume_pin(request)
+            self._deref_guided_request(request)
+            request.error = ex
+            request.out_queue.put_nowait(_FINISHED)
+            self._admitting.discard(slot)
+            return None
+        # the prefix lookup ran (hit or miss): the preemption-era eviction
+        # pin on the stored history has done its job (legacy parity)
+        self._release_resume_pin(request)
+        return _RaggedJob(request=request, slot=slot, pos=pos)
+
+    def _free_ragged_slot(self, slot: int) -> None:
+        """Reclaim a ragged job's slot pages (no pipeline barrier applies:
+        ragged steps run with the pipeline drained and are synchronous)."""
+        if self.paged_cache is not None:
+            self.paged_cache.pool.free(slot)
+
+    def _fail_ragged_job(self, job: "_RaggedJob",
+                         err: Optional[BaseException]) -> None:
+        """Fail one in-progress ragged admission (err None = cancelled):
+        release its grammar ref and slot pages and unblock its consumer."""
+        if job in self._prefill_jobs:  # identity (dataclass eq=False)
+            self._prefill_jobs.remove(job)
+        self._admitting.discard(job.slot)
+        request = job.request
+        self._deref_guided_request(request)
+        self._release_prefix_hit(request)  # defensive; released at job start
+        if err is not None:
+            request.error = err
+        request.out_queue.put_nowait(_FINISHED)
+        self._free_ragged_slot(job.slot)
+
+    def _abort_ragged_jobs(self, err: BaseException) -> None:
+        for job in list(self._prefill_jobs):
+            self._fail_ragged_job(job, err)
+
+    def _sweep_ragged_jobs(self) -> None:
+        """Drop cancelled / deadline-expired jobs before planning a step —
+        budget spent on a dead admission is budget stolen from live ones."""
+        for job in list(self._prefill_jobs):
+            request = job.request
+            if request.cancelled:
+                self._fail_ragged_job(job, None)
+                continue
+            err = self._deadline_error_at_commit(request)
+            if err is not None:
+                self._fail_ragged_job(job, err)
+
+    def _effective_token_budget(self) -> int:
+        """Ragged admission budget for the NEXT step. Brownout stage >= 3
+        re-expresses the legacy prefill gate's ``set_budget(1)`` on the
+        token budget: the admission share shrinks to about one minimal
+        chunk beside the decode batch, so decode slots drain ahead of new
+        admissions (docs/slo_scheduling.md; regression in
+        tests/test_scheduler.py)."""
+        if self._brownout is not None and self._brownout.stage >= 3:
+            return min(
+                self._step_token_budget,
+                self.max_batch + _RAGGED_BROWNOUT_CHUNK,
+            )
+        return self._step_token_budget
+
+    def _prepare_ragged(self, active_mask: np.ndarray,
+                        epoch: int) -> Optional[dict]:
+        """Loop-thread half of a ragged step: sweep dead jobs, hand each
+        live job its token share under the step budget (class/arrival order
+        — the jobs list is in admission-pop order), and snapshot every
+        piece of shared host state the worker needs. Returns None when
+        nothing is dispatchable."""
+        self._last_progress = time.monotonic()
+        self._sweep_ragged_jobs()
+        decode_mask = active_mask.copy()
+        budget = self._effective_token_budget()
+        n_decode = int(decode_mask.sum())
+        shares: List[tuple] = []
+        left = max(0, budget - n_decode)
+        for job in list(self._prefill_jobs):
+            if left <= 0:
+                break
+            remaining = len(job.request.prompt_ids) - job.pos
+            take = min(left, remaining)
+            if take <= 0:
+                continue
+            if faults.active():
+                try:
+                    # chaos seam: budget admission of one prefill job into
+                    # this step (docs/ragged_attention.md)
+                    faults.fire("engine.admit.budget", request=job.request)
+                except faults.InjectedFault as ex:
+                    self._count_shed("budget", job.request.priority)
+                    self._fail_ragged_job(job, EngineOverloadedError(
+                        "ragged budget admission shed (injected): {}".format(
+                            ex
+                        ),
+                        retry_after=self._retry_after_hint(),
+                        shed_class=job.request.priority,
+                    ))
+                    continue
+            shares.append((job, take))
+            left -= take
+        if n_decode == 0 and not shares:
+            return None
+        want_lp = any(
+            self._slot_req[s] is not None
+            and self._slot_req[s].logprobs is not None
+            for s in np.nonzero(decode_mask)[0]
+        )
+        use_extras = self._extras_active(decode_mask)
+        use_guided = bool(np.any(self._gstate[decode_mask] >= 0))
+        gtables = self._guided_device_tables() if use_guided else None
+        self._dispatch_seq += 1
+        plan = {
+            "seq": self._dispatch_seq,
+            "epoch": epoch,
+            "decode_mask": decode_mask,
+            "shares": shares,
+            "budget": budget,
+            "want_lp": want_lp,
+            "use_extras": use_extras,
+            "sampling": self._batch_sampling(),
+            "extras": self._batch_extras() if use_extras else None,
+            "gtables": gtables,
+            "gstate": (
+                jnp.asarray(self._gstate.copy())
+                if gtables is not None
+                else None
+            ),
+            "rng": self._next_rng(),
+            "lora": (
+                jnp.asarray(self._lora_slots.copy())
+                if self._lora_enabled
+                else None
+            ),
+            "requests": [r for r in self._slot_req if r is not None]
+            + [j.request for j, _ in shares],
+            "exhausted": [],
+            "failed_jobs": [],
+        }
+        job_of = {job.slot: job for job, _ in shares}
+        take_of = {job.slot: take for job, take in shares}
+        if self.cache_mode == "paged":
+            from ..ops.paged_attention import ragged_layout
+
+            pool = self.paged_cache.pool
+            row_lens = np.zeros(self.max_batch, np.int32)
+            for slot in np.nonzero(decode_mask)[0]:
+                row_lens[int(slot)] = 1
+            for slot, take in take_of.items():
+                row_lens[slot] = take
+            starts, block_rows, block_q0, tpad = ragged_layout(
+                row_lens, self._ragged_qb, total=self._ragged_tpad
+            )
+            tokens = np.zeros(tpad, np.int32)
+            tok_pos = np.zeros(tpad, np.int32)
+            tok_row = np.zeros(tpad, np.int32)
+            tok_valid = np.zeros(tpad, bool)
+            row_last = np.zeros(self.max_batch, np.int32)
+            kv_lens = np.zeros(self.max_batch, np.int32)
+            pre_lens = np.zeros(self.max_batch, np.int32)
+            spans: Dict[int, tuple] = {}
+            for slot in range(self.max_batch):
+                n = int(row_lens[slot])
+                if n == 0:
+                    continue
+                s = int(starts[slot])
+                pre = pool.slot_length(slot)
+                pre_lens[slot] = pre
+                if slot in job_of:
+                    job = job_of[slot]
+                    tokens[s : s + n] = job.request.prompt_ids[
+                        job.pos : job.pos + n
+                    ]
+                else:
+                    tokens[s] = self._next_token[slot]
+                spans[slot] = (s, n)
+                tok_pos[s : s + n] = pre + np.arange(n, dtype=np.int32)
+                tok_row[s : s + n] = slot
+                tok_valid[s : s + n] = True
+                row_last[slot] = s + n - 1
+                kv_lens[slot] = pre + n
+            plan.update(
+                tokens=tokens, tok_pos=tok_pos, tok_row=tok_row,
+                tok_valid=tok_valid, row_last=row_last, kv_lens=kv_lens,
+                pre_lens=pre_lens, row_starts=starts, row_lens=row_lens,
+                spans=spans,
+                write_page=np.zeros(tpad, np.int32),
+                write_offset=np.zeros(tpad, np.int32),
+                block_rows=(
+                    jnp.asarray(block_rows) if self._ragged_on_tpu else None
+                ),
+                block_q0=(
+                    jnp.asarray(block_q0) if self._ragged_on_tpu else None
+                ),
+            )
+        else:
+            # dense ragged: the rectangular chunk layout [B, C] — C buckets
+            # to the next power of two of the widest chunk so traces stay
+            # bounded (log2(budget) shapes per variant)
+            c_need = max([take for _, take in shares], default=1)
+            c = 1
+            while c < c_need:
+                c *= 2
+            tokens = np.zeros((self.max_batch, c), np.int32)
+            start = np.zeros(self.max_batch, np.int32)
+            last_rel = np.zeros(self.max_batch, np.int32)
+            row_active = np.zeros(self.max_batch, bool)
+            for slot in np.nonzero(decode_mask)[0]:
+                slot = int(slot)
+                request = self._slot_req[slot]
+                tokens[slot, 0] = self._next_token[slot]
+                # dense cache length = prompt_len + produced - 1 (the
+                # pending token's KV is written by the step consuming it)
+                start[slot] = request.prompt_len + request.produced - 1
+                row_active[slot] = True
+            for job, take in shares:
+                tokens[job.slot, :take] = job.request.prompt_ids[
+                    job.pos : job.pos + take
+                ]
+                start[job.slot] = job.pos
+                last_rel[job.slot] = take - 1
+                row_active[job.slot] = True
+            for job in self._prefill_jobs:
+                if not row_active[job.slot]:
+                    # budget-starved job rows still get their garbage chunk
+                    # window WRITTEN (the dense layer loop writes every
+                    # row): pin it to job.pos so it lands where the job's
+                    # next chunk overwrites it before any read — at the
+                    # default start=0 it would clobber already-written
+                    # prompt KV. (In-order whole-budget serving currently
+                    # implies a starved job has pos == 0, but correctness
+                    # must not hang on that scheduling subtlety.)
+                    start[job.slot] = job.pos
+            plan.update(
+                tokens=tokens, start=start, last_rel=last_rel,
+                row_active=row_active, chunk=c,
+            )
+        if faults.active():
+            # yield-point seam parity with _prepare_dispatch: snapshot
+            # complete, worker not yet started
+            faults.fire("engine.dispatch.prepare", requests=plan["requests"])
+        return plan
+
+    def _ragged_drop_row(self, plan: dict, slot: int) -> None:
+        """Worker-side removal of a row whose page extension failed: its
+        tokens become pads (null-page writes, masked compute); the retire
+        stage fails the decode request / admission job it carried."""
+        s, n = plan["spans"].pop(slot)
+        plan["tokens"][s : s + n] = 0
+        plan["tok_pos"][s : s + n] = 0
+        plan["tok_row"][s : s + n] = 0
+        plan["tok_valid"][s : s + n] = False
+        plan["row_lens"][slot] = 0
+        plan["kv_lens"][slot] = plan["pre_lens"][slot]
+        plan["row_last"][slot] = 0
+        if plan["decode_mask"][slot]:
+            plan["decode_mask"][slot] = False
+            plan["exhausted"].append(slot)
+        else:
+            job = next(j for j, _ in plan["shares"] if j.slot == slot)
+            plan["failed_jobs"].append((
+                job,
+                MemoryError("kv page pool exhausted during ragged admission"),
+            ))
+
+    def _dispatch_ragged_device(self, plan: dict) -> dict:
+        """Worker-thread half of a ragged step: page allocation for every
+        row's chunk plus the ONE device launch (donated pools/cache,
+        rebound under the dispatch lock — same discipline as the legacy
+        dispatch workers)."""
+        t0 = time.perf_counter()
+        if faults.active():
+            # chaos seam, BEFORE any device work: a per-request poison
+            # fails only its row's request/job, never the launch
+            faults.fire("engine.decode", requests=plan["requests"])
+        use_extras = plan["use_extras"]
+        gtables = plan["gtables"]
+        want_lp = plan["want_lp"]
+        if self.cache_mode == "paged":
+            pool = self.paged_cache.pool
+            for slot in list(plan["spans"]):
+                s, n = plan["spans"][slot]
+                try:
+                    pool.extend(slot, n)
+                except MemoryError:
+                    self._ragged_drop_row(plan, slot)
+                    continue
+                coords = pool.token_coords(
+                    slot, int(plan["pre_lens"][slot]), n
+                )
+                for i, (page, offset) in enumerate(coords):
+                    plan["write_page"][s + i] = page
+                    plan["write_offset"][s + i] = offset
+            self.paged_cache.apply_pending_cow()
+            page_table = pool.page_table(self._pages_per_seq)
+            with self.paged_cache.dispatch_lock:
+                (
+                    sampled, logits,
+                    self.paged_cache.k, self.paged_cache.v,
+                    new_ks, new_vs, new_counts, lp, gstate_out,
+                ) = self._ragged_paged_jit(
+                    self.params,
+                    jnp.asarray(plan["tokens"]),
+                    jnp.asarray(plan["tok_pos"]),
+                    jnp.asarray(plan["tok_row"]),
+                    jnp.asarray(plan["tok_valid"]),
+                    jnp.asarray(plan["row_last"]),
+                    self.paged_cache.k,
+                    self.paged_cache.v,
+                    self.paged_cache.k_scale,
+                    self.paged_cache.v_scale,
+                    jnp.asarray(page_table),
+                    jnp.asarray(plan["kv_lens"]),
+                    jnp.asarray(plan["row_starts"]),
+                    jnp.asarray(plan["row_lens"]),
+                    jnp.asarray(plan["write_page"]),
+                    jnp.asarray(plan["write_offset"]),
+                    plan["block_rows"],
+                    plan["block_q0"],
+                    jnp.asarray(plan["decode_mask"].copy()),
+                    plan["sampling"],
+                    plan["rng"],
+                    plan["lora"],
+                    plan["extras"],
+                    self._counts_dev if use_extras else None,
+                    self._pmask_dev if use_extras else None,
+                    gtables,
+                    plan["gstate"],
+                    want_lp=want_lp,
+                )
+                if self._paged_quant:
+                    self.paged_cache.k_scale = new_ks
+                    self.paged_cache.v_scale = new_vs
+        else:
+            (
+                sampled, logits, self.cache, new_counts, lp, gstate_out,
+            ) = self._ragged_dense_jit(
+                self.params,
+                jnp.asarray(plan["tokens"]),
+                jnp.asarray(plan["start"]),
+                jnp.asarray(plan["last_rel"]),
+                jnp.asarray(plan["row_active"]),
+                self.cache,
+                jnp.asarray(plan["decode_mask"].copy()),
+                plan["sampling"],
+                plan["rng"],
+                plan["lora"],
+                plan["extras"],
+                self._counts_dev if use_extras else None,
+                self._pmask_dev if use_extras else None,
+                gtables,
+                plan["gstate"],
+                want_lp=want_lp,
+            )
+        if use_extras:
+            self._counts_dev = new_counts
+        self._last_progress = time.monotonic()
+        self._hist_dispatch.observe((time.perf_counter() - t0) * 1e3)
+        return {
+            "sampled": sampled,
+            "logits": logits,
+            "lp": lp,
+            "gstate": gstate_out if gtables is not None else None,
+        }
+
+    async def _ragged_step(self, active_mask: np.ndarray, epoch: int) -> None:
+        """One ragged scheduling iteration (docs/ragged_attention.md): ONE
+        device launch carries every decode row (one token each) plus as
+        many prefill-chunk rows as fit the step token budget — admissions
+        no longer stall the decode loop, they share its launches. Serial
+        dispatch -> sync -> emit; the pipelined in-flight queue resumes
+        the moment the admission backlog drains."""
+        # post-ragged decode must re-upload the host mirrors: the device
+        # chains were built by the (drained) pipelined path
+        self._reset_device_chains()
+        plan = self._prepare_ragged(active_mask, epoch)
+        if plan is None:
+            return
+        self._dispatching = (plan["seq"], plan["decode_mask"], time.monotonic())
+        try:
+            result = await asyncio.to_thread(self._dispatch_ragged_device, plan)
+        except asyncio.CancelledError:
+            raise
+        except BaseException as ex:
+            req = getattr(ex, "request", None)
+            job = (
+                next(
+                    (j for j in self._prefill_jobs if j.request is req), None
+                )
+                if req is not None
+                else None
+            )
+            if job is not None:
+                # per-request fault attributed to an admission row: the
+                # seam fires before any device work, so decode rows lost
+                # nothing — fail only the job; next iteration re-plans
+                self.counters["step_failures"] += 1
+                self._fail_ragged_job(job, EngineStepError(
+                    "ragged admission chunk failed for this request: "
+                    "{}".format(ex)
+                ))
+                return
+            raise
+        finally:
+            self._dispatching = None
+        if epoch != self._recover_epoch:
+            await self._ragged_recover(plan, result)
+            return
+        self._retire_ragged(plan, result)
+
+    async def _ragged_recover(self, plan: dict, result: dict) -> None:
+        """The watchdog tripped while this ragged step was mid-worker: the
+        decode results are stale (those requests were already failed) and
+        no commit may run. Wait out the device program off-thread, roll
+        surviving jobs' page extensions back to their pre-step lengths
+        (the next step redoes the chunk cleanly — its K/V rewrites are
+        value-identical), then run the shared recovery."""
+
+        def _wait():
+            try:
+                jax.block_until_ready(result["sampled"])
+            except Exception:
+                pass
+
+        await asyncio.to_thread(_wait)
+        if self.paged_cache is not None:
+            pool = self.paged_cache.pool
+            for job, _take in plan["shares"]:
+                if job in self._prefill_jobs:  # identity compare
+                    pool.truncate(job.slot, int(plan["pre_lens"][job.slot]))
+        await self._finish_recovery()
+
+    def _retire_ragged(self, plan: dict, result: dict) -> None:
+        """Loop-thread tail of a ragged step: decode emissions re-anchor
+        the host mirrors exactly like a pipelined retire; finishing
+        prefill jobs sample their first token (the legacy admission code
+        path) and activate their slot."""
+        t0 = time.perf_counter()
+        sampled = np.asarray(result["sampled"])
+        gstate_np = (
+            np.array(result["gstate"]) if result["gstate"] is not None else None
+        )
+        lp_np = (
+            tuple(np.asarray(a) for a in result["lp"])
+            if result["lp"] is not None
+            else None
+        )
+        if faults.active():
+            try:
+                faults.fire("engine.decode.retire", requests=plan["requests"])
+            except faults.InjectedFault as ex:
+                if ex.request is None:
+                    raise  # batch-wide: loop-level step-failure handling
+                self.counters["step_failures"] += 1
+                handled = False
+                for slot, request in enumerate(self._slot_req):
+                    if request is ex.request:
+                        self._fail_slot(slot, EngineStepError(
+                            "retire failed for this request: {}".format(ex)
+                        ))
+                        handled = True
+                        break
+                if not handled:
+                    job = next(
+                        (
+                            j for j, _ in plan["shares"]
+                            if j.request is ex.request
+                        ),
+                        None,
+                    )
+                    if job is not None:
+                        plan["failed_jobs"].append((job, EngineStepError(
+                            "retire failed for this request: {}".format(ex)
+                        )))
+        for slot in plan["exhausted"]:
+            self._fail_slot(
+                slot, MemoryError("kv page pool exhausted for this sequence")
+            )
+        decode_slots = [int(s) for s in np.nonzero(plan["decode_mask"])[0]]
+        for slot in decode_slots:
+            self._next_token[slot] = int(sampled[slot])
+            if gstate_np is not None:
+                self._gstate[slot] = int(gstate_np[slot])
+        for slot in decode_slots:
+            request = self._slot_req[slot]
+            if request is not None and self._tokbuf is not None:
+                # speculation history stays warm through ragged phases so
+                # the n-gram proposer drafts well when spec steps resume
+                idx = request.prompt_len + request.produced
+                if idx < self._tokbuf.shape[1]:
+                    self._tokbuf[slot, idx] = int(sampled[slot])
+            lp_entry = None
+            if (
+                lp_np is not None
+                and request is not None
+                and request.logprobs is not None
+            ):
+                chosen, top_id, top_lp = lp_np
+                lp_entry = {
+                    "id": int(sampled[slot]),
+                    "logprob": float(chosen[slot]),
+                    "top_ids": top_id[slot].tolist(),
+                    "top_logprobs": top_lp[slot].tolist(),
+                }
+            self._emit(slot, int(sampled[slot]), lp_entry)
+        failed = [j for j, _ in plan["failed_jobs"]]
+        live_shares = [
+            (j, t) for j, t in plan["shares"]
+            if not any(j is f for f in failed)
+        ]
+        self.counters["ragged_steps"] += 1
+        self._step_rows["decode"] += len(decode_slots)
+        self._step_rows["prefill"] += len(live_shares)
+        used = len(decode_slots) + sum(t for _, t in live_shares)
+        self._hist_budget.observe(used / max(1, plan["budget"]))
+        for job, err in plan["failed_jobs"]:
+            self._fail_ragged_job(job, err)
+        logits_np = None
+        for job, take in live_shares:
+            if job not in self._prefill_jobs:  # failed since planning
+                continue
+            job.pos += take
+            if job.pos < len(job.request.prompt_ids):
+                continue
+            # final chunk landed: the row's last-token logits are the
+            # prompt's prefill logits — first token + slot activation
+            request = job.request
+            self._prefill_jobs.remove(job)
+            self._admitting.discard(job.slot)
+            if request.cancelled:
+                self._deref_guided_request(request)
+                request.out_queue.put_nowait(_FINISHED)
+                self._free_ragged_slot(job.slot)
+                continue
+            err = self._deadline_error_at_commit(request)
+            if err is not None:
+                self._deref_guided_request(request)
+                request.error = err
+                request.out_queue.put_nowait(_FINISHED)
+                self._free_ragged_slot(job.slot)
+                continue
+            if logits_np is None:
+                logits_np = np.asarray(result["logits"])
+            first_id, first_lp = self._first_token_from_logits(
+                request, jnp.asarray(logits_np[job.slot][None])
+            )
+            if self.cache_mode == "paged" and self._prefix is not None:
+                # zero-copy store, same point as the legacy commit: the
+                # slot's own pages now hold the whole prompt's KV
+                self._prefix.store_pages(
+                    request.prompt_ids,
+                    self._slot_lora(request),
+                    self.paged_cache.pool.slot_pages(job.slot),
+                )
+            self._activate_slot(request, job.slot, first_id, first_lp)
+        self._last_progress = time.monotonic()
+        self._hist_retire.observe((time.perf_counter() - t0) * 1e3)
+
     async def _run_loop(self) -> None:
         try:
             await self._run_loop_inner()
@@ -3778,6 +4702,15 @@ class LLMEngineCore:
             if self._prefill_gate is not None:
                 # no decode loop -> nothing to pace against; unblock waiters
                 self._prefill_gate.set_active(False)
+            # ragged scheduler: no loop means no further chunk rows — fail
+            # in-progress jobs and prepped-but-unopened admissions (their
+            # consumers must never hang; slot pages reclaimed below)
+            exit_err = EngineUnavailableError(
+                "engine stopped" if self._stopped else "engine loop exited"
+            )
+            if self._prefill_jobs:
+                self._abort_ragged_jobs(exit_err)
+            self._drain_ragged_ready(exit_err)
             if self._stopped:
                 # catch requests admitted while stop() was racing the loop
                 # (popped from _pending before stop drained it)
@@ -3847,9 +4780,12 @@ class LLMEngineCore:
                 self._admitting.add(slot)
                 # hold a strong ref: the loop keeps only weak refs to tasks,
                 # so an unreferenced admission could be GC'd mid-flight,
-                # leaving the slot stuck in _admitting forever
+                # leaving the slot stuck in _admitting forever. Ragged mode
+                # routes to the chunk-row admission (no prefill dispatch).
                 task = asyncio.get_running_loop().create_task(
-                    self._admission_task(request, slot)
+                    self._ragged_admission_task(request, slot)
+                    if self._ragged
+                    else self._admission_task(request, slot)
                 )
                 self._admission_tasks.add(task)
                 task.add_done_callback(self._admission_tasks.discard)
@@ -3882,13 +4818,31 @@ class LLMEngineCore:
                     continue
                 self._commit_admission(request, slot, first_id, mini_cache, first_lp)
                 self._last_progress = time.monotonic()
+            # ragged scheduler: open jobs for prepped admissions — their
+            # prompts start riding this loop's launches as chunk rows
+            while not self._ragged_ready.empty():
+                request, slot = self._ragged_ready.get_nowait()
+                if request.cancelled or request.error is not None:
+                    self._release_resume_pin(request)
+                    self._deref_guided_request(request)
+                    request.out_queue.put_nowait(_FINISHED)
+                    self._admitting.discard(slot)
+                    continue
+                job = self._start_ragged_job(request, slot)
+                if job is not None:
+                    self._prefill_jobs.append(job)
+                    self._last_progress = time.monotonic()
             active_mask = np.array([r is not None for r in self._slot_req])
             if self._prefill_gate is not None:
                 # open the gate while decode idles; pace prefills while active
                 self._prefill_gate.set_active(
                     bool(active_mask.any() or self._inflight)
                 )
-            if not active_mask.any() and not self._inflight:
+            if (
+                not active_mask.any()
+                and not self._inflight
+                and not self._prefill_jobs
+            ):
                 if (
                     self._pending.empty()
                     and self._ready.empty()
@@ -3913,7 +4867,18 @@ class LLMEngineCore:
             # queue — the loop itself survives both and keeps serving
             step_epoch = self._recover_epoch
             try:
-                await self._decode_step(active_mask, step_epoch)
+                if self._prefill_jobs:
+                    # ragged scheduling phase (docs/ragged_attention.md):
+                    # drain the pipelined queue first (host mirrors must be
+                    # current — same rule as spec steps), then each
+                    # iteration is ONE mixed launch of every decode row
+                    # plus budget-bounded prefill-chunk rows
+                    if self._inflight:
+                        await self._retire_oldest()
+                    else:
+                        await self._ragged_step(active_mask, step_epoch)
+                else:
+                    await self._decode_step(active_mask, step_epoch)
             except asyncio.CancelledError:
                 raise
             except Exception as ex:

@@ -29,6 +29,12 @@ Known points (ctx carried with each):
                          path (``request``); a raise forces a class-policy
                          shed (429 with the request's priority class in the
                          payload) regardless of queue state.
+- ``engine.admit.budget`` — ragged scheduler (docs/ragged_attention.md): on
+                         the loop thread as one prefill job's chunk is
+                         admitted into a step's token budget (``request``);
+                         a raise sheds that admission (structured 429) —
+                         decode rows and the other jobs ride the step
+                         untouched.
 - ``engine.pool``      — inside check_admission's KV-pool headroom check; a
                          raise simulates pool exhaustion.
 - ``engine.preempt``   — on the loop thread mid-preemption, AFTER the
@@ -103,6 +109,7 @@ KNOWN_POINTS = frozenset({
     "engine.drain",
     "engine.admit",
     "engine.admit.class",
+    "engine.admit.budget",
     "engine.pool",
     "engine.preempt",
     "engine.release",

@@ -255,6 +255,17 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("pipeline_depth")
                 else None
             ),
+            # ragged token-budget scheduler (docs/ragged_attention.md):
+            # aux engine.scheduler = "ragged" puts chunked prefill and
+            # decode in one launch per step, paced by
+            # engine.step_token_budget; unset defers to TPUSERVE_SCHEDULER
+            # (constructor validates values at ENDPOINT LOAD)
+            scheduler=engine_cfg.get("scheduler"),
+            step_token_budget=(
+                int(engine_cfg["step_token_budget"])
+                if engine_cfg.get("step_token_budget")
+                else None
+            ),
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),
             prefix_block=int(engine_cfg.get("prefix_block", 64)),

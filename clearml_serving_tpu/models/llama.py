@@ -1447,6 +1447,154 @@ def build(config: dict) -> SimpleNamespace:
             )
         return (_logits(params, x),) + tuple(new_pools)
 
+    # -- ragged mixed prefill+decode step (docs/ragged_attention.md) ---------
+
+    def forward_ragged(
+        params,
+        tokens,        # [T] int32 flattened ragged chunk (token-major)
+        tok_pos,       # [T] int32 absolute position of each token in its row
+        tok_row,       # [T] int32 owning batch row per token (pads -> 0)
+        tok_valid,     # [T] bool real tokens (pads never route in MoE)
+        row_last,      # [R] int32 flat index of each row's last real token
+        k_pools,       # [L, Hkv, N, P, D] (int8 under kv_quant)
+        v_pools,
+        page_table,    # [R, PP] int32
+        kv_lens,       # [R] int32 tokens present AFTER this chunk's writes
+        row_starts,    # [R] int32 ragged row map (ops.ragged_layout)
+        row_lens,      # [R] int32 query tokens per row (0 = idle row)
+        write_page,    # [T] int32 per-token write coords (pads -> null page)
+        write_offset,  # [T] int32
+        block_rows=None,  # [T/QB] int32 kernel q-block map (host-built;
+        block_q0=None,    #  None routes attention to the XLA reference)
+        lora_idx=None,    # [R] int32 adapter index per row (None = base)
+        *,
+        k_scales=None,  # [L, Hkv, N, P] f32 scale pools (kv_quant only)
+        v_scales=None,
+    ):
+        """ONE forward step over a ragged mixed batch: each row is at an
+        arbitrary phase — decode rows contribute one query token, prefill
+        rows a prompt chunk — flattened into a token-major operand
+        (PAPERS.md "Ragged Paged Attention"). Every token embeds at its own
+        absolute position, writes its K/V into the paged pools at
+        host-precomputed (page, offset) coords — the same scatter as
+        decode_paged, with the chunk's quantized scales beside int8 pages —
+        and attends through ops.ragged_paged_attention with per-row causal
+        bounds. Returns (row logits [R, vocab] at each row's last real
+        token, updated pools); a decode row's logits are numerically the
+        decode path's logits, which is what the engine's ragged-vs-two-
+        dispatch byte-identity rests on."""
+        from ..ops.paged_attention import ragged_paged_attention
+
+        if kv_quant and k_scales is None:
+            raise ValueError("kv_quant forward_ragged needs k_scales/v_scales")
+        t = tokens.shape[0]
+        positions = tok_pos[:, None]                               # [T, 1]
+        cos, sin = _rope(positions, head_dim, theta, rope_scaling)
+        x = _embed(params, tokens)[:, None]                        # [T, 1, dim]
+        tok_lora = lora_idx[tok_row] if lora_idx is not None else None
+        q_prescale = query_scale * (head_dim ** 0.5)
+
+        def layer_body(x, layer, k_pool_l, v_pool_l, k_sc_l, v_sc_l):
+            stash = []
+
+            def attn_fn(layer_, h):
+                q, k, v = _qkv(layer_, h, cos, sin, tok_lora)  # [T,1,H,D]
+                k_q, k_s = _kv_store(k)
+                v_q, v_s = _kv_store(v)
+                k_hm = k_q[:, 0].transpose(1, 0, 2).astype(k_pool_l.dtype)
+                v_hm = v_q[:, 0].transpose(1, 0, 2).astype(v_pool_l.dtype)
+                k_p = k_pool_l.at[:, write_page, write_offset].set(k_hm)
+                v_p = v_pool_l.at[:, write_page, write_offset].set(v_hm)
+                scale_kw = {}
+                if kv_quant:
+                    k_sp = k_sc_l.at[:, write_page, write_offset].set(
+                        k_s[:, 0].transpose(1, 0)
+                    )
+                    v_sp = v_sc_l.at[:, write_page, write_offset].set(
+                        v_s[:, 0].transpose(1, 0)
+                    )
+                    stash.append((k_p, v_p, k_sp, v_sp))
+                    scale_kw = {"k_scale": k_sp, "v_scale": v_sp}
+                else:
+                    stash.append((k_p, v_p))
+                q_grouped = q[:, 0].reshape(t, n_kv, group, head_dim)
+                if q_prescale != 1.0:
+                    q_grouped = q_grouped * jnp.asarray(
+                        q_prescale, q_grouped.dtype
+                    )
+                attn = ragged_paged_attention(
+                    q_grouped, k_p, v_p, page_table, kv_lens,
+                    row_starts, row_lens,
+                    block_rows=block_rows, block_q0=block_q0, **scale_kw,
+                )                                                  # [T,Hkv,G,D]
+                return attn.reshape(t, 1, n_heads * head_dim).astype(x.dtype)
+
+            # dropless MoE: capacity dropping would make a row's tokens
+            # depend on what the OTHER rows put in the launch — the ragged
+            # scheduler requires per-row determinism (like verify)
+            x = _block(layer, x, attn_fn, tok_lora,
+                       ffn_kwargs={"valid": tok_valid[:, None],
+                                   "dropless": True})
+            return (x,) + stash[0]
+
+        if kv_quant:
+            xs_all = (params["layers"], k_pools, v_pools, k_scales, v_scales)
+        else:
+            xs_all = (params["layers"], k_pools, v_pools)
+        if scan_layers:
+            def scan_body(x, xs):
+                layer = xs[0]
+                pools = xs[1:] if kv_quant else xs[1:] + (None, None)
+                out = layer_body(x, layer, *pools)
+                return out[0], out[1:]
+
+            x, new_pools = jax.lax.scan(scan_body, x, xs_all)
+        else:
+            per_layer = []
+            for li, layer in enumerate(params["layers"]):
+                tup = tuple(a[li] for a in xs_all[1:])
+                if not kv_quant:
+                    tup = tup + (None, None)
+                out = layer_body(x, layer, *tup)
+                x = out[0]
+                per_layer.append(out[1:])
+            new_pools = tuple(
+                jnp.stack([bufs[j] for bufs in per_layer])
+                for j in range(len(per_layer[0]))
+            )
+        last_x = x[:, 0][row_last][:, None]                    # [R, 1, dim]
+        logits = _logits(params, last_x)[:, 0]                 # [R, vocab]
+        return (logits,) + tuple(new_pools)
+
+    def forward_ragged_dense(params, tokens, start, last_rel, row_active,
+                             cache, lora_idx=None):
+        """Dense-cache ragged step (docs/ragged_attention.md): the mixed
+        batch takes the RECTANGULAR chunk layout — tokens [B, C] where
+        decode rows carry one real token, prefill rows a prompt chunk, and
+        idle rows garbage their frozen length masks. Each row's chunk
+        writes at its own absolute positions (the chunked-prefill layer
+        loop) and attends causally over its slot's cache; logits return at
+        ``last_rel`` and lengths advance only where ``row_active``."""
+        b, c = tokens.shape
+        ffn_valid = (
+            jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
+        ) & row_active[:, None]
+        x, new_kv = _cached_chunk_layers(
+            params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid},
+            lora_idx=lora_idx,
+        )
+        last_x = jnp.take_along_axis(
+            x, last_rel[:, None, None].clip(0, c - 1), axis=1
+        )                                                      # [B, 1, dim]
+        last = _logits(params, last_x)[:, 0]                   # [B, vocab]
+        new_len = jnp.maximum(
+            cache["length"], start + last_rel + 1
+        ).astype(jnp.int32)
+        cache = dict(
+            new_kv, length=jnp.where(row_active, new_len, cache["length"])
+        )
+        return last, cache
+
     def prepare_params(params):
         """Adapt a loaded param pytree to this build's layout: under
         scan_layers, a list/tuple of per-layer dicts (e.g. from a checkpoint
@@ -1521,6 +1669,10 @@ def build(config: dict) -> SimpleNamespace:
         verify=verify,
         decode_paged=decode_paged,
         verify_paged=verify_paged,
+        # ragged mixed prefill+decode step (docs/ragged_attention.md): the
+        # engine's token-budget scheduler drives one of these per iteration
+        forward_ragged=forward_ragged,
+        forward_ragged_dense=forward_ragged_dense,
         # pipeline-parallel prefill: gated to configs whose forward the
         # pipeline stage body reproduces exactly (see prefill_pipeline doc)
         prefill_pipeline=(

@@ -402,3 +402,393 @@ def paged_attention(
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         interpret=interpret,
     )(page_table, lengths, *inputs)
+
+
+# ----------------------------------------------------------- ragged (mixed)
+
+# Ragged paged attention (PAPERS.md "Ragged Paged Attention",
+# docs/ragged_attention.md): ONE kernel over a batch whose rows sit at
+# arbitrary phases — a decode row contributes one query token, a prefill row
+# contributes a whole prompt chunk. The queries of all rows flatten into one
+# token-major operand; per-row offsets/lengths ride in SMEM. This is what
+# lets the engine's token-budget scheduler put chunked prefill and decode in
+# a single launch instead of two dispatches (llm/engine.py ragged mode).
+#
+# Layout:
+#     q           [T, Hkv, G, D]   flattened ragged queries: row r occupies
+#                                  q[row_starts[r] : row_starts[r]+row_lens[r]]
+#     page_table  [R, PP]          one row per batch row (same pools/ids as
+#                                  the decode kernel above)
+#     kv_lens     [R]              tokens present per row INCLUDING this
+#                                  step's chunk (K/V are written before the
+#                                  attention call, like decode_paged)
+#     row_starts  [R], row_lens [R]  the ragged row map (row_lens 0 = idle)
+#
+# Causality: query i of row r sits at absolute position
+# kv_lens[r] - row_lens[r] + i and attends KV positions <= its own — decode
+# rows (row_lens 1) degenerate to exactly the decode kernel's masking,
+# prefill rows get the standard causal triangle against their own history.
+#
+# Pallas design: the grid runs (T/QB, Hkv) where QB (`q_block`) is a small
+# static query block. The flattened layout is Q-BLOCK ALIGNED — every row's
+# segment starts at a QB boundary (ragged_layout below builds it), so each
+# q block belongs to exactly ONE row and the host passes that mapping as two
+# scalar-prefetch vectors (block_rows / block_q0). Each grid step re-uses the
+# decode kernel's manual double-buffered page-DMA plan against its row's
+# pages — including the int8 path's pre-gathered per-row scale operands,
+# which pipeline per BLOCK via an index map that reads block_rows — and runs
+# the flash update on a [QB*G, pages_per_block*P] score tile. Pages past the
+# block's causal bound are never copied: a prefill chunk's early q blocks
+# stop their DMA train at their own triangle's edge.
+
+_RAGGED_QB = 8  # default query block (sublane-friendly; decode rows pad to it)
+
+
+def ragged_layout(row_lens, q_block: int = _RAGGED_QB, total: int | None = None):
+    """Host-side layout of a ragged batch: returns (row_starts [R],
+    block_rows [NB], block_q0 [NB], t_pad) as numpy int32, with every row's
+    flat segment aligned to ``q_block`` (the kernel's one-row-per-q-block
+    contract). ``total`` pads the flat token axis to a fixed static size so
+    engine traces stay bucketed; blocks not owned by any row carry -1."""
+    import numpy as np
+
+    lens = np.asarray(row_lens, np.int32)
+    starts = np.zeros(lens.shape[0], np.int32)
+    off = 0
+    for r, n in enumerate(lens):
+        starts[r] = off
+        if n > 0:
+            off += -(-int(n) // q_block) * q_block
+    t_pad = -(-max(off, 1) // q_block) * q_block
+    if total is not None:
+        if total < t_pad:
+            raise ValueError(
+                "ragged layout needs {} tokens but total={}".format(t_pad, total)
+            )
+        t_pad = -(-int(total) // q_block) * q_block
+    nb = t_pad // q_block
+    block_rows = np.full(nb, -1, np.int32)
+    block_q0 = np.zeros(nb, np.int32)
+    for r, n in enumerate(lens):
+        if n <= 0:
+            continue
+        b0 = int(starts[r]) // q_block
+        for j in range(-(-int(n) // q_block)):
+            block_rows[b0 + j] = r
+            block_q0[b0 + j] = j * q_block
+    return starts, block_rows, block_q0, int(t_pad)
+
+
+def ragged_paged_attention_xla(q, k_pool, v_pool, page_table, kv_lens,
+                               row_starts, row_lens,
+                               k_scale=None, v_scale=None):
+    """Reference ragged paged attention in plain XLA ops (CPU fallback).
+
+    Shapes per the module's ragged section; returns [T, Hkv, G, D] with
+    zeros at tokens no row owns. Per-token math mirrors
+    :func:`paged_attention_xla` exactly (same contraction order, f32
+    softmax, probs cast to the value dtype before the PV product) so a
+    decode row's output is the decode reference's output — the engine's
+    byte-identity A/B rests on that.
+
+    The pool gather runs per ROW ([Hkv, R, cap, D]) and fans out to
+    tokens by row index — the per-token [T, cap] operand still
+    materializes for the score/PV einsums (acceptable at the fallback's
+    test/smoke scale; the Pallas kernel is the capacity-scale path), but
+    HBM gather traffic stays R*cap, not T*cap."""
+    t, hkv, g, d = q.shape
+    _, n, p, _ = k_pool.shape
+    pp = page_table.shape[1]
+    cap = pp * p
+    t_idx = jnp.arange(t, dtype=jnp.int32)
+    ends = row_starts + row_lens
+    in_row = (t_idx[None, :] >= row_starts[:, None]) & (
+        t_idx[None, :] < ends[:, None]
+    )                                                       # [R, T]
+    tok_valid = jnp.any(in_row, axis=0)                     # [T]
+    tok_row = jnp.argmax(in_row, axis=0).astype(jnp.int32)  # [T]
+    qi = t_idx - row_starts[tok_row]
+    base = (kv_lens - row_lens)[tok_row]
+    bound = jnp.where(
+        tok_valid, jnp.minimum(base + qi + 1, kv_lens[tok_row]), 0
+    )                                                       # [T]
+    k_rows = k_pool[:, page_table].reshape(hkv, -1, cap, d)  # [Hkv, R, cap, D]
+    v_rows = v_pool[:, page_table].reshape(hkv, -1, cap, d)
+    if k_scale is not None:
+        ks = k_scale[:, page_table].reshape(hkv, -1, cap, 1)
+        vs = v_scale[:, page_table].reshape(hkv, -1, cap, 1)
+        k_rows = (k_rows.astype(jnp.float32) * ks).astype(q.dtype)
+        v_rows = (v_rows.astype(jnp.float32) * vs).astype(q.dtype)
+    k = k_rows[:, tok_row]                                  # [Hkv, T, cap, D]
+    v = v_rows[:, tok_row]
+    scores = jnp.einsum(
+        "thgd,htcd->thgc", q, k, preferred_element_type=jnp.float32
+    ) * (d ** -0.5)
+    valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < bound[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    row_max = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), -1e30)
+    probs = jnp.exp(scores - row_max)
+    probs = jnp.where(valid[:, None, None, :], probs, 0.0)
+    denom = jnp.sum(probs, axis=-1, keepdims=True)
+    probs = (probs / jnp.where(denom == 0.0, 1.0, denom)).astype(v.dtype)
+    out = jnp.einsum("thgc,htcd->thgd", probs, v)
+    return out.astype(q.dtype)
+
+
+def _ragged_attention_kernel(
+    # scalar prefetch (SMEM): block_rows [NB], block_q0 [NB],
+    # page_table [R, PP], kv_lens [R], row_lens [R]
+    block_rows_ref,
+    block_q0_ref,
+    page_table_ref,
+    kv_lens_ref,
+    row_lens_ref,
+    # then positionally: q_ref [QB,1,G,D]; k_hbm/v_hbm [Hkv,N,P,D] (ANY);
+    # quantized only: k_scale_ref/v_scale_ref [1,1,1,cap_pad] (per-ROW
+    # pre-gathered scales, pipelined by the block_rows index map);
+    # out_ref [QB,1,G,D]; scratch k_buf/v_buf [2, PB*P, D], sems [2, PB, 2]
+    *refs,
+    page_size: int,
+    pages_per_block: int,
+    q_block: int,
+    quantized: bool = False,
+):
+    if quantized:
+        (q_ref, k_hbm, v_hbm, k_scale_ref, v_scale_ref,
+         out_ref, k_buf, v_buf, sems) = refs
+    else:
+        q_ref, k_hbm, v_hbm, out_ref, k_buf, v_buf, sems = refs
+        k_scale_ref = v_scale_ref = None
+    bi = pl.program_id(0)
+    h = pl.program_id(1)
+    g, d = q_ref.shape[2], q_ref.shape[3]
+    p = page_size
+    pb = pages_per_block
+    qb = q_block
+    row_raw = block_rows_ref[bi]
+    live = row_raw >= 0
+    row = jnp.maximum(row_raw, 0)
+    q0 = block_q0_ref[bi]
+    kv_len = kv_lens_ref[row]
+    row_len = row_lens_ref[row]
+    base = kv_len - row_len          # absolute position of the row's query 0
+    # causal bound of this block's LAST query — pages past it never DMA
+    bound = jnp.where(live, jnp.minimum(kv_len, base + q0 + qb), 0)
+    block_tokens = pb * p
+    n_blocks = (bound + block_tokens - 1) // block_tokens
+
+    def _copies(block_idx, slot, j):
+        page_idx = block_idx * pb + j
+        page = page_table_ref[row, page_idx]
+        dst = pl.ds(j * p, p)
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[h, page], k_buf.at[slot, dst], sems.at[slot, j, 0]
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[h, page], v_buf.at[slot, dst], sems.at[slot, j, 1]
+            ),
+        )
+
+    def start_block(block_idx, slot):
+        for j in range(pb):  # static unroll; ragged tail gated per page
+            @pl.when((block_idx * pb + j) * p < bound)
+            def _start(j=j):
+                ck, cv = _copies(block_idx, slot, j)
+                ck.start()
+                cv.start()
+
+    def wait_block(block_idx, slot):
+        for j in range(pb):
+            @pl.when((block_idx * pb + j) * p < bound)
+            def _wait(j=j):
+                ck, cv = _copies(block_idx, slot, j)
+                ck.wait()
+                cv.wait()
+
+    @pl.when(n_blocks > 0)
+    def _run():
+        start_block(0, 0)
+
+        def body(i, carry):
+            m_prev, l_prev, acc_prev = carry
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _prefetch():
+                start_block(i + 1, jax.lax.rem(i + 1, 2))
+
+            wait_block(i, slot)
+            # queries flatten to [QB*G, D]: query-in-block index = ri // G
+            q = q_ref[:, 0].reshape(qb * g, d)                  # [QB*G, D]
+            k = k_buf[slot]                                     # [PB*P, D]
+            v = v_buf[slot]
+            if quantized:
+                op_dtype = out_ref.dtype
+                k = k.astype(op_dtype)
+                v = v.astype(op_dtype)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * (d ** -0.5)                                     # [QB*G, PB*P]
+            if quantized:
+                k_s = k_scale_ref[0, 0, :, pl.ds(i * block_tokens,
+                                                 block_tokens)]  # [1, PB*P]
+                scores = scores * k_s
+            # per-query causal masking: query q0+qi attends KV positions
+            # <= base+q0+qi; 2-D i32 iota compares (Mosaic: no i1 minor dim)
+            token_ids = (
+                i * block_tokens
+                + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            )
+            qi = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // g
+            q_live = (q0 + qi) < row_len                        # query exists
+            valid = (token_ids < base + q0 + qi + 1) & q_live
+            scores = jnp.where(valid, scores, -jnp.inf)
+            # rows past the bound were never DMA'd: zero before the matmul
+            row_ids = i * block_tokens + jax.lax.broadcasted_iota(
+                jnp.int32, (block_tokens, 1), 0
+            )
+            v = jnp.where(row_ids < bound, v, jnp.zeros_like(v))
+
+            block_max = jnp.maximum(jnp.max(scores, axis=1), -1e30)
+            m_new = jnp.maximum(m_prev, block_max)              # [QB*G]
+            probs = jnp.exp(scores - m_new[:, None])
+            probs = jnp.where(valid, probs, 0.0)
+            correction = jnp.exp(m_prev - m_new)
+            l_new = l_prev * correction + jnp.sum(probs, axis=1)
+            pv = probs
+            if quantized:
+                v_s = v_scale_ref[0, 0, :, pl.ds(i * block_tokens,
+                                                 block_tokens)]  # [1, PB*P]
+                pv = probs * v_s
+            acc_new = acc_prev * correction[:, None] + jax.lax.dot_general(
+                pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((qb * g,), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((qb * g,), jnp.float32)
+        acc0 = jnp.zeros((qb * g, d), jnp.float32)
+        _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[:, 0] = (acc / safe_l[:, None]).reshape(qb, g, d).astype(
+            out_ref.dtype
+        )
+
+    @pl.when(n_blocks == 0)
+    def _empty():
+        out_ref[:, 0] = jnp.zeros((qb, g, d), out_ref.dtype)
+
+
+def ragged_paged_attention(
+    q, k_pool, v_pool, page_table, kv_lens, row_starts, row_lens, *,
+    block_rows=None, block_q0=None,
+    k_scale=None, v_scale=None,
+    pages_per_block: int = 32, q_block: int = _RAGGED_QB,
+    interpret: bool = False,
+):
+    """Ragged paged attention over mixed prefill+decode rows (falls back to
+    :func:`ragged_paged_attention_xla` off-TPU and on misaligned shapes —
+    the SAME gates as the decode kernel: D % 128, dtype-dependent page
+    sublane tile).
+
+    ``block_rows``/``block_q0`` ([T/q_block] int32) are the host-computed
+    q-block -> row map (:func:`ragged_layout`); the Pallas path REQUIRES
+    them (they cannot be derived from traced row metadata on device) and
+    the flat layout must be q_block-aligned per row. Without them every
+    call routes to the XLA reference."""
+    quantized = k_scale is not None
+    if jnp.issubdtype(k_pool.dtype, jnp.signedinteger) and not quantized:
+        raise ValueError(
+            "int8 KV pools need k_scale/v_scale operands (per-token dequant)"
+        )
+
+    def _xla():
+        return ragged_paged_attention_xla(
+            q, k_pool, v_pool, page_table, kv_lens, row_starts, row_lens,
+            k_scale, v_scale,
+        )
+
+    if not _PALLAS_OK or block_rows is None or block_q0 is None:
+        return _xla()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and not interpret:
+        return _xla()
+    min_sublane = 32 if k_pool.dtype.itemsize == 1 else 16
+    if on_tpu and not interpret and (
+        q.shape[-1] % 128 != 0 or k_pool.shape[2] % min_sublane != 0
+    ):
+        return _xla()
+
+    t, hkv, g, d = q.shape
+    _, n, page_size, _ = k_pool.shape
+    pages_per_seq = page_table.shape[1]
+    if t % q_block:
+        raise ValueError(
+            "ragged q length {} must be a multiple of q_block {}".format(
+                t, q_block
+            )
+        )
+    pb = max(1, min(pages_per_block, pages_per_seq))
+    cap = pages_per_seq * page_size
+
+    kernel = functools.partial(
+        _ragged_attention_kernel,
+        page_size=page_size,
+        pages_per_block=pb,
+        q_block=q_block,
+        quantized=quantized,
+    )
+    nb = t // q_block
+    in_specs = [
+        pl.BlockSpec(
+            (q_block, 1, g, d), lambda b, h, br, bq, pt, kl, rl: (b, h, 0, 0)
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),   # K pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),   # V pool stays in HBM
+    ]
+    inputs = [q, k_pool, v_pool]
+    if quantized:
+        # per-ROW pre-gathered scales (same rationale/padding as the decode
+        # kernel's: f32 scale rows are not tile-alignable for the page DMA
+        # plan); the grid pipeline picks each q block's row via block_rows
+        block_tokens = pb * page_size
+        cap_pad = -(-cap // block_tokens) * block_tokens
+        pad = ((0, 0), (0, 0), (0, 0), (0, cap_pad - cap))
+        r = page_table.shape[0]
+
+        def gather(scale):
+            seq = jnp.moveaxis(
+                scale[:, page_table].reshape(hkv, r, cap), 0, 1
+            ).reshape(r, hkv, 1, cap)
+            return jnp.pad(seq, pad)
+
+        def scale_idx(b, h, br, bq, pt, kl, rl):
+            return (jnp.maximum(br[b], 0), h, 0, 0)
+
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, cap_pad), scale_idx),
+            pl.BlockSpec((1, 1, 1, cap_pad), scale_idx),
+        ]
+        inputs += [gather(k_scale), gather(v_scale)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,  # block_rows, block_q0, page_table, kv/row lens
+        grid=(nb, hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (q_block, 1, g, d), lambda b, h, br, bq, pt, kl, rl: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, pb * page_size, d), k_pool.dtype),
+            pltpu.VMEM((2, pb * page_size, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, pb, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, hkv, g, d), q.dtype),
+        interpret=interpret,
+    )(block_rows, block_q0, page_table, kv_lens, row_lens, *inputs)

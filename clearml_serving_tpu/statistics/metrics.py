@@ -304,6 +304,31 @@ class EngineLifecycleCollector:
             "host time to sync + emit one retired chunk (ms)",
             labels=["model"],
         )
+        # ragged token-budget scheduler (docs/ragged_attention.md): how full
+        # each mixed launch ran against its token budget, and how many rows
+        # of each phase rode the launches — occupancy and admission
+        # interleave are dashboard lines, not log greps
+        budget_util = HistogramMetricFamily(
+            p + "_step_token_budget_utilization",
+            "per ragged step: tokens dispatched / step token budget",
+            labels=["model"],
+        )
+        step_rows = CounterMetricFamily(
+            p + "_step_rows",
+            "rows carried by ragged mixed launches, by phase "
+            "(prefill = admission chunk rows, decode = one-token rows)",
+            labels=["model", "phase"],
+        )
+        ragged_jobs = GaugeMetricFamily(
+            p + "_ragged_prefill_jobs",
+            "admissions currently mid-prefill in the ragged scheduler",
+            labels=["model"],
+        )
+        ragged_budget = GaugeMetricFamily(
+            p + "_step_token_budget",
+            "effective ragged step token budget (brownout stage 3 shrinks "
+            "it)", labels=["model"],
+        )
         # paged KV pool capacity (docs/paged_kv_quant.md): bytes split by
         # kind (kv = data planes, scale = int8 dequant scale rows) plus an
         # info gauge carrying the pool dtype — the int8 capacity win is a
@@ -333,6 +358,7 @@ class EngineLifecycleCollector:
         any_pipeline = False
         any_kv_pool = False
         any_slo = False
+        any_ragged = False
         for key, provider in providers.items():
             try:
                 s = provider() or {}
@@ -346,6 +372,19 @@ class EngineLifecycleCollector:
                         kv_pool_bytes.add_metric([key, kind], kv_pool[kind])
                 if kv_pool.get("dtype"):
                     kv_pool_dtype.add_metric([key, str(kv_pool["dtype"])], 1)
+            ragged = s.get("ragged") or {}
+            if ragged:
+                any_ragged = True
+                snap = ragged.get("budget_utilization")
+                if snap:
+                    buckets, total = _hist_buckets(snap)
+                    budget_util.add_metric([key], buckets, total)
+                for phase, v in (ragged.get("step_rows") or {}).items():
+                    step_rows.add_metric([key, str(phase)], v)
+                if "prefill_jobs" in ragged:
+                    ragged_jobs.add_metric([key], ragged["prefill_jobs"])
+                if "effective_budget" in ragged:
+                    ragged_budget.add_metric([key], ragged["effective_budget"])
             pipe = s.get("pipeline") or {}
             if pipe:
                 any_pipeline = True
@@ -409,6 +448,11 @@ class EngineLifecycleCollector:
             yield pipe_depth
             yield dispatch_ms
             yield retire_ms
+        if any_ragged:
+            yield budget_util
+            yield step_rows
+            yield ragged_jobs
+            yield ragged_budget
         if any_kv_pool:
             yield kv_pool_bytes
             yield kv_pool_dtype

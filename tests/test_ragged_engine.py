@@ -1,0 +1,346 @@
+"""Ragged scheduler engine tests (docs/ragged_attention.md): byte-identity
+of the token-budget single-launch scheduler against the legacy two-dispatch
+path (greedy + seeded, dense + paged, int8 KV, pipeline depths), prefix
+cache / speculation composition, chaos behavior mid-ragged-dispatch, and
+the committed ``bench.py --ragged-ab`` CPU smoke artifact."""
+
+import asyncio
+import json
+import pathlib
+
+import jax
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.errors import EngineOverloadedError
+from clearml_serving_tpu.llm import faults
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+CFG = {"preset": "llama-tiny", "dtype": "float32"}
+QCFG = dict(CFG, kv_quant="int8")
+
+LONG = [(i * 7 + 3) % 250 + 1 for i in range(40)]
+SHORT = [5, 9, 2, 17, 33]
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model("llama", CFG)
+    qbundle = models.build_model("llama", QCFG)
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, qbundle, params
+
+
+def _engine(bundle, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("prefill_buckets", [16, 64])
+    kw.setdefault("eos_token_id", None)
+    kw.setdefault("decode_steps", 2)
+    return LLMEngineCore(bundle, params, **kw)
+
+
+def _staggered(engine, prompts, n=8, seeds=None):
+    """Submit prompts 50 ms apart so later admissions overlap live decode
+    streams — the mixed prefill+decode batch the ragged scheduler exists
+    for. Seeded entries sample at temperature (deterministic per seed)."""
+
+    async def one(i, ids):
+        if i:
+            await asyncio.sleep(0.05 * i)
+        seed = seeds[i] if seeds else None
+        req = GenRequest(
+            prompt_ids=list(ids), max_new_tokens=n,
+            temperature=0.7 if seed is not None else 0.0, seed=seed,
+        )
+        return [t async for t in engine.generate(req)]
+
+    async def run():
+        outs = await asyncio.gather(*(one(i, p) for i, p in enumerate(prompts)))
+        await engine.wait_drained()
+        return outs
+
+    return asyncio.run(run())
+
+
+def _ab(bundle, params, prompts, *, seeds=None, n=8, legacy_kw=None,
+        ragged_kw=None, **common):
+    """(legacy streams, ragged streams) for the same staggered workload.
+    The legacy arm chunks EVERY prompt (chunk below the shortest prompt):
+    under kv_quant, full prefill attends live precision while chunked
+    prefill reads back what it quantized — different caches by design —
+    and the ragged scheduler is a chunked path by construction."""
+    legacy = _engine(bundle, params, chunked_prefill_size=4,
+                     **{**common, **(legacy_kw or {})})
+    a = _staggered(legacy, prompts, n=n, seeds=seeds)
+    legacy.stop()
+    ragged = _engine(bundle, params, scheduler="ragged",
+                     step_token_budget=12, **{**common, **(ragged_kw or {})})
+    b = _staggered(ragged, prompts, n=n, seeds=seeds)
+    stats = ragged.lifecycle_stats()
+    ragged.stop()
+    return a, b, stats
+
+
+def test_ragged_ab_dense_greedy_and_seeded(parts, monkeypatch):
+    """One mixed batch carries a GREEDY decode stream (row 0, seed None)
+    and a SEEDED temperature>0 admission (row 1) — both must replay the
+    two-dispatch arm exactly, serial pipeline."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    a, b, stats = _ab(bundle, params, [SHORT, LONG], seeds=[None, 22],
+                      cache_mode="dense", legacy_kw={"pipeline_depth": 1},
+                      ragged_kw={"pipeline_depth": 1})
+    assert a == b
+    assert stats["ragged"]["steps"] >= 2           # chunked admission ran
+    assert stats["ragged"]["step_rows"]["prefill"] >= 2
+    assert stats["ragged"]["step_rows"]["decode"] >= 1  # mixed launches
+
+
+def test_ragged_ab_paged_greedy_seeded_depth2(parts, monkeypatch):
+    """Paged backend at pipeline depth 2: ragged phases drain the
+    in-flight queue and reset the device chains; greedy + seeded streams
+    still replay the two-dispatch arm exactly (depth 1 is covered by the
+    dense cell above and the int8 cells below)."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    a, b, _ = _ab(
+        bundle, params, [SHORT, LONG], seeds=[None, 22],
+        cache_mode="paged",
+        legacy_kw={"pipeline_depth": 2},
+        ragged_kw={"pipeline_depth": 2},
+    )
+    assert a == b
+
+
+def test_ragged_ab_int8_kv(parts, monkeypatch):
+    """int8 KV through the ragged path: chunk K/V quantize via the same
+    _kv_store math and the ragged kernel/reference dequantizes like the
+    decode path — streams match the (fully chunked) two-dispatch arm on
+    BOTH backends."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    _, qbundle, params = parts
+    for cache_mode in ("dense", "paged"):
+        a, b, _ = _ab(qbundle, params, [SHORT, LONG], cache_mode=cache_mode)
+        assert a == b, cache_mode
+
+
+def test_ragged_prefix_cache_tail_chunks(parts, monkeypatch):
+    """Paged radix hits under the ragged scheduler: the shared run maps
+    into the slot's table by reference at job start and only the TAIL
+    rides the launches as chunk rows — warm streams replay the cold ones
+    exactly, under the armed KV sanitizer, leak-free."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    plain = _engine(bundle, params, cache_mode="paged",
+                    chunked_prefill_size=4, max_seq_len=160)
+    want = _staggered(plain, [LONG], n=6)
+    plain.stop()
+    cached = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=16, max_seq_len=160,
+                     prefix_cache=4, prefix_block=16)
+    first = _staggered(cached, [LONG], n=6)
+    second = _staggered(cached, [LONG], n=6)
+    assert cached._prefix.hits >= 1
+    pool = cached.paged_cache.pool
+    live = pool.num_pages - 1 - pool.free_pages
+    assert live == cached._prefix.cached_pages  # only the cache holds pages
+    cached.stop()
+    assert first == want
+    assert second == want
+
+
+def test_ragged_speculation_composes(parts):
+    """Spec decode runs in the pure-decode phases between admissions (the
+    jobs drain first); greedy streams stay identical to the plain ragged
+    engine."""
+    bundle, _, params = parts
+    prompt = [5, 9, 2, 17, 5, 9, 2]
+    plain = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                    step_token_budget=12)
+    want = _staggered(plain, [prompt], n=8)
+    plain.stop()
+    spec = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                   step_token_budget=12, speculation="ngram", spec_k=2,
+                   spec_ngram=2)
+    got = _staggered(spec, [prompt], n=8)
+    spec.stop()
+    assert got == want
+
+
+def test_ragged_budget_validation(parts):
+    bundle, _, params = parts
+    with pytest.raises(ValueError, match="step_token_budget"):
+        _engine(bundle, params, scheduler="ragged", step_token_budget=2)
+    with pytest.raises(ValueError, match="scheduler"):
+        _engine(bundle, params, scheduler="nope")
+
+
+def test_ragged_health_and_stats_blocks(parts):
+    bundle, _, params = parts
+    engine = _engine(bundle, params, scheduler="ragged", step_token_budget=16)
+    try:
+        assert engine._prefill_gate is None  # the gate is REPLACED
+        h = engine.health()
+        assert h["scheduler"] == "ragged"
+        assert h["ragged"]["step_token_budget"] == 16
+        s = engine.lifecycle_stats()["ragged"]
+        assert s["budget_utilization"]["count"] == 0
+        assert s["step_rows"] == {"prefill": 0, "decode": 0}
+    finally:
+        engine.stop()
+    legacy = _engine(bundle, params)
+    try:
+        assert legacy.lifecycle_stats()["ragged"] is None
+        assert legacy.health()["scheduler"] == "two_dispatch"
+    finally:
+        legacy.stop()
+
+
+# -- chaos ------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_fault_mid_ragged_dispatch_isolates_job(parts, monkeypatch):
+    """A poison attributed to the ADMISSION row of a mixed launch (fault at
+    the dispatch seam, before device work) fails that request structurally;
+    the decode rows keep streaming to completion with the exact tokens an
+    undisturbed run produces."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    marker = 251  # only in the admitted prompt
+    poisoned = list(LONG)
+    poisoned[7] = marker
+
+    clean = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                    step_token_budget=12)
+    want = _staggered(clean, [SHORT], n=8)[0]
+    clean.stop()
+
+    engine = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=12)
+    faults.configure([
+        {"point": "engine.decode", "action": "raise",
+         "match_token": marker, "times": 1},
+    ])
+    try:
+
+        async def run():
+            a = GenRequest(prompt_ids=list(SHORT), max_new_tokens=8)
+            a_task = asyncio.create_task(
+                _collect_async(engine, a)
+            )
+            # wait for the decode stream to be live, then admit the poison
+            while a.produced < 2:
+                await asyncio.sleep(0.005)
+            b = GenRequest(prompt_ids=poisoned, max_new_tokens=4)
+            b_err = None
+            try:
+                async for _ in engine.generate(b):
+                    pass
+            except Exception as ex:
+                b_err = ex
+            out_a = await asyncio.wait_for(a_task, 60)
+            await engine.wait_drained()
+            return out_a, b_err
+
+        out_a, b_err = asyncio.run(run())
+        assert b_err is not None          # job failed structurally
+        assert out_a == want              # decode rows survived, exactly
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1  # nothing leaked
+    finally:
+        faults.clear()
+        engine.stop()
+
+
+async def _collect_async(engine, req):
+    return [t async for t in engine.generate(req)]
+
+
+@pytest.mark.chaos
+def test_chaos_budget_admission_shed(parts, monkeypatch):
+    """``engine.admit.budget`` (faults.KNOWN_POINTS): an injected raise as
+    a job's chunk is admitted into a step's budget sheds that admission
+    with a structured 429; the shed books under reason="budget"."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    engine = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=12)
+    faults.configure([
+        {"point": "engine.admit.budget", "action": "raise", "times": 1},
+    ])
+    try:
+
+        async def run():
+            req = GenRequest(prompt_ids=list(LONG), max_new_tokens=4)
+            try:
+                async for _ in engine.generate(req):
+                    pass
+            except EngineOverloadedError as ex:
+                return ex
+            return None
+
+        err = asyncio.run(run())
+        assert err is not None and err.retry_after is not None
+        assert engine._class_sheds.get("budget", {}).get("interactive") == 1
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1
+        # the engine keeps serving afterwards
+        out = _staggered(engine, [SHORT], n=4)
+        assert len(out[0]) == 4
+    finally:
+        faults.clear()
+        engine.stop()
+
+
+def test_ragged_cancel_mid_admission_reclaims(parts, monkeypatch):
+    """Client disconnect while the prompt is mid-chunking: the job aborts
+    at the next step boundary and the slot's pages free (sanitizer-armed)."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    engine = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=8, max_seq_len=160)
+
+    async def run():
+        req = GenRequest(prompt_ids=list(LONG), max_new_tokens=4)
+        agen = engine.generate(req)
+        task = asyncio.ensure_future(agen.__anext__())
+        await asyncio.sleep(0.05)
+        req.cancel()
+        try:
+            await asyncio.wait_for(task, 30)
+        except BaseException:
+            pass
+        await agen.aclose()
+        await engine.wait_drained()
+
+    try:
+        asyncio.run(run())
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1
+    finally:
+        engine.stop()
+
+
+# -- committed CPU smoke artifact -------------------------------------------
+
+def test_ragged_ab_artifact_schema():
+    """benchmarks/RAGGED_AB_cpu.json (committed by ``bench.py --ragged-ab``)
+    carries the acceptance headline: byte-identical streams across
+    schedulers and decode-stall-during-admission STRICTLY below the
+    two-dispatch arm (ISSUE 9 acceptance)."""
+    path = REPO / "benchmarks" / "RAGGED_AB_cpu.json"
+    row = json.loads(path.read_text())
+    assert row["metric"] == "llm_ragged_scheduler_ab_cpusmoke"
+    assert row["identical_tokens"] is True
+    assert (
+        row["ragged"]["decode_stall_ms"]
+        < row["two_dispatch"]["decode_stall_ms"]
+    )
+    for arm in ("two_dispatch", "ragged"):
+        assert row[arm]["tok_s"] > 0
+        assert row[arm]["admit_ttft_ms"] > 0
+        assert row[arm]["ttft_p99_ms"] >= row[arm]["ttft_p50_ms"]
+        assert 0 < row[arm]["occupancy"] <= row["batch"]

@@ -1,0 +1,204 @@
+"""Ragged paged attention kernel tests (docs/ragged_attention.md): the
+mixed prefill+decode Pallas kernel (interpret mode) against the ragged XLA
+reference, the ragged reference against the per-row decode/dense references,
+and the layout helper's q-block contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.ops.paged_attention import (
+    paged_attention_xla,
+    ragged_layout,
+    ragged_paged_attention,
+    ragged_paged_attention_xla,
+)
+
+
+def _quantize_pool(pool):
+    """Per-(token, head) symmetric int8, mirroring models/llama._kv_store."""
+    x = np.asarray(pool, np.float32)
+    absmax = np.abs(x).max(axis=-1)
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(x / scale[..., None]), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale)
+
+
+def _setup(key, *, rows=4, hkv=2, g=2, d=64, page=16, pages_per_seq=6,
+           row_lens=(1, 5, 1, 12), kv_extra=(7, 0, 30, 0), q_block=8):
+    """Build a mixed batch: row_lens[r] query tokens per row (1 = decode),
+    kv_lens = history + chunk. Returns the full operand set plus the
+    layout metadata."""
+    ks = jax.random.split(key, 3)
+    n_pages = rows * pages_per_seq + 1
+    k_pool = jax.random.normal(ks[0], (hkv, n_pages, page, d), jnp.float32)
+    v_pool = jax.random.normal(ks[1], (hkv, n_pages, page, d), jnp.float32)
+    page_table = np.zeros((rows, pages_per_seq), np.int32)
+    for r in range(rows):
+        page_table[r] = 1 + r * pages_per_seq + np.arange(pages_per_seq)
+    row_lens = np.asarray(row_lens, np.int32)
+    kv_lens = row_lens + np.asarray(kv_extra, np.int32)
+    assert kv_lens.max() <= pages_per_seq * page
+    starts, block_rows, block_q0, t_pad = ragged_layout(
+        row_lens, q_block=q_block
+    )
+    q = jax.random.normal(ks[2], (t_pad, hkv, g, d), jnp.float32)
+    return (
+        q, k_pool, v_pool, jnp.asarray(page_table), jnp.asarray(kv_lens),
+        jnp.asarray(starts), jnp.asarray(row_lens),
+        jnp.asarray(block_rows), jnp.asarray(block_q0),
+    )
+
+
+def test_ragged_layout_alignment():
+    starts, block_rows, block_q0, t_pad = ragged_layout([1, 5, 0, 12], 8)
+    assert t_pad % 8 == 0
+    # every row starts on a q-block boundary; idle rows own no block
+    assert all(int(s) % 8 == 0 for s in starts)
+    assert list(block_rows) == [0, 1, 3, 3]
+    assert list(block_q0) == [0, 0, 0, 8]
+    # fixed `total` pads with unowned blocks (static engine shapes)
+    _, br2, _, t2 = ragged_layout([1, 5, 0, 12], 8, total=48)
+    assert t2 == 48 and list(br2[4:]) == [-1, -1]
+    with pytest.raises(ValueError):
+        ragged_layout([64], 8, total=32)
+
+
+def test_ragged_xla_decode_rows_match_decode_reference():
+    """All-decode ragged batch == the decode reference, row for row."""
+    args = _setup(jax.random.PRNGKey(0), row_lens=(1, 1, 1, 1),
+                  kv_extra=(4, 17, 30, 0))
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     _br, _bq) = args
+    out = ragged_paged_attention_xla(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens
+    )
+    # the decode reference consumes one query per row
+    q_rows = jnp.stack([q[int(s)] for s in starts])        # [R, Hkv, G, D]
+    ref = paged_attention_xla(q_rows, k_pool, v_pool, page_table, kv_lens)
+    for r, s in enumerate(np.asarray(starts)):
+        np.testing.assert_allclose(
+            np.asarray(out[int(s)]), np.asarray(ref[r]), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_ragged_xla_prefill_row_matches_dense_causal():
+    """A prefill row's chunk must see its history + its own causal
+    triangle — checked against an explicit dense softmax."""
+    args = _setup(
+        jax.random.PRNGKey(1), rows=1, hkv=2, g=2, d=32, page=8,
+        pages_per_seq=4, row_lens=(6,), kv_extra=(10,),
+    )
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     _br, _bq) = args
+    out = ragged_paged_attention_xla(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens
+    )
+    kv_len, row_len = int(kv_lens[0]), int(row_lens[0])
+    base = kv_len - row_len
+    pages = np.asarray(page_table[0])
+    k = np.asarray(k_pool[:, pages]).reshape(2, -1, 32)
+    v = np.asarray(v_pool[:, pages]).reshape(2, -1, 32)
+    for i in range(row_len):
+        bound = base + i + 1
+        qi = np.asarray(q[i])                               # [Hkv, G, D]
+        for h in range(2):
+            scores = qi[h] @ k[h, :bound].T * (32 ** -0.5)  # [G, bound]
+            p = np.exp(scores - scores.max(axis=-1, keepdims=True))
+            p = p / p.sum(axis=-1, keepdims=True)
+            want = p @ v[h, :bound]
+            np.testing.assert_allclose(
+                np.asarray(out[i, h]), want, rtol=1e-5, atol=1e-5
+            )
+
+
+@pytest.mark.parametrize("page", [16, 32])
+@pytest.mark.parametrize("pages_per_block", [1, 2, 4])
+def test_ragged_kernel_interpret_matches_xla(page, pages_per_block):
+    """Mixed row phases x page sizes x DMA block sizes, including a partial
+    final chunk (kv not page-aligned) and an idle row."""
+    args = _setup(
+        jax.random.PRNGKey(2), rows=5, hkv=2, g=2, d=64, page=page,
+        pages_per_seq=4, row_lens=(1, 9, 1, 13, 0),
+        kv_extra=(page * 2 + 3, 5, 0, 7, 0),
+    )
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     block_rows, block_q0) = args
+    ref = ragged_paged_attention_xla(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens
+    )
+    out = ragged_paged_attention(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+        block_rows=block_rows, block_q0=block_q0,
+        pages_per_block=pages_per_block, interpret=True,
+    )
+    # compare only owned tokens (unowned blocks hold zeros in both)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("page", [16, 32])
+def test_ragged_kernel_int8_interpret_matches_xla(page):
+    """int8 pools + pre-gathered per-row scale operands through the ragged
+    kernel (interpret) against the ragged XLA dequant reference."""
+    args = _setup(
+        jax.random.PRNGKey(3), rows=4, hkv=2, g=2, d=64, page=page,
+        pages_per_seq=4, row_lens=(1, 7, 1, 10),
+        kv_extra=(page + 1, 3, 2 * page, 0),
+    )
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     block_rows, block_q0) = args
+    k8, ks = _quantize_pool(k_pool)
+    v8, vs = _quantize_pool(v_pool)
+    ref = ragged_paged_attention_xla(
+        q, k8, v8, page_table, kv_lens, starts, row_lens, ks, vs
+    )
+    out = ragged_paged_attention(
+        q, k8, v8, page_table, kv_lens, starts, row_lens,
+        block_rows=block_rows, block_q0=block_q0,
+        k_scale=ks, v_scale=vs, pages_per_block=2, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+    # dequant correctness vs a dequantized-pool run (same tolerance class
+    # as the decode kernel's int8 test)
+    kd = (np.asarray(k8, np.float32) * np.asarray(ks)[..., None])
+    vd = (np.asarray(v8, np.float32) * np.asarray(vs)[..., None])
+    dense = ragged_paged_attention_xla(
+        q, jnp.asarray(kd), jnp.asarray(vd), page_table, kv_lens, starts,
+        row_lens,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ragged_int8_requires_scales():
+    args = _setup(jax.random.PRNGKey(4), row_lens=(1, 3, 1, 1))
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     _br, _bq) = args
+    k8, _ks = _quantize_pool(k_pool)
+    v8, _vs = _quantize_pool(v_pool)
+    with pytest.raises(ValueError):
+        ragged_paged_attention(
+            q, k8, v8, page_table, kv_lens, starts, row_lens, interpret=True
+        )
+
+
+def test_ragged_without_block_map_falls_back_to_xla():
+    """No block metadata -> the XLA reference (identical output), never a
+    kernel crash: jitted callers may omit the host-only layout."""
+    args = _setup(jax.random.PRNGKey(5), row_lens=(1, 4, 1, 1))
+    (q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+     _br, _bq) = args
+    a = ragged_paged_attention(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens,
+        interpret=True,
+    )
+    b = ragged_paged_attention_xla(
+        q, k_pool, v_pool, page_table, kv_lens, starts, row_lens
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))

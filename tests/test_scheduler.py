@@ -569,3 +569,63 @@ def test_preempt_budget_makes_request_immune(parts):
     engine = asyncio.run(run())
     assert engine.counters["preemptions"] == 0
     engine.stop()
+
+
+# -- ragged scheduler: brownout on the token budget ---------------------------
+
+
+def test_brownout_stage3_shrinks_ragged_step_token_budget(parts):
+    """The legacy stage-3 hook was _prefill_gate.set_budget(1); under the
+    ragged scheduler the gate no longer exists — stage 3 must instead
+    shrink the effective step token budget, so decode slots drain ahead of
+    new admission chunks, and restore it when the stage drops
+    (docs/ragged_attention.md)."""
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, brownout=True, brownout_dwell=120.0,
+        scheduler="ragged", step_token_budget=128,
+    )
+    try:
+        assert engine._prefill_gate is None  # the gate is gone in ragged mode
+        assert engine._effective_token_budget() == 128
+        engine._brownout.stage = 3
+        engine._brownout._changed_at = time.monotonic()
+        shrunk = engine._effective_token_budget()
+        assert shrunk < 128
+        assert shrunk > engine.max_batch  # decode rows always still fit
+        assert engine.lifecycle_stats()["ragged"]["effective_budget"] == shrunk
+        # admission work under stage 3 is bounded by the shrunken budget:
+        # a planned step may hand prefill jobs at most shrunk - n_decode
+        # tokens, exactly the legacy drain-ahead-of-admissions behavior
+        engine._brownout.stage = 0
+        assert engine._effective_token_budget() == 128
+    finally:
+        engine.stop()
+
+
+def test_brownout_stage3_still_sets_gate_budget_on_two_dispatch(parts):
+    """Legacy two-dispatch engines keep the historical gate hook: the
+    stage transition shrinks the per-chunk segment budget to 1 and
+    restores the configured value on the way down."""
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, brownout=True, brownout_dwell=0.0,
+        prefill_segments_per_decode=3,
+    )
+    try:
+        gate = engine._prefill_gate
+        assert gate is not None and gate._spc == 3
+        engine._brownout_checked = 0.0
+        engine._brownout.update = lambda *a, **k: 3  # force stage
+        engine._brownout.stage = 0
+        engine._update_brownout()
+        assert gate._spc == 1
+        engine._brownout.update = lambda *a, **k: 0
+        engine._brownout.stage = 3
+        engine._brownout_checked = 0.0
+        engine._update_brownout()
+        assert gate._spc == 3
+    finally:
+        engine.stop()
