@@ -640,6 +640,242 @@ def run_ragged_ab(
     }
 
 
+def run_kv_tier_ab(
+    cfg: dict,
+    *,
+    n_prefixes: int = 3,
+    prefix_len: int = 768,
+    tail_len: int = 12,
+    new_tokens: int = 8,
+    decode_tokens: int = 48,
+    page_size: int = 16,
+    prefix_block: int = 16,
+    device_cache_pages: int = 48,
+    host_pages: int = 160,
+    max_seq_len: int = 832,
+    num_pages: int = 192,
+) -> dict:
+    """Host-RAM KV tiering A/B on the real engine (docs/kv_tiering.md):
+    a constrained-HBM trace whose shared-prefix WORKING SET exceeds the
+    device-side prefix-cache budget. Two engines differ only in the host
+    tier: the tiered arm demotes evicted runs to pinned host RAM and
+    re-onlines them on a hit via async DMA; the untiered arm drops them
+    (the pre-tier behavior) and every revisit of an evicted prefix pays a
+    cold prefill.
+
+    Reports warm-TTFT by serving tier {hbm hit, host hit, cold}, the
+    promotion DMA overlap ratio (share of the copy hidden behind other
+    device work, observed at retire reaps), tok/s of a decode stream
+    running CONCURRENTLY with the warm sweep, and stream byte-identity: a
+    demoted-then-promoted run must produce the same tokens as the
+    always-resident warm hit, under the armed KV sanitizer."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    os.environ.setdefault("TPUSERVE_SANITIZE", "1")
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    prompts = [
+        [(13 * i + 5 * j) % 250 + 1 for j in range(prefix_len + tail_len)]
+        for i in range(n_prefixes)
+    ]
+    decode_prompt = [(17 * j + 11) % 250 + 1 for j in range(tail_len)]
+    blocks_per_prompt = (prefix_len + tail_len - 1) // prefix_block
+    working_set_pages = n_prefixes * blocks_per_prompt * (
+        prefix_block // page_size
+    )
+
+    def measure(tiered: bool):
+        engine = LLMEngineCore(
+            bundle, params,
+            max_batch=2,
+            max_seq_len=max_seq_len,
+            # the cold bucket covers the whole prompt; the warm tail rides
+            # the small chunk bucket (prefix + one chunk must fit too)
+            prefill_buckets=[16, 32, 64, prefix_len + 2 * prefix_block],
+            eos_token_id=None,
+            decode_steps=2,
+            cache_mode="paged",
+            page_size=page_size,
+            num_pages=num_pages,
+            prefix_cache=256,
+            prefix_block=prefix_block,
+            prefix_cache_pages=device_cache_pages,
+            prefix_cache_host_pages=host_pages if tiered else None,
+        )
+
+        async def one(ids, n, stamps=None):
+            req = GenRequest(
+                prompt_ids=list(ids), max_new_tokens=n, temperature=0.0
+            )
+            out, t0 = [], time.perf_counter()
+            async for tok in engine.generate(req):
+                if stamps is not None:
+                    stamps.append(time.perf_counter())
+                elif not out:
+                    stamps_first[0] = time.perf_counter() - t0
+                out.append(tok)
+            return out
+
+        stamps_first = [0.0]
+
+        async def one_drained(ids, n):
+            out = await one(ids, n)
+            # each sequential request runs in its own asyncio.run: the
+            # engine loop must drain before that event loop closes
+            await engine.wait_drained()
+            return out
+
+        def timed(ids, n=new_tokens):
+            """(stream, ttft_s, tier) — tier classified from the cache's
+            hit counters around the request."""
+            s0 = engine._prefix.stats()
+            stream = asyncio.run(one_drained(ids, n))
+            s1 = engine._prefix.stats()
+            if s1["hits_by_tier"]["host"] > s0["hits_by_tier"]["host"]:
+                tier = "host"
+            elif s1["hits_by_tier"]["hbm"] > s0["hits_by_tier"]["hbm"]:
+                tier = "hbm"
+            else:
+                tier = "cold"
+            return stream, stamps_first[0], tier
+
+        # warmup: compile every shape off the clock (prefill buckets, the
+        # radix-hit gather + tail chunk, decode chunk, promotion scatter)
+        warm_ids = [(3 * j + 7) % 250 + 1 for j in range(prefix_len + tail_len)]
+        timed(warm_ids)
+        timed(warm_ids)
+        if tiered:
+            engine._prefix.spill(0)
+            timed(warm_ids)  # host-hit shapes (promotion scatter) compile
+        # cold pass: working set exceeds the device budget, so the tiered
+        # arm demotes older runs as it goes and the untiered arm drops them
+        cold_ttfts, cold_streams = [], []
+        for ids in prompts:
+            stream, ttft, _tier = timed(ids)
+            cold_ttfts.append(ttft)
+            cold_streams.append(stream)
+        # byte-identity pair on the LAST prefix (still resident): resident
+        # warm hit vs demoted-then-promoted warm hit
+        resident_stream, resident_ttft, resident_tier = timed(prompts[-1])
+        identical = True
+        if tiered:
+            engine._prefix.spill(0)
+            promoted_stream, _t, promoted_tier = timed(prompts[-1])
+            identical = (
+                promoted_stream == resident_stream
+                and promoted_tier == "host"
+                and resident_tier == "hbm"
+            )
+        # warm sweep over the whole working set with a CONCURRENT decode
+        # stream (does the promotion DMA steal from live decodes?)
+        sweep: dict = {"ttft": {"hbm": [], "host": [], "cold": []},
+                       "hits": {"hbm": 0, "host": 0, "cold": 0}}
+        decode_stamps: list = []
+
+        async def sweep_group():
+            decode_task = asyncio.create_task(
+                one(decode_prompt, decode_tokens, stamps=decode_stamps)
+            )
+            while len(decode_stamps) < 2:
+                await asyncio.sleep(0.002)
+            for ids in prompts:
+                s0 = engine._prefix.stats()
+                t0 = time.perf_counter()
+                req = GenRequest(
+                    prompt_ids=list(ids), max_new_tokens=new_tokens,
+                    temperature=0.0,
+                )
+                first = None
+                async for _tok in engine.generate(req):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                s1 = engine._prefix.stats()
+                if s1["hits_by_tier"]["host"] > s0["hits_by_tier"]["host"]:
+                    tier = "host"
+                elif s1["hits_by_tier"]["hbm"] > s0["hits_by_tier"]["hbm"]:
+                    tier = "hbm"
+                else:
+                    tier = "cold"
+                sweep["ttft"][tier].append(first)
+                sweep["hits"][tier] += 1
+            await decode_task
+            await engine.wait_drained()
+
+        asyncio.run(sweep_group())
+        decode_tok_s = (
+            (len(decode_stamps) - 1)
+            / max(1e-9, decode_stamps[-1] - decode_stamps[0])
+        )
+        tier_stats = (engine.lifecycle_stats() or {}).get("kv_tier") or {}
+        sanitizer = (
+            engine._sanitizer.stats()
+            if engine._sanitizer is not None
+            else {"checks": 0, "failures": 0}
+        )
+        engine.stop()
+
+        def med(xs):
+            xs = sorted(xs)
+            return round(xs[len(xs) // 2] * 1e3, 3) if xs else None
+
+        return {
+            "cold_streams": cold_streams,
+            "identical": identical,
+            "ttft_ms": {
+                "cold": med(cold_ttfts),
+                "hbm": med(sweep["ttft"]["hbm"]),
+                "host": med(sweep["ttft"]["host"]),
+                "warm_cold": med(sweep["ttft"]["cold"]),
+            },
+            "warm_hits": dict(sweep["hits"]),
+            "decode_tok_s": round(decode_tok_s, 2),
+            "promo_overlap_ratio": tier_stats.get("promo_overlap_ratio"),
+            "demotions": tier_stats.get("demotions", 0),
+            "promotions": tier_stats.get("promotions", 0),
+            "sanitizer_checks": sanitizer["checks"],
+            "sanitizer_violations": sanitizer["failures"],
+        }
+
+    tiered = measure(True)
+    untiered = measure(False)
+    identical = (
+        tiered.pop("identical")
+        and tiered["cold_streams"] == untiered["cold_streams"]
+    )
+    untiered.pop("identical", None)
+    tiered.pop("cold_streams")
+    untiered.pop("cold_streams")
+    cold = tiered["ttft_ms"]["cold"]
+    host = tiered["ttft_ms"]["host"]
+    return {
+        "metric": "llm_kv_tier_ab",
+        # headline: how many cold-prefill TTFTs one host-tier warm hit saves
+        "value": round(cold / host, 2) if (cold and host) else None,
+        "unit": "x cold-prefill TTFT over host-tier warm TTFT",
+        "tiered": tiered,
+        "untiered": untiered,
+        "identical_streams": identical,
+        "n_prefixes": n_prefixes,
+        "prefix_len": prefix_len,
+        "working_set_pages": working_set_pages,
+        "device_cache_pages": device_cache_pages,
+        "host_pages": host_pages,
+        "page_size": page_size,
+        "cpus": os.cpu_count() or 1,
+        "note": (
+            "working set > device prefix-cache budget by construction: the "
+            "untiered arm re-prefills evicted prefixes cold; the tiered arm "
+            "serves them from host RAM with the promotion DMA overlapped "
+            "with the tail prefill (overlap observed at retire reaps)"
+        ),
+    }
+
+
 def run_paged_quant_ab(
     cfg: dict,
     *,
@@ -1179,6 +1415,41 @@ def _ragged_ab_smoke() -> None:
     print(json.dumps(row))
 
 
+def _kv_tier_ab_smoke() -> None:
+    """CPU smoke for ``--kv-tier-ab`` (acceptance: byte-identical streams
+    for a demoted-then-promoted run vs the always-resident warm hit under
+    the armed sanitizer, and host-tier warm TTFT well under cold-prefill
+    TTFT on a working set larger than the device prefix-cache budget).
+    Updates benchmarks/KV_TIER_AB_cpu.json (asserted by tier-1). Knobs:
+    BENCH_TIER_PREFIXES / BENCH_TIER_PREFIX_LEN / BENCH_TIER_HOST_PAGES /
+    BENCH_TIER_DEVICE_PAGES."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    row = run_kv_tier_ab(
+        # int8 KV: the tier holds int8 pages + scale rows (the 2x-cheaper
+        # representation the design banks on)
+        {"preset": "llama-tiny", "dtype": "float32", "kv_quant": "int8"},
+        n_prefixes=int(os.environ.get("BENCH_TIER_PREFIXES", 3)),
+        prefix_len=int(os.environ.get("BENCH_TIER_PREFIX_LEN", 768)),
+        device_cache_pages=int(os.environ.get("BENCH_TIER_DEVICE_PAGES", 48)),
+        host_pages=int(os.environ.get("BENCH_TIER_HOST_PAGES", 160)),
+    )
+    row["metric"] += "_cpusmoke"
+    row["platform"] = "cpu"
+    artifact = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "KV_TIER_AB_cpu.json",
+    )
+    with open(artifact, "w") as f:
+        json.dump(row, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(row))
+
+
 def _paged_quant_ab_smoke() -> None:
     """CPU smoke for ``--paged-quant-ab`` (acceptance: >= 1.8x pool-bytes
     reduction at equal page budget, no step-time regression, Pallas int8
@@ -1327,6 +1598,10 @@ if __name__ == "__main__":
         os.environ.get("BENCH_SCENARIO") == "ragged_ab"
     ):
         _ragged_ab_smoke()
+    elif "--kv-tier-ab" in sys.argv or (
+        os.environ.get("BENCH_SCENARIO") == "kv_tier_ab"
+    ):
+        _kv_tier_ab_smoke()
     elif "--paged-quant-ab" in sys.argv or (
         os.environ.get("BENCH_SCENARIO") == "paged_quant_ab"
     ):

@@ -265,6 +265,33 @@ def main() -> int:
         emit({"metric": "llm_ragged_scheduler_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t6, 1)})
 
+    # -- phase 9: host-RAM KV tiering A/B (docs/kv_tiering.md) --------------
+    # constrained-HBM shared-prefix trace on 8B int8-KV shapes: warm TTFT
+    # by serving tier {hbm, host, cold}, promotion DMA overlap ratio, and
+    # tok/s of a concurrent decode stream — on a real chip the promotion
+    # hides behind the tail prefill's compute, which the 1-core CPU smoke
+    # can only approximate
+    t7 = time.time()
+    try:
+        row = bench.run_kv_tier_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "scan_layers": True,
+             "kv_quant": "int8"},
+            n_prefixes=3, prefix_len=768, tail_len=32,
+            # int8 paged tile is (32, 128): 32-token pages keep the Pallas
+            # kernel engaged (docs/paged_kv_quant.md)
+            page_size=32, prefix_block=32,
+            device_cache_pages=24, host_pages=96,
+            max_seq_len=1024, num_pages=160,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t7, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_kv_tier_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t7, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
@@ -274,6 +301,7 @@ def main() -> int:
         "loadtest_wall_s": round(time.time() - t4, 1),
         "int4_ab_wall_s": round(time.time() - t5, 1),
         "ragged_ab_wall_s": round(time.time() - t6, 1),
+        "kv_tier_ab_wall_s": round(time.time() - t7, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

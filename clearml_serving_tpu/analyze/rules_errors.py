@@ -46,6 +46,8 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.pool",
     "engine.preempt",
     "engine.release",
+    "engine.kv.demote",
+    "engine.kv.promote",
     "grpc.call",
 })
 

@@ -54,6 +54,14 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "_leaf_nodes": ("_lock", None),
     "_n_nodes": ("_lock", None),
     "_clock": ("_lock", None),
+    # host-RAM KV tier (docs/kv_tiering.md): the cache's resident frontier
+    # + per-tier accounting, and the HostKVTier id allocator (kv_cache.py;
+    # its "_free"/"_used" ride the existing "_free" entry and this one)
+    "_frontier": ("_lock", None),
+    "_n_resident": ("_lock", None),
+    "_host_pages": ("_lock", ("self", "cache", "prefix", "_prefix")),
+    "_host_bytes": ("_lock", None),
+    "_used": ("_lock", ("self", "tier", "host_tier", "host")),
     # PagedKVCache pool handles: a donating dispatch invalidates the old
     # handle, so rebinds happen only under the dispatch lock. Receiver-
     # filtered to the engine's naming for the paged cache object; inside
@@ -67,6 +75,11 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
         "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
     ),
     "v_scale": (
+        "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
+    ),
+    # in-flight host->device promotion records (docs/kv_tiering.md):
+    # appended at copy-enqueue (dispatch path), drained at retire reaps
+    "_promotions": (
         "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
     ),
     # SLO scheduler pending-queue state (engine._ClassedPendingQueue,

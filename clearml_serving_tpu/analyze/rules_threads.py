@@ -98,6 +98,9 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # ragged scheduler job list (docs/ragged_attention.md): the loop opens,
     # shares out, and retires jobs; dispatch workers only read plan dicts
     "_prefill_jobs": (LOOP, ("self", "engine")),
+    # host-tier promotion reap counters (docs/kv_tiering.md): bumped only
+    # at loop-thread retire boundaries
+    "_tier_counters": (LOOP, ("self", "engine")),
     # device-resident cross-chunk chains: written by the dispatch worker
     # (the only stage that runs device programs); the loop resets them only
     # at protocol-serialized points (annotated at the definition site)

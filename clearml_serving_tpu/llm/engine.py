@@ -545,7 +545,7 @@ class LLMEngineCore:
         "loop": (
             "_inflight", "_quarantine", "_dispatching", "_slot_req",
             "_admitting", "_next_token", "_gstate", "_slot_overrides",
-            "_prefill_jobs",
+            "_prefill_jobs", "_tier_counters",
         ),
         "worker": ("_next_token_dev", "_gstate_dev"),
     }
@@ -584,6 +584,13 @@ class LLMEngineCore:
         prefix_block: int = 64,
         prefix_cache_bytes: Optional[int] = None,
         prefix_cache_pages: Optional[int] = None,
+        # host-RAM KV tier (docs/kv_tiering.md, paged backend only): number
+        # of preallocated host pages behind the prefix cache — device-budget
+        # eviction demotes cached runs there instead of dropping them, and a
+        # hit on a demoted run re-onlines via async DMA overlapped with the
+        # tail prefill. None/0 disables (legacy drop-on-evict).
+        prefix_cache_host_pages: Optional[int] = None,
+        prefix_cache_host_bytes: Optional[int] = None,
         logprobs_k: int = 20,  # OpenAI's top_logprobs ceiling
         tokenizer=None,  # required for guided decoding (token byte tables)
         # -- request-lifecycle hardening (None disables each knob; the
@@ -643,6 +650,27 @@ class LLMEngineCore:
         if cache_mode not in ("dense", "paged"):
             raise ValueError("cache_mode must be 'dense' or 'paged'")
         self.cache_mode = cache_mode
+        # host-tier knob validation (docs/kv_tiering.md): a budget that
+        # silently does nothing reads as "tiering on" to the operator —
+        # fail at construction (= endpoint load) naming the knob instead
+        if prefix_cache_host_bytes and not prefix_cache_host_pages:
+            raise ValueError(
+                "prefix_cache_host_bytes (aux engine.prefix_cache_host_mb) "
+                "is set but the host tier is disabled: set "
+                "prefix_cache_host_pages (aux "
+                "engine.prefix_cache_host_pages) to enable it"
+            )
+        if prefix_cache_host_pages and (
+            cache_mode != "paged"
+            or not prefix_cache
+            or not hasattr(bundle, "prefill_chunk")
+        ):
+            raise ValueError(
+                "prefix_cache_host_pages needs cache_mode='paged' and a "
+                "prefix_cache on a bundle with prefill_chunk (the host "
+                "tier spills the paged radix prefix cache; "
+                "docs/kv_tiering.md)"
+            )
         # -- ragged scheduling (docs/ragged_attention.md) ------------------
         # resolved EARLY: the dense cache slack and the prefill gate both
         # depend on the scheduler choice
@@ -1087,6 +1115,9 @@ class LLMEngineCore:
         # dispatch/retire stage timing for the lifecycle collector
         self._hist_dispatch = _MsHistogram()
         self._hist_retire = _MsHistogram()
+        # host-tier promotion reaping (docs/kv_tiering.md): loop-affine —
+        # completed promotion DMAs are observed at retire boundaries
+        self._tier_counters = {"reaps": 0}
 
         # -- compiled functions --------------------------------------------
         # frozen config the traced closures need is captured as LOCALS, not
@@ -1193,10 +1224,22 @@ class LLMEngineCore:
                     sum(self.paged_cache.pool_bytes().values())
                     // pool.num_pages
                 )
+                # host-RAM tier (docs/kv_tiering.md): preallocate the host
+                # page slabs and hand the cache the demote/promote backend —
+                # leaf-LRU eviction then spills to host RAM instead of
+                # dropping, and warm TTFT becomes capacity-planned
+                tier_backend = None
+                if prefix_cache_host_pages:
+                    self.paged_cache.enable_host_tier(
+                        int(prefix_cache_host_pages)
+                    )
+                    tier_backend = self.paged_cache
                 self._prefix = RadixPrefixCache(
                     int(prefix_cache), block, max_bytes=prefix_cache_bytes,
                     max_pages=prefix_cache_pages, pool=pool,
                     page_bytes=page_bytes,
+                    backend=tier_backend,
+                    host_max_bytes=prefix_cache_host_bytes,
                 )
                 paged_quant = self._paged_quant
 
@@ -1914,6 +1957,16 @@ class LLMEngineCore:
             budget = self._step_token_budget
             waste = self.max_batch * (qb - 1) if qb > 1 else 0
             self._ragged_tpad = -(-(budget + waste) // qb) * qb
+
+            def _gather_finish_logits(logits, rows):
+                # only the FINISHING admission rows' logits leave the
+                # device: retire used to read back the full [R, vocab]
+                # matrix every step that completed a job (8B: R x 128k
+                # f32), when it only ever consumes the finishing rows —
+                # row lists pad to a power of two so traces stay bounded
+                return logits[rows]
+
+            self._gather_finish_jit = jax.jit(_gather_finish_logits)
 
         # runtime KV/refcount sanitizer (llm/kv_sanitizer.py): armed via
         # TPUSERVE_SANITIZE=1 (tests arm it for the chaos + paged suites).
@@ -2774,6 +2827,55 @@ class LLMEngineCore:
             page_size=self.paged_cache.pool.page_size,
         )
 
+    def _reap_promotions(self, force: bool = False) -> None:
+        """Loop-thread: retire-stage observation of completed host-tier
+        promotion DMAs (docs/kv_tiering.md). A no-op without a host tier;
+        ``force`` blocks on stragglers (drain/stop)."""
+        pc = self.paged_cache
+        if pc is None or pc.host_tier is None:
+            return
+        reaped = pc.reap_promotions(force=force)
+        if reaped:
+            self._tier_counters["reaps"] += reaped
+
+    def _kv_tier_snapshot(self):
+        """Host-tier capacity/movement block shared by health() and
+        lifecycle_stats() (docs/kv_tiering.md). None when no tier."""
+        pc = self.paged_cache
+        if pc is None or pc.host_tier is None:
+            return None
+        backend = pc.tier_stats()
+        prefix = self._prefix.stats() if self._prefix is not None else {}
+        page_bytes = sum(pc.pool_bytes().values()) // pc.pool.num_pages
+        return {
+            "pages": {
+                "hbm": prefix.get("cached_pages", 0),
+                "host": prefix.get("host_pages", 0),
+            },
+            "bytes": {
+                "hbm": prefix.get("cached_bytes", 0),
+                "host": prefix.get("host_bytes", 0),
+            },
+            "nodes": {
+                "hbm": (
+                    prefix.get("nodes", 0) - prefix.get("host_nodes", 0)
+                ),
+                "host": prefix.get("host_nodes", 0),
+            },
+            "demotions": prefix.get("demotions", 0),
+            "promotions": prefix.get("promotions", 0),
+            "hits_by_tier": prefix.get("hits_by_tier", {}),
+            "host_pages_capacity": backend["host_pages_capacity"],
+            "host_pages_used": backend["host_pages_used"],
+            "demoted_pages_total": backend["demoted_pages_total"],
+            "promoted_pages_total": backend["promoted_pages_total"],
+            "promo_overlap_ratio": backend["overlap_ratio"],
+            "promo_wait_ms": backend["promo_wait_ms"],
+            "promo_total_ms": backend["promo_total_ms"],
+            "reaps": self._tier_counters["reaps"],
+            "page_bytes": page_bytes,
+        }
+
     def health(self) -> dict:
         return {
             "ready": self.is_ready,
@@ -2802,6 +2904,7 @@ class LLMEngineCore:
                 else None
             ),
             "kv_pool": self._kv_pool_snapshot(),
+            "kv_tier": self._kv_tier_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
@@ -2854,6 +2957,7 @@ class LLMEngineCore:
                 else None
             ),
             "kv_pool": self._kv_pool_snapshot(),
+            "kv_tier": self._kv_tier_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
@@ -4271,6 +4375,13 @@ class LLMEngineCore:
             + [j.request for j, _ in shares],
             "exhausted": [],
             "failed_jobs": [],
+            # rows whose admission completes THIS step (host-known at
+            # planning time): the dispatch worker gathers only these rows'
+            # logits device-side before readback
+            "finish_slots": [
+                job.slot for job, take in shares
+                if job.pos + take >= len(job.request.prompt_ids)
+            ],
         }
         job_of = {job.slot: job for job, _ in shares}
         take_of = {job.slot: take for job, take in shares}
@@ -4489,6 +4600,20 @@ class LLMEngineCore:
             )
         if use_extras:
             self._counts_dev = new_counts
+        # finishing-row logit gather: keep only rows whose admission
+        # completes this step (minus any the pool-exhaustion path dropped)
+        # — the [R, vocab] matrix never crosses the device boundary
+        finish = [
+            s for s in plan["finish_slots"]
+            if self.cache_mode != "paged" or s in plan["spans"]
+        ]
+        if finish:
+            pad = 1 << (len(finish) - 1).bit_length()
+            rows = np.zeros(pad, np.int32)
+            rows[: len(finish)] = finish
+            logits = self._gather_finish_jit(logits, jnp.asarray(rows))
+        else:
+            logits = None
         self._last_progress = time.monotonic()
         self._hist_dispatch.observe((time.perf_counter() - t0) * 1e3)
         return {
@@ -4496,6 +4621,7 @@ class LLMEngineCore:
             "logits": logits,
             "lp": lp,
             "gstate": gstate_out if gtables is not None else None,
+            "finish_rows": finish,
         }
 
     async def _ragged_step(self, active_mask: np.ndarray, epoch: int) -> None:
@@ -4675,9 +4801,13 @@ class LLMEngineCore:
                 self._free_ragged_slot(job.slot)
                 continue
             if logits_np is None:
+                # [F, vocab]: only the finishing rows were read back
                 logits_np = np.asarray(result["logits"])
+                finish_index = {
+                    s: i for i, s in enumerate(result["finish_rows"])
+                }
             first_id, first_lp = self._first_token_from_logits(
-                request, jnp.asarray(logits_np[job.slot][None])
+                request, jnp.asarray(logits_np[finish_index[job.slot]][None])
             )
             if self.cache_mode == "paged" and self._prefix is not None:
                 # zero-copy store, same point as the legacy commit: the
@@ -4688,6 +4818,8 @@ class LLMEngineCore:
                     self.paged_cache.pool.slot_pages(job.slot),
                 )
             self._activate_slot(request, job.slot, first_id, first_lp)
+        # retire-stage promotion reap, same rule as the pipelined retire
+        self._reap_promotions()
         self._last_progress = time.monotonic()
         self._hist_retire.observe((time.perf_counter() - t0) * 1e3)
 
@@ -4754,6 +4886,9 @@ class LLMEngineCore:
         while not self._stopped:
             # deadline sweep: queued requests expire where they wait
             self._expire_pending()
+            # host-tier promotions that completed since the last boundary
+            # (docs/kv_tiering.md): cheap no-op without in-flight DMAs
+            self._reap_promotions()
             # SLO scheduling (docs/slo_scheduling.md): refresh the brownout
             # stage from the pressure signals, then — under slot pressure
             # with interactive work queued — preempt one batch-lane slot at
@@ -4854,6 +4989,9 @@ class LLMEngineCore:
                         # yield-point seam: the drained boundary, before
                         # the leak audit
                         faults.fire("engine.drain")
+                    # straggler promotion DMAs must settle before the
+                    # drained audit (and before the loop parks)
+                    self._reap_promotions(force=True)
                     self._sanitize("drain", drained=True)
                     return  # drained; a new generate() restarts the loop
                 # idle but admissions in flight: sleep until a prefill lands
@@ -5350,6 +5488,9 @@ class LLMEngineCore:
                     }
                 self._emit(slot, int(token_id), lp_entry)
         self._release_quarantine(entry.seq)
+        # promotion completion is a retire-stage event (docs/kv_tiering.md):
+        # a DMA that finished while this chunk computed cost the loop nothing
+        self._reap_promotions()
         self._last_progress = time.monotonic()
         self._hist_retire.observe((time.perf_counter() - t0) * 1e3)
 

@@ -49,6 +49,18 @@ Known points (ctx carried with each):
                          bug that LEAKS the slot's pages — the KV sanitizer
                          (llm/kv_sanitizer.py, TPUSERVE_SANITIZE=1) must
                          catch it at drain.
+- ``engine.kv.demote`` — in the radix prefix cache as device-budget eviction
+                         is about to demote a cached run's pages to the
+                         host-RAM tier (``pages``; docs/kv_tiering.md); a
+                         raise aborts the demotion — the node drops for
+                         real (legacy eviction), leak-free under the armed
+                         sanitizer.
+- ``engine.kv.promote`` — as a lookup on a demoted run is about to allocate
+                         device pages and enqueue the host→device re-online
+                         DMA (``pages``); a raise aborts the promotion — the
+                         demoted suffix drops, the hit shortens to the
+                         resident prefix, and the tail falls back to
+                         recompute with zero page leaks.
 - ``engine.dispatch.prepare`` — on the loop thread at the end of
                          ``_prepare_dispatch`` (``requests``): the shared
                          host state is snapshotted, the worker-thread device
@@ -113,6 +125,8 @@ KNOWN_POINTS = frozenset({
     "engine.pool",
     "engine.preempt",
     "engine.release",
+    "engine.kv.demote",
+    "engine.kv.promote",
     "grpc.call",
 })
 

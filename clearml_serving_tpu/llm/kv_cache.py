@@ -33,11 +33,25 @@ because the scale pools are addressed by the same page ids the PagePool
 hands out. Pool HBM per token-head drops from 2·D bytes (bf16) to D + 4
 (int8 + f32 scale) — 1.94x at D=128 — which doubles the page budget the
 radix prefix cache can hold.
+
+Host-RAM tier (docs/kv_tiering.md): ``enable_host_tier`` preallocates a
+:class:`HostKVTier` — page-major host buffers addressed by HOST-tier page
+ids, a separate id space from the device pool's. The radix prefix cache
+(llm/prefix_cache.py) demotes cold cached pages into the tier instead of
+dropping them (``demote_pages``: device→host readback of int8 pages AND
+their scale rows, 2x cheaper than bf16 to hold and transfer) and re-onlines
+them on a hit (``promote_pages``: async host→device DMA enqueued under the
+dispatch lock, so every later consumer program is ordered after the copy by
+data dependency on the pool handles — the "tier fence";
+llm/schedule_explorer.py's ``tier_promotion`` scenario models losing it).
+Promotion completion is observed at the engine's retire boundaries
+(``reap_promotions``), which is where the DMA-overlap metric comes from.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -282,6 +296,21 @@ class PagePool:
             self._slot_pages[slot] = list(pages)
             self._slot_len[slot] = tokens
 
+    def allocate_cache_pages(self, n: int) -> List[int]:
+        """Pop ``n`` free pages with one reference each, to be owned by the
+        radix prefix cache (promotion targets for host-tier re-onlining,
+        docs/kv_tiering.md). The caller MUST attach them to cache nodes (or
+        unref them on failure) inside the same tree-lock window it called
+        from — the KV sanitizer's conservation audit snapshots under that
+        lock, so no intermediate owner-less state is ever observable."""
+        with self._lock:
+            if n > len(self._free):
+                raise MemoryError(
+                    "page pool exhausted: promotion needs {} pages, {} "
+                    "free".format(n, len(self._free))
+                )
+            return [self._pop_free() for _ in range(n)]
+
     def drain_pending_cow(self) -> List[Tuple[int, int]]:
         with self._lock:
             out, self._pending_cow = self._pending_cow, []
@@ -337,6 +366,105 @@ class PagePool:
             return np.asarray(self._slot_len, np.int32)
 
 
+class HostKVTier:
+    """Preallocated host-RAM page tier behind the HBM pools
+    (docs/kv_tiering.md).
+
+    Layout is PAGE-MAJOR — ``hk``/``hv`` [Nh, L, Hkv, P, D] (+ [Nh, L, Hkv,
+    P] f32 scale rows on int8 pools) — so one host page's bytes are
+    contiguous: a demotion writes one slab, a promotion stages one slab, and
+    the host→device upload presents the runtime a single contiguous source
+    per page run instead of a strided gather. Buffers are allocated ONCE at
+    construction (numpy keeps them resident; on TPU runtimes jax's transfer
+    path stages through its own pinned buffers, and preallocating here
+    avoids allocator churn on the demote/promote paths).
+
+    Host page ids are a SEPARATE id space from the device pool's: a cached
+    node references either a device page id or a host-tier page id, never
+    both (the KV sanitizer's two-tier invariant). Ownership is single-holder
+    by construction — only the radix prefix cache allocates host pages, one
+    node per id — so the tier needs an allocator, not refcounts."""
+
+    # lock-discipline registry (tpuserve-analyze TPU301): id bookkeeping is
+    # mutated only under self._lock. The data slabs themselves need no lock:
+    # a freshly allocated id is exclusive to its allocator until freed, and
+    # promotion stages a COPY of the rows before the id returns to the free
+    # list (the PR-4 aliasing rule).
+    __guarded_by__ = {"_lock": ("_free", "_used")}
+
+    def __init__(self, num_pages: int, page_size: int, n_layers: int,
+                 n_kv_heads: int, head_dim: int, dtype, quantized: bool):
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        if self.num_pages <= 0:
+            raise ValueError("host tier needs at least one page")
+        shape = (self.num_pages, n_layers, n_kv_heads, page_size, head_dim)
+        self.hk = np.zeros(shape, np.dtype(dtype))
+        self.hv = np.zeros(shape, np.dtype(dtype))
+        if quantized:
+            self.hk_scale = np.zeros(shape[:-1], np.float32)
+            self.hv_scale = np.zeros(shape[:-1], np.float32)
+        else:
+            self.hk_scale = None
+            self.hv_scale = None
+        self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
+        self._used: set = set()
+        self._lock = threading.Lock()
+
+    @property
+    def quantized(self) -> bool:
+        return self.hk_scale is not None
+
+    @property
+    def page_bytes(self) -> int:
+        """True host bytes per page: K+V slabs plus scale rows."""
+        per = int(self.hk[0].nbytes) + int(self.hv[0].nbytes)
+        if self.hk_scale is not None:
+            per += int(self.hk_scale[0].nbytes) + int(self.hv_scale[0].nbytes)
+        return per
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return len(self._used)
+
+    def allocate(self, n: int) -> List[int]:
+        with self._lock:
+            if n > len(self._free):
+                raise MemoryError(
+                    "host KV tier exhausted: need {} pages, {} free".format(
+                        n, len(self._free)
+                    )
+                )
+            ids = [self._free.pop() for _ in range(n)]
+            self._used.update(ids)
+            return ids
+
+    def free(self, ids: List[int]) -> None:
+        with self._lock:
+            for hid in ids:
+                if hid not in self._used:
+                    raise RuntimeError(
+                        "free of unallocated host page {}".format(hid)
+                    )
+                self._used.discard(hid)
+                self._free.append(hid)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Consistent copy of the id bookkeeping for the KV sanitizer."""
+        with self._lock:
+            return {
+                "free": list(self._free),
+                "used": set(self._used),
+                "num_pages": self.num_pages,
+            }
+
+
 class PagedKVCache:
     """Device pools for all layers + the shared host-side PagePool.
 
@@ -368,8 +496,12 @@ class PagedKVCache:
     tpuserve-analyze TPU501 via the engine's ``__affine_to__``."""
 
     # pool-handle rebinds happen only under the dispatch lock (a donating
-    # dispatch invalidates the old handle; tpuserve-analyze TPU301)
-    __guarded_by__ = {"dispatch_lock": ("k", "v", "k_scale", "v_scale")}
+    # dispatch invalidates the old handle; tpuserve-analyze TPU301). The
+    # in-flight promotion records ride the same lock: they are appended at
+    # copy-enqueue time (dispatch path) and drained at retire boundaries.
+    __guarded_by__ = {
+        "dispatch_lock": ("k", "v", "k_scale", "v_scale", "_promotions"),
+    }
 
     def __init__(
         self,
@@ -406,6 +538,17 @@ class PagedKVCache:
             self.k_scale = None
             self.v_scale = None
         self.dispatch_lock = threading.Lock()
+        # host-RAM tier (docs/kv_tiering.md): None until enable_host_tier;
+        # the radix prefix cache demotes into / promotes out of it
+        self.host_tier: Optional[HostKVTier] = None
+        self._promotions: List[dict] = []   # in-flight promotion DMAs
+        # tier counters (pages moved; GIL-atomic int bumps): observability
+        # for engine_kv_demotions_total / engine_kv_promotions_total
+        self.demoted_pages = 0
+        self.promoted_pages = 0
+        self.promo_reaped = 0       # promotion DMAs observed complete
+        self.promo_wait_ms = 0.0    # exposed (un-hidden) wait at the reap
+        self.promo_total_ms = 0.0   # issue -> observed-complete wall time
 
         def _write_pages(pool, chunks, pages):
             # chunks [NP, L, Hkv, P, D] (or [NP, L, Hkv, P] for scale pools),
@@ -501,6 +644,176 @@ class PagedKVCache:
                 self.k_scale = self._copy_pages(self.k_scale, srcs, dsts)
                 self.v_scale = self._copy_pages(self.v_scale, srcs, dsts)
         return len(pairs)
+
+    # -- host-RAM tier (docs/kv_tiering.md) --------------------------------
+
+    def enable_host_tier(self, num_pages: int) -> "HostKVTier":
+        """Preallocate a host-RAM page tier matching this pool's geometry.
+        Returns the tier (also kept as ``self.host_tier``)."""
+        _l, hkv, _n, p, d = self.k.shape
+        self.host_tier = HostKVTier(
+            num_pages, p, self.n_layers, hkv, d,
+            dtype=self.k.dtype, quantized=bool(self.kv_quant),
+        )
+        return self.host_tier
+
+    def demote_pages(self, pages: List[int]) -> List[int]:
+        """Copy device pages (and, on int8 pools, their scale rows) into
+        freshly allocated host-tier pages; returns the host-tier page ids.
+
+        The gather consumes the CURRENT pool handles under the dispatch
+        lock, so it is ordered after every enqueued write by data
+        dependency; the readback itself is synchronous (the host copy is
+        complete before the caller releases the device pages back to the
+        free list — a later re-allocation can never overwrite bytes the
+        tier still needs). Raises MemoryError when the tier is full; the
+        caller (radix cache eviction) then drops the run for real."""
+        import jax.numpy as jnp
+
+        tier = self.host_tier
+        if tier is None:
+            raise RuntimeError("demote_pages without an enabled host tier")
+        host_ids = tier.allocate(len(pages))
+        try:
+            idx = jnp.asarray(pages, jnp.int32)
+            with self.dispatch_lock:
+                k_slab = self.k[:, :, idx]          # [L, Hkv, n, P, D]
+                v_slab = self.v[:, :, idx]
+                if self.kv_quant:
+                    ks_slab = self.k_scale[:, :, idx]   # [L, Hkv, n, P]
+                    vs_slab = self.v_scale[:, :, idx]
+            # device->host readback OUTSIDE the dispatch lock: the gather
+            # outputs are immutable device arrays; only the (cheap) enqueue
+            # needed serializing against donating dispatches
+            tier.hk[host_ids] = np.moveaxis(np.asarray(k_slab), 2, 0)
+            tier.hv[host_ids] = np.moveaxis(np.asarray(v_slab), 2, 0)
+            if self.kv_quant:
+                tier.hk_scale[host_ids] = np.moveaxis(np.asarray(ks_slab), 2, 0)
+                tier.hv_scale[host_ids] = np.moveaxis(np.asarray(vs_slab), 2, 0)
+        except BaseException:
+            tier.free(host_ids)
+            raise
+        self.demoted_pages += len(pages)
+        return host_ids
+
+    def promote_pages(self, host_ids: List[int], pages: List[int]) -> None:
+        """Re-online host-tier pages into freshly allocated device pages
+        (``pages``, from PagePool.allocate_cache_pages) via an ASYNC
+        host→device DMA: the donated page scatter is only ENQUEUED here —
+        dispatch returns in microseconds and the copy itself proceeds in
+        the background, hidden behind whatever the engine enqueues next
+        (the prefix hit's tail-chunk prefill). Ordering for every later
+        consumer holds by data dependency on the rebound pool handles (the
+        tier fence). Frees the host ids: the rows are STAGED into fresh
+        arrays first, so the upload never aliases tier memory a later
+        demotion may overwrite (the PR-4 zero-copy race class)."""
+        import jax.numpy as jnp
+
+        tier = self.host_tier
+        if tier is None:
+            raise RuntimeError("promote_pages without an enabled host tier")
+        if len(host_ids) != len(pages):
+            raise ValueError(
+                "promotion of {} host pages into {} device pages".format(
+                    len(host_ids), len(pages)
+                )
+            )
+        # fancy indexing COPIES: staged slabs are private to this promotion
+        k_rows = tier.hk[host_ids]            # [n, L, Hkv, P, D]
+        v_rows = tier.hv[host_ids]
+        if self.kv_quant:
+            ks_rows = tier.hk_scale[host_ids]
+            vs_rows = tier.hv_scale[host_ids]
+        tier.free(host_ids)
+        page_ids = jnp.asarray(pages, jnp.int32)
+        t_issue = time.perf_counter()
+        with self.dispatch_lock:
+            # the fence holds the UPLOADED chunk arrays (not the pool
+            # handles — a later donating dispatch deletes those): their
+            # readiness marks the host→device transfer complete, and the
+            # scatter that consumes them is ordered for every later reader
+            # by data dependency on the rebound pools
+            k_dev = jnp.asarray(k_rows)
+            v_dev = jnp.asarray(v_rows)
+            self.k = self._write_pages(self.k, k_dev, page_ids)
+            self.v = self._write_pages(self.v, v_dev, page_ids)
+            fence = [k_dev, v_dev]
+            if self.kv_quant:
+                ks_dev = jnp.asarray(ks_rows)
+                vs_dev = jnp.asarray(vs_rows)
+                self.k_scale = self._write_pages(self.k_scale, ks_dev, page_ids)
+                self.v_scale = self._write_pages(self.v_scale, vs_dev, page_ids)
+                fence += [ks_dev, vs_dev]
+            self._promotions.append({
+                "pages": len(pages),
+                "t_issue": t_issue,
+                "fence": fence,
+            })
+        self.promoted_pages += len(pages)
+
+    def reap_promotions(self, force: bool = False) -> int:
+        """Account promotion DMAs that completed (engine retire-stage
+        event): a record whose fence arrays are ready cost the serving loop
+        nothing — the copy hid behind the in-flight prefill/decode work.
+        ``force`` blocks on stragglers (drain/stop paths and the A/B bench's
+        end-of-run accounting). Returns how many records were reaped."""
+        import jax
+
+        with self.dispatch_lock:
+            if not self._promotions:
+                return 0
+            if force:
+                records, self._promotions = self._promotions, []
+            else:
+                records = [
+                    r for r in self._promotions
+                    if all(
+                        getattr(f, "is_ready", lambda: True)()
+                        for f in r["fence"]
+                    )
+                ]
+                for r in records:
+                    self._promotions.remove(r)
+        reaped = 0
+        for rec in records:
+            t_reap = time.perf_counter()
+            try:
+                for f in rec["fence"]:
+                    jax.block_until_ready(f)
+            except Exception:
+                # a poisoned fence surfaces at its consumer; the record is
+                # still retired so the list cannot grow without bound
+                pass
+            t_done = time.perf_counter()
+            self.promo_wait_ms += (t_done - t_reap) * 1e3
+            self.promo_total_ms += (t_done - rec["t_issue"]) * 1e3
+            self.promo_reaped += 1
+            reaped += 1
+        return reaped
+
+    def tier_stats(self) -> Optional[Dict[str, object]]:
+        """Host-tier movement/occupancy counters for lifecycle_stats()
+        (None when no tier is enabled). ``overlap_ratio`` = share of the
+        promotion DMA wall time hidden behind other device work, observed
+        at the reap points."""
+        tier = self.host_tier
+        if tier is None:
+            return None
+        total = self.promo_total_ms
+        hidden = max(0.0, total - self.promo_wait_ms)
+        return {
+            "host_pages_used": tier.used_pages,
+            "host_pages_capacity": tier.num_pages,
+            "host_page_bytes": tier.page_bytes,
+            "demoted_pages_total": self.demoted_pages,
+            "promoted_pages_total": self.promoted_pages,
+            "promotions_reaped": self.promo_reaped,
+            "promo_wait_ms": round(self.promo_wait_ms, 3),
+            "promo_total_ms": round(self.promo_total_ms, 3),
+            "overlap_ratio": (
+                round(hidden / total, 4) if total > 0 else None
+            ),
+        }
 
     def _require_scales(self, k_scales, v_scales) -> None:
         """Fail fast when the caller's scale operands disagree with the

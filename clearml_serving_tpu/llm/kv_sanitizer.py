@@ -28,6 +28,14 @@ asserts, after every decode step and at drain:
    pages (one scale row per page id per side), so every page operation
    (write, CoW, share, free) covers its scale rows by construction; drain
    leak reports name the stranded scale rows beside the pages.
+7. **Two-tier exclusivity** (host-RAM tier, docs/kv_tiering.md) — a page
+   lives in exactly one tier: no cache node holds both a device and a
+   host payload; every allocated host-tier id is referenced by exactly
+   one node and every node-referenced id is allocated; the host free
+   list has no duplicates, overlaps the used set nowhere, and together
+   with it covers the tier exactly; a quantized pool's host tier must
+   carry scale slabs of the matching geometry (demoted scale rows track
+   their pages).
 
 Failures raise :class:`KVSanitizerError` (an AssertionError subclass: armed
 test suites fail closed) with a diagnostic naming the offending pages.
@@ -141,6 +149,71 @@ class KVSanitizer:
                             self.pool.page_size,
                         )
                     )
+
+        # (7) two-tier exclusivity (host-RAM tier, docs/kv_tiering.md)
+        tier = getattr(pc, "host_tier", None) if pc is not None else None
+        if (
+            tier is not None
+            and self.prefix is not None
+            and getattr(self.prefix, "_host", None) is tier
+        ):
+            host_refs, dual = self.prefix.tier_refs()
+            hsnap = tier.snapshot()
+            if dual:
+                fail(
+                    "{} cache node(s) hold BOTH a device and a host "
+                    "payload: a page must live in exactly one tier".format(
+                        dual
+                    )
+                )
+            hfree, hused = hsnap["free"], hsnap["used"]
+            if len(set(hfree)) != len(hfree):
+                dupes = sorted({h for h in hfree if hfree.count(h) > 1})
+                fail(
+                    "host-tier free list contains duplicates: {}".format(
+                        dupes
+                    ),
+                    pages=dupes,
+                )
+            overlap = sorted(set(hfree) & hused)
+            if overlap:
+                fail(
+                    "host pages {} are both free and allocated".format(
+                        overlap
+                    ),
+                    pages=overlap,
+                )
+            if len(hfree) + len(hused) != hsnap["num_pages"]:
+                fail(
+                    "host tier accounts for {} + {} pages of {}".format(
+                        len(hfree), len(hused), hsnap["num_pages"]
+                    )
+                )
+            orphans = sorted(h for h in hused if host_refs.get(h, 0) != 1)
+            orphans += sorted(h for h in host_refs if h not in hused)
+            if orphans:
+                fail(
+                    "host-tier ownership violated (each allocated id must "
+                    "be referenced by exactly one cache node): {}".format(
+                        sorted(set(orphans))
+                    ),
+                    pages=sorted(set(orphans)),
+                )
+            if quantized != tier.quantized:
+                fail(
+                    "host tier {} scale slabs but the device pools are "
+                    "{}quantized: demoted scale rows no longer track "
+                    "their pages".format(
+                        "lacks" if not tier.quantized else "carries",
+                        "" if quantized else "not ",
+                    )
+                )
+            if tier.page_size != self.pool.page_size:
+                fail(
+                    "host tier page size {} != device page size {}".format(
+                        tier.page_size, self.pool.page_size
+                    )
+                )
 
         # slot-table occurrences per page (a page CAN legally appear in
         # several slots — shared prefix mapped into multiple page tables)

@@ -280,6 +280,20 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("prefix_cache_pages")
                 else None
             ),
+            # host-RAM KV tier (docs/kv_tiering.md): aux
+            # engine.prefix_cache_host_pages preallocates that many host
+            # pages behind the prefix cache (paged backend); eviction then
+            # demotes instead of dropping. 0/unset disables.
+            prefix_cache_host_pages=(
+                int(engine_cfg["prefix_cache_host_pages"])
+                if engine_cfg.get("prefix_cache_host_pages")
+                else None
+            ),
+            prefix_cache_host_bytes=(
+                int(float(engine_cfg["prefix_cache_host_mb"]) * (1 << 20))
+                if engine_cfg.get("prefix_cache_host_mb")
+                else None
+            ),
             tokenizer=self.tokenizer,  # guided decoding needs token bytes
             # request-lifecycle hardening (docs/robustness.md): production
             # defaults ON at the serving front — bounded admission and a

@@ -32,6 +32,25 @@ token runs (SGLang's RadixAttention layout; see docs/prefix_caching.md):
 The prompt's final token is never cached: it must always compute live to
 produce the first-token logits (``longest_prefix_len``).
 
+Host-RAM tier (docs/kv_tiering.md): with a ``backend`` (the PagedKVCache
+whose ``host_tier`` was enabled), eviction under the DEVICE budgets DEMOTES
+instead of dropping — the victim's pages (int8 + scale rows) copy into
+host-tier pages and the node flips to a host payload; only the HOST budgets
+drop runs for real (host-tier leaf LRU). Pinned runs stay resident in both
+senses: never demoted, never host-dropped. A lookup whose matched run has a
+demoted suffix PROMOTES it in place — fresh device pages are allocated, the
+async host→device DMA is enqueued BEFORE the new page ids become visible to
+any consumer (ordering then holds by data dependency on the pool handles —
+the tier fence; llm/schedule_explorer.py's ``tier_promotion`` scenario),
+and the hit returns tagged ``tier="host"``. A failed promotion (pool
+pressure, injected ``engine.kv.promote`` fault) falls back to the resident
+prefix and drops the demoted suffix — recompute, never a leak. Demotion
+candidates come from the RESIDENT FRONTIER (resident nodes with no resident
+children), so along any root→leaf path the demoted nodes are always a
+suffix; ``store_pages`` preserves that invariant by re-onlining demoted
+path nodes BY REFERENCE to the admitting slot's own pages (zero copies)
+before attaching new resident children below them.
+
 Thread-safety: admissions run in worker threads; one mutex guards the tree.
 Dense payloads are immutable jax buffers. Paged lookups PIN the returned
 pages (refcount bump under the tree lock) so a concurrent eviction cannot
@@ -44,13 +63,15 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import faults
+
 
 class _Node:
     """One block-granular edge of the radix tree."""
 
     __slots__ = (
         "parent", "edge", "children", "bufs", "pages", "nbytes", "last_used",
-        "pinned",
+        "pinned", "host_pages",
     )
 
     def __init__(self, parent: Optional["_Node"], edge: Tuple[int, ...]):
@@ -58,7 +79,11 @@ class _Node:
         self.edge = edge          # this node's block of tokens
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.bufs: Optional[Dict[str, Any]] = None   # dense payload
-        self.pages: Optional[List[int]] = None       # paged payload
+        self.pages: Optional[List[int]] = None       # paged payload (HBM)
+        # host-tier payload (docs/kv_tiering.md): host page ids; EXACTLY one
+        # of pages/host_pages is set on a tiered paged node (the sanitizer's
+        # two-tier invariant)
+        self.host_pages: Optional[List[int]] = None
         self.nbytes = 0
         self.last_used = 0
         # pin_run() holds: eviction must not drop this node (the engine
@@ -82,7 +107,8 @@ class RadixPrefixCache:
     # mutated only under self._lock; helpers called with it held annotate
     # their def line
     __guarded_by__ = {
-        "_lock": ("_roots", "_leaf_nodes", "_n_nodes", "_clock"),
+        "_lock": ("_roots", "_leaf_nodes", "_n_nodes", "_clock",
+                  "_frontier", "_n_resident", "_host_pages", "_host_bytes"),
     }
 
     def __init__(
@@ -94,6 +120,13 @@ class RadixPrefixCache:
         max_pages: Optional[int] = None,
         pool=None,
         page_bytes: int = 0,
+        # host-RAM tier (docs/kv_tiering.md): the PagedKVCache whose
+        # host_tier was enabled; None keeps the legacy drop-on-evict
+        # behavior byte-identical
+        backend=None,
+        host_max_pages: Optional[int] = None,
+        host_max_bytes: Optional[int] = None,
+        host_max_nodes: Optional[int] = None,
     ):
         self.block = int(block)
         self.max_nodes = int(max_nodes)
@@ -101,14 +134,39 @@ class RadixPrefixCache:
         self.max_pages = int(max_pages) if max_pages else None
         self._pool = pool
         self._page_bytes = int(page_bytes)
+        self._backend = backend
+        self._host = getattr(backend, "host_tier", None) if backend else None
+        if backend is not None and self._host is None:
+            raise ValueError(
+                "tiering backend given but its host tier is not enabled "
+                "(PagedKVCache.enable_host_tier)"
+            )
+        # host-tier budgets: page budget defaults to the tier's capacity;
+        # bytes/nodes unbounded unless set
+        self.host_max_pages = (
+            min(int(host_max_pages), self._host.num_pages)
+            if (self._host is not None and host_max_pages)
+            else (self._host.num_pages if self._host is not None else None)
+        )
+        self.host_max_bytes = int(host_max_bytes) if host_max_bytes else None
+        self.host_max_nodes = int(host_max_nodes) if host_max_nodes else None
         self._roots: Dict[int, _Node] = {}
         # incrementally maintained leaf set (nodes with no children): LRU
         # eviction scans candidates directly instead of a whole-tree DFS per
         # evicted node (O(leaves) vs O(nodes) with the lock held)
         self._leaf_nodes: set = set()
+        # resident frontier (tiered paged backend only): resident nodes with
+        # no resident children — the demotion candidates. Because only
+        # frontier nodes demote and store_pages re-onlines demoted path
+        # nodes before attaching below them, demoted nodes are always a
+        # path SUFFIX.
+        self._frontier: set = set()
         self._bytes = 0
         self._pages = 0
+        self._host_bytes = 0
+        self._host_pages = 0
         self._n_nodes = 0
+        self._n_resident = 0    # resident paged nodes (device budgets)
         self._clock = 0
         self._lock = threading.Lock()
         # observability (statistics/metrics.py PrefixCacheCollector)
@@ -116,6 +174,11 @@ class RadixPrefixCache:
         self.misses = 0
         self.hit_tokens = 0     # prompt tokens served from cache
         self.evictions = 0
+        # tier movement + hits by serving tier (hbm = fully resident run,
+        # host = the run needed promotion)
+        self.demotions = 0
+        self.promotions = 0
+        self._hit_tiers: Dict[str, int] = {"hbm": 0, "host": 0}
 
     # -- shared helpers ------------------------------------------------------
 
@@ -170,6 +233,21 @@ class RadixPrefixCache:
         self._leaf_nodes.discard(parent)
         self._leaf_nodes.add(child)
         self._n_nodes += 1
+        if self._host is not None:
+            self._frontier_fix(child)
+            self._frontier_fix(parent)
+
+    def _frontier_fix(self, node: Optional[_Node]) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        """Re-derive one node's resident-frontier membership (resident with
+        no resident children). O(fanout); lock held by caller."""
+        if node is None or node.parent is None:
+            return  # roots carry no payload
+        if node.pages is not None and not any(
+            c.pages is not None for c in node.children.values()
+        ):
+            self._frontier.add(node)
+        else:
+            self._frontier.discard(node)
 
     def uncount_hit(self, hit: Optional[Dict[str, Any]]) -> None:
         """The engine could not use a returned hit (no prefill bucket fits
@@ -182,6 +260,9 @@ class RadixPrefixCache:
             self.hits -= 1
             self.misses += 1
             self.hit_tokens -= int(hit.get("len", 0))
+            tier = hit.get("tier", "hbm")
+            if tier in self._hit_tiers:
+                self._hit_tiers[tier] -= 1
 
     # -- dense backend -------------------------------------------------------
 
@@ -189,10 +270,19 @@ class RadixPrefixCache:
         """Tokens a lookup for ``ids`` would serve from the cache, WITHOUT
         pinning pages or counting a hit/miss. Admission control uses this to
         size its KV-pool headroom check: a request whose prefix is cached
-        only needs pages for the tail."""
+        only needs pages for the tail. With a host tier, only the RESIDENT
+        run counts — a demoted suffix will allocate fresh device pages at
+        promotion, so headroom must still cover it."""
         with self._lock:
-            _, depth = self._walk(ids, lora)
-        return depth
+            node, depth = self._walk(ids, lora)
+            if self._host is None:
+                return depth
+            resident = 0
+            for n in self._path_nodes(node):
+                if n.pages is None:
+                    break
+                resident += self.block
+        return min(resident, depth)
 
     def lookup(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
         """Longest shared block run of ``ids`` (dense backend).
@@ -204,6 +294,7 @@ class RadixPrefixCache:
                 return None
             self.hits += 1
             self.hit_tokens += depth
+            self._hit_tiers["hbm"] += 1
             blocks = [n.bufs for n in self._path_nodes(node)]
         # concatenate outside the lock: blocks are immutable device arrays,
         # and the eager concat dispatch must not serialize other admissions
@@ -271,21 +362,55 @@ class RadixPrefixCache:
 
     def lookup_pages(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
         """Longest shared block run (paged backend). Returns {"len": P,
-        "pages": [ids]} with the pages PINNED (one cache-side refcount taken
-        on the caller's behalf) so eviction cannot free them before the
-        engine maps them into a slot — the caller MUST release() the hit."""
+        "pages": [ids], "tier": "hbm"|"host"} with the pages PINNED (one
+        cache-side refcount taken on the caller's behalf) so eviction
+        cannot free them before the engine maps them into a slot — the
+        caller MUST release() the hit.
+
+        Host tier (docs/kv_tiering.md): a matched run whose suffix was
+        demoted is PROMOTED in place — fresh device pages are allocated and
+        the async host→device DMA is enqueued before those page ids become
+        visible (any consumer program dispatched later is ordered after the
+        copy by data dependency on the pool handles), then the hit returns
+        ``tier="host"``. If promotion fails (pool pressure, injected
+        ``engine.kv.promote`` fault) the demoted suffix is DROPPED and the
+        hit shortens to the resident prefix — the tail recomputes; nothing
+        leaks."""
         with self._lock:
             node, depth = self._walk(ids, lora)
             if depth < self.block:
                 self.misses += 1
                 return None
+            path = self._path_nodes(node)
+            tier = "hbm"
+            if self._host is not None:
+                first_demoted = next(
+                    (i for i, n in enumerate(path) if n.pages is None), None
+                )
+                if first_demoted is not None:
+                    if self._promote_run(path[first_demoted:]):
+                        tier = "host"
+                    else:
+                        # fall back to the resident prefix (recompute the
+                        # tail) — but a PINNED demoted suffix must survive:
+                        # pin_run promised some preempted request its
+                        # history replays from here, so only unpinned
+                        # suffixes drop (zero leaks either way)
+                        if not self._subtree_pinned(path[first_demoted]):
+                            self._drop_subtree(path[first_demoted])
+                        path = path[:first_demoted]
+                        depth = first_demoted * self.block
+                        if depth < self.block:
+                            self.misses += 1
+                            return None
             self.hits += 1
             self.hit_tokens += depth
+            self._hit_tiers[tier] += 1
             pages: List[int] = []
-            for n in self._path_nodes(node):
+            for n in path:
                 pages.extend(n.pages)
             self._pool.pin_pages(pages)  # pin for the admission in flight
-        return {"len": depth, "pages": pages}
+        return {"len": depth, "pages": pages, "tier": tier}
 
     def release(self, hit: Dict[str, Any]) -> None:
         """Drop a lookup_pages() pin (after slot mapping took its own refs,
@@ -307,6 +432,38 @@ class RadixPrefixCache:
         with self._lock:
             node, depth = self._walk(ids, lora)
             now = self._clock
+            if self._host is not None:
+                # re-online any demoted node on the matched path BY
+                # REFERENCE to the admitting slot's own pages (the slot just
+                # computed this exact prefix — zero copies, and the
+                # demoted-suffix invariant survives attaching resident
+                # children below). Top-down, so residency stays
+                # prefix-closed along the path at every instant.
+                reonlined = 0
+                for i, n in enumerate(self._path_nodes(node)):
+                    if n.pages is not None or n.host_pages is None:
+                        continue
+                    first = (i * self.block) // self._pool.page_size
+                    pages = list(slot_pages[first : first + ppb])
+                    if len(pages) < ppb:
+                        break  # slot shorter than this depth: leave demoted
+                    self._pool.ref_pages(pages)
+                    self._host.free(n.host_pages)
+                    self._host_pages -= len(n.host_pages)
+                    self._host_bytes -= n.nbytes
+                    n.host_pages = None
+                    n.pages = pages
+                    self._pages += len(pages)
+                    self._bytes += n.nbytes
+                    self._n_resident += 1
+                    reonlined += 1
+                    self._frontier_fix(n)
+                    self._frontier_fix(n.parent)
+                if reonlined:
+                    # one promotion EVENT per re-onlined run, matching
+                    # _promote_run's unit (engine_kv_promotions_total
+                    # counts runs; promoted_pages_total counts pages)
+                    self.promotions += 1
             while depth + self.block <= p:
                 blk = tuple(ids[depth : depth + self.block])
                 first = (depth // self._pool.page_size)
@@ -321,6 +478,7 @@ class RadixPrefixCache:
                 self._attach(node, child)
                 self._bytes += child.nbytes
                 self._pages += ppb
+                self._n_resident += 1
                 node = child
                 depth += self.block
             self._evict_over_budget()
@@ -339,7 +497,12 @@ class RadixPrefixCache:
         Pin/unpin balance across every queue-exit path is audited by the
         KV sanitizer's drain check and explored under seeded thread
         interleavings by llm/schedule_explorer.py's ``pin_balance``
-        scenario (``--mutate drop_unpin`` models a lost release)."""
+        scenario (``--mutate drop_unpin`` models a lost release).
+
+        Host tier: a demoted run pins exactly the same way — the pin is a
+        PROMOTION PLAN, not a miss: pinned host nodes survive host-LRU
+        drops, and the resume's lookup_pages promotes them back to HBM
+        (``host_nodes`` in the handle reports how many will need it)."""
         with self._lock:
             node, depth = self._walk(ids, lora)
             if depth < self.block:
@@ -347,7 +510,13 @@ class RadixPrefixCache:
             nodes = self._path_nodes(node)
             for n in nodes:
                 n.pinned += 1
-            return {"nodes": nodes, "len": depth}
+            return {
+                "nodes": nodes,
+                "len": depth,
+                "host_nodes": sum(
+                    1 for n in nodes if n.host_pages is not None
+                ),
+            }
 
     def unpin_run(self, handle: Optional[Dict[str, Any]]) -> None:
         """Release a pin_run() hold; eviction deferred by the pin (the cache
@@ -359,42 +528,307 @@ class RadixPrefixCache:
                 n.pinned = max(0, n.pinned - 1)
             self._evict_over_budget()
 
-    # -- eviction ------------------------------------------------------------
+    # -- eviction / tiering --------------------------------------------------
 
     def _over_budget(self) -> bool:
+        """Device-tier budgets. With a host tier, the node budget counts
+        only RESIDENT nodes (demotion must make progress against it — a
+        total count would loop forever, since demoting never removes a
+        node from the tree)."""
+        nodes = self._n_resident if self._host is not None else self._n_nodes
         return (
-            self._n_nodes > self.max_nodes
+            nodes > self.max_nodes
             or self._bytes > self.max_bytes
             or (self.max_pages is not None and self._pages > self.max_pages)
         )
 
+    def _host_over_budget(self, extra_pages: int = 0, extra_bytes: int = 0,
+                          extra_nodes: int = 0) -> bool:
+        """Host-tier budgets (``extra_*`` reserves room for a demotion about
+        to land, so demote→host-evict never ping-pongs)."""
+        if self._host is None:
+            return False
+        host_nodes = self._n_nodes - self._n_resident
+        return (
+            self._host_pages + extra_pages > self.host_max_pages
+            or (
+                self.host_max_bytes is not None
+                and self._host_bytes + extra_bytes > self.host_max_bytes
+            )
+            or (
+                self.host_max_nodes is not None
+                and host_nodes + extra_nodes > self.host_max_nodes
+            )
+        )
+
+    def _release_node_payload(self, n: _Node) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        """Shared accounting for removing one node from the tree (either
+        tier, or dense): leaf/frontier sets, per-tier counters, page refs /
+        host ids. A paged node only drops the CACHE's page refs; pages a
+        live slot still maps stay allocated until that slot frees (the
+        pool's refcount is the single source of truth)."""
+        self._leaf_nodes.discard(n)
+        self._frontier.discard(n)
+        self._n_nodes -= 1
+        if n.host_pages is not None:
+            self._host_pages -= len(n.host_pages)
+            self._host_bytes -= n.nbytes
+            self._host.free(n.host_pages)
+        else:
+            self._bytes -= n.nbytes
+            if n.pages is not None:
+                self._pages -= len(n.pages)
+                self._n_resident -= 1
+                self._pool.unref_pages(n.pages)
+        n.parent = None
+        self.evictions += 1
+
+    def _drop_leaf(self, victim: _Node) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        """Structurally remove one leaf (either tier, or dense)."""
+        parent = victim.parent
+        parent.children.pop(victim.edge, None)
+        if not parent.children and parent.parent is not None:
+            self._leaf_nodes.add(parent)  # parent became a leaf
+        self._release_node_payload(victim)
+        if self._host is not None:
+            self._frontier_fix(parent)
+
+    def _subtree_pinned(self, root: _Node) -> bool:  # tpuserve: ignore[TPU301] lock held by caller
+        """True when ``root`` or any descendant holds a pin_run() pin (such
+        runs must never drop — the promotion plan survives for the pin
+        holder's resume)."""
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            if n.pinned:
+                return True
+            stack.extend(n.children.values())
+        return False
+
+    def _drop_subtree(self, root: _Node) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        """Structurally remove ``root`` and every descendant (the
+        promote/demote-failure fallbacks: the run recomputes instead of
+        leaking). Callers must route pinned subtrees elsewhere
+        (_subtree_pinned) — eviction victims are unpinned by construction
+        (a pinned descendant pins every ancestor)."""
+        stack, nodes = [root], []
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            stack.extend(n.children.values())
+        parent = root.parent
+        parent.children.pop(root.edge, None)
+        if not parent.children and parent.parent is not None:
+            self._leaf_nodes.add(parent)
+        for n in nodes:
+            self._release_node_payload(n)
+        if self._host is not None:
+            self._frontier_fix(parent)
+
+    def _demote(self, victims: List[_Node]) -> bool:  # tpuserve: ignore[TPU301] lock held by caller
+        """Move resident frontier nodes' pages to the host tier in ONE
+        backend call (docs/kv_tiering.md): a batched device→host copy of
+        the int8 pages and their scale rows (synchronous readback, ordered
+        after every enqueued write by data dependency), then the HBM pages'
+        cache references drop — a page no live slot still maps returns to
+        the free list with its bytes already safe on the host. Batching
+        matters: eviction pressure demotes whole runs at once, and one
+        gather+readback per NODE put O(blocks) device round-trips on the
+        store/commit path. Returns False (caller drops instead) when the
+        tier is full or the ``engine.kv.demote`` fault seam fires."""
+        all_pages = [p for v in victims for p in v.pages]
+        if faults.active():
+            try:
+                faults.fire("engine.kv.demote", pages=all_pages)
+            except faults.InjectedFault:
+                return False
+        try:
+            host_ids = self._backend.demote_pages(all_pages)
+        except MemoryError:
+            return False
+        i = 0
+        for victim in victims:
+            pages = victim.pages
+            k = len(pages)
+            victim.host_pages = host_ids[i : i + k]
+            i += k
+            victim.pages = None
+            self._pages -= k
+            self._bytes -= victim.nbytes
+            self._n_resident -= 1
+            self._host_pages += k
+            self._host_bytes += victim.nbytes
+            self._pool.unref_pages(pages)
+            self._frontier.discard(victim)
+            self._frontier_fix(victim.parent)
+        # one demotion EVENT per batched round (pages are counted by the
+        # backend's demoted_pages_total), mirroring the promotion unit
+        self.demotions += 1
+        return True
+
+    def _promote_run(self, nodes: List[_Node]) -> bool:  # tpuserve: ignore[TPU301] lock held by caller
+        """Re-online a demoted path suffix: allocate device pages, enqueue
+        the async host→device DMA (the page ids become visible only AFTER
+        the copy is in the device queue — the tier fence), flip the nodes.
+        Returns False on pool pressure or an injected ``engine.kv.promote``
+        fault; the caller then drops the suffix (recompute, no leak)."""
+        total = sum(len(n.host_pages) for n in nodes)
+        if faults.active():
+            try:
+                faults.fire("engine.kv.promote", pages=total)
+            except faults.InjectedFault:
+                return False
+        try:
+            fresh = self._pool.allocate_cache_pages(total)
+        except MemoryError:
+            return False
+        host_ids = [h for n in nodes for h in n.host_pages]
+        try:
+            self._backend.promote_pages(host_ids, fresh)
+        except BaseException:
+            # the backend freed the host ids up front (staging copy): the
+            # payloads are gone either way — orphan the nodes' host side so
+            # the caller's drop cannot double-free, release the fresh pages
+            for n in nodes:
+                self._host_pages -= len(n.host_pages)
+                self._host_bytes -= n.nbytes
+                n.host_pages = None
+                n.nbytes = 0
+            self._pool.unref_pages(fresh)
+            return False
+        i = 0
+        for n in nodes:
+            k = len(n.host_pages)
+            n.pages = list(fresh[i : i + k])
+            i += k
+            n.host_pages = None
+            self._pages += k
+            self._bytes += n.nbytes
+            self._n_resident += 1
+            self._host_pages -= k
+            self._host_bytes -= n.nbytes
+            self._frontier_fix(n)
+            self._frontier_fix(n.parent)
+        self.promotions += 1
+        return True
+
+    def spill(self, target_pages: int = 0) -> int:
+        """Demote resident cached runs (LRU over the resident frontier;
+        pinned runs stay) until at most ``target_pages`` device pages remain
+        cached. Test/bench/ops hook: forces the cold-cache state the tier
+        exists for without waiting on budget pressure. Returns pages
+        demoted."""
+        if self._host is None:
+            return 0
+        moved = 0
+        with self._lock:
+            while self._pages > target_pages:
+                victims = self._demotion_round(
+                    lambda pages, _b, _n: pages > target_pages
+                )
+                if not victims:
+                    break
+                self._evict_host_over_budget(
+                    extra_pages=sum(len(v.pages) for v in victims),
+                    extra_bytes=sum(v.nbytes for v in victims),
+                    extra_nodes=len(victims),
+                )
+                if not self._demote(victims):
+                    break
+                moved += sum(len(v.host_pages) for v in victims)
+            # a spill into a smaller host budget trims LRU host runs, same
+            # as the budget-driven eviction path
+            self._evict_host_over_budget()
+        return moved
+
+    def _demotion_round(self, still_over) -> List[_Node]:  # tpuserve: ignore[TPU301] lock held by caller
+        """LRU-ordered victims whose PROJECTED removal clears
+        ``still_over(pages, bytes, resident_nodes)`` — selected up front so
+        ONE batched backend copy moves the whole round. Selecting a
+        frontier node exposes its parent as the next candidate (projected
+        frontier), so a whole cold run demotes before any page of a newer
+        run is touched — run-level LRU, and O(1) device round-trips per
+        eviction burst instead of one per block."""
+        cand = {n for n in self._frontier if not n.pinned}
+        victims: List[_Node] = []
+        selected: set = set()
+        pages, nbytes, nres = self._pages, self._bytes, self._n_resident
+        while cand and still_over(pages, nbytes, nres):
+            victim = min(cand, key=lambda n: n.last_used)
+            cand.discard(victim)
+            victims.append(victim)
+            selected.add(victim)
+            pages -= len(victim.pages)
+            nbytes -= victim.nbytes
+            nres -= 1
+            parent = victim.parent
+            if (
+                parent is not None
+                and parent.parent is not None
+                and parent.pages is not None
+                and not parent.pinned
+                and all(
+                    c.pages is None or c in selected
+                    for c in parent.children.values()
+                )
+            ):
+                cand.add(parent)
+        return victims
+
     def _evict_over_budget(self) -> None:  # tpuserve: ignore[TPU301] lock held by caller
-        """LRU leaf eviction over the incrementally maintained leaf set
-        (O(leaves) per eviction, no tree walk). A paged leaf only drops the
-        CACHE's page refs; pages a live slot still maps stay allocated until
-        that slot frees (the pool's refcount is the single source of
-        truth)."""
+        """LRU eviction. Without a host tier: the historical leaf drop over
+        the incrementally maintained leaf set. With one: DEVICE pressure
+        demotes LRU resident-frontier nodes into the host tier (a batched
+        round per pass, host room made first, so the two loops never
+        ping-pong) and only HOST pressure drops runs for real — warm
+        prefixes degrade to a host hit instead of a cold prefill.
+
+        Pinned nodes (preempted-request histories awaiting resume) are
+        never victims of either motion; all candidates pinned = tolerate
+        the overage until unpin_run() re-runs eviction."""
         while self._over_budget():
-            # pinned leaves (preempted-request histories awaiting resume)
-            # are never victims; their ancestors are not leaves while they
-            # live, so a whole pinned run survives. All leaves pinned =
-            # tolerate the overage until unpin_run() re-runs eviction.
-            candidates = [n for n in self._leaf_nodes if not n.pinned]
+            if self._host is None:
+                candidates = [n for n in self._leaf_nodes if not n.pinned]
+                if not candidates:
+                    return
+                self._drop_leaf(min(candidates, key=lambda n: n.last_used))
+                continue
+            max_nodes = self.max_nodes
+            max_bytes = self.max_bytes
+            max_pages = self.max_pages
+            victims = self._demotion_round(
+                lambda pages, nbytes, nres: (
+                    nres > max_nodes
+                    or nbytes > max_bytes
+                    or (max_pages is not None and pages > max_pages)
+                )
+            )
+            if not victims:
+                break
+            self._evict_host_over_budget(
+                extra_pages=sum(len(v.pages) for v in victims),
+                extra_bytes=sum(v.nbytes for v in victims),
+                extra_nodes=len(victims),
+            )
+            if not self._demote(victims):
+                # tier full even after host eviction (pinned host runs) or
+                # an injected demote fault: drop the LRU victim and its
+                # (all non-resident) descendants for real; the loop
+                # re-plans the rest
+                self._drop_subtree(victims[0])
+        self._evict_host_over_budget()
+
+    def _evict_host_over_budget(self, extra_pages: int = 0,
+                                extra_bytes: int = 0,
+                                extra_nodes: int = 0) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        while self._host_over_budget(extra_pages, extra_bytes, extra_nodes):
+            candidates = [
+                n for n in self._leaf_nodes
+                if not n.pinned and n.host_pages is not None
+            ]
             if not candidates:
                 return
-            victim = min(candidates, key=lambda n: n.last_used)
-            self._leaf_nodes.discard(victim)
-            parent = victim.parent
-            parent.children.pop(victim.edge, None)
-            if not parent.children and parent.parent is not None:
-                self._leaf_nodes.add(parent)  # parent became a leaf
-            self._n_nodes -= 1
-            self._bytes -= victim.nbytes
-            if victim.pages is not None:
-                self._pages -= len(victim.pages)
-                self._pool.unref_pages(victim.pages)
-            victim.parent = None
-            self.evictions += 1
+            self._drop_leaf(min(candidates, key=lambda n: n.last_used))
 
     # -- sanitizer support ---------------------------------------------------
 
@@ -416,6 +850,24 @@ class RadixPrefixCache:
                 return counts
             return counts, pool.snapshot()
 
+    def tier_refs(self) -> Tuple[Dict[int, int], int]:
+        """(host-tier page references per host id, dual-payload node count)
+        under ONE tree-lock hold — the KV sanitizer's two-tier audit: every
+        allocated host id must be referenced by exactly one node, and no
+        node may hold both a device and a host payload."""
+        with self._lock:
+            counts: Dict[int, int] = {}
+            dual = 0
+            stack = [root for root in self._roots.values()]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if node.pages is not None and node.host_pages is not None:
+                    dual += 1
+                for hid in node.host_pages or ():
+                    counts[hid] = counts.get(hid, 0) + 1
+            return counts, dual
+
     # -- observability -------------------------------------------------------
 
     @property
@@ -429,6 +881,10 @@ class RadixPrefixCache:
     def __len__(self) -> int:
         return self._n_nodes
 
+    @property
+    def host_pages_cached(self) -> int:
+        return self._host_pages
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -439,4 +895,16 @@ class RadixPrefixCache:
                 "nodes": self._n_nodes,
                 "cached_bytes": self._bytes,
                 "cached_pages": self._pages,
+                # host tier (docs/kv_tiering.md): zeroes when untiered so
+                # consumers need no schema branch
+                "hits_by_tier": dict(self._hit_tiers),
+                "host_nodes": (
+                    self._n_nodes - self._n_resident
+                    if self._host is not None
+                    else 0
+                ),
+                "host_bytes": self._host_bytes,
+                "host_pages": self._host_pages,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
             }

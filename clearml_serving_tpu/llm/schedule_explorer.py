@@ -465,12 +465,142 @@ def scenario_refcount_lock(ctx: ScenarioContext) -> None:
         )
 
 
+class _ModelTierBackend:
+    """Explorer-local model of the KV tiering backend (docs/kv_tiering.md):
+    page CONTENTS are plain ints, the host side uses the REAL HostKVTier id
+    allocator, and the device queue is a list of pending copy ops. The tier
+    fence — the real backend enqueues the promotion DMA under the dispatch
+    lock BEFORE the new page ids become visible, so any later consumer
+    program is ordered after the copy by data dependency — is modelled by
+    ``flush()``: a consumer "program" first lands every op enqueued before
+    it. Mutation ``drop_tier_fence`` defers the promotion op OUT of the
+    queue (it lands only when a late "DMA thread" re-enqueues it), exactly
+    the corruption an unfenced publish would allow."""
+
+    def __init__(self, host_tier, device_data: Dict[int, int],
+                 drop_fence: bool):
+        self.host_tier = host_tier
+        self.device_data = device_data
+        self.host_data: Dict[int, int] = {}
+        self.queue: List[list] = []     # enqueued device copy programs
+        self.late: List[list] = []      # fence-dropped ops, landed late
+        self.drop_fence = drop_fence
+
+    def demote_pages(self, pages: List[int]) -> List[int]:
+        # synchronous device->host readback: contents are safe on the host
+        # BEFORE the caller releases the device pages
+        ids = self.host_tier.allocate(len(pages))
+        for hid, page in zip(ids, pages):
+            self.host_data[hid] = self.device_data[page]
+        return ids
+
+    def promote_pages(self, host_ids: List[int], pages: List[int]) -> None:
+        op = [(page, self.host_data.pop(hid))
+              for hid, page in zip(host_ids, pages)]
+        if self.drop_fence:
+            self.late.append(op)        # seeded defect: DMA enqueued late
+        else:
+            self.queue.append(op)       # the fence: enqueue before publish
+        self.host_tier.free(host_ids)
+
+    def flush(self) -> None:
+        """A consumer device program: data dependency lands every copy
+        enqueued before it."""
+        for op in self.queue:
+            for page, value in op:
+                self.device_data[page] = value
+        self.queue.clear()
+
+    def land_late(self) -> None:
+        self.queue.extend(self.late)
+        self.late = []
+
+
+def scenario_tier_promotion(ctx: ScenarioContext) -> None:
+    """KV tiering (docs/kv_tiering.md): an eviction DEMOTES a cached run to
+    the host tier while a concurrent admission looks the same run up and
+    map_shared's it. The admission must end up reading the run's original
+    bytes whether it won the race (resident hit) or lost it (host hit whose
+    promotion DMA is fenced ahead of every consumer program). Mutation
+    ``drop_tier_fence`` lets the promotion's copy land AFTER the consumer
+    read — the stale-page corruption an unfenced publish allows."""
+    from .kv_cache import HostKVTier
+    from .kv_sanitizer import KVSanitizer
+    from .prefix_cache import RadixPrefixCache
+
+    pool = _pool(num_pages=9, page_size=4, max_slots=2)
+    host_tier = HostKVTier(4, 4, 1, 1, 2, dtype=np.int8, quantized=False)
+    device_data: Dict[int, int] = {
+        page: -1 for page in range(1, pool.num_pages)  # free pages: garbage
+    }
+    backend = _ModelTierBackend(
+        host_tier, device_data, ctx.mutating("drop_tier_fence")
+    )
+    cache = RadixPrefixCache(
+        block=4, pool=pool, page_bytes=8, backend=backend
+    )
+    ids = list(range(9))                 # 9 tokens -> 8 cacheable (2 blocks)
+    pool.allocate(0, 9)
+    run_pages = pool.slot_pages(0)[:2]   # the cached, block-aligned prefix
+    expect = [100 + page for page in run_pages]
+    for page, value in zip(run_pages, expect):
+        device_data[page] = value
+    cache.store_pages(ids, 0, pool.slot_pages(0))
+    pool.free(0)                         # cache is now the only holder
+    sanitizer = KVSanitizer(pool, prefix_cache=cache)
+    state: Dict[str, Any] = {}
+
+    def evictor():
+        ctx.yield_point("engine.release")
+        cache.spill(0)                   # demote the whole resident run
+        # freed HBM gets reused by other tenants: scramble it so a stale
+        # read can never luck into the original bytes
+        for page in range(1, pool.num_pages):
+            if pool.page_refcount(page) == 0:
+                device_data[page] = -1
+        ctx.yield_point("engine.release")
+
+    def admit():
+        ctx.yield_point("engine.prefill")
+        hit = cache.lookup_pages(ids)
+        ctx.yield_point("engine.prefill")
+        pool.map_shared(1, hit["pages"], hit["len"])
+        ctx.yield_point("engine.dispatch.prepare")
+        # the consumer device program: ordered after every enqueued copy
+        backend.flush()
+        state["read"] = [device_data.get(p, -1) for p in hit["pages"]]
+        state["tier"] = hit["tier"]
+        cache.release(hit)
+        ctx.yield_point("engine.decode")
+
+    def dma():
+        # the fence-dropped copy lands eventually — too late for a
+        # consumer that already read
+        ctx.yield_point("engine.decode")
+        backend.land_late()
+        ctx.yield_point("engine.decode")
+
+    ctx.spawn(evictor, "evictor")
+    ctx.spawn(admit, "admit")
+    ctx.spawn(dma, "dma")
+    ctx.run()
+    if state.get("read") != expect:
+        raise ScheduleViolation(
+            "admission consumed {} instead of {} on a {} hit: the "
+            "promotion copy was not fenced ahead of the consumer "
+            "program".format(state.get("read"), expect, state.get("tier"))
+        )
+    pool.free(1)
+    sanitizer.check("tier-promotion", drained=True)
+
+
 SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "host_buffer_handoff": scenario_host_buffer_handoff,
     "quarantine_barrier": scenario_quarantine_barrier,
     "pin_balance": scenario_pin_balance,
     "stale_chain_commit": scenario_stale_chain_commit,
     "refcount_lock": scenario_refcount_lock,
+    "tier_promotion": scenario_tier_promotion,
 }
 
 # seeded defect -> the scenario that must catch it (self_test proves each)
@@ -480,6 +610,7 @@ MUTATIONS: Dict[str, str] = {
     "drop_unpin": "pin_balance",
     "drop_chain_reset": "stale_chain_commit",
     "drop_lock": "refcount_lock",
+    "drop_tier_fence": "tier_promotion",
 }
 
 

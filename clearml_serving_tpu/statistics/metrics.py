@@ -127,10 +127,14 @@ class PrefixCacheCollector:
         with self._lock:
             entries = dict(self._entries)
         p = self._prefix
+        # hit counter carries the serving TIER (docs/kv_tiering.md): hbm =
+        # the whole run was resident, host = it needed promotion from the
+        # host-RAM tier; sum over tier = total hits
+        hits = CounterMetricFamily(
+            p + "_hits", "prefix-cache lookups that matched >= 1 block, by "
+            "serving tier (hbm = resident, host = promoted from host RAM)",
+            labels=["model", "tier"])
         cache_fams = [
-            ("hits", CounterMetricFamily(
-                p + "_hits", "prefix-cache lookups that matched >= 1 block",
-                labels=["model"])),
             ("misses", CounterMetricFamily(
                 p + "_misses", "prefix-cache lookups with no shared block",
                 labels=["model"])),
@@ -166,6 +170,9 @@ class PrefixCacheCollector:
         any_pool = False
         for key, (cache, pool) in entries.items():
             s = cache.stats()
+            by_tier = s.get("hits_by_tier") or {"hbm": s.get("hits", 0)}
+            for tier_name, count in by_tier.items():
+                hits.add_metric([key, str(tier_name)], count)
             for stat_key, fam in cache_fams:
                 fam.add_metric([key], s[stat_key])
             if pool is not None:
@@ -173,6 +180,7 @@ class PrefixCacheCollector:
                 shared.add_metric([key], pool.shared_pages)
                 free.add_metric([key], pool.free_pages)
                 cow.add_metric([key], pool.cow_events)
+        yield hits
         for _, fam in cache_fams:
             yield fam
         if any_pool:
@@ -343,6 +351,33 @@ class EngineLifecycleCollector:
             "info gauge (always 1): storage dtype of the paged KV pools",
             labels=["model", "dtype"],
         )
+        # host-RAM KV tier (docs/kv_tiering.md): where the prefix cache's
+        # pages live (hbm vs host) and how many moved each way — the
+        # capacity-planning signal the tier exists for
+        kv_tier_pages = GaugeMetricFamily(
+            p + "_kv_tier_pages",
+            "prefix-cache KV pages held, by tier (hbm = device pool, "
+            "host = pinned host RAM)",
+            labels=["model", "tier"],
+        )
+        kv_tier_bytes = GaugeMetricFamily(
+            p + "_kv_tier_bytes",
+            "prefix-cache KV bytes held, by tier",
+            labels=["model", "tier"],
+        )
+        kv_demotions = CounterMetricFamily(
+            p + "_kv_demotions",
+            "demotion events: batched HBM->host spill rounds (eviction "
+            "pressure spilled instead of dropping; pages moved are in "
+            "lifecycle_stats kv_tier.demoted_pages_total)",
+            labels=["model"],
+        )
+        kv_promotions = CounterMetricFamily(
+            p + "_kv_promotions",
+            "promotion events: demoted runs re-onlined to HBM (async DMA "
+            "on a host-tier hit, or by reference at a store)",
+            labels=["model"],
+        )
 
         def _hist_buckets(snap):
             """Engine _MsHistogram snapshot -> prometheus cumulative
@@ -357,6 +392,7 @@ class EngineLifecycleCollector:
         any_grpc = False
         any_pipeline = False
         any_kv_pool = False
+        any_kv_tier = False
         any_slo = False
         any_ragged = False
         for key, provider in providers.items():
@@ -372,6 +408,17 @@ class EngineLifecycleCollector:
                         kv_pool_bytes.add_metric([key, kind], kv_pool[kind])
                 if kv_pool.get("dtype"):
                     kv_pool_dtype.add_metric([key, str(kv_pool["dtype"])], 1)
+            kv_tier = s.get("kv_tier") or {}
+            if kv_tier:
+                any_kv_tier = True
+                for tier_name, v in (kv_tier.get("pages") or {}).items():
+                    kv_tier_pages.add_metric([key, str(tier_name)], v)
+                for tier_name, v in (kv_tier.get("bytes") or {}).items():
+                    kv_tier_bytes.add_metric([key, str(tier_name)], v)
+                if "demotions" in kv_tier:
+                    kv_demotions.add_metric([key], kv_tier["demotions"])
+                if "promotions" in kv_tier:
+                    kv_promotions.add_metric([key], kv_tier["promotions"])
             ragged = s.get("ragged") or {}
             if ragged:
                 any_ragged = True
@@ -456,6 +503,11 @@ class EngineLifecycleCollector:
         if any_kv_pool:
             yield kv_pool_bytes
             yield kv_pool_dtype
+        if any_kv_tier:
+            yield kv_tier_pages
+            yield kv_tier_bytes
+            yield kv_demotions
+            yield kv_promotions
         if any_grpc:
             yield grpc
 

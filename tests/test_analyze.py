@@ -636,11 +636,15 @@ def test_tpu504_ignore_with_reason():
 
 def test_guarded_by_declarations_match_project_registry():
     from clearml_serving_tpu.llm.engine import _ClassedPendingQueue
-    from clearml_serving_tpu.llm.kv_cache import PagedKVCache, PagePool
+    from clearml_serving_tpu.llm.kv_cache import (
+        HostKVTier,
+        PagedKVCache,
+        PagePool,
+    )
     from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
 
     for cls in (PagePool, PagedKVCache, RadixPrefixCache,
-                _ClassedPendingQueue):
+                _ClassedPendingQueue, HostKVTier):
         for lock, attrs in cls.__guarded_by__.items():
             for attr in attrs:
                 entry = rules_locks.PROJECT_REGISTRY.get(attr)

@@ -578,9 +578,14 @@ def test_prefix_cache_aux_config_plumbing(tmp_path, state_root):
     assert prefix.max_pages == 32
     assert prefix.hits >= 1
     assert getattr(processor, "_prefix_collector", None) is not None
-    # the collector scrapes the live cache under the model's label
-    sample = {
-        m.name: {s.labels["model"]: s.value for s in m.samples}
-        for m in processor._prefix_collector.collect()
-    }
-    assert sample["llm_prefix_cache_hits"]["tiny_llm_pfx"] == prefix.hits
+    # the collector scrapes the live cache under the model's label; the
+    # hit counter carries a serving-tier label (docs/kv_tiering.md) —
+    # summing over tiers recovers the total
+    hits_by_tier = {}
+    for m in processor._prefix_collector.collect():
+        if m.name == "llm_prefix_cache_hits":
+            for s in m.samples:
+                if s.labels["model"] == "tiny_llm_pfx":
+                    hits_by_tier[s.labels["tier"]] = s.value
+    assert sum(hits_by_tier.values()) == prefix.hits
+    assert hits_by_tier.get("hbm") == prefix.hits  # untiered: all resident

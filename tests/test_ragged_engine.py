@@ -324,6 +324,45 @@ def test_ragged_cancel_mid_admission_reclaims(parts, monkeypatch):
         engine.stop()
 
 
+def test_ragged_retire_reads_back_only_finishing_rows(parts, monkeypatch):
+    """ISSUE-10 satellite: the retire stage must never read back the full
+    [R, vocab] logits — the dispatch worker gathers only the FINISHING
+    admission rows device-side (None when no job finishes), and the
+    streams stay byte-identical to the two-dispatch arm."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    shapes = []
+    orig = LLMEngineCore._dispatch_ragged_device
+
+    def spy(self, plan):
+        result = orig(self, plan)
+        shapes.append(
+            None if result["logits"] is None
+            else tuple(result["logits"].shape)
+        )
+        return result
+
+    monkeypatch.setattr(LLMEngineCore, "_dispatch_ragged_device", spy)
+    a, b, stats = _ab(bundle, params, [SHORT, LONG], seeds=[None, 22],
+                      cache_mode="paged",
+                      legacy_kw={"pipeline_depth": 1},
+                      ragged_kw={"pipeline_depth": 1})
+    assert a == b, "streams must stay byte-identical under the gather"
+    assert stats["ragged"]["steps"] >= 2
+    assert shapes, "spy never saw a ragged step"
+    vocab = bundle.config["vocab_size"]
+    # most steps finish no job: nothing is read back at all
+    assert any(s is None for s in shapes)
+    finished = [s for s in shapes if s is not None]
+    assert finished, "at least one step must complete an admission"
+    for shape in finished:
+        # padded finishing-row count, never the full R=max_batch rows of
+        # a non-finishing step — with 2 jobs in this workload the padded
+        # gather is at most 2 rows
+        assert shape[1] == vocab
+        assert shape[0] <= 2
+
+
 # -- committed CPU smoke artifact -------------------------------------------
 
 def test_ragged_ab_artifact_schema():

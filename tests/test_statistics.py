@@ -156,7 +156,13 @@ def test_prefix_cache_collector_exports_live_counters():
     def val(name, key="m1"):
         return registry.get_sample_value(name, {"model": key})
 
-    assert val("llm_prefix_cache_hits_total") == 1
+    def hits_val(key="m1", tier="hbm"):
+        # the hit counter carries a serving-tier label (docs/kv_tiering.md)
+        return registry.get_sample_value(
+            "llm_prefix_cache_hits_total", {"model": key, "tier": tier}
+        )
+
+    assert hits_val() == 1
     assert val("llm_prefix_cache_misses_total") == 1
     assert val("llm_prefix_cache_hit_tokens_total") == 4
     assert val("llm_prefix_cache_nodes") == 1
@@ -174,14 +180,14 @@ def test_prefix_cache_collector_exports_live_counters():
     k = np.zeros((1, 1, 4, 1, 2), np.float32)
     dense.store([1, 2, 3], 0, {"k": k, "v": k})
     assert dense.lookup([1, 2, 9], 0) is not None
-    assert val("llm_prefix_cache_hits_total", "m2") == 1
+    assert hits_val("m2") == 1
     assert val("kv_pool_shared_pages", "m2") is None
-    assert val("llm_prefix_cache_hits_total", "m1") == 1  # m1 intact
+    assert hits_val("m1") == 1  # m1 intact
 
     fresh = RadixPrefixCache(block=2)
     c3 = register_prefix_cache(fresh, registry=registry, key="m2")
     assert c3 is c2  # same collector, entry swapped
-    assert val("llm_prefix_cache_hits_total", "m2") == 0
+    assert hits_val("m2") == 0
 
 
 def test_engine_lifecycle_collector_exports_counters_and_gauges():
@@ -592,5 +598,100 @@ def test_engine_ragged_metrics_exported():
         assert registry3.get_sample_value(
             "engine_step_token_budget_utilization_count", {"model": "llm"}
         ) >= 1
+    finally:
+        engine.stop()
+
+
+def test_engine_kv_tier_metrics_exported():
+    """Host-RAM KV tier observability (docs/kv_tiering.md): the lifecycle
+    collector exports engine_kv_tier_pages{tier} / engine_kv_tier_bytes
+    {tier} gauges and the engine_kv_demotions_total /
+    engine_kv_promotions_total counters from the provider's ``kv_tier``
+    block; the prefix-cache hit counter carries the serving tier. Checked
+    from a synthetic provider AND end to end against a real tiered engine
+    that demoted and promoted a run."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "kv_tier": {
+            "pages": {"hbm": 4, "host": 12},
+            "bytes": {"hbm": 1024, "host": 3072},
+            "demotions": 9, "promotions": 3,
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_kv_tier_pages", tier="hbm") == 4
+    assert val("engine_kv_tier_pages", tier="host") == 12
+    assert val("engine_kv_tier_bytes", tier="host") == 3072
+    assert val("engine_kv_demotions_total") == 9
+    assert val("engine_kv_promotions_total") == 3
+
+    # untiered providers (kv_tier None) export no tier families
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "kv_tier": None}, registry=registry2,
+        key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_kv_tier_pages", {"model": "m2", "tier": "hbm"}
+    ) is None
+
+    # end to end: a real tiered engine after a demote -> promote cycle
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+    from clearml_serving_tpu.statistics.metrics import register_prefix_cache
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32",
+                  "kv_quant": "int8"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=96,
+        prefill_buckets=[16, 64], eos_token_id=None, cache_mode="paged",
+        prefix_cache=64, prefix_block=16, prefix_cache_host_pages=16,
+    )
+    try:
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+        register_prefix_cache(
+            engine._prefix, engine.paged_cache.pool, registry=registry3,
+            key="llm",
+        )
+        prompt = [(7 * i + 3) % 100 + 1 for i in range(40)]
+
+        async def run():
+            req = GenRequest(prompt_ids=list(prompt), max_new_tokens=3)
+            out = [t async for t in engine.generate(req)]
+            await engine.wait_drained()
+            return out
+
+        asyncio.run(run())
+        assert engine._prefix.spill(0) == 2
+        asyncio.run(run())  # warm revisit: host-tier hit promotes
+
+        def rval(name, **labels):
+            return registry3.get_sample_value(name, {"model": "llm", **labels})
+
+        assert rval("engine_kv_tier_pages", tier="hbm") == 2  # promoted back
+        assert rval("engine_kv_tier_pages", tier="host") == 0
+        assert rval("engine_kv_tier_bytes", tier="hbm") > 0
+        assert rval("engine_kv_demotions_total") == 1  # one batched round
+        assert rval("engine_kv_promotions_total") == 1
+        # the prefix-cache hit counter carries the serving tier
+        assert rval("llm_prefix_cache_hits_total", tier="host") == 1
+        assert rval("llm_prefix_cache_hits_total", tier="hbm") == 0
     finally:
         engine.stop()
