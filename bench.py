@@ -1502,10 +1502,14 @@ def _pipeline_ab_smoke() -> None:
 def _loadtest(smoke: bool) -> None:
     """``--loadtest [--smoke]``: SLO-aware-scheduling loadtest — open-loop
     Poisson mixed-trace replay against the real engine with priority
-    classes, the preemptible batch lane, the brownout controller and the
-    armed KV sanitizer (benchmarks/slo_loadtest.py; docs/slo_scheduling.md).
-    Emits per-class p50/p99 TTFT + goodput vs offered-load curves and
-    updates benchmarks/LOADTEST_cpu.json."""
+    classes, the preemptible batch lane, the brownout controller, the
+    armed KV sanitizer AND the strict compile sentry (the shared warmup
+    registry llm/warmup.py runs first; any post-warmup XLA compile fails
+    the run, and the committed headline asserts post_warmup_compiles == 0
+    — benchmarks/slo_loadtest.py; docs/slo_scheduling.md;
+    docs/static_analysis.md TPU6xx). Emits per-class p50/p99 TTFT +
+    goodput vs offered-load curves and updates
+    benchmarks/LOADTEST_cpu.json."""
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

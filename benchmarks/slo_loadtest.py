@@ -44,7 +44,7 @@ ARTIFACT = REPO / "benchmarks" / "LOADTEST_cpu.json"
 # artifact schema (asserted by tests/test_loadtest_artifact.py in tier-1)
 SCHEMA_KEYS = {
     "metric", "platform", "smoke", "engine", "mix", "unloaded_ttft_ms",
-    "saturation_rps", "loads", "headline",
+    "saturation_rps", "loads", "headline", "warmup",
 }
 LOAD_KEYS = {
     "x_saturation", "offered_rps", "arrivals", "duration_s", "classes",
@@ -59,6 +59,11 @@ HEADLINE_KEYS = {
     "ttft_ratio_at_2x", "ttft_bound", "ttft_within_bound",
     "batch_goodput_curve_tok_s", "batch_no_cliff", "preemptions_total",
     "sanitizer_checks", "sanitizer_violations",
+    # compile-surface certification (docs/static_analysis.md TPU6xx): XLA
+    # compilations observed AFTER the warmup fence by the strict compile
+    # sentry — the committed artifact asserts 0, so every number in it is
+    # zero-recompile-certified (no mid-run compile stall hid in a tail)
+    "post_warmup_compiles", "compile_sentry_mode",
 }
 
 # the mixed trace: weights sum to 1. Chat + tool loops share system
@@ -335,103 +340,27 @@ async def _run_async(smoke: bool) -> dict:
     engine, cfg = build_engine(smoke)
     mults = (0.5, 1.0, 2.0)
     try:
-        # Shape warmup: compile EVERY prefill bucket, the radix-hit
-        # gather + tail-chunk path per bucket (preempt->resume prompts land
-        # on the larger buckets), and the decode chunk BEFORE anything is
-        # measured. Production fleets run with persistent compilation
-        # caches; on this harness's shared CPU a first-shape XLA compile
-        # mid-run would masquerade as a multi-hundred-ms scheduling tail.
+        # Shape warmup via the SHARED warmup registry (llm/warmup.py —
+        # extracted from this harness's original inline block and
+        # generalized over the engine config): every prefill bucket, the
+        # radix-hit gather + tail chunk per bucket, every resume-commit
+        # final-segment length, every cold-commit page count,
+        # multi-segment tails, and the power-of-two CoW copy programs —
+        # all BEFORE anything is measured. The trace mix rides along as
+        # extra_prompts (twice: the second pass runs the warm radix path
+        # production chat fleets live on). run_warmup then sets the
+        # compile sentry's warmup fence: with TPUSERVE_COMPILE_SENTRY=
+        # strict (run() arms it), ANY further XLA compile fails the run —
+        # the headline below commits post_warmup_compiles == 0, so every
+        # number in the artifact is zero-recompile-certified.
         rng = random.Random(0)
-        from clearml_serving_tpu.llm.engine import GenRequest
+        from clearml_serving_tpu.llm.warmup import run_warmup
 
-        for blen, prefix_len in ((32, 0), (64, 48), (128, 96), (160, 128)):
-            prefix = [
-                (blen * 13 + i * 11) % 250 + 1 for i in range(prefix_len)
-            ]
-            for rep in range(2 if prefix_len else 1):
-                tail = [
-                    (rep * 37 + j * 5 + blen) % 250 + 1 for j in range(15)
-                ]
-                request = GenRequest(
-                    prompt_ids=prefix + tail, max_new_tokens=2
-                )
-                async for _ in engine.generate(request):
-                    pass
-        # resume-commit shapes: a preempted request's history can have any
-        # block-tail length 1..16, and the commit's eager tail-slice /
-        # scatter ops compile once per length ON THE LOOP THREAD — an
-        # unwarmed length mid-run would stall every stream for ~100-200 ms
-        # on this host (measured; a real fleet amortizes this through the
-        # persistent compilation cache)
-        prefix48 = [(64 * 13 + i * 11) % 250 + 1 for i in range(48)]
-        prefix96 = [(128 * 13 + i * 11) % 250 + 1 for i in range(96)]
-        prefix128 = [(160 * 13 + i * 11) % 250 + 1 for i in range(128)]
-        for prefix in (prefix48, prefix96, prefix128):
-            # every final-segment length at every hit bucket: preempted
-            # histories resume (and partially evicted prefixes re-admit)
-            # with arbitrary tail lengths, and the tail's last prefill
-            # segment compiles once per (bucket, length)
-            for t in range(1, 17):
-                tail = [(t * 53 + j * 3) % 250 + 1 for j in range(t)]
-                request = GenRequest(
-                    prompt_ids=prefix + tail, max_new_tokens=2
-                )
-                async for _ in engine.generate(request):
-                    pass
-        # cold-commit scatter warmup: the page-bucketed commit write compiles
-        # once per page COUNT (engine._insert_prefill pads tails to page
-        # multiples); resumes land anywhere in 1..10 pages
-        for n_pages in range(1, 11):
-            ids = [(n_pages * 67 + j * 13) % 250 + 1
-                   for j in range(n_pages * 16 - 3)]
-            request = GenRequest(prompt_ids=ids, max_new_tokens=2)
-            async for _ in engine.generate(request):
-                pass
-        # multi-segment tail warmup: when the radix budget has evicted part
-        # of a stored run, a hit replays with a tail LONGER than one block —
-        # the tail prefill then runs non-final segments (with_logits=False),
-        # a distinct trace per bucket that would otherwise compile mid-run
-        seed31 = [(7 * i + 5) % 250 + 1 for i in range(31)]
-        request = GenRequest(prompt_ids=seed31, max_new_tokens=2)
-        async for _ in engine.generate(request):
-            pass
-        for prefix, tail_len in (
-            (seed31[:16], 17),     # hit 16 + 2-segment tail -> bucket 64
-            (prefix48, 17),        # hit 48 + 2-segment tail -> bucket 128
-            (None, 17),            # hit 128 + 2-segment tail -> bucket 160
-        ):
-            if prefix is None:
-                prefix = [(160 * 13 + i * 11) % 250 + 1 for i in range(128)]
-            tail = [(tail_len * 41 + j * 9) % 250 + 1 for j in range(tail_len)]
-            request = GenRequest(prompt_ids=prefix + tail, max_new_tokens=2)
-            async for _ in engine.generate(request):
-                pass
-        # copy-on-write warmup: radix-shared tail pages CoW when a resumed
-        # slot extends into them, and kv_cache.apply_pending_cow pads pair
-        # lists to power-of-two buckets — each bucket size is a distinct
-        # donated program that would otherwise compile on the DISPATCH path
-        # mid-run. Null-page self-copies are no-ops by construction (same
-        # trick apply_pending_cow's own padding uses).
-        import jax.numpy as jnp
-
-        cache = engine.paged_cache
-        for n in (1, 2, 4, 8):
-            zeros = jnp.zeros((n,), jnp.int32)
-            with cache.dispatch_lock:
-                cache.k = cache._copy_pages(cache.k, zeros, zeros)
-                cache.v = cache._copy_pages(cache.v, zeros, zeros)
-        # trace warmup (twice: the second pass runs the warm radix path)
-        # seeds the shared prefixes — production chat fleets run warm
-        for _ in range(2):
-            for trace in TRACES:
-                request = GenRequest(
-                    prompt_ids=_make_prompt(trace, rng),
-                    max_new_tokens=min(4, trace["max_new"]),
-                    priority=trace["cls"],
-                )
-                async for _ in engine.generate(request):
-                    pass
-        await engine.wait_drained()
+        warm = await run_warmup(
+            engine,
+            full=True,
+            extra_prompts=[_make_prompt(t, rng) for t in TRACES],
+        )
 
         saturation = await _closed_loop_saturation(
             engine, 40 if smoke else 120, seed=2
@@ -463,6 +392,11 @@ async def _run_async(smoke: bool) -> dict:
         sanitizer_stats = (
             sanitizer.stats() if sanitizer is not None
             else {"checks": 0, "failures": -1}
+        )
+        sentry = engine._compile_sentry
+        sentry_stats = (
+            sentry.stats_brief() if sentry is not None
+            else {"mode": "off", "serve": -1, "fenced": False}
         )
         loop_exc = None
         task = engine._loop_task
@@ -513,22 +447,40 @@ async def _run_async(smoke: bool) -> dict:
             "preemptions_total": preemptions_total,
             "sanitizer_checks": sanitizer_stats.get("checks", 0),
             "sanitizer_violations": sanitizer_stats.get("failures", 0),
+            # zero-recompile certification: XLA compiles the strict sentry
+            # counted AFTER llm/warmup.py's fence (tier-1 asserts 0)
+            "post_warmup_compiles": sentry_stats.get("serve", -1),
+            "compile_sentry_mode": sentry_stats.get("mode", "off"),
         },
+        "warmup": warm,
     }
 
 
 def run(smoke: bool = True, write_artifact: bool = True) -> dict:
     """Entry point shared by ``bench.py --loadtest`` and the TPU battery's
-    phase 6. Forces the CPU backend and arms the KV sanitizer BEFORE the
-    engine exists, runs the sweep, optionally updates the committed
-    artifact, and returns the result row."""
+    phase 6. Forces the CPU backend and arms the KV sanitizer AND the
+    strict compile sentry BEFORE the engine exists, runs the sweep,
+    optionally updates the committed artifact, and returns the result
+    row. Strict sentry means the run itself FAILS on any post-warmup XLA
+    compile — completing at all is the zero-recompile certificate the
+    headline commits."""
     os.environ["TPUSERVE_SANITIZE"] = "1"
+    # forced like the sanitizer, not defaulted: a pre-exported "1" in the
+    # environment would silently downgrade the certification run to
+    # count-only mode while the docstring still claims strict
+    os.environ["TPUSERVE_COMPILE_SENTRY"] = "strict"
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    from clearml_serving_tpu.llm import compile_sentry
+
+    if compile_sentry.enabled():
+        # a fresh fence for THIS run (the sentry is process-wide and the
+        # battery may have exercised it already in-process)
+        compile_sentry.get().reset(strict=compile_sentry.strict_enabled())
     row = asyncio.run(_run_async(smoke))
     if write_artifact:
         ARTIFACT.write_text(json.dumps(row, indent=2) + "\n")

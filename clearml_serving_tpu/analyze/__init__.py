@@ -143,6 +143,38 @@ RULES: Dict[str, Tuple[str, str]] = {
         "wrap the call in `with <receiver>.<lock>:`, or annotate the "
         "call site with `# tpuserve: ignore[TPU504] reason`",
     ),
+    "TPU601": (
+        "request-varying length reaches an eager device upload/alloc "
+        "without a registered bucketizer (each distinct length is a "
+        "distinct XLA program: unbounded compile-key cardinality)",
+        "route the value through llm/shapes.py (pow2_bucket / "
+        "pad_to_multiple / pad_pages) or a registered `__bucketizers__` "
+        "helper before it shapes device data",
+    ),
+    "TPU602": (
+        "dtype/weak-type drift into a jit boundary (bare float literal, "
+        "float() conversion, or dtype-less np.asarray at a `*_jit` call "
+        "site splits the compile cache against the cached-constant "
+        "pattern)",
+        "pass an explicitly-typed cached device constant "
+        "(`jnp.float32(x)` / `np.asarray(x, np.int32)`), invalidated at "
+        "commit like the engine's sampling constants",
+    ),
+    "TPU603": (
+        "jit entry violates the class's `__compile_keys__` compile "
+        "surface: either undeclared, or declared serve-path but absent "
+        "from the warmup shape registry (llm/warmup.py WARMUP_COVERED)",
+        "declare the entry under a `__compile_keys__` role; serve-path "
+        "entries must be added to llm/warmup.py's registry (and its "
+        "sweep) so startup/loadtest warmup compiles them before the fence",
+    ),
+    "TPU604": (
+        "request-varying value fed to a static_argnums/static_argnames "
+        "position (static args hash into the compile key: this recompiles "
+        "per request)",
+        "make the argument dynamic, or bucketize it first so the static "
+        "key space is finite",
+    ),
 }
 
 
@@ -278,7 +310,14 @@ def analyze_source(
     select: Optional[Iterable[str]] = None,
 ) -> List[Finding]:
     """All findings for one module's source text (ignores already applied)."""
-    from . import rules_async, rules_errors, rules_jit, rules_locks, rules_threads
+    from . import (
+        rules_async,
+        rules_compile,
+        rules_errors,
+        rules_jit,
+        rules_locks,
+        rules_threads,
+    )
 
     try:
         tree = ast.parse(source, filename=path)
@@ -292,7 +331,7 @@ def analyze_source(
         ]
     findings: List[Finding] = []
     for mod in (rules_async, rules_jit, rules_locks, rules_errors,
-                rules_threads):
+                rules_threads, rules_compile):
         findings.extend(mod.check(tree, path, source))
     ignores = _scope_ignores(tree, _ignore_map(source))
     findings = _filter_ignored(findings, ignores)

@@ -43,9 +43,11 @@ def main(argv=None) -> int:
         help="suppress per-finding output; only the summary table",
     )
     parser.add_argument(
-        "--format", choices=("human", "json"), default="human",
+        "--format", choices=("human", "json", "github"), default="human",
         help="json = one finding object per line "
         "(rule/file/line/col/message/fix) for CI diff annotation; "
+        "github = GitHub Actions workflow-command annotations "
+        "(::error file=...,line=...) rendered inline on the PR diff; "
         "exit codes are identical to human output",
     )
     args = parser.parse_args(argv)
@@ -68,6 +70,24 @@ def main(argv=None) -> int:
         # tree prints zero lines and exits 0
         for finding in findings:
             print(json.dumps(finding.as_dict(), sort_keys=True))
+        return 1 if findings else 0
+    if args.format == "github":
+        # GitHub Actions workflow commands: one ::error per finding (the
+        # runner renders them as inline diff annotations). Same contract
+        # as json: findings only on stdout, identical exit codes. Message
+        # text must stay single-line — workflow commands end at newline —
+        # so the fix-it hint rides the same line.
+        for finding in findings:
+            message = finding.message
+            if finding.hint:
+                message += " fix: {}".format(finding.hint)
+            print(
+                "::error file={},line={},col={},title={}::{}".format(
+                    finding.path, finding.line, finding.col, finding.code,
+                    message.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A"),
+                )
+            )
         return 1 if findings else 0
     if not args.quiet:
         for finding in findings:

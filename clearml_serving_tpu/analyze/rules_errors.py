@@ -48,6 +48,7 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.release",
     "engine.kv.demote",
     "engine.kv.promote",
+    "engine.compile.bucket",
     "grpc.call",
 })
 

@@ -1,0 +1,361 @@
+"""Runtime compile sentry: attribute every serve-time XLA compilation
+(docs/static_analysis.md TPU6xx — the dynamic net behind the static rules).
+
+The compile-surface invariant says the set of (function, shape, dtype)
+keys the serve loop presents to XLA is finite and fully compiled before
+serving starts. The static analyzer proves the bucketizer discipline the
+invariant rests on; this sentry proves the INVARIANT ITSELF at runtime:
+armed with ``TPUSERVE_COMPILE_SENTRY=1`` (count) or ``=strict`` (raise),
+it hooks JAX's compile path, splits compilations at the warmup fence
+(``llm/warmup.py`` sets it after the sweep), attributes each post-fence
+compilation to the in-flight launch (phase, dispatch seq, pipeline depth —
+the engine tags its dispatch workers through a thread-local context), and
+feeds ``engine_xla_compiles_total{phase}`` / ``engine_xla_compile_ms``
+(statistics/metrics.py). In strict mode a post-fence compilation records a
+violation naming the jitted function and its argument avals; the engine
+raises :class:`CompileSentryError` for it at the next loop boundary (the
+same check-at-the-boundary shape as the KV sanitizer).
+
+Hook mechanics (jax 0.4.x): the primary listener is a ``logging.Handler``
+on the two loggers ``jax_log_compiles`` writes through —
+``jax._src.interpreters.pxla`` emits ``Compiling <fn> with global shapes
+and types [<avals>]`` at compile start and ``jax._src.dispatch`` emits
+``Finished XLA compilation of jit(<fn>) in <s> sec`` — captured at DEBUG
+without flipping the (stderr-spamming) ``jax_log_compiles`` flag;
+``propagate`` is disabled on those loggers while installed so armed runs
+stay quiet, and restored on uninstall. ``install()`` PROBES the hook with
+a guaranteed-fresh jit compile; if the log records never arrive (jax
+moved its internals), the sentry falls back to a
+``jax.monitoring`` duration listener on the backend-compile event —
+counts and durations survive, function/aval attribution degrades to the
+thread context, and ``stats()["mode"]`` says which net is live.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+ENV = "TPUSERVE_COMPILE_SENTRY"
+
+_LOGGER_NAMES = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+_COMPILING_RE = re.compile(
+    r"Compiling (\S+) with global shapes and types (\[.*\])\. "
+    r"Argument mapping"
+)
+_FINISHED_RE = re.compile(
+    r"Finished XLA compilation of (?:jit\()?([^)]+)\)? in ([0-9.eE+-]+) sec"
+)
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# scrape-time histogram edges (ms): compile stalls live in the 10 ms (tiny
+# eager op) .. multi-second (big fused graph) range
+_BUCKETS_MS = (10.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 5000.0)
+
+# keep full per-compile attribution for the most recent N events; counters
+# and the histogram are unbounded
+_MAX_EVENTS = 256
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV, "") not in ("", "0")
+
+
+def strict_enabled() -> bool:
+    return os.environ.get(ENV, "") == "strict"
+
+
+class CompileSentryError(RuntimeError):
+    """A post-warmup-fence XLA compilation under strict mode: names the
+    jitted function, its argument avals, and the launch context it was
+    attributed to."""
+
+
+class _SentryHandler(logging.Handler):
+    def __init__(self, sentry: "CompileSentry"):
+        super().__init__(level=logging.DEBUG)
+        self._sentry = sentry
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self._sentry._on_log(record.getMessage())
+        except Exception:  # never let bookkeeping break a compile
+            pass
+
+
+class CompileSentry:
+    """Process-wide compile listener (one per process: the hook surface is
+    global). Thread-safe; attribution context is thread-local so worker
+    threads tag the compiles their own dispatches trigger."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = bool(strict)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._fence = False
+        self._installed = False
+        self._probing = False
+        self._mode = "off"            # "log" | "monitoring" | "off"
+        self._log_seen = False
+        self._handler: Optional[_SentryHandler] = None
+        self._saved: Dict[str, tuple] = {}
+        self.counts = {"warmup": 0, "serve": 0}
+        self._hist_counts = [0] * (len(_BUCKETS_MS) + 1)
+        self._hist_sum_ms = 0.0
+        self.events: List[Dict[str, Any]] = []
+        self.violations: List[Dict[str, Any]] = []
+
+    # -- install / uninstall ----------------------------------------------
+
+    def install(self) -> "CompileSentry":
+        if self._installed:
+            return self
+        for name in _LOGGER_NAMES:
+            logger = logging.getLogger(name)
+            self._saved[name] = (logger.level, logger.propagate)
+        self._handler = _SentryHandler(self)
+        for name in _LOGGER_NAMES:
+            logger = logging.getLogger(name)
+            logger.addHandler(self._handler)
+            logger.setLevel(logging.DEBUG)
+            logger.propagate = False
+        self._installed = True
+        if self._probe():
+            self._mode = "log"
+        else:
+            self._mode = "monitoring"
+            self._install_monitoring()
+        return self
+
+    def _probe(self) -> bool:
+        """Force a guaranteed-fresh jit compile and report whether the log
+        listener saw it (a fresh lambda object is a fresh jit cache, so
+        this compiles no matter what ran before). Probe compiles are not
+        counted."""
+        self._probing = True
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            jax.jit(lambda x: x + jnp.float32(1))(jnp.zeros((3,), jnp.float32))
+        except Exception:
+            return False
+        finally:
+            self._probing = False
+        return self._log_seen
+
+    def _install_monitoring(self) -> None:
+        try:
+            import jax.monitoring as monitoring
+
+            def _on_event(event: str, duration: float, **_kw) -> None:
+                # jax.monitoring has no per-listener unregister: gate on
+                # the installed flag so an uninstalled sentry goes inert
+                # instead of mutating counters forever
+                if (
+                    event == _BACKEND_COMPILE_EVENT
+                    and self._installed
+                    and not self._log_seen
+                ):
+                    self._record(
+                        fn="<unknown>", avals="<unavailable>",
+                        duration_ms=duration * 1e3,
+                    )
+
+            monitoring.register_event_duration_secs_listener(_on_event)
+        except Exception:
+            pass
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for name in _LOGGER_NAMES:
+            logger = logging.getLogger(name)
+            if self._handler is not None:
+                logger.removeHandler(self._handler)
+            level, propagate = self._saved.get(name, (logging.NOTSET, True))
+            logger.setLevel(level)
+            logger.propagate = propagate
+        self._installed = False
+        self._mode = "off"
+
+    # -- attribution context ----------------------------------------------
+
+    @contextlib.contextmanager
+    def context(self, **ctx):
+        """Tag compiles triggered on THIS thread (the engine wraps its
+        dispatch/prefill workers: phase, dispatch seq, pipeline depth)."""
+        prev = getattr(self._tls, "ctx", None)
+        self._tls.ctx = dict(prev or {}, **ctx)
+        try:
+            yield
+        finally:
+            self._tls.ctx = prev
+
+    # -- event intake ------------------------------------------------------
+
+    def _on_log(self, message: str) -> None:
+        m = _COMPILING_RE.search(message)
+        if m is not None:
+            self._log_seen = True
+            if self._probing:
+                return
+            self._record(fn=m.group(1), avals=m.group(2), duration_ms=None)
+            return
+        m = _FINISHED_RE.search(message)
+        if m is not None:
+            self._log_seen = True
+            if self._probing:
+                return
+            try:
+                duration_ms = float(m.group(2)) * 1e3
+            except ValueError:
+                return
+            self._attach_duration(m.group(1), duration_ms)
+
+    def _record(self, fn: str, avals: str,
+                duration_ms: Optional[float]) -> None:
+        ctx = dict(getattr(self._tls, "ctx", None) or {})
+        with self._lock:
+            phase = "serve" if self._fence else "warmup"
+            self.counts[phase] += 1
+            event = {
+                "fn": fn,
+                "avals": avals,
+                "phase": phase,
+                "context": ctx,
+                "t": time.time(),
+                "duration_ms": duration_ms,
+            }
+            self.events.append(event)
+            del self.events[:-_MAX_EVENTS]
+            if duration_ms is not None:
+                self._observe_locked(duration_ms)
+            # a `lazy=True` context marks a __compile_keys__ "lazy"-role
+            # entry (one bounded compile per variant on first use, by
+            # declared design): counted and attributed, never a violation
+            if phase == "serve" and self.strict and not ctx.get("lazy"):
+                self.violations.append(event)
+
+    def _attach_duration(self, fn: str, duration_ms: float) -> None:
+        with self._lock:
+            for event in reversed(self.events):
+                if event["duration_ms"] is None and event["fn"] == fn:
+                    event["duration_ms"] = duration_ms
+                    break
+            else:
+                return
+            self._observe_locked(duration_ms)
+
+    def _observe_locked(self, ms: float) -> None:
+        for i, edge in enumerate(_BUCKETS_MS):
+            if ms <= edge:
+                self._hist_counts[i] += 1
+                break
+        else:
+            self._hist_counts[len(_BUCKETS_MS)] += 1
+        self._hist_sum_ms += ms
+
+    # -- fence / check / stats --------------------------------------------
+
+    def fence(self) -> None:
+        """Everything compiled so far was warmup; everything after is a
+        serve-time compile (and, in strict mode, a violation)."""
+        with self._lock:
+            self._fence = True
+
+    def reset(self, strict: Optional[bool] = None) -> None:
+        """Drop the fence and all accumulated state (tests; a new engine's
+        warmup phase starts clean)."""
+        with self._lock:
+            self._fence = False
+            self.counts = {"warmup": 0, "serve": 0}
+            self.events = []
+            self.violations = []
+            self._hist_counts = [0] * (len(_BUCKETS_MS) + 1)
+            self._hist_sum_ms = 0.0
+            if strict is not None:
+                self.strict = bool(strict)
+
+    def check(self, where: str = "") -> None:
+        """Raise the first pending strict violation (engine loop
+        boundaries call this the way they call the KV sanitizer)."""
+        with self._lock:
+            if not (self.strict and self.violations):
+                return
+            v = self.violations[0]
+        raise CompileSentryError(
+            "XLA compiled {} with avals {} AFTER the warmup fence{}{} — "
+            "a serve-time compile stall; extend llm/warmup.py's sweep or "
+            "bucketize the shape source (docs/static_analysis.md TPU6xx)"
+            .format(
+                v["fn"], v["avals"],
+                " at {}".format(where) if where else "",
+                " (context: {})".format(v["context"]) if v["context"] else "",
+            )
+        )
+
+    @property
+    def post_fence_compiles(self) -> int:
+        with self._lock:
+            return self.counts["serve"]
+
+    def hist_snapshot(self) -> Dict[str, Any]:
+        """engine._MsHistogram-shaped snapshot (buckets/counts/sum_ms) so
+        the metrics collector reuses its histogram plumbing."""
+        with self._lock:
+            return {
+                "buckets": list(_BUCKETS_MS),
+                "counts": list(self._hist_counts),
+                "sum_ms": self._hist_sum_ms,
+            }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "strict": self.strict,
+                "fenced": self._fence,
+                "compiles": dict(self.counts),
+                "violations": len(self.violations),
+                "events": [dict(e) for e in self.events],
+            }
+
+    def stats_brief(self) -> Dict[str, Any]:
+        """The lifecycle_stats()/health() "compile" block (and what the
+        metrics collector reads): counters + histogram, no event list."""
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "strict": self.strict,
+                "fenced": self._fence,
+                "warmup": self.counts["warmup"],
+                "serve": self.counts["serve"],
+                "violations": len(self.violations),
+                "compile_ms": {
+                    "buckets": list(_BUCKETS_MS),
+                    "counts": list(self._hist_counts),
+                    "sum_ms": self._hist_sum_ms,
+                },
+            }
+
+
+# -- module singleton ---------------------------------------------------------
+
+_sentry: Optional[CompileSentry] = None
+_sentry_lock = threading.Lock()
+
+
+def get() -> CompileSentry:
+    """The process-wide sentry, installed on first use (strictness from
+    the env at creation; tests flip ``.strict`` / call ``.reset()``)."""
+    global _sentry
+    with _sentry_lock:
+        if _sentry is None:
+            _sentry = CompileSentry(strict=strict_enabled()).install()
+        return _sentry

@@ -32,6 +32,7 @@ VllmPreprocessRequest (reference preprocess_service.py:619-1348).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import heapq
 import itertools
 import os
@@ -47,7 +48,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import faults, kv_sanitizer
+from . import compile_sentry, faults, kv_sanitizer
 from ..errors import (
     DeadlineExceededError,
     EngineOverloadedError,
@@ -548,6 +549,30 @@ class LLMEngineCore:
             "_prefill_jobs", "_tier_counters",
         ),
         "worker": ("_next_token_dev", "_gstate_dev"),
+    }
+
+    # compile-surface registry (tpuserve-analyze TPU603,
+    # docs/static_analysis.md): every jit entry this class creates must be
+    # declared here, and every "serve"-role entry must appear in the warmup
+    # shape registry (llm/warmup.py WARMUP_COVERED) so its key space
+    # compiles before the serve fence — a serve-time XLA compile is a
+    # 100-1000 ms loop-thread stall that masquerades as scheduling tail.
+    # "lazy" = request-path entries compiled on first use BY DESIGN (rare
+    # features whose one-per-variant compile is bounded and attributed by
+    # the compile sentry, not a per-request key).
+    __compile_keys__ = {
+        "serve": (
+            "_prefill_jit", "_prefill_ring_jit", "_prefill_pipeline_jit",
+            "_prefill_chunk_first_jit", "_prefill_chunk_jit",
+            "_gather_pages_jit", "_assemble_prefix_jit", "_insert_jit",
+            "_merge_rows_jit", "_decode_chunk_jit",
+            "_decode_paged_chunk_jit", "_sample_jit", "_first_lp_jit",
+            "_set_sampling_row_jit", "_spec_chunk_jit", "_spec_paged_jit",
+            "_ragged_paged_jit", "_ragged_dense_jit", "_gather_finish_jit",
+        ),
+        # prompt scoring runs only for completions echo+logprobs requests:
+        # one compile per prefill bucket on first use, sentry-attributed
+        "lazy": ("_score_prompt_jit",),
     }
 
     def __init__(
@@ -1980,11 +2005,44 @@ class LLMEngineCore:
                 paged_cache=self.paged_cache,
             )
 
+        # runtime compile sentry (llm/compile_sentry.py): armed via
+        # TPUSERVE_COMPILE_SENTRY=1|strict. Hooks JAX's compile path,
+        # splits compilations at the warmup fence (llm/warmup.py), and in
+        # strict mode a post-fence compile raises CompileSentryError at
+        # the next loop boundary — the dynamic half of the TPU6xx
+        # compile-surface discipline (docs/static_analysis.md).
+        self._compile_sentry = (
+            compile_sentry.get() if compile_sentry.enabled() else None
+        )
+
     def _sanitize(self, where: str, drained: bool = False) -> None:
         if self._sanitizer is not None:
             self._sanitizer.check(
                 where, drained=drained, inflight=len(self._inflight)
             )
+        if self._compile_sentry is not None:
+            # strict-mode violations surface here, on the loop thread,
+            # through the structured step-failure path (like the sanitizer)
+            self._compile_sentry.check(where=where)
+
+    def _sentry_scope(self, phase: str, **ctx):
+        """Thread-local compile attribution for a dispatch/prefill worker
+        (no-op unless the sentry is armed)."""
+        if self._compile_sentry is None:
+            return contextlib.nullcontext()
+        return self._compile_sentry.context(
+            phase=phase, depth=self.pipeline_depth, **ctx
+        )
+
+    async def warmup(self, full: bool = True) -> dict:
+        """Compile the serve loop's XLA key space ahead of traffic: drive
+        the shared warmup shape registry (llm/warmup.py) against this
+        engine and set the compile sentry's warmup fence when armed.
+        Endpoint startup, ``bench.py --loadtest`` and the coverage tests
+        all run THIS sweep — one coverage-checked list."""
+        from . import warmup as _warmup
+
+        return await _warmup.run_warmup(self, full=full)
 
     # -- public API ----------------------------------------------------------
 
@@ -2909,7 +2967,18 @@ class LLMEngineCore:
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
             },
+            "compile": self._compile_snapshot(),
         }
+
+    def _compile_snapshot(self):
+        """Compile-sentry block shared by health() and lifecycle_stats()
+        (docs/static_analysis.md TPU6xx). None when the sentry is unarmed.
+        The sentry is process-wide (the compile hook surface is global), so
+        co-hosted engines report the same counters — attribution lives in
+        the per-event context, not the counters."""
+        if self._compile_sentry is None:
+            return None
+        return self._compile_sentry.stats_brief()
 
     def lifecycle_stats(self) -> dict:
         """Scrape-time snapshot for statistics.metrics' lifecycle collector
@@ -2962,6 +3031,7 @@ class LLMEngineCore:
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
             },
+            "compile": self._compile_snapshot(),
         }
 
     @property
@@ -3285,6 +3355,15 @@ class LLMEngineCore:
         return None
 
     def _bucket_for(self, n: int) -> int:
+        if faults.active():
+            try:
+                # chaos seam: SKIP the bucketizer — raw per-request lengths
+                # become prefill compile keys, the exact shape-drift defect
+                # the compile sentry exists to catch (its self-test arms
+                # this point and proves the post-fence compile is caught)
+                faults.fire("engine.compile.bucket")
+            except faults.InjectedFault:
+                return max(1, n)
         for b in self._buckets:
             if n <= b:
                 return b
@@ -3320,9 +3399,13 @@ class LLMEngineCore:
             if self._lora_enabled
             else None
         )
-        chosen, rank, top_id, top_lp = self._score_prompt_jit(
-            self.params, jnp.asarray(row), lora_idx
-        )
+        # _score_prompt_jit is declared "lazy" in __compile_keys__: one
+        # bounded compile per bucket on first echo+logprobs use, exempt
+        # from the strict post-fence rule (the sentry still counts it)
+        with self._sentry_scope("score", lazy=True):
+            chosen, rank, top_id, top_lp = self._score_prompt_jit(
+                self.params, jnp.asarray(row), lora_idx
+            )
         chosen = np.asarray(chosen)
         rank = np.asarray(rank)
         top_id = np.asarray(top_id)
@@ -3348,6 +3431,10 @@ class LLMEngineCore:
         touches no slot state, so decode throughput does not stall while a
         long prompt prefills. The cheap commit happens on the loop thread at
         the next chunk boundary (_commit_admission)."""
+        with self._sentry_scope("prefill", prompt_len=len(request.prompt_ids)):
+            return self._prefill_device_inner(request)
+
+    def _prefill_device_inner(self, request: GenRequest):
         if faults.active():
             # chaos seam: delayed prefill (deadline tests) or a raised
             # admission failure (isolated by _admission_task's except path)
@@ -4514,6 +4601,10 @@ class LLMEngineCore:
         row's chunk plus the ONE device launch (donated pools/cache,
         rebound under the dispatch lock — same discipline as the legacy
         dispatch workers)."""
+        with self._sentry_scope("ragged", seq=plan["seq"]):
+            return self._dispatch_ragged_device_inner(plan)
+
+    def _dispatch_ragged_device_inner(self, plan: dict) -> dict:
         t0 = time.perf_counter()
         if faults.active():
             # chaos seam, BEFORE any device work: a per-request poison
@@ -5252,6 +5343,10 @@ class LLMEngineCore:
         on the paged backend, the host page allocation it needs). Only
         touches state the retire stage never reads: the cache/pool handles,
         the device-resident chains, and the dispatch histogram."""
+        with self._sentry_scope("decode", seq=prep["seq"]):
+            return self._dispatch_device_inner(prep)
+
+    def _dispatch_device_inner(self, prep: dict) -> "_InFlightChunk":
         t0 = time.perf_counter()
         if faults.active():
             # chaos seam (BEFORE any device dispatch, so a per-request

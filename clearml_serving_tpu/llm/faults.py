@@ -55,6 +55,16 @@ Known points (ctx carried with each):
                          raise aborts the demotion — the node drops for
                          real (legacy eviction), leak-free under the armed
                          sanitizer.
+- ``engine.compile.bucket`` — inside the engine's prefill bucket picker
+                         (``_bucket_for``); a raise makes the picker return
+                         the RAW request length instead of a bucket — the
+                         seeded shape-drift defect of the compile-surface
+                         discipline (docs/static_analysis.md TPU6xx): every
+                         novel prompt length then mints a fresh XLA program,
+                         which the armed compile sentry
+                         (llm/compile_sentry.py) must count post-fence and,
+                         in strict mode, raise on. Proven caught by the
+                         sentry self-test in tests/test_compile_sentry.py.
 - ``engine.kv.promote`` — as a lookup on a demoted run is about to allocate
                          device pages and enqueue the host→device re-online
                          DMA (``pages``); a raise aborts the promotion — the
@@ -127,6 +137,7 @@ KNOWN_POINTS = frozenset({
     "engine.release",
     "engine.kv.demote",
     "engine.kv.promote",
+    "engine.compile.bucket",
     "grpc.call",
 })
 

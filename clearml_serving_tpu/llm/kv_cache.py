@@ -56,6 +56,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .shapes import pad_pages, pow2_bucket
+
 
 class PagePool:
     """Host-side refcounted page allocator for a fixed pool.
@@ -629,9 +631,7 @@ class PagedKVCache:
         pairs = self.pool.drain_pending_cow()
         if not pairs:
             return 0
-        bucket = 1
-        while bucket < len(pairs):
-            bucket *= 2
+        bucket = pow2_bucket(len(pairs))
         padded = pairs + [(0, 0)] * (bucket - len(pairs))
         srcs = jnp.asarray([s for s, _ in padded], jnp.int32)
         dsts = jnp.asarray([d for _, d in padded], jnp.int32)
@@ -675,21 +675,32 @@ class PagedKVCache:
             raise RuntimeError("demote_pages without an enabled host tier")
         host_ids = tier.allocate(len(pages))
         try:
-            idx = jnp.asarray(pages, jnp.int32)
+            # pad the victim list to a power-of-two with null-page entries
+            # (llm/shapes.py): the gather compiles once per power of two
+            # instead of once per demotion-round size — an unbucketed round
+            # would mint a fresh XLA program on the eviction path mid-serve
+            # (tpuserve-analyze TPU601; docs/static_analysis.md)
+            n = len(pages)
+            idx = jnp.asarray(pad_pages(pages), jnp.int32)
             with self.dispatch_lock:
-                k_slab = self.k[:, :, idx]          # [L, Hkv, n, P, D]
+                k_slab = self.k[:, :, idx]          # [L, Hkv, n_pad, P, D]
                 v_slab = self.v[:, :, idx]
                 if self.kv_quant:
-                    ks_slab = self.k_scale[:, :, idx]   # [L, Hkv, n, P]
+                    ks_slab = self.k_scale[:, :, idx]   # [L, Hkv, n_pad, P]
                     vs_slab = self.v_scale[:, :, idx]
             # device->host readback OUTSIDE the dispatch lock: the gather
             # outputs are immutable device arrays; only the (cheap) enqueue
-            # needed serializing against donating dispatches
-            tier.hk[host_ids] = np.moveaxis(np.asarray(k_slab), 2, 0)
-            tier.hv[host_ids] = np.moveaxis(np.asarray(v_slab), 2, 0)
+            # needed serializing against donating dispatches. Rows past the
+            # real count gathered the null page and are dropped here.
+            tier.hk[host_ids] = np.moveaxis(np.asarray(k_slab), 2, 0)[:n]
+            tier.hv[host_ids] = np.moveaxis(np.asarray(v_slab), 2, 0)[:n]
             if self.kv_quant:
-                tier.hk_scale[host_ids] = np.moveaxis(np.asarray(ks_slab), 2, 0)
-                tier.hv_scale[host_ids] = np.moveaxis(np.asarray(vs_slab), 2, 0)
+                tier.hk_scale[host_ids] = (
+                    np.moveaxis(np.asarray(ks_slab), 2, 0)[:n]
+                )
+                tier.hv_scale[host_ids] = (
+                    np.moveaxis(np.asarray(vs_slab), 2, 0)[:n]
+                )
         except BaseException:
             tier.free(host_ids)
             raise
@@ -718,14 +729,27 @@ class PagedKVCache:
                     len(host_ids), len(pages)
                 )
             )
-        # fancy indexing COPIES: staged slabs are private to this promotion
-        k_rows = tier.hk[host_ids]            # [n, L, Hkv, P, D]
-        v_rows = tier.hv[host_ids]
+        # stage into POWER-OF-TWO-bucketed private slabs (llm/shapes.py):
+        # fancy indexing COPIES the real rows, rows beyond the count stay
+        # zero and scatter into the dead null page 0 — so the upload and
+        # the donated page scatter compile once per power of two, not once
+        # per promotion size (tpuserve-analyze TPU601), and never alias
+        # tier memory a later demotion may overwrite (the PR-4 race class)
+        n = len(pages)
+        padded = pad_pages(pages)
+        k_rows = np.zeros((len(padded),) + tier.hk.shape[1:], tier.hk.dtype)
+        v_rows = np.zeros_like(k_rows)
+        k_rows[:n] = tier.hk[host_ids]        # [n_pad, L, Hkv, P, D]
+        v_rows[:n] = tier.hv[host_ids]
         if self.kv_quant:
-            ks_rows = tier.hk_scale[host_ids]
-            vs_rows = tier.hv_scale[host_ids]
+            ks_rows = np.zeros(
+                (len(padded),) + tier.hk_scale.shape[1:], tier.hk_scale.dtype
+            )
+            vs_rows = np.zeros_like(ks_rows)
+            ks_rows[:n] = tier.hk_scale[host_ids]
+            vs_rows[:n] = tier.hv_scale[host_ids]
         tier.free(host_ids)
-        page_ids = jnp.asarray(pages, jnp.int32)
+        page_ids = jnp.asarray(padded, jnp.int32)
         t_issue = time.perf_counter()
         with self.dispatch_lock:
             # the fence holds the UPLOADED chunk arrays (not the pool
@@ -852,7 +876,10 @@ class PagedKVCache:
 
         k_chunks = to_chunks(k_stack, True)
         v_chunks = to_chunks(v_stack, True)
-        page_ids = jnp.asarray(pages, jnp.int32)
+        # page-multiple key space: one trace per page COUNT (the commit
+        # path already rounds through pool.pages_needed, and llm/warmup.py
+        # compiles counts 1..N before the serve fence)
+        page_ids = jnp.asarray(pages, jnp.int32)  # tpuserve: ignore[TPU601] page-count-keyed, warmup-covered
         with self.dispatch_lock:
             self.k = self._write_pages(self.k, k_chunks, page_ids)
             self.v = self._write_pages(self.v, v_chunks, page_ids)

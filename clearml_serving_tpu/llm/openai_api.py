@@ -60,7 +60,30 @@ class LLMEngineRequest(BaseEngineRequest):
         # endpoint-level SLO class default (docs/slo_scheduling.md): aux
         # engine.default_priority; a request body `priority` overrides it
         self._default_priority = "interactive"
+        # startup shape warmup (aux engine.warmup; llm/warmup.py)
+        self._warmup_needed = False
+        self._warmup_full = False
+        self._warmup_task = None
         super().__init__(*args, **kwargs)
+
+    async def _ensure_warm(self) -> None:
+        """First arrivals share one warmup task (llm/warmup.py) and wait
+        for it; afterwards this is one attribute read. A failed warmup is
+        logged and disabled rather than bricking the endpoint — serving
+        then compiles lazily, exactly the pre-knob behavior."""
+        if not self._warmup_needed or self.engine is None:
+            return
+        if self._warmup_task is None:
+            self._warmup_task = asyncio.create_task(
+                self.engine.warmup(full=self._warmup_full)
+            )
+        try:
+            await asyncio.shield(self._warmup_task)
+        except Exception as ex:  # tpuserve: ignore[TPU401] warmup is best-effort by contract; failure falls back to lazy compiles and is logged
+            logging.getLogger(__name__).warning(
+                "engine warmup failed (serving will compile lazily): %s", ex
+            )
+        self._warmup_needed = False
 
     # -- loading --------------------------------------------------------------
 
@@ -331,6 +354,26 @@ class LLMEngineRequest(BaseEngineRequest):
                 "aux engine.default_priority must be one of {}: got {!r}"
                 .format("/".join(PRIORITY_CLASSES), self._default_priority)
             )
+        # startup shape warmup (llm/warmup.py, docs/static_analysis.md
+        # TPU6xx): "startup" runs the cheap per-bucket pass before the
+        # first request is admitted, "full" runs the whole
+        # zero-recompile-certified sweep. Runs as ONE shared task the
+        # first arrivals await — the alternative is every cold shape
+        # compiling 100-1000 ms on the loop thread under live traffic.
+        warmup_mode = str(engine_cfg.get("warmup", "off")).lower()
+        if warmup_mode in ("1", "true", "on"):
+            warmup_mode = "startup"
+        if warmup_mode in ("0", "false"):
+            warmup_mode = "off"
+        if warmup_mode not in ("off", "startup", "full"):
+            # fail at ENDPOINT LOAD, same contract as default_priority
+            raise ValueError(
+                "aux engine.warmup must be off/startup/full: got {!r}"
+                .format(engine_cfg.get("warmup"))
+            )
+        self._warmup_full = warmup_mode == "full"
+        self._warmup_needed = warmup_mode != "off"
+        self._warmup_task = None
         self._model_name = self.endpoint.serving_url
         if self.engine._prefix is not None:
             # hit rate / shared pages / CoW visible from day one on the same
@@ -980,6 +1023,7 @@ class LLMEngineRequest(BaseEngineRequest):
         )
 
         self._require_engine("v1/chat/completions")
+        await self._ensure_warm()
         messages = body.get("messages") or []
         tool_mode, forced_tool = resolve_tool_choice(body)
         # OpenAI semantics: tool_choice "none" only prevents CALLING — the
@@ -1386,6 +1430,7 @@ class LLMEngineRequest(BaseEngineRequest):
 
     async def v1_completions(self, body: Dict[str, Any], state: dict, collect_fn=None):
         self._require_engine("v1/completions")
+        await self._ensure_warm()
         if body.get("suffix") is not None:
             # vLLM rejects suffix explicitly — even "" — (fill-in-middle
             # needs a FIM-trained model + template); silent ignoring would

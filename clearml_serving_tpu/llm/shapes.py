@@ -1,0 +1,61 @@
+"""Shared shape-bucketing helpers — the registered compile-surface
+sanitizers (docs/static_analysis.md, TPU6xx).
+
+Every serve-time XLA recompile is a 100-1000 ms stall of the loop thread
+that masquerades as scheduling tail, so any value derived from per-request
+data (prompt length, token count, page count) must collapse into a FINITE
+key space before it reaches a jit boundary or an eager device op. These
+helpers are the canonical collapses:
+
+- ``pow2_bucket``      — next power of two (log2(max) keys);
+- ``pad_to_multiple``  — round up to a fixed multiple (max/m keys, the
+                         page-multiple pad of the PR-6 commit-slice fix);
+- ``pad_pages``        — pad a device-page id list to a power-of-two
+                         length with null-page (id 0) no-op entries, the
+                         idiom ``PagedKVCache.apply_pending_cow`` proved:
+                         gathers of page 0 are discarded host-side and
+                         scatters into page 0 land in the dead null page.
+
+The static analyzer (``analyze/rules_compile.py``, rule TPU601) treats a
+call to any name in its ``BUCKETIZERS`` registry as laundering the
+request-varying taint; this module is the project-level home of those
+names — a new bucketizer is added HERE and registered THERE (the
+registry-consistency test in tests/test_analyze_compile.py pins the two
+together).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["pow2_bucket", "pad_to_multiple", "pad_pages"]
+
+
+def pow2_bucket(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= max(n, lo). The canonical unbounded->log2
+    cardinality collapse for counts (CoW pair lists, finish-row gathers,
+    dense ragged chunk widths, tier demotion/promotion rounds)."""
+    bucket = max(1, int(lo))
+    n = int(n)
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Round ``n`` up to a whole multiple (page-multiple pads: the compile
+    key collapses from per-token-length to per-page-count)."""
+    m = int(multiple)
+    if m <= 0:
+        raise ValueError("multiple must be positive (got {})".format(m))
+    return -(-int(n) // m) * m
+
+
+def pad_pages(pages: Sequence[int], lo: int = 1) -> List[int]:
+    """Pad a page-id list to a power-of-two length with null-page (id 0)
+    entries, so the gather/scatter consuming it compiles once per power of
+    two instead of once per count. Page 0 is the pool's dead null page by
+    project convention: gathered rows beyond the real count are discarded
+    host-side, and scattered rows land where nothing ever reads."""
+    pages = list(pages)
+    return pages + [0] * (pow2_bucket(len(pages), lo) - len(pages))

@@ -1,0 +1,287 @@
+"""Warmup shape registry: compile the serve loop's XLA key space BEFORE the
+serve fence (docs/static_analysis.md TPU6xx, docs/slo_scheduling.md).
+
+Every serve-time XLA compile is a 100-1000 ms stall of the loop thread that
+masquerades as scheduling tail — PR 6's loadtest measured each unwarmed
+shape costing 100-1000 ms mid-run, and PR 10's tiering work re-discovered
+the same class on resume-commit shapes. The fix was an inline warmup block
+private to the loadtest; this module is that block extracted, generalized
+over the ENGINE'S OWN configuration (prefill buckets, prefix block, page
+size, scheduler), and made a registry three consumers share:
+
+- engine startup (``LLMEngineCore.warmup()``, e.g. at endpoint load),
+- ``bench.py --loadtest`` (benchmarks/slo_loadtest.py),
+- tests (the warmup-coverage suite proves a warmed engine serves in-class
+  traffic with ZERO further compiles under the strict compile sentry).
+
+``WARMUP_COVERED`` is the machine-readable half: the engine jit entries
+whose key space the sweep drives. The static analyzer (TPU603,
+analyze/rules_compile.py) parses it FROM SOURCE — keep it a literal — and
+requires every ``"serve"``-role entry of the engine's ``__compile_keys__``
+to appear here, so a new dispatch-path jit entry cannot land without
+either a warmup extension or an explicit role reclassification.
+
+What the sweep enumerates (derived from engine attributes, never
+hard-coded): cold prefill per bucket; radix-hit gather + tail chunk per
+bucket; every resume-commit final-segment length 1..block per hit bucket
+(preempted histories resume with arbitrary tails); cold-commit scatters at
+every page count up to the largest bucket; multi-segment tails (partially
+evicted prefixes replay tails longer than one block); power-of-two CoW
+copy buckets (and, on int8 pools, their scale-row copies); the ragged
+finish-row gathers at every power of two; a spec-decode round when
+speculation is on. Coverage assumption, stated plainly: the sweep warms
+the PLAIN-SAMPLING serve surface — sampling-extras / guided / logprob
+variants trace on first use (each is one bounded compile per variant, not
+a per-request key), and the compile sentry attributes them when armed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# The engine jit entries whose compile keys the sweep drives (conditional
+# on the engine's configuration: a dense engine has no paged entries to
+# warm, a two-dispatch engine no ragged ones). Parsed from source by
+# analyze/rules_compile.py (TPU603) — MUST stay a literal; the analyzer's
+# build-time mirror is consistency-tested in tests/test_analyze_compile.py.
+WARMUP_COVERED = frozenset({
+    "_prefill_jit",
+    "_prefill_ring_jit",
+    "_prefill_pipeline_jit",
+    "_prefill_chunk_first_jit",
+    "_prefill_chunk_jit",
+    "_gather_pages_jit",
+    "_assemble_prefix_jit",
+    "_insert_jit",
+    "_merge_rows_jit",
+    "_decode_chunk_jit",
+    "_decode_paged_chunk_jit",
+    "_sample_jit",
+    "_first_lp_jit",
+    "_set_sampling_row_jit",
+    "_spec_chunk_jit",
+    "_spec_paged_jit",
+    "_ragged_paged_jit",
+    "_ragged_dense_jit",
+    "_gather_finish_jit",
+})
+
+
+def _ids(seed: int, n: int, vocab: int) -> List[int]:
+    """Deterministic token content: the same (seed, n) always yields the
+    same ids, so a stored radix prefix is hit by the later sweep steps
+    that rely on it."""
+    lim = max(2, min(250, vocab - 2))
+    return [(seed * 13 + i * 11) % lim + 1 for i in range(n)]
+
+
+def _tail(seed: int, n: int, vocab: int) -> List[int]:
+    lim = max(2, min(250, vocab - 2))
+    return [(seed * 53 + j * 3) % lim + 1 for j in range(n)]
+
+
+def warmup_plan(engine, full: bool = True) -> List[Dict[str, Any]]:
+    """Enumerate the warmup REQUEST sweep for this engine's configuration:
+    a list of ``{"prompt_ids": [...], "max_new_tokens": n}`` specs in the
+    order they must run (earlier steps seed the radix runs later steps
+    hit). ``full=False`` keeps only the per-bucket cold+hit pass — the
+    cheap startup subset; the full sweep is what the zero-recompile
+    certification runs."""
+    vocab = max(engine._vocab, 8)
+    buckets = list(engine._buckets)
+    if buckets[-1] < engine.max_seq_len:
+        # _bucket_for falls back to max_seq_len for prompts past the last
+        # configured bucket — that implicit bucket is part of the compile
+        # surface too (the sentry caught exactly this hole in testing)
+        buckets.append(engine.max_seq_len)
+    prefix = engine._prefix
+    block = prefix.block if prefix is not None else 0
+    paged = engine.paged_cache is not None
+    plan: List[Dict[str, Any]] = []
+
+    def req(ids: List[int], max_new: int = 2) -> None:
+        if 0 < len(ids) < engine.max_seq_len:
+            plan.append({"prompt_ids": ids, "max_new_tokens": max_new})
+
+    def bucket_prefix_len(b: int) -> int:
+        # largest block multiple that leaves room for a sub-block tail in
+        # the same bucket (0 = no stored prefix at this bucket)
+        return ((b - block) // block) * block if block and b > block else 0
+
+    # 1) cold prefill per bucket + radix store/hit per bucket: the repeat
+    # runs the hit path (gather/assemble + tail chunk) at that bucket
+    for b in buckets:
+        p = bucket_prefix_len(b)
+        head = _ids(b, p, vocab)
+        reps = 2 if (p and prefix is not None) else 1
+        for rep in range(reps):
+            tail = [
+                (rep * 37 + j * 5 + b) % max(2, min(250, vocab - 2)) + 1
+                for j in range(max(1, min(b - p, block or b) - 1))
+            ]
+            req(head + tail)
+    if not full or prefix is None:
+        return plan
+
+    # 2) resume-commit tails, single-page: a preempted request's history
+    # (and a partially evicted prefix) can resume with ANY final-segment
+    # length 1..block, and the commit's tail slice/scatter compiles once
+    # per (bucket, length-class) — the exact class PR 6 measured at
+    # 100-200 ms per unwarmed length on the loop thread
+    for b in buckets:
+        p = bucket_prefix_len(b)
+        if p < block:
+            continue
+        head = _ids(b, p, vocab)
+        for t in range(1, block + 1):
+            req(head + _tail(t, t, vocab))
+
+    # 2b) resume-commit tails, multi-page: the commit slices the mini
+    # cache with a DYNAMIC start and a PAGE-MULTIPLE static size
+    # (engine._insert_prefill._tail), so its key space is (mini-cache
+    # bucket, padded tail pages) — and eviction can shorten a stored run
+    # to ANY block-multiple depth, which makes EVERY (bucket, k*page)
+    # pair reachable at serve time (the strict sentry caught exactly the
+    # missing (128, 2-page) pair during this sweep's own development).
+    # A stored head's trie path contains all its block-aligned prefixes,
+    # so head[:p'] + a fresh tail forces each pair deliberately.
+    if paged:
+        page = engine.paged_cache.pool.page_size
+        for b in buckets:
+            p_b = bucket_prefix_len(b)
+            if p_b < block:
+                continue
+            head = _ids(b, p_b, vocab)
+            for k in range(2, (b - block) // page + 1):
+                p_prime = ((b - k * page) // block) * block
+                if p_prime < block or p_prime > p_b:
+                    continue
+                tail_len = (k - 1) * page + 1
+                req(head[:p_prime] + _tail(200 + b + k, tail_len, vocab))
+
+    # 3) cold-commit scatter at every page count: the page-bucketed commit
+    # write compiles once per page COUNT (kv_cache._scatter_pages)
+    if paged:
+        page = engine.paged_cache.pool.page_size
+        for n_pages in range(1, engine.paged_cache.pool.pages_needed(
+                buckets[-1]) + 1):
+            n = n_pages * page - min(3, page - 1)
+            req(_ids(67 + n_pages, n, vocab))
+
+    # 4) multi-segment tails: when eviction shortened a stored run, a hit
+    # replays a tail LONGER than one block — non-final chunk segments
+    # (with_logits=False) are a distinct trace per bucket
+    if block:
+        seed_run = _ids(7, 2 * block - 1, vocab)
+        req(seed_run)
+        heads = [seed_run[:block]]
+        heads += [
+            _ids(b, bucket_prefix_len(b), vocab)
+            for b in buckets
+            if bucket_prefix_len(b) >= block
+        ]
+        for i, head in enumerate(heads):
+            req(head + _tail(100 + i, block + 1, vocab))
+
+    # 5) speculation: one longer greedy request so the spec draft/verify
+    # chunk (and its commit bookkeeping) traces before the fence
+    if engine._speculation is not None:
+        k = engine._spec_k
+        req(
+            _ids(5, max(1, 2 * block or 8), vocab),
+            max_new=max(4, 2 * engine.decode_steps * (k + 1)),
+        )
+    return plan
+
+
+async def run_warmup(
+    engine,
+    full: bool = True,
+    extra_prompts: Optional[List[List[int]]] = None,
+    fence: bool = True,
+) -> Dict[str, Any]:
+    """Drive the warmup sweep against a live engine, then (optionally) set
+    the compile sentry's warmup fence: every XLA compile after the fence
+    is attributed to serving and — in strict mode — raises. Returns
+    ``{"requests", "cow_buckets", "fenced"}``.
+
+    ``extra_prompts`` lets a caller append workload-specific prompts (the
+    loadtest replays its trace mix twice so production-shaped shared
+    prefixes run warm); each is swept twice, cold then radix-hit.
+    """
+    import jax.numpy as jnp
+
+    from . import compile_sentry
+    from .engine import GenRequest
+
+    plan = warmup_plan(engine, full=full)
+    for spec in plan:
+        request = GenRequest(
+            prompt_ids=spec["prompt_ids"],
+            max_new_tokens=spec["max_new_tokens"],
+        )
+        async for _ in engine.generate(request):
+            pass
+    if extra_prompts:
+        for rep in range(2):  # second pass runs the warm radix path
+            for ids in extra_prompts:
+                request = GenRequest(
+                    prompt_ids=list(ids), max_new_tokens=2
+                )
+                async for _ in engine.generate(request):
+                    pass
+
+    # copy-on-write program warmup: apply_pending_cow pads pair lists to
+    # power-of-two buckets (llm/shapes.py) and each bucket is a distinct
+    # DONATED program that would otherwise compile on the dispatch path
+    # mid-run. Null-page self-copies are no-ops by construction. On int8
+    # pools the scale pools CoW in the same batch — warm those programs too.
+    cow = 0
+    cache = engine.paged_cache
+    if full and cache is not None:
+        # bound by max_seq_len, not the last configured bucket: prompts in
+        # the implicit fallback bucket hold pages_needed(max_seq_len)
+        # pages, and their resumes can CoW-burst past a smaller bound
+        max_pairs = 2 * cache.pool.pages_needed(engine.max_seq_len)
+        p = 1
+        while p <= max_pairs:
+            zeros = jnp.zeros((p,), jnp.int32)
+            with cache.dispatch_lock:
+                cache.k = cache._copy_pages(cache.k, zeros, zeros)
+                cache.v = cache._copy_pages(cache.v, zeros, zeros)
+                if cache.k_scale is not None:
+                    cache.k_scale = cache._copy_pages(
+                        cache.k_scale, zeros, zeros
+                    )
+                    cache.v_scale = cache._copy_pages(
+                        cache.v_scale, zeros, zeros
+                    )
+            cow += 1
+            p *= 2
+
+    # ragged finish-row gather: retire reads back only finishing admission
+    # rows, padded to a power of two — warm every pad size directly
+    if full and engine._ragged and engine._gather_finish_jit is not None:
+        logits = jnp.zeros((engine.max_batch, max(engine._vocab, 8)),
+                           jnp.float32)
+        p = 1
+        while p <= engine.max_batch:
+            engine._gather_finish_jit(logits, jnp.zeros((p,), jnp.int32))
+            p *= 2
+
+    await engine.wait_drained()
+    fenced = False
+    if fence and full and compile_sentry.enabled():
+        # only the FULL sweep certifies: fencing after the reduced
+        # startup pass would declare a knowingly-incomplete surface
+        # warmed — resume tails and CoW programs would then count (and in
+        # strict mode raise) as serve-time violations on a healthy engine.
+        # Callers that deliberately fence a partial sweep (tests proving
+        # the fence machinery) call compile_sentry.get().fence() directly.
+        compile_sentry.get().fence()
+        fenced = True
+    return {
+        "requests": len(plan) + 2 * len(extra_prompts or []),
+        "cow_buckets": cow,
+        "fenced": fenced,
+    }

@@ -378,6 +378,24 @@ class EngineLifecycleCollector:
             "on a host-tier hit, or by reference at a store)",
             labels=["model"],
         )
+        # compile-surface discipline (docs/static_analysis.md TPU6xx): XLA
+        # compilations observed by the compile sentry, split at the warmup
+        # fence — phase="serve" must stay 0 on a zero-recompile-certified
+        # engine; anything else is a loop-thread stall hiding in the tail
+        xla_compiles = CounterMetricFamily(
+            p + "_xla_compiles_total",
+            "XLA compilations observed by the compile sentry "
+            "(TPUSERVE_COMPILE_SENTRY), by phase (warmup = before the "
+            "llm/warmup.py fence, serve = after: each is a loop-thread "
+            "compile stall)",
+            labels=["model", "phase"],
+        )
+        xla_compile_ms = HistogramMetricFamily(
+            p + "_xla_compile_ms",
+            "per-compilation XLA compile time (ms) observed by the "
+            "compile sentry",
+            labels=["model"],
+        )
 
         def _hist_buckets(snap):
             """Engine _MsHistogram snapshot -> prometheus cumulative
@@ -395,6 +413,7 @@ class EngineLifecycleCollector:
         any_kv_tier = False
         any_slo = False
         any_ragged = False
+        any_compile = False
         for key, provider in providers.items():
             try:
                 s = provider() or {}
@@ -419,6 +438,18 @@ class EngineLifecycleCollector:
                     kv_demotions.add_metric([key], kv_tier["demotions"])
                 if "promotions" in kv_tier:
                     kv_promotions.add_metric([key], kv_tier["promotions"])
+            compile_block = s.get("compile") or {}
+            if compile_block:
+                any_compile = True
+                for phase in ("warmup", "serve"):
+                    if phase in compile_block:
+                        xla_compiles.add_metric(
+                            [key, phase], compile_block[phase]
+                        )
+                snap = compile_block.get("compile_ms")
+                if snap:
+                    buckets, total = _hist_buckets(snap)
+                    xla_compile_ms.add_metric([key], buckets, total)
             ragged = s.get("ragged") or {}
             if ragged:
                 any_ragged = True
@@ -508,6 +539,9 @@ class EngineLifecycleCollector:
             yield kv_tier_bytes
             yield kv_demotions
             yield kv_promotions
+        if any_compile:
+            yield xla_compiles
+            yield xla_compile_ms
         if any_grpc:
             yield grpc
 

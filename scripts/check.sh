@@ -51,6 +51,11 @@ if [ "$advisory_rc" -ne 0 ]; then
   fi
 fi
 
+# one pass runs every rule family, TPU1xx..TPU6xx — including the
+# compile-surface rules (TPU601-604: bucketizer discipline, __compile_keys__
+# closed world, warmup-registry coverage; docs/static_analysis.md). CI
+# (.github/workflows/checks.yml) invokes this same script; use
+# `--format github` there for inline diff annotations.
 echo "== tpuserve-analyze =="
 python -m clearml_serving_tpu.analyze "${paths[@]}" || rc=1
 

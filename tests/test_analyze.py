@@ -678,7 +678,8 @@ def test_every_emitted_code_is_in_the_catalog():
     # (TPU000 = unparseable file, emitted by the driver itself)
     for code in ("TPU000", "TPU101", "TPU102", "TPU103", "TPU104", "TPU201",
                  "TPU202", "TPU203", "TPU301", "TPU401", "TPU402", "TPU403",
-                 "TPU501", "TPU502", "TPU503", "TPU504"):
+                 "TPU501", "TPU502", "TPU503", "TPU504",
+                 "TPU601", "TPU602", "TPU603", "TPU604"):
         assert code in RULES
 
 

@@ -58,6 +58,13 @@ def test_artifact_internal_consistency():
     assert head["preemptions_total"] >= 10
     assert head["sanitizer_violations"] == 0
     assert head["sanitizer_checks"] > 0
+    # zero-recompile certification (docs/static_analysis.md TPU6xx): the
+    # run completed under the STRICT compile sentry with every XLA compile
+    # landing before llm/warmup.py's fence — no number in this artifact
+    # hides a mid-run compile stall
+    assert head["post_warmup_compiles"] == 0
+    assert head["compile_sentry_mode"] in ("log", "monitoring")
+    assert row["warmup"]["fenced"] is True
     # headline fields restate the curves they were derived from
     at_2x = loads[-1]["classes"]["interactive"]
     assert head["interactive_p99_ttft_at_2x_ms"] == at_2x["ttft_p99_ms"]

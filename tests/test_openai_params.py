@@ -813,6 +813,84 @@ def test_default_priority_typo_fails_at_endpoint_load(tmp_path):
     assert "bad_prio" not in mrp._engine_processor_lookup
 
 
+def test_warmup_knob_typo_fails_at_endpoint_load(tmp_path):
+    """aux engine.warmup (llm/warmup.py, docs/static_analysis.md TPU6xx)
+    is validated when the endpoint LOADS, like default_priority: a typo'd
+    mode fails fast naming the knob — an inert warmup knob would read as
+    "warmed" while every cold shape still compiled under live traffic."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="badwarm"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_warm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "warmup": "ful",  # typo'd mode
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "bad_warm", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "warmup" in text, (status, text)
+    assert "bad_warm" not in mrp._engine_processor_lookup
+
+
+def test_warmup_knob_startup_serves_warm(tmp_path):
+    """aux engine.warmup="startup": the first request awaits the shared
+    warmup task (engine.warmup(full=False)) and then serves normally."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="warm"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="warm_ep",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "warmup": "startup",
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "warm_ep", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.json()
+
+    status, body = _run(mrp, fn)
+    assert status == 200, body
+    proc = mrp._engine_processor_lookup["warm_ep"]
+    assert proc._warmup_needed is False  # ran (or disabled after running)
+    assert proc._warmup_task is not None
+
+
 def test_weight_quant_typo_fails_at_endpoint_load(tmp_path):
     """aux engine.weight_quant (docs/w4a16.md) is validated when the
     endpoint LOADS, like default_priority: a typo'd value fails fast with

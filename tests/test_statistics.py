@@ -695,3 +695,87 @@ def test_engine_kv_tier_metrics_exported():
         assert rval("llm_prefix_cache_hits_total", tier="hbm") == 0
     finally:
         engine.stop()
+
+
+def test_engine_compile_metrics_exported(monkeypatch):
+    """Compile-surface observability (docs/static_analysis.md TPU6xx): the
+    lifecycle collector exports engine_xla_compiles_total{phase} and the
+    engine_xla_compile_ms histogram from the provider's ``compile`` block —
+    from a synthetic provider AND end to end against a real engine with the
+    compile sentry armed."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "compile": {
+            "mode": "log", "strict": False, "fenced": True,
+            "warmup": 7, "serve": 2, "violations": 0,
+            "compile_ms": {
+                "buckets": [10.0, 50.0],
+                "counts": [3, 4, 2],
+                "sum_ms": 431.0,
+            },
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_xla_compiles_total", phase="warmup") == 7
+    assert val("engine_xla_compiles_total", phase="serve") == 2
+    assert registry.get_sample_value(
+        "engine_xla_compile_ms_bucket", {"model": "m1", "le": "50.0"}
+    ) == 7  # cumulative: 3 + 4
+    assert registry.get_sample_value(
+        "engine_xla_compile_ms_sum", {"model": "m1"}
+    ) == 431.0
+    # unarmed providers (compile None) export no compile families
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "compile": None},
+        registry=registry2, key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_xla_compiles_total", {"model": "m2", "phase": "warmup"}
+    ) is None
+
+    # end to end against a REAL engine with the sentry armed: the engine's
+    # lifecycle_stats carries the live sentry block, and a fresh compile in
+    # the process bumps the exported warmup counter
+    import jax
+    import jax.numpy as jnp
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm import compile_sentry
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+
+    monkeypatch.setenv("TPUSERVE_COMPILE_SENTRY", "1")
+    sentry = compile_sentry.get()
+    sentry.reset(strict=False)
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16], eos_token_id=None,
+    )
+    try:
+        assert engine._compile_sentry is sentry
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+        jax.jit(lambda x: x * 17)(jnp.ones((3,)))  # fresh lambda: compiles
+        count = registry3.get_sample_value(
+            "engine_xla_compiles_total", {"model": "llm", "phase": "warmup"}
+        )
+        assert count is not None and count >= 1
+        assert registry3.get_sample_value(
+            "engine_xla_compiles_total", {"model": "llm", "phase": "serve"}
+        ) == 0
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)
