@@ -1499,8 +1499,10 @@ def _pipeline_ab_smoke() -> None:
     print(json.dumps(row))
 
 
-def _loadtest(smoke: bool) -> None:
-    """``--loadtest [--smoke]``: SLO-aware-scheduling loadtest — open-loop
+def _loadtest(smoke: bool, replicas: int = 0) -> None:
+    """``--loadtest [--smoke] [--replicas N]``: loadtest harnesses.
+
+    Without ``--replicas``: the SLO-aware-scheduling loadtest — open-loop
     Poisson mixed-trace replay against the real engine with priority
     classes, the preemptible batch lane, the brownout controller, the
     armed KV sanitizer AND the strict compile sentry (the shared warmup
@@ -1509,13 +1511,26 @@ def _loadtest(smoke: bool) -> None:
     — benchmarks/slo_loadtest.py; docs/slo_scheduling.md;
     docs/static_analysis.md TPU6xx). Emits per-class p50/p99 TTFT +
     goodput vs offered-load curves and updates
-    benchmarks/LOADTEST_cpu.json."""
+    benchmarks/LOADTEST_cpu.json.
+
+    With ``--replicas N`` (N >= 2): the replica-fleet router loadtest —
+    1 vs N engine replicas behind the prefix-affine router on the
+    repeated-conversation trace, plus the kill-one-replica chaos case
+    (benchmarks/replica_loadtest.py; docs/replication.md). Headline:
+    affine-hit rate, interactive p99 TTFT, aggregate goodput speedup,
+    zero sanitizer/sentry violations, zero chaos 503s. Updates
+    benchmarks/LOADTEST_replicas_cpu.json."""
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from benchmarks import slo_loadtest
+    if replicas and replicas > 1:
+        from benchmarks import replica_loadtest
 
-    row = slo_loadtest.run(smoke=smoke)
+        row = replica_loadtest.run(smoke=smoke, replicas=replicas)
+    else:
+        from benchmarks import slo_loadtest
+
+        row = slo_loadtest.run(smoke=smoke)
     print(json.dumps(row))
 
 
@@ -1617,9 +1632,36 @@ if __name__ == "__main__":
     elif "--loadtest" in sys.argv or (
         os.environ.get("BENCH_SCENARIO") == "loadtest"
     ):
+        replicas = None
+        if "--replicas" in sys.argv:
+            try:
+                replicas = int(sys.argv[sys.argv.index("--replicas") + 1])
+            except (IndexError, ValueError):
+                # fail loudly: silently running the default scale would
+                # overwrite the committed artifact with numbers the
+                # operator thinks are something else
+                print("error: --replicas needs an integer argument",
+                      file=sys.stderr)
+                sys.exit(2)
+        elif os.environ.get("BENCH_LOADTEST_REPLICAS"):
+            try:
+                replicas = int(os.environ["BENCH_LOADTEST_REPLICAS"])
+            except ValueError:
+                print("error: BENCH_LOADTEST_REPLICAS must be an integer",
+                      file=sys.stderr)
+                sys.exit(2)
+        if replicas is not None and replicas < 2:
+            # an EXPLICIT replica count below the harness minimum (0 and 1
+            # included) must not silently fall through to the single-engine
+            # SLO loadtest (and overwrite ITS artifact with numbers the
+            # operator thinks are router output)
+            print("error: --replicas needs >= 2 (the replica loadtest "
+                  "always runs its own single-replica arm)", file=sys.stderr)
+            sys.exit(2)
         _loadtest(
             "--smoke" in sys.argv
-            or os.environ.get("BENCH_LOADTEST_SMOKE", "") in ("1", "true")
+            or os.environ.get("BENCH_LOADTEST_SMOKE", "") in ("1", "true"),
+            replicas=replicas or 0,
         )
     else:
         try:

@@ -1,0 +1,664 @@
+"""Replica-fleet router loadtest (docs/replication.md).
+
+Replays a REPEATED-CONVERSATION + batch trace against one replica and
+against a 2-replica engine group behind the prefix-affine router
+(serving/replica_router.py), then runs the kill-one-replica chaos case on
+the fleet. Headline (ISSUE 12 acceptance, asserted on the committed
+artifact by tests/test_loadtest_artifact.py):
+
+- affine-hit rate >= 0.9 on the repeated-conversation slice (turn >= 2
+  requests whose routed replica already held their prefix KV),
+- aggregate goodput >= 1.6x the single-replica arm,
+- 0 post-warmup XLA compiles (strict compile sentry; the run FAILS
+  otherwise), 0 KV-sanitizer violations,
+- the chaos case (watchdog-trip one replica mid-trace) completes with 0
+  user-visible 503s and byte-identical streams for untouched
+  conversations.
+
+Measurement model, stated plainly: every replica gets the SAME per-chip
+budget (slots, KV pool, prefix-cache pages). The ROUTING runs for real —
+every request of the trace goes through the live router (ring sweeps,
+HRW order, route counters) — and then each replica EXECUTES its routed
+substream in isolation, with the fleet's duration taken as the MAX over
+its replicas' substream durations. That is a parallel wall-clock
+ESTIMATE: it models replicas as non-interfering, which is exactly true
+of the production deployment (one replica per chip group / host,
+parallel/multihost.py) and is the only honest way to measure a fleet on
+this ONE-core CPU container — time-sharing two engine loops on one core
+measures scheduler interference that no real fleet has (observed: false
+watchdog trips and 2-6x wall-time inflation from co-scheduling). The
+chaos case still runs both replicas CONCURRENTLY: it asserts
+correctness (zero 503s, byte identity, re-admission), not timing.
+
+The scrambled-routing arm replays the same trace on the same fleet with
+per-turn pseudo-random replica assignment — what a affinity-blind load
+balancer would do. Its affine-hit rate and goodput quantify what the
+prefix-affine hash is worth: conversations alternating replicas leave
+KV gaps on both, and every gap is re-prefill work.
+
+    python bench.py --loadtest --replicas 2 --smoke   # CPU; updates
+                                                      # LOADTEST_replicas_cpu.json
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+REPO = Path(__file__).resolve().parent.parent
+ARTIFACT = REPO / "benchmarks" / "LOADTEST_replicas_cpu.json"
+
+# artifact schema (asserted by tests/test_loadtest_artifact.py in tier-1)
+SCHEMA_KEYS = {
+    "metric", "platform", "smoke", "replicas", "engine", "trace", "arms",
+    "chaos", "headline",
+}
+ARM_KEYS = {
+    "replicas", "routing", "requests", "completed", "shed", "errors",
+    "duration_s", "substream_durations_s", "parallel_estimate",
+    "goodput_tok_s", "interactive_ttft_p50_ms", "interactive_ttft_p99_ms",
+    "affine_hit_rate", "affine_eligible", "routes", "preemptions",
+    "post_warmup_compiles", "warmup_requests",
+}
+CHAOS_KEYS = {
+    "requests", "completed", "unavailable_errors", "other_errors",
+    "failovers", "ejections", "readmissions", "ring_recovered",
+    "untouched_streams_identical", "failover_stream_identical",
+    "post_warmup_compiles",
+}
+HEADLINE_KEYS = {
+    "affine_hit_rate", "affine_hit_bound", "affine_ok",
+    "goodput_tok_s_single", "goodput_tok_s_fleet", "speedup",
+    "speedup_bound", "speedup_ok", "interactive_p99_ttft_ms_single",
+    "interactive_p99_ttft_ms_fleet",
+    # the affinity-blind contrast arm: same fleet, per-turn random
+    # assignment — what the prefix-affine hash is worth
+    "affine_hit_rate_random", "goodput_tok_s_random",
+    "post_warmup_compiles",
+    "compile_sentry_mode", "sanitizer_checks", "sanitizer_violations",
+    "chaos_unavailable_errors", "chaos_ok",
+}
+
+# the trace: C multi-turn conversations (interactive chat whose history
+# grows by TURN_STEP tokens per turn — the radix cache's repeated-prefix
+# workload) + closed-loop batch summarization pressure. 24 conversations
+# at their final 11-page storable prefix = 264 pages of working set:
+# far over ONE replica's 160-page prefix budget (leaf-LRU decays every
+# run's tail, so turns re-prefill most of their history), comfortably
+# under the fleet's 2x160 with the HRW split (14/10 for these ids).
+N_CONVERSATIONS = 24
+N_TURNS = 5
+CONV_BASE = 128          # tokens of history at turn 0
+TURN_STEP = 16           # tokens appended per turn (1 prefix block)
+CONV_MAX_NEW = 8
+N_BATCH = 12             # batch one-shots across BATCH_WORKERS workers
+BATCH_WORKERS = 2
+BATCH_PROMPT = 48
+BATCH_MAX_NEW = 24
+
+# chaos phase sizing
+CHAOS_CONVS_PER_REPLICA = 2
+CHAOS_TURNS = 3
+SENTINEL = 251           # plants the watchdog-stall fault on the victim
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def conv_history(conv: int, n: int) -> List[int]:
+    """Deterministic per-conversation token stream (same (conv, n) always
+    yields the same prefix, so turn t+1 extends turn t's exact history)."""
+    return [(conv * 67 + i * 13) % 239 + 1 for i in range(n)]
+
+
+def conv_prompt(conv: int, turn: int) -> List[int]:
+    return conv_history(conv, CONV_BASE + TURN_STEP * turn)
+
+
+def batch_prompt(i: int) -> List[int]:
+    return [(i * 101 + j * 17) % 239 + 1 for j in range(BATCH_PROMPT)]
+
+
+def engine_cfg() -> Dict[str, Any]:
+    """One replica = one chip's budget. The 160-page prefix budget holds
+    ~14 conversations at their final 11-page storable prefix: the fleet's
+    14/10 split stays fully resident per replica, one replica decays."""
+    return dict(
+        max_batch=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 160, 192],
+        eos_token_id=None,          # fixed work per request
+        decode_steps=1,
+        cache_mode="paged",
+        page_size=16,
+        chunked_prefill_size=16,
+        prefix_cache=384,
+        prefix_block=16,
+        num_pages=257,              # 256 usable (page 0 is the null page)
+        prefix_cache_pages=160,
+        max_pending=32,
+        preempt_batch=True,
+        preempt_budget=2,
+        brownout=True,
+        brownout_dwell=1.0,
+        # the chaos case trips this; 2s (not the robustness-suite 0.3s)
+        # because co-scheduled replicas share this host's ONE core — a
+        # busy sibling must not read as a stall (observed: 0.5s
+        # false-tripped the fleet arm under full load)
+        watchdog_interval=2.0,
+        # a single-core host gains no overlap from pipelining but pays its
+        # commit/quarantine latency in TTFT (bench.py --pipeline-ab note)
+        pipeline_depth=1 if (os.cpu_count() or 1) == 1 else None,
+    )
+
+
+def build_group(n_replicas: int):
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+    from clearml_serving_tpu.llm.replica import ReplicaGroup
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    cfg = engine_cfg()
+    engines = [
+        LLMEngineCore(bundle, params, replica="r{}".format(i), **cfg)
+        for i in range(n_replicas)
+    ]
+    # warmup_mode="startup" makes post-ejection re-admission re-warm with
+    # the cheap per-bucket pass (fast, compile-free after the full sweep
+    # below) — the gate machinery the chaos case must drive
+    return ReplicaGroup(engines, warmup_mode="startup"), cfg
+
+
+async def _consume(target, request, rec: dict, records: List[dict]) -> None:
+    """Drive one request against ``target`` (a ReplicaGroup in the chaos
+    phase, a bare engine in the isolated substreams) and record the
+    outcome."""
+    from clearml_serving_tpu.errors import (
+        DeadlineExceededError,
+        EngineOverloadedError,
+        EngineUnavailableError,
+    )
+
+    try:
+        toks: List[int] = []
+        async for token in target.generate(request):
+            toks.append(int(token))
+        rec["status"] = "ok"
+        rec["tokens"] = toks
+        if request.first_token_at is not None:
+            rec["ttft_ms"] = (
+                request.first_token_at - request.submitted_at
+            ) * 1e3
+        rec["t_done"] = time.perf_counter()
+    except EngineOverloadedError:
+        rec["status"] = "shed"
+    except EngineUnavailableError as ex:
+        # the chaos criterion: a user-visible 503 — the failure drain must
+        # keep this at zero even with a replica mid-trip
+        rec["status"] = "unavailable"
+        rec["error"] = repr(ex)[:200]
+    except DeadlineExceededError:
+        rec["status"] = "deadline"
+    except asyncio.CancelledError:
+        rec["status"] = "cancelled"
+        raise
+    except Exception as ex:  # noqa: BLE001 - harness must keep counting
+        rec["status"] = "error"
+        rec["error"] = repr(ex)[:200]
+    finally:
+        records.append(rec)
+
+
+def _assign(group, scramble: bool, seed: int):
+    """Route the whole trace through the LIVE router (route counters and
+    ring sweeps run for real) and return per-replica substreams:
+    ``(conv_turns[name][conv] -> [turns...], batch_ids[name])``. With
+    ``scramble`` the router is bypassed per (conv, turn) by a hash — the
+    affinity-blind contrast assignment."""
+    import hashlib
+
+    from clearml_serving_tpu.llm.engine import GenRequest
+
+    names = [r.name for r in group.replicas]
+    conv_turns: Dict[str, Dict[int, List[int]]] = {n: {} for n in names}
+    batch_ids: Dict[str, List[int]] = {n: [] for n in names}
+
+    def scrambled(tag: str) -> str:
+        h = hashlib.blake2b(
+            "{}/{}".format(seed, tag).encode(), digest_size=4
+        ).digest()
+        return names[int.from_bytes(h, "little") % len(names)]
+
+    for conv in range(N_CONVERSATIONS):
+        for turn in range(N_TURNS):
+            ids = conv_prompt(conv, turn)
+            if scramble:
+                name = scrambled("c{}/{}".format(conv, turn))
+            else:
+                replica, _ = group.router.pick(GenRequest(
+                    prompt_ids=ids, max_new_tokens=CONV_MAX_NEW,
+                    priority="interactive",
+                ))
+                name = replica.name
+            conv_turns[name].setdefault(conv, []).append(turn)
+    for i in range(N_BATCH):
+        if scramble:
+            name = scrambled("b{}".format(i))
+        else:
+            replica, _ = group.router.pick(GenRequest(
+                prompt_ids=batch_prompt(i), max_new_tokens=BATCH_MAX_NEW,
+                priority="batch",
+            ))
+            name = replica.name
+        batch_ids[name].append(i)
+    return conv_turns, batch_ids
+
+
+async def _run_substream(replica, conv_turns, batch_ids, seed: int) -> dict:
+    """Execute one replica's routed substream in ISOLATION (no sibling on
+    the core): conversation sessions run their assigned turns in order
+    with think times, batch workers run closed-loop. Affine hit = a
+    turn>=1 request whose replica already held (nearly) the WHOLE history
+    in its radix cache — measured against the real tree, not the route
+    label; leaf-LRU decay leaves head blocks resident on a thrashing
+    cache, and counting those partial hits would flatter an arm that
+    still re-prefills most of every turn."""
+    from clearml_serving_tpu.llm.engine import GenRequest
+
+    engine = replica.engine
+    rng = random.Random(seed)
+    records: List[dict] = []
+    affine = {"eligible": 0, "hits": 0}
+
+    async def session(conv: int, turns: List[int]) -> None:
+        await asyncio.sleep(0.02 * (conv % 8))
+        for turn in turns:
+            ids = conv_prompt(conv, turn)
+            if turn >= 1:
+                affine["eligible"] += 1
+                prefix = engine._prefix
+                if prefix is not None and prefix.match_len(ids) >= (
+                    len(ids) - 2 * prefix.block
+                ):
+                    affine["hits"] += 1
+            request = GenRequest(
+                prompt_ids=ids, max_new_tokens=CONV_MAX_NEW,
+                priority="interactive",
+            )
+            rec = {"cls": "interactive", "conv": conv, "turn": turn}
+            await _consume(engine, request, rec, records)
+            await asyncio.sleep(rng.uniform(0.005, 0.03))
+
+    async def batch_worker(wid: int) -> None:
+        for i in batch_ids[wid::BATCH_WORKERS]:
+            request = GenRequest(
+                prompt_ids=batch_prompt(i), max_new_tokens=BATCH_MAX_NEW,
+                priority="batch",
+            )
+            rec = {"cls": "batch", "idx": i}
+            await _consume(engine, request, rec, records)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(
+        *[session(c, turns) for c, turns in sorted(conv_turns.items())],
+        *[batch_worker(w) for w in range(BATCH_WORKERS)],
+    )
+    await engine.wait_drained()
+    done_times = [r["t_done"] for r in records if "t_done" in r]
+    duration = (max(done_times) if done_times else time.perf_counter()) - t0
+    return {
+        "records": records,
+        "duration_s": duration,
+        "affine": affine,
+    }
+
+
+async def _run_trace(group, seed: int, scramble: bool = False) -> dict:
+    """The measured phase: route everything, then execute each replica's
+    substream in isolation. Fleet duration = MAX over substream durations
+    (the parallel wall-clock estimate the module docstring defends);
+    goodput = total delivered tokens / that duration."""
+    conv_turns, batch_ids = _assign(group, scramble, seed)
+    preempt0 = sum(
+        r.engine.counters["preemptions"] for r in group.replicas
+    )
+    records: List[dict] = []
+    durations: Dict[str, float] = {}
+    affine = {"eligible": 0, "hits": 0}
+    for i, replica in enumerate(group.replicas):
+        sub = await _run_substream(
+            replica, conv_turns[replica.name], batch_ids[replica.name],
+            seed + i,
+        )
+        records.extend(sub["records"])
+        durations[replica.name] = round(sub["duration_s"], 3)
+        affine["eligible"] += sub["affine"]["eligible"]
+        affine["hits"] += sub["affine"]["hits"]
+    duration = max(durations.values())
+    done = [r for r in records if r["status"] == "ok"]
+    ttfts = [
+        r["ttft_ms"] for r in done
+        if r["cls"] == "interactive" and r.get("ttft_ms") is not None
+    ]
+    return {
+        "routing": "random" if scramble else (
+            "affine" if len(group.replicas) > 1 else "single"
+        ),
+        "requests": len(records),
+        "completed": len(done),
+        "shed": sum(1 for r in records if r["status"] == "shed"),
+        "errors": sum(
+            1 for r in records
+            if r["status"] not in ("ok", "shed")
+        ),
+        "duration_s": round(duration, 2),
+        "substream_durations_s": durations,
+        "parallel_estimate": len(group.replicas) > 1,
+        "goodput_tok_s": round(
+            sum(len(r.get("tokens", [])) for r in done)
+            / max(1e-6, duration), 2,
+        ),
+        "interactive_ttft_p50_ms": round(_percentile(ttfts, 0.5) or 0.0, 2),
+        "interactive_ttft_p99_ms": round(_percentile(ttfts, 0.99) or 0.0, 2),
+        "affine_eligible": affine["eligible"],
+        "affine_hit_rate": round(
+            affine["hits"] / max(1, affine["eligible"]), 4
+        ),
+        "preemptions": sum(
+            r.engine.counters["preemptions"] for r in group.replicas
+        ) - preempt0,
+    }
+
+
+async def _run_chaos(group) -> dict:
+    """Kill-one-replica mid-trace: fresh conversations split across both
+    replicas; a sentinel token in one victim-routed conversation arms a
+    one-shot decode stall that trips the victim's watchdog. The contract:
+    every stream completes (failed ones resume on the sibling), zero
+    user-visible 503s, untouched conversations byte-identical to their
+    pre-chaos replay, and the victim re-warms through the gate back into
+    the ring."""
+    from clearml_serving_tpu.llm import faults
+    from clearml_serving_tpu.llm.engine import GenRequest
+
+    # fresh conversation ids (disjoint from the measured trace), grouped
+    # by routed replica so the chaos case provably touches both
+    by_replica: Dict[str, List[int]] = {r.name: [] for r in group.replicas}
+    conv = 1000
+    while any(
+        len(v) < CHAOS_CONVS_PER_REPLICA for v in by_replica.values()
+    ):
+        ids = conv_prompt(conv, 0)
+        name = group.router.order_for(ids)[0].name
+        if len(by_replica[name]) < CHAOS_CONVS_PER_REPLICA:
+            by_replica[name].append(conv)
+        conv += 1
+    victim_name = group.replicas[-1].name
+    victim_conv = by_replica[victim_name][0]
+
+    def prompt_for(c: int, turn: int) -> List[int]:
+        ids = conv_prompt(c, turn)
+        if c == victim_conv:
+            # the sentinel rides the WHOLE conversation (prompt prefix),
+            # so the one-shot stall fault targets exactly this stream
+            ids = [SENTINEL] + ids[1:]
+        return ids
+
+    # pre-chaos replay: expected greedy tokens per (conv, turn) — the
+    # byte-identity baseline (radix caching never changes tokens)
+    expected: Dict[tuple, List[int]] = {}
+    for name, convs in by_replica.items():
+        for c in convs:
+            for turn in range(CHAOS_TURNS):
+                request = GenRequest(
+                    prompt_ids=prompt_for(c, turn),
+                    max_new_tokens=CONV_MAX_NEW,
+                )
+                toks = []
+                async for t in group.generate(request):
+                    toks.append(int(t))
+                expected[(c, turn)] = toks
+    await group.wait_drained()
+
+    stats0 = group.router.stats()
+    failovers0 = group.failovers
+    faults.configure([
+        {"point": "engine.decode.stall", "action": "delay",
+         "delay": 5.0, "times": 1, "match_token": SENTINEL},
+    ])
+    records: List[dict] = []
+
+    async def chaos_session(c: int) -> None:
+        for turn in range(CHAOS_TURNS):
+            request = GenRequest(
+                prompt_ids=prompt_for(c, turn),
+                max_new_tokens=CONV_MAX_NEW, priority="interactive",
+            )
+            rec = {"cls": "interactive", "conv": c, "turn": turn}
+            await _consume(group, request, rec, records)
+
+    try:
+        await asyncio.gather(
+            *[chaos_session(c) for convs in by_replica.values()
+              for c in convs]
+        )
+    finally:
+        faults.clear()
+
+    # the victim recovers, re-warms through the gate, rejoins the ring
+    ring_recovered = False
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 120.0:
+        group.router.sweep()
+        if group.router.ring_size == len(group.replicas):
+            ring_recovered = True
+            break
+        await asyncio.sleep(0.05)
+    await group.wait_drained()
+
+    untouched_ok = True
+    failover_ok = True
+    for rec in records:
+        if rec["status"] != "ok":
+            continue
+        same = rec["tokens"] == expected[(rec["conv"], rec["turn"])]
+        if rec["conv"] == victim_conv:
+            failover_ok = failover_ok and same
+        else:
+            untouched_ok = untouched_ok and same
+    stats1 = group.router.stats()
+    return {
+        "requests": len(records),
+        "completed": sum(1 for r in records if r["status"] == "ok"),
+        "unavailable_errors": sum(
+            1 for r in records if r["status"] == "unavailable"
+        ),
+        "other_errors": sum(
+            1 for r in records
+            if r["status"] not in ("ok", "unavailable")
+        ),
+        "failovers": group.failovers - failovers0,
+        "ejections": sum(stats1["ejections"].values())
+        - sum(stats0["ejections"].values()),
+        "readmissions": sum(stats1["readmissions"].values())
+        - sum(stats0["readmissions"].values()),
+        "ring_recovered": ring_recovered,
+        "untouched_streams_identical": untouched_ok,
+        "failover_stream_identical": failover_ok,
+    }
+
+
+def _sentry_serve_count() -> int:
+    from clearml_serving_tpu.llm import compile_sentry
+
+    if not compile_sentry.enabled():
+        return -1
+    return int(compile_sentry.get().stats_brief().get("serve", -1))
+
+
+async def _run_arm(n_replicas: int, with_chaos: bool,
+                   scramble: bool = False) -> dict:
+    from clearml_serving_tpu.llm import compile_sentry
+
+    group, cfg = build_group(n_replicas)
+    try:
+        if compile_sentry.enabled():
+            # fresh fence per arm: the next arm's engines re-warm their
+            # own jit caches and those compiles must count as warmup
+            compile_sentry.get().reset(strict=compile_sentry.strict_enabled())
+        warm = await group.warmup(full=True)
+        arm = await _run_trace(group, seed=7 + n_replicas, scramble=scramble)
+        arm["replicas"] = n_replicas
+        arm["routes"] = group.router.stats()["requests"]
+        arm["warmup_requests"] = warm["requests"]
+        arm["post_warmup_compiles"] = _sentry_serve_count()
+        chaos = None
+        if with_chaos:
+            chaos = await _run_chaos(group)
+            chaos["post_warmup_compiles"] = _sentry_serve_count()
+        sanitizer_checks = 0
+        sanitizer_failures = 0
+        for replica in group.replicas:
+            sanitizer = replica.engine._sanitizer
+            if sanitizer is None:
+                sanitizer_failures = -1
+                continue
+            s = sanitizer.stats()
+            sanitizer_checks += s.get("checks", 0)
+            sanitizer_failures += s.get("failures", 0)
+        arm["sanitizer_checks"] = sanitizer_checks
+        arm["sanitizer_violations"] = sanitizer_failures
+        return {"arm": arm, "chaos": chaos, "cfg": cfg}
+    finally:
+        group.stop()
+
+
+async def _run_async(smoke: bool, replicas: int) -> dict:
+    from clearml_serving_tpu.llm import compile_sentry
+
+    single = await _run_arm(1, with_chaos=False)
+    fleet = await _run_arm(replicas, with_chaos=True)
+    scrambled = await _run_arm(replicas, with_chaos=False, scramble=True)
+    a1, a2, a3 = single["arm"], fleet["arm"], scrambled["arm"]
+    chaos = fleet["chaos"]
+    speedup = (
+        a2["goodput_tok_s"] / a1["goodput_tok_s"]
+        if a1["goodput_tok_s"] else None
+    )
+    chaos_ok = bool(
+        chaos["unavailable_errors"] == 0
+        and chaos["other_errors"] == 0
+        and chaos["completed"] == chaos["requests"]
+        and chaos["ring_recovered"]
+        and chaos["untouched_streams_identical"]
+    )
+    sentry_mode = (
+        compile_sentry.get().stats_brief().get("mode", "off")
+        if compile_sentry.enabled() else "off"
+    )
+    post_warmup = max(
+        a1["post_warmup_compiles"], a2["post_warmup_compiles"],
+        a3["post_warmup_compiles"], chaos["post_warmup_compiles"],
+    )
+    return {
+        "metric": "llm_replica_loadtest" + ("_cpusmoke" if smoke else ""),
+        "platform": "cpu",
+        "smoke": smoke,
+        "replicas": replicas,
+        "engine": {
+            k: v for k, v in fleet["cfg"].items() if k != "prefill_buckets"
+        },
+        "trace": {
+            "conversations": N_CONVERSATIONS,
+            "turns": N_TURNS,
+            "conv_base_tokens": CONV_BASE,
+            "turn_step_tokens": TURN_STEP,
+            "conv_max_new": CONV_MAX_NEW,
+            "batch_requests": N_BATCH,
+            "batch_prompt_tokens": BATCH_PROMPT,
+            "batch_max_new": BATCH_MAX_NEW,
+        },
+        "arms": [a1, a2, a3],
+        "chaos": chaos,
+        "headline": {
+            "affine_hit_rate": a2["affine_hit_rate"],
+            "affine_hit_bound": 0.9,
+            "affine_ok": bool(a2["affine_hit_rate"] >= 0.9),
+            "goodput_tok_s_single": a1["goodput_tok_s"],
+            "goodput_tok_s_fleet": a2["goodput_tok_s"],
+            "speedup": round(speedup, 2) if speedup else None,
+            "speedup_bound": 1.6,
+            "speedup_ok": bool(speedup is not None and speedup >= 1.6),
+            "interactive_p99_ttft_ms_single": a1["interactive_ttft_p99_ms"],
+            "interactive_p99_ttft_ms_fleet": a2["interactive_ttft_p99_ms"],
+            "affine_hit_rate_random": a3["affine_hit_rate"],
+            "goodput_tok_s_random": a3["goodput_tok_s"],
+            "post_warmup_compiles": post_warmup,
+            "compile_sentry_mode": sentry_mode,
+            "sanitizer_checks": a1["sanitizer_checks"]
+            + a2["sanitizer_checks"] + a3["sanitizer_checks"],
+            "sanitizer_violations": max(
+                a1["sanitizer_violations"], a2["sanitizer_violations"],
+                a3["sanitizer_violations"],
+            ),
+            "chaos_unavailable_errors": chaos["unavailable_errors"],
+            "chaos_ok": chaos_ok,
+        },
+    }
+
+
+def run(smoke: bool = True, replicas: int = 2,
+        write_artifact: bool = True) -> dict:
+    """Entry point for ``bench.py --loadtest --replicas N``. Forces the
+    CPU backend, arms the KV sanitizer AND the strict compile sentry
+    BEFORE any engine exists (completing at all is the zero-recompile
+    certificate), runs both arms + the chaos case, optionally updates the
+    committed artifact."""
+    if replicas < 2:
+        raise ValueError("the replica loadtest needs --replicas >= 2")
+    os.environ["TPUSERVE_SANITIZE"] = "1"
+    # forced, not defaulted: a pre-exported "1" must not silently
+    # downgrade the certification run to count-only mode
+    os.environ["TPUSERVE_COMPILE_SENTRY"] = "strict"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from clearml_serving_tpu.engines.jax_engine import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    row = asyncio.run(_run_async(smoke, replicas))
+    if write_artifact:
+        ARTIFACT.write_text(json.dumps(row, indent=2) + "\n")
+    return row
+
+
+def main() -> None:
+    import sys
+
+    smoke = "--smoke" in sys.argv
+    row = run(smoke=smoke)
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,8 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.kv.demote",
     "engine.kv.promote",
     "engine.compile.bucket",
+    "router.pick",
+    "router.eject",
     "grpc.call",
 })
 

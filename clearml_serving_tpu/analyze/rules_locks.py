@@ -86,6 +86,11 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # docs/slo_scheduling.md): per-class heaps + starvation counters
     "_heaps": ("_lock", None),
     "_starve": ("_lock", None),
+    # replica-router shared maps (serving/replica_router.py,
+    # docs/replication.md): route/event counters written on the serving
+    # loop, read by the Prometheus scrape thread
+    "_route_counts": ("_lock", ("self", "router", "_router")),
+    "_router_events": ("_lock", ("self", "router", "_router")),
 }
 
 _MUTATORS = {

@@ -106,6 +106,10 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # at protocol-serialized points (annotated at the definition site)
     "_next_token_dev": (WORKER, None),
     "_gstate_dev": (WORKER, None),
+    # replica-router ring membership (serving/replica_router.py,
+    # docs/replication.md): sweeps/picks rebind an immutable frozenset on
+    # the serving loop; the scrape thread reads snapshots by reference
+    "_ring_members": (LOOP, ("self", "router", "_router")),
     # model_request_processor daemon-shared registries: read on the serving
     # event loop; the sync daemon swaps them only through the zero-downtime
     # drain protocol (annotated at the definition sites)

@@ -655,6 +655,11 @@ class LLMEngineCore:
         brownout: Optional[bool] = None,
         brownout_batch_cap: int = 32,   # stage>=2 batch max_new_tokens cap
         brownout_dwell: float = 2.0,    # min seconds between stage drops
+        # replica identity (docs/replication.md): set by the replica group
+        # (llm/replica.py) so health()/lifecycle_stats() — and through them
+        # the Prometheus lifecycle series — carry a ``replica`` label.
+        # None keeps the legacy single-engine payload shape.
+        replica: Optional[str] = None,
     ):
         self.bundle = bundle
         self.max_batch = int(max_batch)
@@ -996,6 +1001,9 @@ class LLMEngineCore:
         # starvation floor) — docs/slo_scheduling.md
         self._pending = _ClassedPendingQueue(starvation_floor)
         self._loop_task: Optional[asyncio.Task] = None
+        # replica identity in a fleet (docs/replication.md); None = legacy
+        # single-engine payloads (no `replica` key in health/stats)
+        self.replica_id = str(replica) if replica is not None else None
         # -- request-lifecycle hardening state ----------------------------
         self.max_pending = int(max_pending) if max_pending else None
         self._queue_timeout = float(queue_timeout) if queue_timeout else None
@@ -2935,7 +2943,7 @@ class LLMEngineCore:
         }
 
     def health(self) -> dict:
-        return {
+        out = {
             "ready": self.is_ready,
             "stopped": self._stopped,
             "recovering": self._recovering,
@@ -2969,6 +2977,9 @@ class LLMEngineCore:
             },
             "compile": self._compile_snapshot(),
         }
+        if self.replica_id is not None:
+            out["replica"] = self.replica_id
+        return out
 
     def _compile_snapshot(self):
         """Compile-sentry block shared by health() and lifecycle_stats()
@@ -2984,7 +2995,7 @@ class LLMEngineCore:
         """Scrape-time snapshot for statistics.metrics' lifecycle collector
         (counters monotonic; gauges instantaneous)."""
         c = self.counters
-        return {
+        out = {
             "queue_depth": self._pending.qsize(),
             "queue_depths": self._pending.depths(),
             "active_slots": self.active_slots,
@@ -3033,6 +3044,9 @@ class LLMEngineCore:
             },
             "compile": self._compile_snapshot(),
         }
+        if self.replica_id is not None:
+            out["replica"] = self.replica_id
+        return out
 
     @property
     def logprobs_k(self) -> int:

@@ -85,6 +85,20 @@ Known points (ctx carried with each):
 - ``engine.drain``     — on the loop thread at the drained boundary, before
                          the drained sanitizer audit; a raise fails the loop
                          through the structured step-failure path.
+- ``router.pick``      — in the replica router as a route decision is
+                         about to return its pick (``request``;
+                         serving/replica_router.py, docs/replication.md);
+                         a raise makes the router fall to the next ring
+                         member (counted as a ``rebalance``) instead of
+                         failing the request — the structured-fallback
+                         contract of the routing path.
+- ``router.eject``     — fired per replica during each ring sweep; the
+                         carried shim's ``prompt_ids`` holds the replica
+                         INDEX, so ``match_token: <index>`` force-ejects
+                         exactly that replica from the ring while the
+                         spec stays armed. Used by the chaos suite to
+                         prove ejection drains traffic to siblings and
+                         re-admission re-warms through the warmup gate.
 - ``grpc.call``        — before each gRPC attempt (``attempt``); set
                          ``grpc_code`` ("UNAVAILABLE"/"DEADLINE_EXCEEDED")
                          to exercise the transient-retry path.
@@ -138,6 +152,8 @@ KNOWN_POINTS = frozenset({
     "engine.kv.demote",
     "engine.kv.promote",
     "engine.compile.bucket",
+    "router.pick",
+    "router.eject",
     "grpc.call",
 })
 

@@ -235,9 +235,7 @@ class LLMEngineRequest(BaseEngineRequest):
             )
             self._model_name = self.endpoint.serving_url
             return self.encoder
-        self.engine = LLMEngineCore(
-            bundle,
-            params,
+        engine_kwargs = dict(
             max_batch=int(engine_cfg.get("max_batch", 8)),
             max_seq_len=int(engine_cfg.get("max_seq_len", bundle.config.get("max_seq_len", 2048))),
             prefill_buckets=engine_cfg.get("prefill_buckets"),
@@ -344,22 +342,12 @@ class LLMEngineRequest(BaseEngineRequest):
             brownout_batch_cap=int(engine_cfg.get("brownout_batch_cap", 32)),
             brownout_dwell=float(engine_cfg.get("brownout_dwell", 2.0)),
         )
-        self._default_priority = str(
-            engine_cfg.get("default_priority", "interactive")
-        )
-        if self._default_priority not in PRIORITY_CLASSES:
-            # fail at ENDPOINT LOAD: a typo'd default would otherwise 422
-            # every request that omits an explicit body priority
-            raise ValueError(
-                "aux engine.default_priority must be one of {}: got {!r}"
-                .format("/".join(PRIORITY_CLASSES), self._default_priority)
-            )
         # startup shape warmup (llm/warmup.py, docs/static_analysis.md
-        # TPU6xx): "startup" runs the cheap per-bucket pass before the
-        # first request is admitted, "full" runs the whole
-        # zero-recompile-certified sweep. Runs as ONE shared task the
-        # first arrivals await — the alternative is every cold shape
-        # compiling 100-1000 ms on the loop thread under live traffic.
+        # TPU6xx): parsed BEFORE engine construction because the replica
+        # group's ring-entry gate needs it. "startup" runs the cheap
+        # per-bucket pass before the first request is admitted, "full"
+        # runs the whole zero-recompile-certified sweep. Runs as ONE
+        # shared task the first arrivals await.
         warmup_mode = str(engine_cfg.get("warmup", "off")).lower()
         if warmup_mode in ("1", "true", "on"):
             warmup_mode = "startup"
@@ -371,48 +359,188 @@ class LLMEngineRequest(BaseEngineRequest):
                 "aux engine.warmup must be off/startup/full: got {!r}"
                 .format(engine_cfg.get("warmup"))
             )
+        # replica fleet (docs/replication.md): aux engine.replicas > 1
+        # builds N identically configured engine replicas — ONE shared
+        # params tree (read-only for compute), private KV pools — behind
+        # the prefix-affine router (serving/replica_router.py). Validated
+        # at ENDPOINT LOAD like default_priority: a bad value must fail
+        # fast naming the knob, not 422 per request.
+        raw_replicas = engine_cfg.get("replicas")
+        if raw_replicas is None:
+            n_replicas = 1
+        else:
+            try:
+                n_replicas = int(raw_replicas)
+                # a non-integral float (2.5) must not silently truncate
+                if float(raw_replicas) != n_replicas:
+                    raise ValueError(raw_replicas)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "aux engine.replicas must be an integer >= 1: got {!r}"
+                    .format(raw_replicas)
+                )
+        if not 1 <= n_replicas <= 16:
+            raise ValueError(
+                "aux engine.replicas must be in 1..16: got {}".format(
+                    n_replicas
+                )
+            )
+        if n_replicas > 1:
+            from .replica import ReplicaGroup
+
+            engines = [
+                # "rN" everywhere: the engine's replica id must match the
+                # ring member names, registry keys, and /ready blocks so
+                # one identity joins every surface (PromQL on(replica))
+                LLMEngineCore(
+                    bundle, params, replica="r{}".format(i), **engine_kwargs
+                )
+                for i in range(n_replicas)
+            ]
+            self.engine = ReplicaGroup(
+                engines,
+                warmup_mode=warmup_mode,
+                affinity_blocks=int(
+                    engine_cfg.get("router_affinity_blocks", 4)
+                ),
+                # `is not None`, not truthiness: an explicit 0 is the
+                # documented "never spill on queue depth" spelling and
+                # must not silently fall back to the max_pending default
+                spill_queue_depth=(
+                    int(engine_cfg["router_spill_queue_depth"])
+                    if engine_cfg.get("router_spill_queue_depth") is not None
+                    else None
+                ),
+                spill_brownout_stage=int(
+                    engine_cfg.get("router_spill_stage", 2)
+                ),
+                fleet_shed_stage=int(
+                    engine_cfg.get("router_fleet_shed_stage", 3)
+                ),
+            )
+        else:
+            self.engine = LLMEngineCore(bundle, params, **engine_kwargs)
+        self._default_priority = str(
+            engine_cfg.get("default_priority", "interactive")
+        )
+        if self._default_priority not in PRIORITY_CLASSES:
+            # fail at ENDPOINT LOAD: a typo'd default would otherwise 422
+            # every request that omits an explicit body priority
+            raise ValueError(
+                "aux engine.default_priority must be one of {}: got {!r}"
+                .format("/".join(PRIORITY_CLASSES), self._default_priority)
+            )
         self._warmup_full = warmup_mode == "full"
         self._warmup_needed = warmup_mode != "off"
         self._warmup_task = None
         self._model_name = self.endpoint.serving_url
-        if self.engine._prefix is not None:
-            # hit rate / shared pages / CoW visible from day one on the same
-            # Prometheus registry the serving process already exports
+        self._register_metrics(n_replicas > 1)
+        return self.engine
+
+    def _register_metrics(self, fleet: bool) -> None:
+        """Prometheus wiring for the engine (or engine group). Every
+        provider holds its engine WEAKLY: the process-lifetime registry
+        must not pin an evicted endpoint's engine (params + KV = GBs of
+        device memory) after the processor cache drops it.
+
+        Fleet mode (docs/replication.md): each replica registers its OWN
+        lifecycle/prefix-cache entry — the engine's payloads carry the
+        replica id, so the lifecycle families grow a ``replica`` label —
+        and the router registers the ring/route counters."""
+        import weakref
+
+        model = self._model_name
+
+        def _lifecycle_provider(engine_ref, inject_model=None):
+            def provider():
+                engine = engine_ref()
+                if engine is None:
+                    return None
+                s = engine.lifecycle_stats()
+                if inject_model is not None:
+                    s["model"] = inject_model
+                return s
+            return provider
+
+        def _register_prefix(engine, key, replica=None):
+            if engine._prefix is None:
+                return None
+            # hit rate / shared pages / CoW visible from day one on the
+            # same Prometheus registry the serving process already exports.
+            # Fleet entries keep the real model label and carry `replica`
+            # (same {model, replica} split as the lifecycle families)
             try:
                 from ..statistics.metrics import register_prefix_cache
 
                 pool = (
-                    self.engine.paged_cache.pool
-                    if self.engine.paged_cache is not None
+                    engine.paged_cache.pool
+                    if engine.paged_cache is not None
                     else None
                 )
-                self._prefix_collector = register_prefix_cache(
-                    self.engine._prefix, pool, key=self._model_name
+                return register_prefix_cache(
+                    engine._prefix, pool, key=key,
+                    model=model if replica is not None else None,
+                    replica=replica,
                 )
             except Exception:
-                self._prefix_collector = None  # registry unavailable etc.
+                return None  # registry unavailable etc.
+
         try:
-            # shed/deadline/watchdog counters + queue-depth/active-slot
-            # gauges on the same registry (docs/robustness.md). The provider
-            # holds the engine WEAKLY: the process-lifetime registry must
-            # not pin an evicted endpoint's engine (params + KV = GBs of
-            # device memory) after the processor cache drops it.
-            import weakref
+            from ..statistics.metrics import (
+                prune_engine_lifecycle,
+                prune_prefix_caches,
+                prune_replica_router,
+                register_engine_lifecycle,
+            )
 
-            from ..statistics.metrics import register_engine_lifecycle
+            if not fleet:
+                self._prefix_collector = _register_prefix(self.engine, model)
+                self._lifecycle_collector = register_engine_lifecycle(
+                    _lifecycle_provider(weakref.ref(self.engine)), key=model
+                )
+                # hot-reload hygiene: a previous FLEET incarnation of this
+                # endpoint left per-replica entries (model@rN) that would
+                # otherwise pin dead engines' caches and export frozen
+                # series forever
+                prune_prefix_caches(model, {model})
+                prune_engine_lifecycle(model, {model})
+                prune_replica_router(model, set())
+                return
+            keep = {
+                "{}@{}".format(model, r.name) for r in self.engine.replicas
+            }
+            for replica in self.engine.replicas:
+                key = "{}@{}".format(model, replica.name)
+                self._prefix_collector = _register_prefix(
+                    replica.engine, key, replica=replica.name
+                )
+                self._lifecycle_collector = register_engine_lifecycle(
+                    _lifecycle_provider(
+                        weakref.ref(replica.engine), inject_model=model
+                    ),
+                    key=key,
+                )
+            # prune a previous incarnation's bare-model entry and any
+            # replicas beyond the current count (scale-down reload)
+            prune_prefix_caches(model, keep)
+            prune_engine_lifecycle(model, keep)
+            from ..statistics.metrics import register_replica_router
 
-            engine_ref = weakref.ref(self.engine)
+            group_ref = weakref.ref(self.engine)
 
-            def _lifecycle_provider():
-                engine = engine_ref()
-                return engine.lifecycle_stats() if engine is not None else None
+            def _router_provider():
+                group = group_ref()
+                if group is None:
+                    return None
+                s = group.router.stats()
+                s["model"] = model
+                return s
 
-            self._lifecycle_collector = register_engine_lifecycle(
-                _lifecycle_provider, key=self._model_name
+            self._router_collector = register_replica_router(
+                _router_provider, key=model
             )
         except Exception:
             self._lifecycle_collector = None
-        return self.engine
 
     @staticmethod
     def _lifecycle_knob(engine_cfg: Dict[str, Any], key: str, default):

@@ -1,0 +1,497 @@
+"""Replica engine group: N ``LLMEngineCore`` replicas behind one
+prefix-affine router (docs/replication.md).
+
+``ReplicaGroup`` presents the single-engine surface the OpenAI front and
+the serving router already consume (``validate`` / ``check_admission`` /
+``generate`` / ``score_prompt`` / ``warmup`` / ``health`` /
+``lifecycle_stats`` / ``stop``), so a fleet drops in wherever one engine
+stood. Routing is delegated to ``serving/replica_router.py``: every
+request's block-aligned prompt prefix picks the replica whose KV tier
+already holds its conversation, with health-aware rebalance and
+load-aware spill.
+
+Failure drain ("kill one replica, zero user-visible 503s"): when a
+replica fails a stream with a REPLICA-level error (watchdog trip →
+``EngineStuckError``, stop/eject → ``EngineUnavailableError``), the group
+resumes the request on a sibling — the generated-so-far tokens become
+part of the resume prompt (the same history-as-prompt trick the
+preemptible batch lane uses, docs/slo_scheduling.md), so a greedy stream
+continues byte-identically and the consumer only observes latency.
+Eligibility matches the preemption lane's rule: plain-sampling requests
+only — guided or penalty-bearing requests would resume WRONG (the
+history-as-prompt resume resets the device penalty histogram / DFA
+state) and propagate their error instead. Request-attributable errors
+(deadlines, sheds, per-request step failures) propagate unchanged:
+retrying those would hide real contract violations.
+
+In-process replicas share one params tree (read-only for compute: the
+engines donate only their KV buffers) and allocate private KV pools —
+the same interface a per-mesh process group (parallel/multihost.py)
+plugs into later with RPC instead of method calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, List, Optional
+
+from ..errors import EngineStuckError, EngineUnavailableError
+from ..serving.replica_router import ReplicaRouter
+
+logger = logging.getLogger(__name__)
+
+
+class EngineReplica:
+    """One ring member: an engine plus its warmup gate and identity.
+
+    The warmup gate (docs/static_analysis.md TPU6xx, llm/warmup.py) gates
+    RING ENTRY: a cold replica never takes serve traffic, and an ejected
+    replica re-warms before re-admission (fast no-compile pass when its
+    jit caches survived, a real warmup when they did not).
+    """
+
+    def __init__(self, index: int, engine, *, warmup_mode: str = "off"):
+        if warmup_mode not in ("off", "startup", "full"):
+            raise ValueError(
+                "replica warmup mode must be off/startup/full: got {!r}".format(
+                    warmup_mode
+                )
+            )
+        self.index = int(index)
+        self.name = "r{}".format(index)
+        self.engine = engine
+        # one replica identity across every surface (metrics labels, ring
+        # names, registry keys, /ready blocks): default-fill the engine's
+        # id with the ring name when the caller left it unset
+        if getattr(engine, "replica_id", None) is None:
+            engine.replica_id = self.name
+        self._warmup_mode = warmup_mode
+        # gate open from birth when warmup is off — the legacy lazy-compile
+        # behavior, byte-identical to a single engine without warmup
+        self.warmed = warmup_mode == "off"
+        # whether the FULL sweep has run (a cheap startup pass opens the
+        # gate but must not satisfy a full-certification warmup request)
+        self.warmed_full = False
+        # last warmup sweep's run_warmup result (group.warmup aggregates)
+        self.warm_result = {"requests": 0, "cow_buckets": 0}
+        self._warm_task: Optional[asyncio.Task] = None
+
+    # -- state the router consumes ------------------------------------------
+
+    @property
+    def engine_ready(self) -> bool:
+        return bool(self.engine.is_ready)
+
+    @property
+    def serving_ready(self) -> bool:
+        return self.engine_ready and self.warmed
+
+    @property
+    def warming(self) -> bool:
+        return self._warm_task is not None and not self._warm_task.done()
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self.engine._pending.qsize())
+
+    @property
+    def brownout_stage(self) -> int:
+        snap = self.engine._brownout_snapshot()
+        return int((snap or {}).get("stage", 0))
+
+    # -- warmup gate --------------------------------------------------------
+
+    def invalidate_warm(self) -> None:
+        """Close the gate on ejection so re-admission re-warms (no-op when
+        warmup is disabled — then the gate never closes)."""
+        if self._warmup_mode != "off":
+            self.warmed = False
+            self.warmed_full = False
+
+    def begin_warm(self) -> None:
+        """Schedule the shared warmup task (event loop only). The gate
+        reopens when it finishes; a FAILED warmup logs and reopens the
+        gate anyway — serving then compiles lazily, the same best-effort
+        contract as the endpoint-level warmup knob."""
+        if self.warmed or self.warming or not self.engine_ready:
+            return
+        if self._warmup_mode == "off":
+            self.warmed = True
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no running loop (construction-time sweep): defer — the next
+            # sweep from a loop context schedules the task; scheduling on
+            # a never-running loop would leave the gate closed forever
+            return
+        self._warm_task = loop.create_task(self.ensure_warm())
+
+    async def ensure_warm(self, full: Optional[bool] = None) -> None:
+        from .warmup import run_warmup
+
+        if full is None:
+            full = self._warmup_mode == "full"
+        try:
+            self.warm_result = await run_warmup(
+                self.engine, full=full, fence=False
+            )
+        except Exception as ex:  # tpuserve: ignore[TPU401] warmup is best-effort by contract; failure falls back to lazy compiles and is logged
+            logger.warning(
+                "replica %s warmup failed (will serve with lazy compiles): %s",
+                self.name, ex,
+            )
+        self.warmed = True
+        self.warmed_full = self.warmed_full or bool(full)
+
+    # -- observability ------------------------------------------------------
+
+    def health(self) -> dict:
+        out = self.engine.health()
+        out["replica"] = self.name
+        out["ring_state"] = (
+            "ready" if self.serving_ready
+            else ("warming" if self.warming else "ejected")
+        )
+        return out
+
+
+class ReplicaGroup:
+    """Engine-group facade: routes the single-engine API over N replicas."""
+
+    def __init__(
+        self,
+        engines: List[Any],
+        *,
+        warmup_mode: str = "off",
+        affinity_blocks: int = 4,
+        spill_queue_depth: Optional[int] = None,
+        spill_brownout_stage: int = 2,
+        fleet_shed_stage: int = 3,
+    ):
+        if not engines:
+            raise ValueError("a replica group needs at least one engine")
+        self.replicas = [
+            EngineReplica(i, engine, warmup_mode=warmup_mode)
+            for i, engine in enumerate(engines)
+        ]
+        prefix = engines[0]._prefix
+        block = prefix.block if prefix is not None else 64
+        # spill bound defaults to half the admission bound: deep enough
+        # that transient bursts stay affine, shallow enough to redirect
+        # before the affine member starts shedding. An EXPLICIT 0 disables
+        # queue-depth spill (maps to the router's None spelling).
+        if spill_queue_depth is None and engines[0].max_pending:
+            spill_queue_depth = max(2, int(engines[0].max_pending) // 2)
+        elif spill_queue_depth is not None and int(spill_queue_depth) <= 0:
+            spill_queue_depth = None
+        self.router = ReplicaRouter(
+            self.replicas,
+            block=block,
+            affinity_blocks=affinity_blocks,
+            spill_queue_depth=spill_queue_depth,
+            spill_brownout_stage=spill_brownout_stage,
+            fleet_shed_stage=fleet_shed_stage,
+        )
+        self.failovers = 0
+
+    # -- single-engine surface (config/readonly) ----------------------------
+
+    def _first_engine(self):
+        return self.replicas[0].engine
+
+    @property
+    def bundle(self):
+        # replicas share one model bundle (and its params tree)
+        return self._first_engine().bundle
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._first_engine().max_seq_len
+
+    @property
+    def max_batch(self) -> int:
+        return self._first_engine().max_batch
+
+    @property
+    def logprobs_k(self) -> int:
+        return self._first_engine().logprobs_k
+
+    @property
+    def _adapter_index(self):
+        return getattr(self._first_engine(), "_adapter_index", {})
+
+    @property
+    def adapter_names(self) -> List[str]:
+        # mirrors the engine's @property (a method here would break the
+        # /v1/models iteration over it)
+        return self._first_engine().adapter_names
+
+    @property
+    def _prefix(self):
+        # replica 0's cache stands in for "the" prefix cache on config
+        # probes; metrics register EVERY replica's cache separately
+        return self._first_engine()._prefix
+
+    @property
+    def paged_cache(self):
+        return self._first_engine().paged_cache
+
+    @property
+    def is_ready(self) -> bool:
+        """Fleet readiness: at least one ring member serves."""
+        self.router.sweep()
+        return self.router.ring_size >= 1
+
+    # -- request path -------------------------------------------------------
+
+    def validate(self, request) -> None:
+        # replicas are identically configured: validation is config-only
+        self._first_engine().validate(request)
+
+    def check_admission(self, request, reserve: int = 0) -> None:
+        """Route and pre-admit: the chosen replica is pinned on the request
+        so the later ``generate`` lands on the engine whose admission
+        state this check consulted (streaming callers run this before
+        response headers, exactly like the single-engine contract)."""
+        replica, route = self.router.pick(request)
+        request._replica_name = replica.name
+        replica.engine.check_admission(request, reserve=reserve)
+
+    def _replica_by_name(self, name: Optional[str]):
+        for replica in self.replicas:
+            if replica.name == name:
+                return replica
+        return None
+
+    @staticmethod
+    def _resume_clone(request, emitted: List[int]):
+        """A fresh request continuing ``request`` after ``emitted`` tokens:
+        history rides as prompt (the radix cache replays its KV on the
+        sibling when warm; recompute when not). Greedy continuations are
+        byte-identical; seeded sampling replays its stream from the resume
+        point (documented failover approximation).
+
+        Deadline budgets carry REMAINING time, not fresh values: the
+        original request's resolved monotonic deadlines bound the clone —
+        a 10s-budget request 9s in when its replica trips gets ~1s on the
+        sibling, not a fresh 10s (the 408 contract survives failover).
+        The TTFT budget only still applies when no token was emitted; the
+        queue budget likewise covered the ORIGINAL admission wait, so a
+        mid-stream resume is bounded by the total budget alone."""
+        import time as _time
+
+        from .engine import GenRequest
+
+        done = len(emitted)
+        now = _time.monotonic()
+
+        def _remaining(deadline):
+            # floor, not fail-fast: an exactly-elapsed budget still gets
+            # one admission attempt and fails there with a structured 408
+            return None if deadline is None else max(0.05, deadline - now)
+
+        return GenRequest(
+            prompt_ids=list(request.prompt_ids) + list(emitted),
+            max_new_tokens=max(1, request.max_new_tokens - done),
+            temperature=request.temperature,
+            top_k=request.top_k,
+            top_p=request.top_p,
+            stop_token_ids=list(request.stop_token_ids or []) or None,
+            presence_penalty=request.presence_penalty,
+            frequency_penalty=request.frequency_penalty,
+            repetition_penalty=request.repetition_penalty,
+            seed=request.seed,
+            logit_bias=dict(request.logit_bias) if request.logit_bias else None,
+            logprobs=request.logprobs,
+            adapter=request.adapter,
+            min_tokens=max(0, request.min_tokens - done),
+            priority=request.priority,
+            queue_timeout=(
+                _remaining(request._queue_deadline) if done == 0 else None
+            ),
+            ttft_timeout=(
+                _remaining(request._ttft_deadline) if done == 0 else None
+            ),
+            total_timeout=_remaining(request._deadline),
+        )
+
+    @staticmethod
+    def _resumable(request) -> bool:
+        """Failover eligibility, matching the engine's own preemption-lane
+        rule (engine._preempt_slot): history-as-prompt resume resets the
+        device penalty histogram and guided DFA state, so requests using
+        either must propagate their error instead of resuming WRONG.
+        (Seeded sampling resumes with a replayed RNG stream — an explicit,
+        documented approximation; greedy resumes byte-identically.)"""
+        return (
+            request.guided is None
+            and request.presence_penalty == 0.0
+            and request.frequency_penalty == 0.0
+            and request.repetition_penalty == 1.0
+        )
+
+    async def generate(self, request) -> AsyncIterator[int]:
+        """Routed generation with failure drain: replica-level failures
+        (stuck/unavailable) resume the stream on the next-choice sibling;
+        request-attributable errors propagate unchanged."""
+        replica = self._replica_by_name(getattr(request, "_replica_name", None))
+        if replica is None or replica.name not in self.router._ring_members:
+            replica, _ = self.router.pick(request)
+            request._replica_name = replica.name
+        # set before the engine does: a pre-admission failover must not
+        # leave the caller's usage accounting reading prompt_len == 0
+        request.prompt_len = len(request.prompt_ids)
+        emitted: List[int] = []
+        base_lp = 0  # caller-side logprob entries at the last failover
+        active = request
+        tried = set()
+        try:
+            while True:
+                tried.add(replica.name)
+                failed: Optional[BaseException] = None
+                try:
+                    async for token in replica.engine.generate(active):
+                        emitted.append(int(token))
+                        if active is not request:
+                            # mirror progress onto the caller's request:
+                            # usage/TTFT/logprobs read from it post-stream
+                            request.produced = len(emitted)
+                            if request.first_token_at is None:
+                                request.first_token_at = active.first_token_at
+                            if request.logprobs is not None:
+                                request.logprob_entries.extend(
+                                    active.logprob_entries[
+                                        len(request.logprob_entries) - base_lp:
+                                    ]
+                                )
+                        yield token
+                except (EngineStuckError, EngineUnavailableError) as ex:
+                    failed = ex
+                if failed is None:
+                    return
+                if len(emitted) >= request.max_new_tokens:
+                    # the stream already delivered everything the caller
+                    # asked for (the replica failed between the last token
+                    # and the finish marker): finish normally — a resume
+                    # would overshoot max_new_tokens by at least one
+                    return
+                if not self._resumable(request):
+                    raise failed
+                self.router.sweep()
+                candidates = [
+                    r for r in self.router.order_for(request.prompt_ids)
+                    if r.name in self.router._ring_members
+                    and r.name not in tried
+                ]
+                if not candidates:
+                    raise failed
+                failed_name = replica.name
+                replica = candidates[0]
+                self.failovers += 1
+                logger.warning(
+                    "replica %s failed a stream (%s); resuming %d-token "
+                    "history on %s", failed_name, type(failed).__name__,
+                    len(emitted), replica.name,
+                )
+                active = self._resume_clone(request, emitted)
+                base_lp = len(request.logprob_entries)
+                request._replica_name = replica.name
+        finally:
+            # consumer stopped early (GeneratorExit lands here): flag the
+            # LIVE request so its engine frees the slot/pages promptly —
+            # closing the wrapper does not synchronously close a resumed
+            # clone's inner generator. Redundant after a normal finish.
+            active.cancelled = True
+
+    def score_prompt(self, prompt_ids, adapter: Optional[str] = None):
+        # stateless readonly compute: any ring member serves it
+        replica = self._replica_by_name(next(iter(self.router.ring()), None))
+        engine = replica.engine if replica is not None else self._first_engine()
+        return engine.score_prompt(prompt_ids, adapter)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def warmup(self, full: bool = True) -> dict:
+        """Warm every replica through its gate, then set the process-wide
+        compile-sentry fence once (only a FULL sweep certifies — the same
+        contract as llm/warmup.run_warmup). Every sweep runs AS the
+        replica's own gate task (``_warm_task``): a concurrent ring sweep
+        (e.g. a /ready probe mid-warmup) sees ``warming`` and never
+        schedules a duplicate run_warmup on the same engine; an in-flight
+        gate task is awaited, then topped up with the full sweep if this
+        call needs certification and the gate only ran the startup pass."""
+        from . import compile_sentry
+
+        results = []
+        for replica in self.replicas:
+            if replica.warming:
+                try:
+                    await asyncio.shield(replica._warm_task)
+                except Exception:  # tpuserve: ignore[TPU401] gate task logs its own failure; warmup stays best-effort
+                    pass
+            if replica.warmed and (replica.warmed_full or not full):
+                continue
+            replica._warm_task = asyncio.get_running_loop().create_task(
+                replica.ensure_warm(full=full)
+            )
+            try:
+                await asyncio.shield(replica._warm_task)
+            except Exception:  # tpuserve: ignore[TPU401] ensure_warm logs its own failure; warmup stays best-effort
+                pass
+            results.append(replica.warm_result)
+        self.router.sweep()
+        fenced = False
+        if full and compile_sentry.enabled():
+            compile_sentry.get().fence()
+            fenced = True
+        return {
+            "replicas": len(self.replicas),
+            "requests": sum(r.get("requests", 0) for r in results),
+            "cow_buckets": sum(r.get("cow_buckets", 0) for r in results),
+            "fenced": fenced,
+        }
+
+    def stop(self) -> None:
+        for replica in self.replicas:
+            replica.engine.stop()
+        self.router.sweep()
+
+    async def wait_drained(self, timeout: float = 30.0) -> None:
+        for replica in self.replicas:
+            await replica.engine.wait_drained(timeout=timeout)
+
+    # -- observability ------------------------------------------------------
+
+    def health(self) -> dict:
+        """Fleet-aggregated health: ready iff the ring has >= 1 member;
+        per-replica blocks + the router's ring/route state ride along so
+        /ready can show WHICH replica is out and why."""
+        self.router.sweep()
+        stats = self.router.stats()
+        return {
+            "ready": stats["ring_size"] >= 1,
+            "ring_size": stats["ring_size"],
+            "replicas": {r.name: r.health() for r in self.replicas},
+            "router": stats,
+            "brownout": {"stage": stats["fleet_brownout"]["stage"]},
+            "queue_depth": sum(r.queue_depth for r in self.replicas),
+            "active_slots": sum(r.engine.active_slots for r in self.replicas),
+            "failovers": self.failovers,
+        }
+
+    def lifecycle_stats(self) -> dict:
+        """Fleet view for dashboards: the router block plus per-replica
+        engine snapshots (each replica ALSO registers its own provider so
+        the Prometheus series carry the ``replica`` label)."""
+        stats = self.router.stats()
+        return {
+            "ready": int(stats["ring_size"] >= 1),
+            "ring_size": stats["ring_size"],
+            "router": stats,
+            "failovers": self.failovers,
+            "replicas": {
+                r.name: r.engine.lifecycle_stats() for r in self.replicas
+            },
+        }

@@ -101,8 +101,9 @@ async def _read_body(request: web.Request) -> Any:
 
 def _engine_health(processor: ModelRequestProcessor) -> dict:
     """Per-endpoint engine health for /ready: any loaded processor exposing
-    an ``engine`` with a ``health()`` surface (the LLM engine core)
-    contributes; plain CPU/gRPC engines are stateless and always ready."""
+    an ``engine`` with a ``health()`` surface (the LLM engine core, or a
+    replica group's fleet aggregate) contributes; plain CPU/gRPC engines
+    are stateless and always ready."""
     out = {}
     for url, proc in getattr(processor, "_engine_processor_lookup", {}).items():
         engine = getattr(proc, "engine", None)
@@ -112,6 +113,56 @@ def _engine_health(processor: ModelRequestProcessor) -> dict:
                 out[url] = health()
             except Exception as ex:
                 out[url] = {"ready": False, "error": str(ex)}
+    return out
+
+
+def _fleet_health(processor: ModelRequestProcessor) -> dict:
+    """Health of REPLICA-GROUP engines only (those exposing a ``router``):
+    /health is a liveness probe and must not pay every plain engine's
+    full health snapshot — nor run fleet ring sweeps it then discards —
+    on each kubelet poll."""
+    out = {}
+    for url, proc in getattr(processor, "_engine_processor_lookup", {}).items():
+        engine = getattr(proc, "engine", None)
+        if getattr(engine, "router", None) is None:
+            continue
+        try:
+            out[url] = engine.health()
+        except Exception as ex:
+            out[url] = {"ready": False, "error": str(ex)}
+    return out
+
+
+def _fleet_summary(engines: dict) -> dict:
+    """Replica-fleet view of the engine healths (docs/replication.md):
+    endpoints backed by a replica group report per-replica state and the
+    router's ring — an endpoint is READY iff its ring has >= 1 member
+    (the group's own ``ready`` aggregate), so one tripped replica never
+    flips /ready while its siblings still serve."""
+    out = {}
+    for url, h in engines.items():
+        router = h.get("router")
+        if not isinstance(router, dict):
+            continue  # single-engine endpoint: no fleet block
+        out[url] = {
+            "replicas": router.get("replicas"),
+            "ring_size": router.get("ring_size"),
+            "ring": router.get("ring"),
+            "ready": bool(h.get("ready")),
+            "failovers": h.get("failovers", 0),
+            "fleet_brownout": router.get("fleet_brownout"),
+            "per_replica": {
+                name: {
+                    "ready": bool(rh.get("ready")),
+                    "ring_state": rh.get("ring_state"),
+                    "brownout_stage": (rh.get("brownout") or {}).get(
+                        "stage", 0
+                    ),
+                    "queue_depth": rh.get("queue_depth", 0),
+                }
+                for name, rh in (h.get("replicas") or {}).items()
+            },
+        }
     return out
 
 
@@ -285,13 +336,18 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
         return await _respond(request, result)
 
     async def health(request: web.Request) -> web.Response:
-        return web.json_response(
-            {
-                "status": "ok",
-                "instance": _instance_id(processor),
-                "endpoints": sorted(processor.list_endpoints()),
-            }
-        )
+        payload = {
+            "status": "ok",
+            "instance": _instance_id(processor),
+            "endpoints": sorted(processor.list_endpoints()),
+        }
+        # replica-fleet endpoints surface per-replica liveness here too
+        # (docs/replication.md) — /health stays liveness (200 while the
+        # process serves anything), /ready below is the routing signal
+        fleet = _fleet_summary(_fleet_health(processor))
+        if fleet:
+            payload["fleet"] = fleet
+        return web.json_response(payload)
 
     async def dashboard(request: web.Request) -> web.Response:
         return web.json_response(processor.get_serving_layout())
@@ -302,6 +358,10 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
         recovery in progress) — so load balancers stop routing here while
         /health keeps the container from being killed."""
         engines = _engine_health(processor)
+        # a replica-group endpoint aggregates its own readiness (ready iff
+        # >= 1 ring member, docs/replication.md); the fleet block carries
+        # the per-replica detail either way
+        fleet = _fleet_summary(engines)
         not_ready = sorted(
             url for url, h in engines.items() if not h.get("ready")
         )
@@ -321,6 +381,7 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
                     "instance": _instance_id(processor),
                     "not_ready": not_ready,
                     "brownout": brownout,
+                    "fleet": fleet,
                     "engines": engines,
                 },
                 status=503,
@@ -331,6 +392,7 @@ def build_app(processor: ModelRequestProcessor) -> web.Application:
                 "status": "ready",
                 "instance": _instance_id(processor),
                 "brownout": brownout,
+                "fleet": fleet,
                 "engines": engines,
             }
         )

@@ -91,7 +91,52 @@ class EnumHistogram:
         return self.collect()
 
 
-class PrefixCacheCollector:
+
+class _KeyedCollector:
+    """Shared bookkeeping for scrape-time collectors keyed by model (or
+    model@replica): one entry per key, replace-on-reregister (endpoint
+    hot-reload must not leak the old engine or duplicate families), and
+    hot-reload pruning for per-replica key variants."""
+
+    def __init__(self, prefix: str):
+        self._prefix = _sanitize(prefix)
+        self._entries: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def set_entry(self, key: str, value) -> None:
+        with self._lock:
+            self._entries[str(key)] = value
+
+    def remove_entry(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(str(key), None)
+
+    def prune_entries(self, key: str, keep) -> None:
+        """Drop entries registered for ``key`` or its per-replica
+        variants (``key@...``) that are not in ``keep``: an endpoint
+        hot-reload that changes the replica count must not leave stale
+        entries pinning dead engines' state or exporting frozen series
+        (docs/replication.md)."""
+        keep = set(keep)
+        with self._lock:
+            stale = [
+                k for k in self._entries
+                if (k == key or k.startswith(key + "@")) and k not in keep
+            ]
+            for k in stale:
+                self._entries.pop(k, None)
+
+    def _snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._entries)
+
+    def describe(self):
+        # empty describe => prometheus_client registers without probing
+        # collect() (providers may not be fully constructed yet)
+        return []
+
+
+class PrefixCacheCollector(_KeyedCollector):
     """Live LLM prefix-cache observability (llm/prefix_cache.py
     RadixPrefixCache): collect() reads each registered cache's counters —
     and, on the paged backend, the page pool's sharing/CoW counters — at
@@ -103,20 +148,19 @@ class PrefixCacheCollector:
     re-registering a model (endpoint hot-reload rebuilds its engine)
     REPLACES its entry, dropping the dead engine's cache reference — a
     per-engine collector would both leak the old cache's device KV and emit
-    duplicate metric families, which makes Prometheus reject the scrape."""
+    duplicate metric families, which makes Prometheus reject the scrape.
+    Replica-fleet entries (docs/replication.md) register per replica with
+    ``model``/``replica`` overrides: their samples carry the same
+    {model, replica} label split as the lifecycle families (never a
+    mangled model label), while legacy entries keep the historical
+    {model} shape."""
 
     def __init__(self, prefix: str = "llm_prefix_cache"):
-        self._prefix = _sanitize(prefix)
-        self._entries: Dict[str, tuple] = {}  # model key -> (cache, pool)
-        self._lock = threading.Lock()
+        super().__init__(prefix)
 
-    def set_entry(self, key: str, cache, pool=None) -> None:
-        with self._lock:
-            self._entries[str(key)] = (cache, pool)
-
-    def remove_entry(self, key: str) -> None:
-        with self._lock:
-            self._entries.pop(str(key), None)
+    def set_entry(self, key: str, cache, pool=None, *, model=None,
+                  replica=None) -> None:
+        super().set_entry(key, (cache, pool, model, replica))
 
     def collect(self):
         from prometheus_client.core import (
@@ -124,77 +168,85 @@ class PrefixCacheCollector:
             GaugeMetricFamily,
         )
 
-        with self._lock:
-            entries = dict(self._entries)
+        entries = self._snapshot()
         p = self._prefix
+
+        def labels(key, model, replica, extra=None):
+            out = {"model": str(model or key)}
+            if replica is not None:
+                out["replica"] = str(replica)
+            if extra:
+                out.update({k: str(v) for k, v in extra.items()})
+            return out
+
         # hit counter carries the serving TIER (docs/kv_tiering.md): hbm =
         # the whole run was resident, host = it needed promotion from the
         # host-RAM tier; sum over tier = total hits
         hits = CounterMetricFamily(
             p + "_hits", "prefix-cache lookups that matched >= 1 block, by "
-            "serving tier (hbm = resident, host = promoted from host RAM)",
-            labels=["model", "tier"])
+            "serving tier (hbm = resident, host = promoted from host RAM)")
         cache_fams = [
-            ("misses", CounterMetricFamily(
-                p + "_misses", "prefix-cache lookups with no shared block",
-                labels=["model"])),
-            ("hit_tokens", CounterMetricFamily(
+            ("misses", "_total", CounterMetricFamily(
+                p + "_misses", "prefix-cache lookups with no shared block")),
+            ("hit_tokens", "_total", CounterMetricFamily(
                 p + "_hit_tokens", "prompt tokens served from cached KV "
-                "(prefill compute skipped)", labels=["model"])),
-            ("evictions", CounterMetricFamily(
-                p + "_evictions", "radix-tree leaf evictions",
-                labels=["model"])),
-            ("nodes", GaugeMetricFamily(
-                p + "_nodes", "cached block-granular tree nodes",
-                labels=["model"])),
-            ("cached_bytes", GaugeMetricFamily(
+                "(prefill compute skipped)")),
+            ("evictions", "_total", CounterMetricFamily(
+                p + "_evictions", "radix-tree leaf evictions")),
+            ("nodes", "", GaugeMetricFamily(
+                p + "_nodes", "cached block-granular tree nodes")),
+            ("cached_bytes", "", GaugeMetricFamily(
                 p + "_bytes", "bytes of KV held (dense) or referenced "
-                "(paged) by the cache", labels=["model"])),
-            ("cached_pages", GaugeMetricFamily(
+                "(paged) by the cache")),
+            ("cached_pages", "", GaugeMetricFamily(
                 p + "_pages", "KV pool pages referenced by the cache (paged "
-                "backend)", labels=["model"])),
+                "backend)")),
         ]
         shared = GaugeMetricFamily(
             "kv_pool_shared_pages",
             "pool pages with more than one reference (slot+cache or "
-            "slot+slot zero-copy sharing)", labels=["model"],
+            "slot+slot zero-copy sharing)",
         )
         free = GaugeMetricFamily(
-            "kv_pool_free_pages", "unreferenced pool pages", labels=["model"]
+            "kv_pool_free_pages", "unreferenced pool pages"
         )
         cow = CounterMetricFamily(
             "kv_pool_cow_events",
             "copy-on-write page duplications (live slot extended into a "
-            "shared page)", labels=["model"],
+            "shared page)",
         )
         any_pool = False
-        for key, (cache, pool) in entries.items():
-            s = cache.stats()
-            by_tier = s.get("hits_by_tier") or {"hbm": s.get("hits", 0)}
+        for key, (cache, pool, model, replica) in entries.items():
+            stats = cache.stats()
+            by_tier = stats.get("hits_by_tier") or {
+                "hbm": stats.get("hits", 0)
+            }
             for tier_name, count in by_tier.items():
-                hits.add_metric([key, str(tier_name)], count)
-            for stat_key, fam in cache_fams:
-                fam.add_metric([key], s[stat_key])
+                hits.add_sample(
+                    hits.name + "_total",
+                    labels(key, model, replica, {"tier": tier_name}), count,
+                )
+            for stat_key, suffix, fam in cache_fams:
+                fam.add_sample(
+                    fam.name + suffix, labels(key, model, replica),
+                    stats[stat_key],
+                )
             if pool is not None:
                 any_pool = True
-                shared.add_metric([key], pool.shared_pages)
-                free.add_metric([key], pool.free_pages)
-                cow.add_metric([key], pool.cow_events)
+                row = labels(key, model, replica)
+                shared.add_sample(shared.name, row, pool.shared_pages)
+                free.add_sample(free.name, row, pool.free_pages)
+                cow.add_sample(cow.name + "_total", row, pool.cow_events)
         yield hits
-        for _, fam in cache_fams:
+        for _, _, fam in cache_fams:
             yield fam
         if any_pool:
             yield shared
             yield free
             yield cow
 
-    def describe(self):
-        # empty describe => prometheus_client registers without probing
-        # collect() (the engine may not be fully constructed yet)
-        return []
 
-
-class EngineLifecycleCollector:
+class EngineLifecycleCollector(_KeyedCollector):
     """Request-lifecycle observability (docs/robustness.md): shed / deadline
     / watchdog / step-failure counters plus queue-depth and active-slot
     gauges, read live from each registered provider at scrape time so
@@ -208,26 +260,61 @@ class EngineLifecycleCollector:
     engine or duplicate families)."""
 
     def __init__(self, prefix: str = "engine"):
-        self._prefix = _sanitize(prefix)
-        self._providers: Dict[str, Any] = {}
-        self._lock = threading.Lock()
-
-    def set_entry(self, key: str, provider) -> None:
-        with self._lock:
-            self._providers[str(key)] = provider
-
-    def remove_entry(self, key: str) -> None:
-        with self._lock:
-            self._providers.pop(str(key), None)
+        super().__init__(prefix)
 
     def collect(self):
         from prometheus_client.core import (
             CounterMetricFamily,
             GaugeMetricFamily,
+            HistogramMetricFamily,
         )
 
-        with self._lock:
-            providers = dict(self._providers)
+        providers = self._snapshot()
+        rows = []
+        for key, provider in providers.items():
+            try:
+                s = provider() or {}
+            except Exception:
+                continue
+            rows.append((key, s))
+
+        # label shape is PER ROW (docs/replication.md): a provider that
+        # reports a `replica` id gets the replica label on its samples —
+        # two replicas of one model would otherwise emit duplicate series
+        # and Prometheus rejects the scrape — while providers without one
+        # keep the historical {model} label set. Deciding this per row
+        # (raw samples, not add_metric) means a fleet endpoint registering
+        # on a shared registry never changes a LEGACY endpoint's series
+        # identity: dashboards on engine_ready{model="A"} keep matching
+        # when endpoint B scales out, and nothing flaps when B is evicted.
+        def _labels(key, s, extra=None):
+            out = {"model": str(s.get("model") or key)}
+            if "replica" in s:
+                out["replica"] = str(s["replica"])
+            if extra:
+                out.update({k: str(v) for k, v in extra.items()})
+            return out
+
+        def gauge(fam, key, s, value, **extra):
+            fam.add_sample(fam.name, _labels(key, s, extra), value)
+
+        def counter(fam, key, s, value, **extra):
+            # CounterMetricFamily strips a trailing _total from its name;
+            # sample names re-append it (same as add_metric)
+            fam.add_sample(fam.name + "_total", _labels(key, s, extra), value)
+
+        def hist(fam, key, s, snap, **extra):
+            labels = _labels(key, s, extra)
+            buckets, total = _hist_buckets(snap)
+            for edge, cum in buckets:
+                fam.add_sample(
+                    fam.name + "_bucket", dict(labels, le=edge), cum
+                )
+            if buckets:
+                # +Inf is last and provides the count (add_metric parity)
+                fam.add_sample(fam.name + "_count", labels, buckets[-1][1])
+            fam.add_sample(fam.name + "_sum", labels, total)
+
         p = self._prefix
         # per-class queue depth (docs/slo_scheduling.md): one series per
         # priority class plus class="all" for the total; providers that
@@ -236,81 +323,68 @@ class EngineLifecycleCollector:
             p + "_queue_depth",
             "requests waiting in the engine's admission queue, by priority "
             "class (class=\"all\" = total)",
-            labels=["model", "class"],
         )
         active_slots = GaugeMetricFamily(
             p + "_active_slots", "decode slots currently generating",
-            labels=["model"],
         )
         ready = GaugeMetricFamily(
             p + "_ready", "1 while the engine accepts work (0 = stopped or "
-            "watchdog recovery in progress)", labels=["model"],
+            "watchdog recovery in progress)",
         )
         sheds = CounterMetricFamily(
             p + "_sheds_total",
             "admissions shed at the front door, by reason and priority "
             "class (class=\"all\" = legacy per-reason totals)",
-            labels=["model", "reason", "class"],
         )
         preemptions = CounterMetricFamily(
             p + "_preemptions_total",
             "batch-lane slots preempted for queued interactive work "
-            "(docs/slo_scheduling.md)", labels=["model"],
+            "(docs/slo_scheduling.md)",
         )
         brownout_stage = GaugeMetricFamily(
             p + "_brownout_stage",
             "staged-degradation level (0 = normal; 1 spec decode off; 2 + "
             "batch token cap; 3 + prefill budget shrunk and best-effort "
-            "shed)", labels=["model"],
+            "shed)",
         )
         brownout_score = GaugeMetricFamily(
             p + "_brownout_score",
             "overload pressure score driving the brownout stage",
-            labels=["model"],
         )
         deadlines = CounterMetricFamily(
             p + "_deadline_hits_total",
             "requests failed on an elapsed budget",
-            labels=["model", "stage"],
         )
         trips = CounterMetricFamily(
             p + "_watchdog_trips_total",
             "stalled-loop detections (each failed the in-flight batch and "
-            "recovered the loop)", labels=["model"],
+            "recovered the loop)",
         )
         failures = CounterMetricFamily(
             p + "_step_failures_total",
             "decode dispatch failures survived by the loop",
-            labels=["model"],
         )
         grpc = CounterMetricFamily(
             "grpc_client_upstream_total",
             "engine-server gRPC attempts/retries/retry-budget exhaustions",
-            labels=["model", "kind"],
         )
         # pipelined-decode observability (docs/pipelined_decode.md): stage
         # timing histograms + the live in-flight dispatch queue depth
-        from prometheus_client.core import HistogramMetricFamily
-
         inflight = GaugeMetricFamily(
             p + "_pipeline_inflight",
             "decode chunks dispatched but not yet retired",
-            labels=["model"],
         )
         pipe_depth = GaugeMetricFamily(
             p + "_pipeline_depth",
             "configured decode pipeline depth (1 = serial)",
-            labels=["model"],
         )
         dispatch_ms = HistogramMetricFamily(
             p + "_step_dispatch_ms",
             "host time to enqueue one decode chunk (ms)",
-            labels=["model"],
         )
         retire_ms = HistogramMetricFamily(
             p + "_step_retire_ms",
             "host time to sync + emit one retired chunk (ms)",
-            labels=["model"],
         )
         # ragged token-budget scheduler (docs/ragged_attention.md): how full
         # each mixed launch ran against its token budget, and how many rows
@@ -319,23 +393,20 @@ class EngineLifecycleCollector:
         budget_util = HistogramMetricFamily(
             p + "_step_token_budget_utilization",
             "per ragged step: tokens dispatched / step token budget",
-            labels=["model"],
         )
         step_rows = CounterMetricFamily(
             p + "_step_rows",
             "rows carried by ragged mixed launches, by phase "
             "(prefill = admission chunk rows, decode = one-token rows)",
-            labels=["model", "phase"],
         )
         ragged_jobs = GaugeMetricFamily(
             p + "_ragged_prefill_jobs",
             "admissions currently mid-prefill in the ragged scheduler",
-            labels=["model"],
         )
         ragged_budget = GaugeMetricFamily(
             p + "_step_token_budget",
             "effective ragged step token budget (brownout stage 3 shrinks "
-            "it)", labels=["model"],
+            "it)",
         )
         # paged KV pool capacity (docs/paged_kv_quant.md): bytes split by
         # kind (kv = data planes, scale = int8 dequant scale rows) plus an
@@ -344,12 +415,10 @@ class EngineLifecycleCollector:
         kv_pool_bytes = GaugeMetricFamily(
             p + "_kv_pool_bytes",
             "device HBM held by the paged KV pools, by kind",
-            labels=["model", "kind"],
         )
         kv_pool_dtype = GaugeMetricFamily(
             p + "_kv_pool_dtype",
             "info gauge (always 1): storage dtype of the paged KV pools",
-            labels=["model", "dtype"],
         )
         # host-RAM KV tier (docs/kv_tiering.md): where the prefix cache's
         # pages live (hbm vs host) and how many moved each way — the
@@ -358,25 +427,21 @@ class EngineLifecycleCollector:
             p + "_kv_tier_pages",
             "prefix-cache KV pages held, by tier (hbm = device pool, "
             "host = pinned host RAM)",
-            labels=["model", "tier"],
         )
         kv_tier_bytes = GaugeMetricFamily(
             p + "_kv_tier_bytes",
             "prefix-cache KV bytes held, by tier",
-            labels=["model", "tier"],
         )
         kv_demotions = CounterMetricFamily(
             p + "_kv_demotions",
             "demotion events: batched HBM->host spill rounds (eviction "
             "pressure spilled instead of dropping; pages moved are in "
             "lifecycle_stats kv_tier.demoted_pages_total)",
-            labels=["model"],
         )
         kv_promotions = CounterMetricFamily(
             p + "_kv_promotions",
             "promotion events: demoted runs re-onlined to HBM (async DMA "
             "on a host-tier hit, or by reference at a store)",
-            labels=["model"],
         )
         # compile-surface discipline (docs/static_analysis.md TPU6xx): XLA
         # compilations observed by the compile sentry, split at the warmup
@@ -388,13 +453,11 @@ class EngineLifecycleCollector:
             "(TPUSERVE_COMPILE_SENTRY), by phase (warmup = before the "
             "llm/warmup.py fence, serve = after: each is a loop-thread "
             "compile stall)",
-            labels=["model", "phase"],
         )
         xla_compile_ms = HistogramMetricFamily(
             p + "_xla_compile_ms",
             "per-compilation XLA compile time (ms) observed by the "
             "compile sentry",
-            labels=["model"],
         )
 
         def _hist_buckets(snap):
@@ -414,102 +477,98 @@ class EngineLifecycleCollector:
         any_slo = False
         any_ragged = False
         any_compile = False
-        for key, provider in providers.items():
-            try:
-                s = provider() or {}
-            except Exception:
-                continue
+        for key, s in rows:
             kv_pool = s.get("kv_pool") or {}
             if kv_pool:
                 any_kv_pool = True
                 for kind in ("kv", "scale"):
                     if kind in kv_pool:
-                        kv_pool_bytes.add_metric([key, kind], kv_pool[kind])
+                        gauge(kv_pool_bytes, key, s, kv_pool[kind], kind=kind)
                 if kv_pool.get("dtype"):
-                    kv_pool_dtype.add_metric([key, str(kv_pool["dtype"])], 1)
+                    gauge(kv_pool_dtype, key, s, 1, dtype=kv_pool["dtype"])
             kv_tier = s.get("kv_tier") or {}
             if kv_tier:
                 any_kv_tier = True
                 for tier_name, v in (kv_tier.get("pages") or {}).items():
-                    kv_tier_pages.add_metric([key, str(tier_name)], v)
+                    gauge(kv_tier_pages, key, s, v, tier=tier_name)
                 for tier_name, v in (kv_tier.get("bytes") or {}).items():
-                    kv_tier_bytes.add_metric([key, str(tier_name)], v)
+                    gauge(kv_tier_bytes, key, s, v, tier=tier_name)
                 if "demotions" in kv_tier:
-                    kv_demotions.add_metric([key], kv_tier["demotions"])
+                    counter(kv_demotions, key, s, kv_tier["demotions"])
                 if "promotions" in kv_tier:
-                    kv_promotions.add_metric([key], kv_tier["promotions"])
+                    counter(kv_promotions, key, s, kv_tier["promotions"])
             compile_block = s.get("compile") or {}
             if compile_block:
                 any_compile = True
                 for phase in ("warmup", "serve"):
                     if phase in compile_block:
-                        xla_compiles.add_metric(
-                            [key, phase], compile_block[phase]
+                        counter(
+                            xla_compiles, key, s, compile_block[phase],
+                            phase=phase,
                         )
                 snap = compile_block.get("compile_ms")
                 if snap:
-                    buckets, total = _hist_buckets(snap)
-                    xla_compile_ms.add_metric([key], buckets, total)
+                    hist(xla_compile_ms, key, s, snap)
             ragged = s.get("ragged") or {}
             if ragged:
                 any_ragged = True
                 snap = ragged.get("budget_utilization")
                 if snap:
-                    buckets, total = _hist_buckets(snap)
-                    budget_util.add_metric([key], buckets, total)
+                    hist(budget_util, key, s, snap)
                 for phase, v in (ragged.get("step_rows") or {}).items():
-                    step_rows.add_metric([key, str(phase)], v)
+                    counter(step_rows, key, s, v, phase=phase)
                 if "prefill_jobs" in ragged:
-                    ragged_jobs.add_metric([key], ragged["prefill_jobs"])
+                    gauge(ragged_jobs, key, s, ragged["prefill_jobs"])
                 if "effective_budget" in ragged:
-                    ragged_budget.add_metric([key], ragged["effective_budget"])
+                    gauge(ragged_budget, key, s, ragged["effective_budget"])
             pipe = s.get("pipeline") or {}
             if pipe:
                 any_pipeline = True
                 if "inflight" in pipe:
-                    inflight.add_metric([key], pipe["inflight"])
+                    gauge(inflight, key, s, pipe["inflight"])
                 if "depth" in pipe:
-                    pipe_depth.add_metric([key], pipe["depth"])
+                    gauge(pipe_depth, key, s, pipe["depth"])
                 for fam, field in ((dispatch_ms, "dispatch_ms"),
                                    (retire_ms, "retire_ms")):
                     snap = pipe.get(field)
                     if snap:
-                        buckets, total = _hist_buckets(snap)
-                        fam.add_metric([key], buckets, total)
+                        hist(fam, key, s, snap)
             qd_classes = s.get("queue_depths")
             if isinstance(qd_classes, dict):
                 for cls_name, v in qd_classes.items():
-                    queue_depth.add_metric([key, str(cls_name)], v)
+                    gauge(queue_depth, key, s, v, **{"class": cls_name})
             if "queue_depth" in s:
-                queue_depth.add_metric([key, "all"], s["queue_depth"])
+                gauge(queue_depth, key, s, s["queue_depth"],
+                      **{"class": "all"})
             if "active_slots" in s:
-                active_slots.add_metric([key], s["active_slots"])
+                gauge(active_slots, key, s, s["active_slots"])
             if "ready" in s:
-                ready.add_metric([key], s["ready"])
+                gauge(ready, key, s, s["ready"])
             by_class = s.get("sheds_by_class")
             if isinstance(by_class, dict):
                 for reason, per in by_class.items():
                     for cls_name, v in (per or {}).items():
-                        sheds.add_metric([key, str(reason), str(cls_name)], v)
+                        counter(sheds, key, s, v, reason=reason,
+                                **{"class": cls_name})
             for reason, v in (s.get("sheds") or {}).items():
-                sheds.add_metric([key, reason, "all"], v)
+                counter(sheds, key, s, v, reason=reason, **{"class": "all"})
             if "preemptions" in s:
                 any_slo = True
-                preemptions.add_metric([key], s["preemptions"])
+                counter(preemptions, key, s, s["preemptions"])
             brown = s.get("brownout")
             if isinstance(brown, dict):
                 any_slo = True
-                brownout_stage.add_metric([key], brown.get("stage", 0))
-                brownout_score.add_metric([key], brown.get("score", 0.0))
+                gauge(brownout_stage, key, s, brown.get("stage", 0))
+                gauge(brownout_score, key, s, brown.get("score", 0.0))
             for stage, v in (s.get("deadlines") or {}).items():
-                deadlines.add_metric([key, stage], v)
+                counter(deadlines, key, s, v, stage=stage)
             if "watchdog_trips" in s:
-                trips.add_metric([key], s["watchdog_trips"])
+                counter(trips, key, s, s["watchdog_trips"])
             if "step_failures" in s:
-                failures.add_metric([key], s["step_failures"])
+                counter(failures, key, s, s["step_failures"])
             for kind, v in (s.get("grpc") or {}).items():
                 any_grpc = True
-                grpc.add_metric([key, kind], v)
+                counter(grpc, key, s, v, kind=kind)
         yield queue_depth
         yield active_slots
         yield ready
@@ -545,16 +604,125 @@ class EngineLifecycleCollector:
         if any_grpc:
             yield grpc
 
-    def describe(self):
-        # empty describe => register without probing collect() (providers
-        # may not be fully constructed yet)
-        return []
+
+
+class ReplicaRouterCollector(_KeyedCollector):
+    """Replica-fleet routing observability (docs/replication.md): ring
+    size, per-(replica, route) request counters and ejection/re-admission
+    events, read live from each registered router provider at scrape time.
+    A provider is a zero-arg callable returning ``ReplicaRouter.stats()``
+    (optionally with a ``model`` key overriding the entry key as the model
+    label). One collector per registry, one entry per model key —
+    re-registering a key replaces its provider (endpoint hot-reload)."""
+
+    def __init__(self, prefix: str = "router"):
+        super().__init__(prefix)
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        providers = self._snapshot()
+        p = self._prefix
+        ring_size = GaugeMetricFamily(
+            p + "_ring_size",
+            "replicas currently serving traffic (ready + warm)",
+            labels=["model"],
+        )
+        replicas = GaugeMetricFamily(
+            p + "_replicas",
+            "replicas configured in the engine group",
+            labels=["model"],
+        )
+        requests = CounterMetricFamily(
+            p + "_requests_total",
+            "routing decisions, by replica and route (affine = HRW first "
+            "choice, spill = load-aware second choice, rebalance = "
+            "health/eject reroute); decisions can exceed served requests "
+            "when a stale pin re-routes between admission and generation",
+            labels=["model", "replica", "route"],
+        )
+        ejections = CounterMetricFamily(
+            p + "_ejections_total",
+            "ring ejections (engine not ready, or fault-forced via the "
+            "router.eject seam)", labels=["model", "replica"],
+        )
+        readmissions = CounterMetricFamily(
+            p + "_readmissions_total",
+            "ring re-admissions after recovery (each re-warmed through "
+            "the warmup gate first)", labels=["model", "replica"],
+        )
+        fleet_stage = GaugeMetricFamily(
+            p + "_fleet_brownout_stage",
+            "fleet brownout stage: the minimum stage over ring members "
+            "(what the least-pressured replica can still absorb)",
+            labels=["model"],
+        )
+        fleet_sheds = CounterMetricFamily(
+            p + "_fleet_sheds_total",
+            "requests shed at the router door by the fleet-wide brownout, "
+            "by priority class", labels=["model", "class"],
+        )
+        for key, provider in providers.items():
+            try:
+                s = provider() or {}
+            except Exception:
+                continue
+            model = str(s.get("model") or key)
+            if "ring_size" in s:
+                ring_size.add_metric([model], s["ring_size"])
+            if "replicas" in s:
+                replicas.add_metric([model], s["replicas"])
+            for name, routes in (s.get("requests") or {}).items():
+                for route, v in (routes or {}).items():
+                    requests.add_metric([model, str(name), str(route)], v)
+            for name, v in (s.get("ejections") or {}).items():
+                ejections.add_metric([model, str(name)], v)
+            for name, v in (s.get("readmissions") or {}).items():
+                readmissions.add_metric([model, str(name)], v)
+            brown = s.get("fleet_brownout") or {}
+            if "stage" in brown:
+                fleet_stage.add_metric([model], brown["stage"])
+            for cls, v in (s.get("fleet_sheds") or {}).items():
+                fleet_sheds.add_metric([model, str(cls)], v)
+        yield ring_size
+        yield replicas
+        yield requests
+        yield ejections
+        yield readmissions
+        yield fleet_stage
+        yield fleet_sheds
+
 
 
 # one collector per live registry (weak: test registries die with their
 # tests; a reused id must not resurrect a collector bound to a dead one)
 _prefix_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
 _lifecycle_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
+_router_collectors: "weakref.WeakKeyDictionary" = None  # lazy init
+
+
+def register_replica_router(provider, registry=REGISTRY, key: str = "llm",
+                            prefix: str = "router"):
+    """Expose live replica-router metrics for ``key`` (model/endpoint
+    name). ``provider`` is a zero-arg callable returning a
+    ``ReplicaRouter.stats()``-shaped dict. Idempotent per (registry, key):
+    re-registering replaces the provider. Returns the shared collector."""
+    global _router_collectors
+    import weakref
+
+    if _router_collectors is None:
+        _router_collectors = weakref.WeakKeyDictionary()
+    per_registry = _router_collectors.setdefault(registry, {})
+    collector = per_registry.get(prefix)
+    if collector is None:
+        collector = ReplicaRouterCollector(prefix)
+        registry.register(collector)
+        per_registry[prefix] = collector
+    collector.set_entry(key, provider)
+    return collector
 
 
 def register_engine_lifecycle(provider, registry=REGISTRY, key: str = "llm",
@@ -580,11 +748,16 @@ def register_engine_lifecycle(provider, registry=REGISTRY, key: str = "llm",
 
 def register_prefix_cache(cache, pool=None, registry=REGISTRY,
                           key: str = "llm",
-                          prefix: str = "llm_prefix_cache"):
+                          prefix: str = "llm_prefix_cache",
+                          model: Optional[str] = None,
+                          replica: Optional[str] = None):
     """Expose live prefix-cache metrics for ``key`` (the model/endpoint
     name). Idempotent per (registry, key): re-registering replaces the
     entry, so engine hot-reloads neither leak the old cache nor duplicate
-    metric families. Returns the registry's shared collector."""
+    metric families. Replica-fleet callers register one entry per replica
+    under a unique key with ``model``/``replica`` overrides — samples then
+    carry the {model, replica} label split (docs/replication.md). Returns
+    the registry's shared collector."""
     global _prefix_collectors
     import weakref
 
@@ -596,8 +769,44 @@ def register_prefix_cache(cache, pool=None, registry=REGISTRY,
         collector = PrefixCacheCollector(prefix)
         registry.register(collector)
         per_registry[prefix] = collector
-    collector.set_entry(key, cache, pool)
+    collector.set_entry(key, cache, pool, model=model, replica=replica)
     return collector
+
+
+
+def _registry_collector(store, registry, prefix):
+    if store is None:
+        return None
+    try:
+        return store.get(registry, {}).get(prefix)
+    except TypeError:
+        return None
+
+
+def prune_prefix_caches(key, keep, registry=REGISTRY,
+                        prefix: str = "llm_prefix_cache") -> None:
+    """Drop stale per-replica prefix-cache entries for ``key`` (see
+    collector.prune_entries). No-op when no collector exists yet."""
+    collector = _registry_collector(_prefix_collectors, registry, prefix)
+    if collector is not None:
+        collector.prune_entries(key, keep)
+
+
+def prune_engine_lifecycle(key, keep, registry=REGISTRY,
+                           prefix: str = "engine") -> None:
+    """Drop stale per-replica lifecycle providers for ``key``."""
+    collector = _registry_collector(_lifecycle_collectors, registry, prefix)
+    if collector is not None:
+        collector.prune_entries(key, keep)
+
+
+def prune_replica_router(key, keep, registry=REGISTRY,
+                         prefix: str = "router") -> None:
+    """Drop stale router providers for ``key`` (e.g. a fleet endpoint
+    reloaded as a single engine)."""
+    collector = _registry_collector(_router_collectors, registry, prefix)
+    if collector is not None:
+        collector.prune_entries(key, keep)
 
 
 class StatisticsController:

@@ -17,6 +17,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from benchmarks import replica_loadtest  # noqa: E402
 from benchmarks.slo_loadtest import (  # noqa: E402
     CLASS_KEYS,
     CLASSES,
@@ -29,6 +30,12 @@ from benchmarks.slo_loadtest import (  # noqa: E402
 
 def _artifact():
     return json.loads((REPO / "benchmarks" / "LOADTEST_cpu.json").read_text())
+
+
+def _replica_artifact():
+    return json.loads(
+        (REPO / "benchmarks" / "LOADTEST_replicas_cpu.json").read_text()
+    )
 
 
 def test_artifact_schema():
@@ -76,3 +83,79 @@ def test_artifact_internal_consistency():
         for cls in CLASSES:
             c = load["classes"][cls]
             assert c["completed"] + c["shed"] + c["errors"] <= c["requests"]
+
+
+# -- replica-fleet loadtest artifact (docs/replication.md, ISSUE 12) ----------
+
+
+def test_replica_artifact_schema():
+    row = _replica_artifact()
+    assert replica_loadtest.SCHEMA_KEYS <= set(row), "missing top-level keys"
+    assert row["metric"].startswith("llm_replica_loadtest")
+    assert row["replicas"] >= 2
+    assert len(row["arms"]) == 3
+    for arm in row["arms"]:
+        assert replica_loadtest.ARM_KEYS <= set(arm), arm.keys()
+    assert row["arms"][0]["replicas"] == 1
+    assert row["arms"][1]["replicas"] == row["replicas"]
+    assert [a["routing"] for a in row["arms"]] == [
+        "single", "affine", "random"
+    ]
+    assert replica_loadtest.CHAOS_KEYS <= set(row["chaos"])
+    assert replica_loadtest.HEADLINE_KEYS <= set(row["headline"])
+
+
+def test_replica_artifact_headline_passes():
+    """The committed artifact must carry a PASSING ISSUE-12 headline:
+    affine-hit rate >= 0.9 on the repeated-conversation slice, aggregate
+    goodput >= 1.6x the single-replica arm, zero post-warmup compiles
+    under the strict sentry, zero sanitizer violations, and the
+    kill-one-replica chaos case with zero user-visible 503s."""
+    row = _replica_artifact()
+    head = row["headline"]
+    assert head["affine_ok"] is True
+    assert head["affine_hit_rate"] >= 0.9
+    assert head["speedup_ok"] is True
+    assert head["speedup"] >= 1.6
+    assert head["post_warmup_compiles"] == 0
+    assert head["compile_sentry_mode"] in ("log", "monitoring")
+    assert head["sanitizer_checks"] > 0
+    assert head["sanitizer_violations"] == 0
+    assert head["chaos_unavailable_errors"] == 0
+    assert head["chaos_ok"] is True
+
+
+def test_replica_artifact_internal_consistency():
+    row = _replica_artifact()
+    a1, a2, a3 = row["arms"]
+    head = row["headline"]
+    # headline fields restate the arms they were derived from
+    assert head["goodput_tok_s_single"] == a1["goodput_tok_s"]
+    assert head["goodput_tok_s_fleet"] == a2["goodput_tok_s"]
+    assert head["affine_hit_rate"] == a2["affine_hit_rate"]
+    assert abs(
+        head["speedup"] - a2["goodput_tok_s"] / a1["goodput_tok_s"]
+    ) < 0.01
+    # every arm replayed the same trace
+    assert a1["requests"] == a2["requests"] == a3["requests"]
+    assert head["affine_hit_rate_random"] == a3["affine_hit_rate"]
+    assert head["goodput_tok_s_random"] == a3["goodput_tok_s"]
+    for arm in row["arms"]:
+        assert arm["completed"] + arm["shed"] + arm["errors"] == arm["requests"]
+        assert arm["sanitizer_violations"] == 0
+        assert arm["post_warmup_compiles"] == 0
+    # the route counters cover the fleet arm's routed requests, and the
+    # single arm can only ever route to its one replica
+    assert set(a2["routes"]) == {
+        "r{}".format(i) for i in range(row["replicas"])
+    }
+    assert set(a1["routes"]) == {"r0"}
+    # the chaos case drove a real ejection + re-warm + readmission
+    chaos = row["chaos"]
+    assert chaos["completed"] == chaos["requests"]
+    assert chaos["unavailable_errors"] == 0 and chaos["other_errors"] == 0
+    assert chaos["failovers"] >= 1
+    assert chaos["ejections"] >= 1 and chaos["readmissions"] >= 1
+    assert chaos["ring_recovered"] is True
+    assert chaos["untouched_streams_identical"] is True
+    assert chaos["failover_stream_identical"] is True

@@ -1008,3 +1008,193 @@ def test_weight_quant_int4_endpoint_serves(tmp_path):
     assert engine.weight_quant == "int4"
     stats = engine.lifecycle_stats()["weights"]
     assert stats["quant"] == "int4" and stats["bytes"] > 0
+
+
+# -- replica fleet (docs/replication.md) --------------------------------------
+
+
+def test_replicas_knob_typo_fails_at_endpoint_load(tmp_path):
+    """aux engine.replicas is validated when the endpoint LOADS, like
+    default_priority: a non-integer value fails fast naming the knob and
+    the endpoint never registers."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="badrep"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_rep",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "replicas": "two",  # not an integer
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "bad_rep", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "replicas" in text, (status, text)
+    assert "bad_rep" not in mrp._engine_processor_lookup
+
+
+def test_replica_fleet_endpoint_serves_and_aggregates_ready(tmp_path):
+    """aux engine.replicas=2 builds a replica group behind the endpoint:
+    requests serve through the prefix-affine router, /ready aggregates
+    per-replica state (ready iff >= 1 ring member) with the fleet block,
+    and stopping one replica keeps the endpoint ready while its sibling
+    serves."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="fleet"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="fleet_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32],
+                    "cache": "paged",
+                    "page_size": 16,
+                    "prefix_cache": 64,
+                    "prefix_block": 16,
+                    "replicas": 2,
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        prompt = [(3 + i * 7) % 90 + 1 for i in range(40)]
+        for _ in range(2):
+            r = await client.post(
+                "/serve/openai/v1/completions",
+                json={"model": "fleet_llm", "prompt": prompt,
+                      "max_tokens": 2},
+            )
+            assert r.status == 200, await r.text()
+        group = mrp._engine_processor_lookup["fleet_llm"].engine
+        assert len(group.replicas) == 2
+        # the repeated prompt stuck to one replica (prefix affinity)
+        routes = group.router.stats()["requests"]
+        assert sum(
+            per["affine"] for per in routes.values()
+        ) == 2
+        assert max(per["affine"] for per in routes.values()) == 2
+
+        r = await client.get("/ready")
+        assert r.status == 200
+        body = await r.json()
+        fleet = body["fleet"]["fleet_llm"]
+        assert fleet["replicas"] == 2 and fleet["ring_size"] == 2
+        assert set(fleet["per_replica"]) == {"r0", "r1"}
+
+        # one replica down: endpoint stays ready (ring >= 1), the fleet
+        # block shows the ejected member
+        group.replicas[1].engine.stop()
+        r = await client.get("/ready")
+        assert r.status == 200
+        body = await r.json()
+        fleet = body["fleet"]["fleet_llm"]
+        assert fleet["ring_size"] == 1
+        assert fleet["per_replica"]["r1"]["ring_state"] == "ejected"
+        # /health carries the same fleet block
+        r = await client.get("/health")
+        assert r.status == 200
+        assert (await r.json())["fleet"]["fleet_llm"]["ring_size"] == 1
+
+        # the sibling still serves the conversation (rebalance route)
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "fleet_llm", "prompt": prompt, "max_tokens": 2},
+        )
+        assert r.status == 200, await r.text()
+
+        # all replicas down: the endpoint flips not-ready
+        group.replicas[0].engine.stop()
+        r = await client.get("/ready")
+        assert r.status == 503
+        body = await r.json()
+        assert "fleet_llm" in body["not_ready"]
+        return True
+
+    assert _run(mrp, fn)
+
+
+def test_canary_weights_across_replica_groups(tmp_path):
+    """The control plane composes with fleets: a CanaryEP weights traffic
+    ACROSS endpoints, each of which may itself be a replica group —
+    weighted routing across groups, prefix-affine routing within one
+    (docs/replication.md)."""
+    from clearml_serving_tpu.serving.endpoints import CanaryEP
+
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="canaryfleet"
+    )
+    for url, replicas in (("fleet_a", 2), ("solo_b", 1)):
+        mrp.add_endpoint(
+            ModelEndpoint(
+                engine_type="llm",
+                serving_url=url,
+                auxiliary_cfg={
+                    "engine": {
+                        "preset": "llama-tiny",
+                        "config": {"dtype": "float32"},
+                        "max_batch": 2,
+                        "max_seq_len": 128,
+                        "prefill_buckets": [32],
+                        "replicas": replicas,
+                    }
+                },
+            )
+        )
+    mrp.add_canary_endpoint(
+        CanaryEP(
+            endpoint="cn_ep",
+            load_endpoints=["fleet_a", "solo_b"],
+            weights=[0.5, 0.5],
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        prompt = [(9 + i * 5) % 90 + 1 for i in range(40)]
+        for _ in range(12):
+            r = await client.post(
+                "/serve/openai/v1/completions",
+                json={"model": "cn_ep", "prompt": prompt, "max_tokens": 2},
+            )
+            assert r.status == 200, await r.text()
+        served = set(mrp._engine_processor_lookup)
+        # both canary targets took traffic; the fleet target is a group
+        assert {"fleet_a", "solo_b"} <= served
+        group = mrp._engine_processor_lookup["fleet_a"].engine
+        assert len(group.replicas) == 2
+        routed = sum(
+            sum(per.values())
+            for per in group.router.stats()["requests"].values()
+        )
+        assert routed >= 1  # the canary sent the group a share
+        return True
+
+    assert _run(mrp, fn)

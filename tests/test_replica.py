@@ -1,0 +1,602 @@
+"""Replica fleet: prefix-affine router + engine group (docs/replication.md).
+
+Unit half: routing math (affinity keys, HRW ranking), the ring's
+eject/re-warm/readmit lifecycle and the fleet brownout door on stub
+replicas. Integration half (chaos marker, real engines on CPU): repeated
+conversations stick to one replica's radix cache, a watchdog-tripped
+replica drains its streams to the sibling with zero user-visible 503s and
+byte-identical tokens, and a fault-forced ``router.eject`` re-admits
+through the warmup gate.
+"""
+
+import asyncio
+import time
+
+import jax
+import pytest
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.errors import (
+    EngineOverloadedError,
+    EngineUnavailableError,
+)
+from clearml_serving_tpu.llm import faults
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.replica import EngineReplica, ReplicaGroup
+from clearml_serving_tpu.serving.replica_router import (
+    ReplicaRouter,
+    affinity_key,
+    hrw_order,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(autouse=True)
+def armed_sanitizer(monkeypatch):
+    """Every engine this suite builds runs with the KV sanitizer armed:
+    failover resumes and ejection drains must keep page accounting
+    balanced, not merely produce the right tokens."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+
+
+# -- routing math --------------------------------------------------------------
+
+
+def test_affinity_key_stable_as_conversation_grows():
+    # once the history is past the anchor depth (max_blocks * block), the
+    # block-aligned head — and so the key — never changes: one
+    # conversation, one replica, for life
+    base = [(7 + i * 13) % 200 + 1 for i in range(80)]
+    keys = {
+        affinity_key(base, block=16),
+        affinity_key(base + [5] * 16, block=16),
+        affinity_key(base + [9] * 40, block=16),
+    }
+    assert len(keys) == 1
+
+
+def test_affinity_key_caps_at_max_blocks():
+    long = list(range(1, 400))
+    assert affinity_key(long, block=16, max_blocks=4) == affinity_key(
+        long[:64] + [0] * 300, block=16, max_blocks=4
+    )
+
+
+def test_affinity_key_differs_across_conversations():
+    a = [(1 + i * 13) % 200 + 1 for i in range(64)]
+    b = [(2 + i * 13) % 200 + 1 for i in range(64)]
+    assert affinity_key(a, block=16) != affinity_key(b, block=16)
+
+
+def test_affinity_key_short_prompts_hash_whole():
+    # prompts under one block have no storable prefix: hash everything so
+    # one-shot work spreads over the ring instead of pinning to one member
+    assert affinity_key([1, 2, 3], block=16) != affinity_key(
+        [1, 2, 4], block=16
+    )
+
+
+def test_hrw_order_is_deterministic_and_minimally_disruptive():
+    names = ["r0", "r1", "r2", "r3"]
+    key = affinity_key(list(range(40)), block=16)
+    order = hrw_order(key, names)
+    assert order == hrw_order(key, names)
+    # rendezvous property: dropping one member preserves the relative
+    # order of the survivors (only the removed member's keys move)
+    survivors = [i for i in order if names[i] != "r1"]
+    reduced = hrw_order(key, ["r0", "r2", "r3"])
+    mapped = [["r0", "r2", "r3"][i] for i in reduced]
+    assert [names[i] for i in survivors] == mapped
+
+
+# -- router over stub replicas -------------------------------------------------
+
+
+class StubReplica:
+    def __init__(self, index, ready=True, warmed=True, depth=0, stage=0,
+                 warm_delay_sweeps=0):
+        self.index = index
+        self.name = "r{}".format(index)
+        self.engine_ready = ready
+        self.warmed = warmed
+        self.queue_depth = depth
+        self.brownout_stage = stage
+        self.warm_calls = 0
+        self._warm_delay = warm_delay_sweeps
+        self.warming = False
+
+    def invalidate_warm(self):
+        self.warmed = False
+
+    def begin_warm(self):
+        self.warm_calls += 1
+        if self._warm_delay > 0:
+            self._warm_delay -= 1
+            self.warming = True
+        else:
+            self.warming = False
+            self.warmed = True
+
+
+def _req(ids, priority="interactive"):
+    return GenRequest(prompt_ids=list(ids), priority=priority)
+
+
+def _conv(seed, n=48):
+    return [(seed * 29 + i * 7) % 200 + 1 for i in range(n)]
+
+
+def test_pick_is_affine_and_sticky():
+    router = ReplicaRouter([StubReplica(0), StubReplica(1)], block=16)
+    ids = _conv(3)
+    first, route = router.pick(_req(ids))
+    assert route == "affine"
+    for _ in range(5):
+        replica, route = router.pick(_req(ids + [9] * 7))
+        assert replica is first and route == "affine"
+
+
+def test_pick_rebalances_when_affine_member_is_out():
+    a, b = StubReplica(0), StubReplica(1)
+    router = ReplicaRouter([a, b], block=16)
+    ids = _conv(3)
+    affine = router.order_for(ids)[0]
+    other = b if affine is a else a
+    affine.engine_ready = False
+    replica, route = router.pick(_req(ids))
+    assert replica is other and route == "rebalance"
+    assert router.stats()["ejections"][affine.name] == 1
+    # recovery: back into the ring, affinity restored
+    affine.engine_ready = True
+    router.sweep()
+    replica, route = router.pick(_req(ids))
+    assert replica is affine and route == "affine"
+    assert router.stats()["readmissions"][affine.name] == 1
+
+
+def test_pick_spills_on_pressure_gap_but_not_on_tie():
+    a, b = StubReplica(0), StubReplica(1)
+    router = ReplicaRouter([a, b], block=16, spill_brownout_stage=2)
+    ids = _conv(5)
+    affine = router.order_for(ids)[0]
+    other = b if affine is a else a
+    affine.brownout_stage = 2
+    replica, route = router.pick(_req(ids))
+    assert replica is other and route == "spill"
+    # a tie is NOT a spill: prefix warmth wins unless the alternative is
+    # strictly less pressured
+    other.brownout_stage = 2
+    replica, route = router.pick(_req(ids))
+    assert replica is affine and route == "affine"
+
+
+def test_pick_spills_on_queue_depth_bound():
+    a, b = StubReplica(0), StubReplica(1)
+    router = ReplicaRouter([a, b], block=16, spill_queue_depth=4)
+    ids = _conv(5)
+    affine = router.order_for(ids)[0]
+    affine.queue_depth = 4
+    replica, route = router.pick(_req(ids))
+    assert replica is not affine and route == "spill"
+
+
+def test_fleet_brownout_sheds_best_effort_at_the_door():
+    a, b = StubReplica(0, stage=3), StubReplica(1, stage=3)
+    router = ReplicaRouter([a, b], block=16, fleet_shed_stage=3)
+    with pytest.raises(EngineOverloadedError) as ei:
+        router.pick(_req(_conv(1), priority="best_effort"))
+    assert ei.value.shed_class == "best_effort"
+    assert router.stats()["fleet_sheds"]["best_effort"] == 1
+    # interactive work still routes under fleet brownout
+    replica, _ = router.pick(_req(_conv(1)))
+    assert replica in (a, b)
+    # one member recovering (stage < shed stage) reopens the door:
+    # fleet stage = MIN over members — redirect, don't shed
+    b.brownout_stage = 0
+    replica, _ = router.pick(_req(_conv(1), priority="best_effort"))
+    assert replica in (a, b)
+
+
+def test_empty_ring_raises_unavailable():
+    a = StubReplica(0, ready=False)
+    router = ReplicaRouter([a], block=16)
+    with pytest.raises(EngineUnavailableError):
+        router.pick(_req(_conv(2)))
+
+
+def test_injected_pick_fault_falls_to_next_member():
+    a, b = StubReplica(0), StubReplica(1)
+    router = ReplicaRouter([a, b], block=16)
+    ids = _conv(7)
+    affine = router.order_for(ids)[0]
+    faults.configure([{"point": "router.pick", "times": 1}])
+    replica, route = router.pick(_req(ids))
+    assert replica is not affine and route == "rebalance"
+    # spec exhausted: the next pick is affine again
+    replica, route = router.pick(_req(ids))
+    assert replica is affine and route == "affine"
+
+
+def test_forced_eject_gates_readmission_through_warmup():
+    a = StubReplica(0)
+    b = StubReplica(1, warm_delay_sweeps=2)
+    router = ReplicaRouter([a, b], block=16)
+    assert router.ring_size == 2
+    faults.configure([
+        {"point": "router.eject", "match_token": 1, "times": -1},
+    ])
+    router.sweep()
+    assert router.ring() == ["r0"]
+    assert router.stats()["ejections"]["r1"] == 1
+    faults.clear()
+    # re-admission runs through the warmup gate: b needs 2 sweeps of
+    # "warming" before the gate opens, and it stays OUT of the ring until
+    # the sweep AFTER it warms — a cold replica never takes serve traffic
+    router.sweep()
+    assert router.ring() == ["r0"] and b.warm_calls == 1
+    router.sweep()
+    assert router.ring() == ["r0"]
+    router.sweep()  # gate opens during this sweep...
+    assert router.ring() == ["r0"]
+    router.sweep()  # ...and membership follows on the next
+    assert "r1" in router.ring()
+    assert router.stats()["readmissions"]["r1"] == 1
+
+
+# -- real-engine integration ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+def _make_group(bundle, params, n=2, **overrides):
+    cfg = dict(
+        max_batch=2, max_seq_len=128, prefill_buckets=[16, 32, 64],
+        eos_token_id=None, decode_steps=1, cache_mode="paged",
+        page_size=16, prefix_cache=64, prefix_block=16, max_pending=8,
+    )
+    cfg.update(overrides)
+    engines = [
+        LLMEngineCore(bundle, params, replica="r{}".format(i), **cfg)
+        for i in range(n)
+    ]
+    return ReplicaGroup(engines)
+
+
+async def _collect(group, ids, n=4, **kw):
+    request = GenRequest(prompt_ids=list(ids), max_new_tokens=n, **kw)
+    out = []
+    async for token in group.generate(request):
+        out.append(int(token))
+    return out, request
+
+
+def test_conversation_sticks_to_one_replica_and_hits_its_cache(parts):
+    bundle, params = parts
+    group = _make_group(bundle, params)
+    try:
+        async def run():
+            conv = _conv(11, 40)
+            homes = set()
+            for turn in range(3):
+                ids = conv + [3 + turn] * (turn + 1)
+                _, req = await _collect(group, ids)
+                homes.add(req._replica_name)
+            await group.wait_drained()
+            return homes
+
+        homes = asyncio.run(run())
+        assert len(homes) == 1, homes
+        home = next(
+            r for r in group.replicas if r.name == next(iter(homes))
+        )
+        other = next(r for r in group.replicas if r is not home)
+        # turns 2..3 replayed the stored prefix from the HOME replica's
+        # radix tree; the sibling never saw the conversation
+        assert home.engine._prefix.hits >= 2
+        assert (
+            other.engine._prefix is None
+            or other.engine._prefix.hits == 0
+        )
+        routes = group.router.stats()["requests"]
+        assert routes[home.name]["affine"] == 3
+    finally:
+        group.stop()
+
+
+def test_watchdog_trip_drains_streams_to_sibling_byte_identically(parts):
+    """The chaos contract end to end: a stalled replica trips its
+    watchdog mid-stream; its streams RESUME on the sibling (no
+    user-visible 503), byte-identical for greedy decoding; untouched
+    conversations never notice; the tripped replica re-enters the ring
+    after recovery."""
+    bundle, params = parts
+    group = _make_group(bundle, params, watchdog_interval=0.3)
+    try:
+        async def run():
+            prompts = {}
+            seed = 0
+            while len(prompts) < 2:
+                p = _conv(seed, 40)
+                prompts.setdefault(
+                    group.router.order_for(p)[0].name, p
+                )
+                seed += 1
+            victim_prompt = prompts["r1"][:-1] + [251]
+            base_victim, _ = await _collect(group, victim_prompt, 12)
+            base_other, _ = await _collect(group, prompts["r0"], 12)
+            await group.wait_drained()
+            faults.configure([
+                {"point": "engine.decode.stall", "action": "delay",
+                 "delay": 1.2, "times": 1, "match_token": 251},
+            ])
+            v_task = asyncio.create_task(
+                _collect(group, victim_prompt, 12)
+            )
+            u_task = asyncio.create_task(
+                _collect(group, prompts["r0"], 12)
+            )
+            (v_out, v_req), (u_out, _) = await asyncio.gather(
+                v_task, u_task
+            )
+            faults.clear()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30:
+                group.router.sweep()
+                if group.router.ring_size == 2:
+                    break
+                await asyncio.sleep(0.02)
+            await group.wait_drained()
+            return base_victim, base_other, v_out, u_out, v_req
+
+        base_victim, base_other, v_out, u_out, v_req = asyncio.run(run())
+        # the victim's stream failed over and CONTINUED byte-identically
+        assert v_out == base_victim
+        assert v_req._replica_name == "r0"
+        assert group.failovers >= 1
+        # the untouched conversation never noticed
+        assert u_out == base_other
+        # the tripped replica recovered, re-warmed, and rejoined
+        assert group.router.ring_size == 2
+        stats = group.router.stats()
+        assert stats["ejections"]["r1"] >= 1
+        assert stats["readmissions"]["r1"] >= 1
+        assert group.replicas[1].engine.counters["watchdog_trips"] >= 1
+    finally:
+        group.stop()
+
+
+def test_forced_eject_reroutes_and_rewarms_through_gate(parts, monkeypatch):
+    """Injected ``router.eject`` (the chaos seam): the ejected replica's
+    conversations rebalance to the sibling with zero errors; clearing the
+    fault re-admits it through the warmup gate (run_warmup called)."""
+    bundle, params = parts
+    warm_calls = []
+
+    async def fake_warmup(engine, full=True, extra_prompts=None,
+                          fence=True):
+        warm_calls.append((engine, full, fence))
+        return {"requests": 0, "cow_buckets": 0, "fenced": False}
+
+    import clearml_serving_tpu.llm.warmup as warmup_mod
+
+    monkeypatch.setattr(warmup_mod, "run_warmup", fake_warmup)
+    engines = [
+        LLMEngineCore(
+            bundle, params, replica="r{}".format(i), max_batch=2,
+            max_seq_len=128,
+            prefill_buckets=[16, 32, 64], eos_token_id=None,
+            cache_mode="paged", page_size=16, prefix_cache=64,
+            prefix_block=16, max_pending=8,
+        )
+        for i in range(2)
+    ]
+    group = ReplicaGroup(engines, warmup_mode="startup")
+    # gates start closed under warmup_mode=startup: open them directly
+    for replica in group.replicas:
+        replica.warmed = True
+    group.router.sweep()
+    try:
+        async def run():
+            # a conversation homed on r1
+            seed = 0
+            while True:
+                p = _conv(seed, 40)
+                if group.router.order_for(p)[0].name == "r1":
+                    break
+                seed += 1
+            base, _ = await _collect(group, p, 6)
+            await group.wait_drained()
+            faults.configure([
+                {"point": "router.eject", "match_token": 1, "times": -1},
+            ])
+            out, req = await _collect(group, p, 6)
+            assert req._replica_name == "r0"
+            assert out == base  # greedy: identical tokens on the sibling
+            assert group.router.ring() == ["r0"]
+            faults.clear()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:
+                group.router.sweep()
+                if group.router.ring_size == 2:
+                    break
+                await asyncio.sleep(0.01)
+            await group.wait_drained()
+            return out
+
+        asyncio.run(run())
+        assert group.router.ring_size == 2
+        # re-admission went THROUGH the warmup gate
+        assert any(e is engines[1] for e, _, _ in warm_calls)
+        routes = group.router.stats()["requests"]
+        assert routes["r0"]["rebalance"] >= 1
+    finally:
+        group.stop()
+
+
+def test_group_health_aggregates_ready_iff_ring_nonempty(parts):
+    bundle, params = parts
+    group = _make_group(bundle, params)
+    health = group.health()
+    assert health["ready"] and health["ring_size"] == 2
+    assert set(health["replicas"]) == {"r0", "r1"}
+    assert health["replicas"]["r0"]["replica"] == "r0"
+    assert health["router"]["replicas"] == 2
+    # one replica down: still ready (>= 1 ring member)
+    group.replicas[1].engine.stop()
+    health = group.health()
+    assert health["ready"] and health["ring_size"] == 1
+    assert health["replicas"]["r1"]["ring_state"] == "ejected"
+    # all down: not ready
+    group.replicas[0].engine.stop()
+    health = group.health()
+    assert not health["ready"] and health["ring_size"] == 0
+    # lifecycle_stats mirrors the fleet view with per-replica blocks
+    stats = group.lifecycle_stats()
+    assert stats["ready"] == 0
+    assert set(stats["replicas"]) == {"r0", "r1"}
+    assert stats["replicas"]["r0"]["replica"] == "r0"
+
+
+def test_check_admission_pins_route_for_generate(parts):
+    bundle, params = parts
+    group = _make_group(bundle, params)
+    try:
+        async def run():
+            ids = _conv(21, 40)
+            request = GenRequest(prompt_ids=ids, max_new_tokens=2)
+            group.validate(request)
+            group.check_admission(request)
+            pinned = request._replica_name
+            out = []
+            async for token in group.generate(request):
+                out.append(token)
+            await group.wait_drained()
+            return pinned, request._replica_name, out
+
+        pinned, final, out = asyncio.run(run())
+        assert pinned == final and len(out) == 2
+    finally:
+        group.stop()
+
+
+def test_resume_clone_carries_remaining_deadline_budget():
+    """Failover must not reset per-request budgets: the clone's timeouts
+    derive from the ORIGINAL request's resolved monotonic deadlines, so a
+    request near its total budget cannot run ~2x it across a trip."""
+    import time as _time
+
+    request = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=8,
+                         total_timeout=10.0, ttft_timeout=5.0,
+                         queue_timeout=2.0)
+    now = _time.monotonic()
+    request._deadline = now + 1.0       # 9s of a 10s budget already spent
+    request._ttft_deadline = now + 0.5
+    request._queue_deadline = now + 0.2
+    clone = ReplicaGroup._resume_clone(request, [7, 8])
+    assert clone.total_timeout is not None and clone.total_timeout <= 1.1
+    # tokens already emitted: TTFT/queue phases passed — only the total
+    # budget bounds the resume
+    assert clone.ttft_timeout is None and clone.queue_timeout is None
+    # pre-first-token failover keeps the remaining TTFT/queue budgets
+    clone0 = ReplicaGroup._resume_clone(request, [])
+    assert clone0.ttft_timeout is not None and clone0.ttft_timeout <= 0.6
+    assert clone0.queue_timeout is not None and clone0.queue_timeout <= 0.3
+    # an elapsed budget floors at a fail-fast-at-admission value
+    request._deadline = now - 5.0
+    assert ReplicaGroup._resume_clone(request, [7]).total_timeout == 0.05
+
+
+def test_failover_does_not_overshoot_max_new_tokens(parts):
+    """A replica that fails AFTER delivering every requested token (trip
+    between the last token and the finish marker) finishes the stream
+    normally — a resume would overshoot max_new_tokens."""
+    from clearml_serving_tpu.errors import EngineStuckError
+
+    bundle, params = parts
+    group = _make_group(bundle, params)
+    try:
+        async def run():
+            ids = _conv(31, 40)
+            home = group.router.order_for(ids)[0]
+            orig = home.engine.generate
+
+            async def flaky(req):
+                async for token in orig(req):
+                    yield token
+                raise EngineStuckError("tripped after the last token")
+
+            home.engine.generate = flaky
+            try:
+                out = []
+                request = GenRequest(prompt_ids=ids, max_new_tokens=4)
+                async for token in group.generate(request):
+                    out.append(token)
+            finally:
+                home.engine.generate = orig
+            await group.wait_drained()
+            return out
+
+        out = asyncio.run(run())
+        assert len(out) == 4
+        assert group.failovers == 0
+    finally:
+        group.stop()
+
+
+def test_penalty_requests_do_not_fail_over(parts):
+    """Failover eligibility matches the preemption lane: a history-as-
+    prompt resume resets the device penalty histogram, so penalty-bearing
+    requests propagate their replica's error instead of resuming wrong."""
+    from clearml_serving_tpu.errors import EngineStuckError
+
+    bundle, params = parts
+    group = _make_group(bundle, params)
+    try:
+        async def run():
+            ids = _conv(33, 40)
+            home = group.router.order_for(ids)[0]
+            orig = home.engine.generate
+
+            async def dead(req):
+                raise EngineStuckError("tripped")
+                yield  # pragma: no cover - makes this an async generator
+
+            home.engine.generate = dead
+            try:
+                request = GenRequest(
+                    prompt_ids=ids, max_new_tokens=4, frequency_penalty=0.5
+                )
+                with pytest.raises(EngineStuckError):
+                    async for _ in group.generate(request):
+                        pass
+                # the SAME failure with plain sampling fails over fine —
+                # and a pre-admission failover still reports prompt_len
+                plain = GenRequest(prompt_ids=ids, max_new_tokens=4)
+                out = []
+                async for token in group.generate(plain):
+                    out.append(token)
+                assert len(out) == 4
+                assert plain.prompt_len == len(ids)
+            finally:
+                home.engine.generate = orig
+            await group.wait_drained()
+
+        asyncio.run(run())
+        assert group.failovers >= 1
+    finally:
+        group.stop()

@@ -779,3 +779,240 @@ def test_engine_compile_metrics_exported(monkeypatch):
     finally:
         engine.stop()
         sentry.reset(strict=False)
+
+
+def test_replica_label_on_lifecycle_families():
+    """Replica fleets (docs/replication.md): a provider that reports a
+    ``replica`` id gets the replica label on ITS samples (two replicas of
+    one model would otherwise emit duplicate series and Prometheus
+    rejects the scrape), a ``model`` key overrides the entry key so entry
+    keys stay unique per replica — and the label shape is PER PROVIDER: a
+    fleet registering on a shared registry never changes a legacy
+    single-engine endpoint's series identity."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    s0 = {
+        "model": "m", "replica": "r0",
+        "queue_depth": 2, "active_slots": 1, "ready": 1,
+    }
+    s1 = {
+        "model": "m", "replica": "r1",
+        "queue_depth": 5, "active_slots": 0, "ready": 0,
+        "sheds": {"queue": 3},
+        "watchdog_trips": 1,
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: s0, registry=registry, key="m@r0")
+    register_engine_lifecycle(lambda: s1, registry=registry, key="m@r1")
+    # a LEGACY endpoint co-hosted on the same registry
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "ready": 1}, registry=registry,
+        key="legacy",
+    )
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, labels)
+
+    assert val("engine_queue_depth", model="m", replica="r0",
+               **{"class": "all"}) == 2
+    assert val("engine_queue_depth", model="m", replica="r1",
+               **{"class": "all"}) == 5
+    assert val("engine_ready", model="m", replica="r0") == 1
+    assert val("engine_ready", model="m", replica="r1") == 0
+    assert val("engine_sheds_total", model="m", replica="r1",
+               reason="queue", **{"class": "all"}) == 3
+    assert val("engine_watchdog_trips_total", model="m", replica="r1") == 1
+    # the legacy endpoint's series identity is UNTOUCHED by the fleet:
+    # dashboards matching {model="legacy"} with no replica label keep
+    # working, and nothing flaps when the fleet endpoint is evicted
+    assert val("engine_queue_depth", model="legacy",
+               **{"class": "all"}) == 1
+    assert val("engine_ready", model="legacy") == 1
+    # gauges read live on the next scrape
+    s0["queue_depth"] = 7
+    assert val("engine_queue_depth", model="m", replica="r0",
+               **{"class": "all"}) == 7
+
+
+def test_replica_router_collector_exports_ring_and_routes():
+    """router_requests_total{replica,route} + router_ring_size and the
+    eject/readmit/fleet-brownout families from a synthetic
+    ReplicaRouter.stats() provider (docs/replication.md)."""
+    from clearml_serving_tpu.statistics.metrics import register_replica_router
+
+    stats = {
+        "replicas": 2,
+        "ring_size": 1,
+        "requests": {
+            "r0": {"affine": 5, "spill": 1, "rebalance": 2},
+            "r1": {"affine": 3, "spill": 0, "rebalance": 0},
+        },
+        "ejections": {"r0": 0, "r1": 1},
+        "readmissions": {"r0": 0, "r1": 1},
+        "fleet_sheds": {"best_effort": 4},
+        "fleet_brownout": {"stage": 2, "stages": {"r0": 2, "r1": 3}},
+    }
+    registry = CollectorRegistry()
+    register_replica_router(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("router_ring_size") == 1
+    assert val("router_replicas") == 2
+    assert val("router_requests_total", replica="r0", route="affine") == 5
+    assert val("router_requests_total", replica="r0", route="spill") == 1
+    assert val("router_requests_total", replica="r1", route="rebalance") == 0
+    assert val("router_ejections_total", replica="r1") == 1
+    assert val("router_readmissions_total", replica="r1") == 1
+    assert val("router_fleet_brownout_stage") == 2
+    assert val("router_fleet_sheds_total", **{"class": "best_effort"}) == 4
+    # the ring gauge reads live on the next scrape
+    stats["ring_size"] = 2
+    assert val("router_ring_size") == 2
+
+
+def test_replica_fleet_real_engine_end_to_end():
+    """End to end against a REAL 2-replica group: per-replica lifecycle
+    providers (replica label from the engine's own lifecycle_stats) and
+    the router provider feed one registry, exactly as openai_api wires
+    them."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+    from clearml_serving_tpu.llm.replica import ReplicaGroup
+    from clearml_serving_tpu.statistics.metrics import (
+        register_engine_lifecycle,
+        register_replica_router,
+    )
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engines = [
+        LLMEngineCore(
+            bundle, params, replica="r{}".format(i), max_batch=2,
+            max_seq_len=64,
+            prefill_buckets=[32], eos_token_id=None, cache_mode="paged",
+            page_size=16, prefix_cache=32, prefix_block=16,
+        )
+        for i in range(2)
+    ]
+    group = ReplicaGroup(engines)
+    try:
+        registry = CollectorRegistry()
+        for replica in group.replicas:
+
+            def provider(engine=replica.engine):
+                s = engine.lifecycle_stats()
+                s["model"] = "fleet"
+                return s
+
+            register_engine_lifecycle(
+                provider, registry=registry, key="fleet@" + replica.name
+            )
+        register_replica_router(
+            lambda: dict(group.router.stats(), model="fleet"),
+            registry=registry, key="fleet",
+        )
+
+        async def run():
+            conv = [(5 + i * 3) % 90 + 1 for i in range(40)]
+            for turn in range(2):
+                request = GenRequest(
+                    prompt_ids=conv + [7] * (turn + 1), max_new_tokens=2
+                )
+                async for _ in group.generate(request):
+                    pass
+            await group.wait_drained()
+            return request._replica_name
+
+        home = asyncio.run(run())
+
+        def val(name, **labels):
+            return registry.get_sample_value(name, {"model": "fleet", **labels})
+
+        assert val("engine_ready", replica="r0") == 1
+        assert val("engine_ready", replica="r1") == 1
+        assert val("router_ring_size") == 2
+        home_id = home  # "r0"/"r1"
+        assert val("router_requests_total", replica=home_id,
+                   route="affine") == 2
+    finally:
+        group.stop()
+
+
+def test_prune_entries_drops_stale_replica_keys():
+    """Endpoint hot-reloads that change the replica count must not leave
+    stale per-replica collector entries (docs/replication.md): a fleet
+    scaled down (or reloaded as a single engine) prunes its model@rN
+    entries — nothing pins dead engines' caches or exports frozen
+    series — while OTHER endpoints' entries are untouched."""
+    from clearml_serving_tpu.statistics.metrics import (
+        prune_engine_lifecycle,
+        register_engine_lifecycle,
+    )
+
+    registry = CollectorRegistry()
+    for key in ("m@r0", "m@r1", "m@r2", "m", "m2@r0", "m2"):
+        register_engine_lifecycle(
+            lambda key=key: {"queue_depth": 1}, registry=registry, key=key
+        )
+    # reload to 2 replicas: bare "m" and "m@r2" go, r0/r1 stay, m2* stays
+    prune_engine_lifecycle("m", {"m@r0", "m@r1"}, registry=registry)
+
+    def has(key):
+        label = {"model": key, "class": "all"}
+        return registry.get_sample_value("engine_queue_depth", label) is not None
+
+    assert has("m@r0") and has("m@r1")
+    assert not has("m@r2") and not has("m")
+    assert has("m2@r0") and has("m2")
+    # reload to a single engine: every m@rN goes
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 3}, registry=registry, key="m"
+    )
+    prune_engine_lifecycle("m", {"m"}, registry=registry)
+    assert has("m") and not has("m@r0") and not has("m@r1")
+
+
+def test_prefix_cache_collector_replica_label_split():
+    """Fleet prefix-cache entries carry the {model, replica} label split
+    (docs/replication.md) — never a mangled model label — while legacy
+    entries on the same collector keep the historical {model} shape."""
+    from clearml_serving_tpu.llm.kv_cache import PagePool
+    from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+    from clearml_serving_tpu.statistics.metrics import register_prefix_cache
+
+    registry = CollectorRegistry()
+    pool = PagePool(num_pages=16, page_size=2, max_slots=2)
+    cache_r0 = RadixPrefixCache(block=4, pool=pool, page_bytes=32)
+    cache_r1 = RadixPrefixCache(block=4)
+    legacy = RadixPrefixCache(block=4)
+    register_prefix_cache(cache_r0, pool, registry=registry,
+                          key="fleet@r0", model="fleet", replica="r0")
+    register_prefix_cache(cache_r1, registry=registry,
+                          key="fleet@r1", model="fleet", replica="r1")
+    register_prefix_cache(legacy, registry=registry, key="plain")
+
+    cache_r0.lookup_pages([1, 2, 3, 4, 5, 6], 0)  # miss
+    legacy.lookup([9, 9, 9, 9, 9], 0)             # miss
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, labels)
+
+    # fleet rows: real model label + replica label (joinable with the
+    # lifecycle/router families on (model, replica))
+    assert val("llm_prefix_cache_misses_total",
+               model="fleet", replica="r0") == 1
+    assert val("llm_prefix_cache_misses_total",
+               model="fleet", replica="r1") == 0
+    assert val("kv_pool_free_pages", model="fleet", replica="r0") is not None
+    # no mangled model label anywhere
+    assert val("llm_prefix_cache_misses_total", model="fleet@r0") is None
+    # the legacy entry's series identity is untouched
+    assert val("llm_prefix_cache_misses_total", model="plain") == 1
