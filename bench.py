@@ -640,6 +640,232 @@ def run_ragged_ab(
     }
 
 
+def run_ragged_decode_steps_ab(
+    cfg: dict,
+    *,
+    q: int = 4,
+    new_tokens: int = 96,
+    decode_prompt_len: int = 12,
+    admit_prompt_len: int = 24,
+    step_token_budget: int = 48,
+    max_seq_len: int = 256,
+    cache_mode: str = "paged",
+    page_size: int = 16,
+) -> dict:
+    """Multi-step ragged decode-row A/B (docs/ragged_attention.md, ISSUE
+    13): one long greedy decode stream rides the ragged scheduler's mixed
+    launches while a continuous trickle of short admissions keeps the loop
+    in ragged phases — the steady decode-while-admitting state where q=1
+    rows pay ONE dispatch per token. The arms differ only in
+    ``ragged_decode_steps`` (1 vs ``q``); the headline is
+    dispatches-per-decode-token (ragged launches / decode tokens advanced
+    by ragged launches) with the stream's tok/s beside it, and the streams
+    must be byte-identical across arms (greedy)."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    stream_prompt = [(7 * j + 3) % 250 + 1 for j in range(decode_prompt_len)]
+    admit_prompt = [(11 * j + 5) % 250 + 1 for j in range(admit_prompt_len)]
+    buckets = sorted({
+        max(16, decode_prompt_len),
+        max(16, 1 << (admit_prompt_len - 1).bit_length()),
+    })
+
+    def measure(steps: int):
+        engine = LLMEngineCore(
+            bundle, params,
+            max_batch=3, max_seq_len=max_seq_len, prefill_buckets=buckets,
+            eos_token_id=None, decode_steps=max(4, q),
+            ragged_decode_steps=steps, scheduler="ragged",
+            step_token_budget=step_token_budget,
+            cache_mode=cache_mode, page_size=page_size,
+        )
+
+        async def group():
+            out: list = []
+            done = asyncio.Event()
+
+            async def stream():
+                req = GenRequest(
+                    prompt_ids=list(stream_prompt),
+                    max_new_tokens=new_tokens, temperature=0.0,
+                )
+                async for tok in engine.generate(req):
+                    out.append(tok)
+                done.set()
+
+            async def feeder():
+                while not done.is_set():
+                    req = GenRequest(
+                        prompt_ids=list(admit_prompt),
+                        max_new_tokens=1, temperature=0.0,
+                    )
+                    async for _ in engine.generate(req):
+                        pass
+
+            await asyncio.gather(stream(), feeder())
+            await engine.wait_drained()
+            return out
+
+        asyncio.run(group())            # warmup pass: compiles every trace
+        base = dict(engine.counters)
+        t0 = time.perf_counter()
+        out = asyncio.run(group())
+        wall = time.perf_counter() - t0
+        launches = engine.counters["ragged_steps"] - base["ragged_steps"]
+        dec_tokens = (
+            engine.counters["ragged_decode_tokens"]
+            - base["ragged_decode_tokens"]
+        )
+        snap = engine.lifecycle_stats()["ragged"]["tokens_per_launch"]
+        engine.stop()
+        return {
+            "out": out,
+            "tok_s": round(len(out) / wall, 2),
+            "ragged_launches": launches,
+            "ragged_decode_tokens": dec_tokens,
+            "dispatches_per_decode_token": round(
+                launches / max(1, dec_tokens), 3
+            ),
+            "tokens_per_launch_mean": round(
+                snap["sum_ms"] / max(1, snap["count"]), 2
+            ),
+        }
+
+    one = measure(1)
+    multi = measure(q)
+    identical = one.pop("out") == multi.pop("out")
+    return {
+        "metric": "llm_ragged_decode_steps_ab",
+        # headline: dispatch-bubble amortization — how many launches each
+        # decode token costs at q vs 1
+        "value": multi["dispatches_per_decode_token"],
+        "unit": "ragged launches per decode token at q={}".format(q),
+        "q1": one,
+        "q{}".format(q): multi,
+        "decode_steps": q,
+        "identical_tokens": identical,
+        "new_tokens": new_tokens,
+        "step_token_budget": step_token_budget,
+        "cache": cache_mode,
+        "cpus": os.cpu_count() or 1,
+    }
+
+
+def run_spec_row_ab(
+    cfg: dict,
+    *,
+    spec_k: int = 3,
+    spec_ngram: int = 2,
+    batch: int = 3,
+    new_tokens: int = 64,
+    step_token_budget: int = 16,
+    max_seq_len: int = 256,
+    cache_mode: str = "paged",
+    page_size: int = 16,
+) -> dict:
+    """Spec-as-row vs legacy serial spec (docs/ragged_attention.md, ISSUE
+    13): the same repetitive-prompt greedy workload (n-gram-friendly, so
+    drafts accept) on the two-dispatch scheduler's serial draft-verify
+    scan vs the ragged scheduler's in-launch q=k+1 verify rows. Streams
+    must be byte-identical; reports tok/s per arm and the ragged arm's
+    measured per-launch acceptance.
+
+    Read the CPU tok/s comparison with care: off-TPU the ragged pass is
+    the XLA reference, which computes the FULL budget-padded token axis
+    every launch (the Pallas kernel skips q-blocks no row owns), and the
+    legacy scan amortizes its ONE dispatch over decode_steps draft-verify
+    rounds while spec-as-row verifies one window per launch — on a 1-core
+    CPU, where a dispatch costs ~nothing and compute is everything, the
+    serial scan wins tok/s by construction. What spec-as-row buys is what
+    the scan structurally cannot do: verify rows ride MIXED launches
+    beside decode windows and admission chunks (no pipeline drain, no
+    whole-batch stall while one request speculates), which is the
+    tunnel-dispatch-bound TPU regime's win; the CPU arm certifies stream
+    identity and acceptance parity, not throughput."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    prompts = [
+        ([(5 * i + 3) % 29 + 1] * 3 + [(3 * i + 7) % 29 + 1] * 2) * 4
+        for i in range(batch)
+    ]
+
+    def measure(mode: str):
+        extra = (
+            dict(chunked_prefill_size=8)
+            if mode == "two_dispatch"
+            else dict(scheduler="ragged", step_token_budget=step_token_budget)
+        )
+        engine = LLMEngineCore(
+            bundle, params,
+            max_batch=batch, max_seq_len=max_seq_len,
+            prefill_buckets=[32], eos_token_id=None, decode_steps=4,
+            speculation="ngram", spec_k=spec_k, spec_ngram=spec_ngram,
+            cache_mode=cache_mode, page_size=page_size, **extra,
+        )
+
+        async def group():
+            async def one(ids):
+                req = GenRequest(
+                    prompt_ids=list(ids), max_new_tokens=new_tokens,
+                    temperature=0.0,
+                )
+                return [t async for t in engine.generate(req)]
+
+            outs = await asyncio.gather(*(one(p) for p in prompts))
+            await engine.wait_drained()
+            return outs
+
+        asyncio.run(group())            # warmup pass
+        t0 = time.perf_counter()
+        outs = asyncio.run(group())
+        wall = time.perf_counter() - t0
+        row = {
+            "outs": outs,
+            "tok_s": round(sum(len(o) for o in outs) / wall, 2),
+        }
+        if mode == "ragged":
+            s = engine.lifecycle_stats()["ragged"]
+            row["spec_verify_rows"] = s["step_rows"]["spec_verify"]
+            snap = s["spec_acceptance"]
+            row["acceptance_mean"] = round(
+                snap["sum_ms"] / max(1, snap["count"]), 3
+            )
+        engine.stop()
+        return row
+
+    legacy = measure("two_dispatch")
+    ragged = measure("ragged")
+    identical = legacy.pop("outs") == ragged.pop("outs")
+    return {
+        "metric": "llm_spec_row_ab",
+        "value": round(
+            (ragged["tok_s"] / max(1e-9, legacy["tok_s"]) - 1.0) * 100.0, 2
+        ),
+        "unit": "% tok/s, spec-as-row vs legacy serial spec scan",
+        "legacy_spec": legacy,
+        "spec_as_row": ragged,
+        "identical_tokens": identical,
+        "spec_k": spec_k,
+        "batch": batch,
+        "cache": cache_mode,
+        "cpus": os.cpu_count() or 1,
+    }
+
+
 def run_kv_tier_ab(
     cfg: dict,
     *,
@@ -1384,18 +1610,26 @@ def _int4_ab_smoke() -> None:
 def _ragged_ab_smoke() -> None:
     """CPU smoke for ``--ragged-ab`` (acceptance: byte-identical streams
     across schedulers and a STRICTLY smaller decode stall during a
-    concurrent long-prompt admission — the ISSUE-9 headline). Updates
+    concurrent long-prompt admission — the ISSUE-9 headline; plus the
+    ISSUE-13 arms: the ``--decode-steps`` q=1-vs-q A/B with
+    dispatches-per-decode-token < 0.5 at q, and spec-as-row vs the legacy
+    serial spec scan with identical streams). Updates
     benchmarks/RAGGED_AB_cpu.json (asserted by tier-1). Knobs:
     BENCH_RAGGED_BATCH / BENCH_RAGGED_TOKENS / BENCH_RAGGED_BUDGET /
-    BENCH_RAGGED_ADMIT / BENCH_RAGGED_CACHE."""
+    BENCH_RAGGED_ADMIT / BENCH_RAGGED_CACHE, and ``--decode-steps N``
+    (or BENCH_RAGGED_DECODE_STEPS) for the multi-step arm's window."""
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    q = int(os.environ.get("BENCH_RAGGED_DECODE_STEPS", 4))
+    if "--decode-steps" in sys.argv:
+        q = int(sys.argv[sys.argv.index("--decode-steps") + 1])
+    cfg = {"preset": "llama-tiny", "dtype": "float32"}
     row = run_ragged_ab(
-        {"preset": "llama-tiny", "dtype": "float32"},
+        cfg,
         batch=int(os.environ.get("BENCH_RAGGED_BATCH", 3)),
         new_tokens=int(os.environ.get("BENCH_RAGGED_TOKENS", 64)),
         step_token_budget=int(os.environ.get("BENCH_RAGGED_BUDGET", 24)),
@@ -1405,6 +1639,8 @@ def _ragged_ab_smoke() -> None:
     )
     row["metric"] += "_cpusmoke"
     row["platform"] = "cpu"
+    row["decode_steps_ab"] = run_ragged_decode_steps_ab(cfg, q=q)
+    row["spec_row_ab"] = run_spec_row_ab(cfg)
     artifact = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "benchmarks",
         "RAGGED_AB_cpu.json",

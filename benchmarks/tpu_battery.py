@@ -265,6 +265,41 @@ def main() -> int:
         emit({"metric": "llm_ragged_scheduler_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t6, 1)})
 
+    # -- phase 8b: multi-step decode rows + spec-as-row (ISSUE 13) ----------
+    # the per-launch decode bubble is the thing multi-step windows exist to
+    # amortize (~90 ms tunnel dispatch per PR-4): measure dispatches per
+    # decode token at q=1 vs q=4 on 8B decode shapes, and spec-as-row vs
+    # the legacy serial scan (on chip the ragged Pallas kernel skips
+    # unowned q-blocks, so the tok/s comparison is meaningful here in a
+    # way the CPU smoke's XLA-reference arm is not). These rows decide the
+    # default ragged_decode_steps (ROADMAP phase-8 follow-up).
+    try:
+        row = bench.run_ragged_decode_steps_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "kv_quant": "int8"},
+            q=4, new_tokens=128, decode_prompt_len=64, admit_prompt_len=128,
+            step_token_budget=256, max_seq_len=1024, cache_mode="paged",
+            page_size=32,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_ragged_decode_steps_ab",
+              "error": repr(ex)[:300]})
+    try:
+        row = bench.run_spec_row_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "kv_quant": "int8"},
+            spec_k=3, batch=8, new_tokens=96, step_token_budget=64,
+            max_seq_len=1024, cache_mode="paged", page_size=32,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_spec_row_ab", "error": repr(ex)[:300]})
+
     # -- phase 9: host-RAM KV tiering A/B (docs/kv_tiering.md) --------------
     # constrained-HBM shared-prefix trace on 8B int8-KV shapes: warm TTFT
     # by serving tier {hbm, host, cold}, promotion DMA overlap ratio, and

@@ -97,6 +97,7 @@ BUCKETIZERS: FrozenSet[str] = frozenset({
     "pow2_bucket",
     "pad_to_multiple",
     "pad_pages",
+    "decode_steps_bucket",
     "_bucket_for",
     "pages_needed",
     "ragged_layout",

@@ -98,6 +98,12 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # ragged scheduler job list (docs/ragged_attention.md): the loop opens,
     # shares out, and retires jobs; dispatch workers only read plan dicts
     "_prefill_jobs": (LOOP, ("self", "engine")),
+    # multi-step / spec-as-row per-launch chain state
+    # (docs/ragged_attention.md): window planning and retire-side
+    # acceptance land these counters/histograms on the loop thread only
+    "_step_rows": (LOOP, ("self", "engine")),
+    "_hist_launch_tokens": (LOOP, ("self", "engine")),
+    "_hist_spec_accept": (LOOP, ("self", "engine")),
     # host-tier promotion reap counters (docs/kv_tiering.md): bumped only
     # at loop-thread retire boundaries
     "_tier_counters": (LOOP, ("self", "engine")),

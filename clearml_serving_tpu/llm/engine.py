@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from . import compile_sentry, faults, kv_sanitizer
+from .shapes import decode_steps_bucket
 from ..errors import (
     DeadlineExceededError,
     EngineOverloadedError,
@@ -547,6 +548,11 @@ class LLMEngineCore:
             "_inflight", "_quarantine", "_dispatching", "_slot_req",
             "_admitting", "_next_token", "_gstate", "_slot_overrides",
             "_prefill_jobs", "_tier_counters",
+            # multi-step / spec-as-row chain observability
+            # (docs/ragged_attention.md): per-launch window and acceptance
+            # state is planned and retired on the loop thread only; the
+            # dispatch worker reads plan snapshots, never these attrs
+            "_step_rows", "_hist_launch_tokens", "_hist_spec_accept",
         ),
         "worker": ("_next_token_dev", "_gstate_dev"),
     }
@@ -640,6 +646,15 @@ class LLMEngineCore:
         # launch; must exceed max_batch so admissions always make progress.
         # None -> TPUSERVE_STEP_TOKEN_BUDGET, default max(128, 4*max_batch)
         step_token_budget: Optional[int] = None,
+        # ragged mode: decode rows carry up to this many chained token
+        # positions per mixed launch (multi-step decode rows,
+        # docs/ragged_attention.md) — the launch advances each decode slot
+        # by up to this many tokens, amortizing the per-launch dispatch
+        # bubble and weight read the way the pipelined chunk does. The
+        # per-launch window buckets to a power of two
+        # (llm/shapes.decode_steps_bucket) and shrinks with the token
+        # budget. None inherits ``decode_steps``; 1 restores q=1 rows.
+        ragged_decode_steps: Optional[int] = None,
         # -- SLO-aware scheduling (docs/slo_scheduling.md) -----------------
         # preemptible batch lane: under slot pressure with interactive work
         # queued, batch-class slots are preempted at a chunk boundary (their
@@ -739,6 +754,27 @@ class LLMEngineCore:
                 "prefill chunks always fit beside a full decode batch"
                 .format(self._step_token_budget, self.max_batch)
             )
+        # multi-step ragged decode rows (docs/ragged_attention.md): each
+        # launch advances every decode slot by up to this many chained
+        # tokens. Capped by decode_steps' slack sizing below: the paged
+        # table width and the dense cache slack are dimensioned from
+        # decode_steps, so the ragged window may not exceed it.
+        self._ragged_decode_steps = (
+            max(1, int(ragged_decode_steps))
+            if ragged_decode_steps is not None
+            else self.decode_steps
+        )
+        if self._ragged_decode_steps > self.decode_steps:
+            raise ValueError(
+                "ragged_decode_steps ({}) must not exceed decode_steps "
+                "({}): per-slot KV slack and page-table width are sized "
+                "from decode_steps".format(
+                    self._ragged_decode_steps, self.decode_steps
+                )
+            )
+        # the largest per-launch window actually reachable (pow2-bucketed);
+        # warmup enumerates every power of two up to it
+        self._ragged_steps_cap = decode_steps_bucket(self._ragged_decode_steps)
         self._buckets = sorted(
             b for b in (prefill_buckets or _DEFAULT_PREFILL_BUCKETS) if b <= max_seq_len
         ) or [max_seq_len]
@@ -1028,6 +1064,10 @@ class LLMEngineCore:
             "step_failures": 0,
             "preemptions": 0,
             "ragged_steps": 0,
+            # decode tokens advanced by ragged mixed launches (multi-step
+            # windows + accepted spec tokens): ragged_steps / this ratio is
+            # dispatches-per-decode-token, the bubble-amortization headline
+            "ragged_decode_tokens": 0,
         }
         # -- SLO-aware scheduling state (docs/slo_scheduling.md) ----------
         # per-(reason, class) shed counters backing engine_sheds_total
@@ -1107,7 +1147,20 @@ class LLMEngineCore:
         self._hist_budget = _MsHistogram(
             buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
         )
-        self._step_rows = {"prefill": 0, "decode": 0}
+        self._step_rows = {"prefill": 0, "decode": 0, "spec_verify": 0}
+        # multi-step / spec-as-row observability (loop-affine, like the
+        # budget histogram): decode tokens advanced per mixed launch
+        # (multi-step windows + accepted spec tokens) and the per-launch
+        # mean accepted-draft fraction over spec verify rows — the two
+        # numbers that say whether the per-launch dispatch bubble is
+        # actually amortized (engine_decode_tokens_per_launch /
+        # engine_spec_acceptance_rate in statistics/metrics.py)
+        self._hist_launch_tokens = _MsHistogram(
+            buckets=(1, 2, 4, 8, 16, 32, 64)
+        )
+        self._hist_spec_accept = _MsHistogram(
+            buckets=(0.0, 0.2, 0.4, 0.6, 0.8, 1.0)
+        )
         self._wake: Optional[asyncio.Event] = None
 
         # -- pipelined decode (docs/pipelined_decode.md) -------------------
@@ -1907,6 +1960,82 @@ class LLMEngineCore:
                 lp = _lp_of(lp_src, sampled, nb) if want_lp else None
                 return sampled, counts, lp, gstate
 
+            def _spec_accept(spec, spec_logits, sampling):
+                """In-launch draft acceptance over the spec-verify rows'
+                per-position logits [B, K+1, V]: greedy rows take the
+                argmax-match chain, sampled (sspec) rows the
+                rejection-sampled chain from llm/sampling.py — the same
+                acceptance math the legacy serial scan ran, applied once
+                per launch instead of decode_steps times. Returns
+                (g [B, K+1], acc [B], spec_any [B])."""
+                spec_sel, sspec_sel, drafts, _idx, spec_rng = spec
+                spec_any = spec_sel | sspec_sel
+                sl = spec_logits.astype(jnp.float32)
+                k_ = drafts.shape[1]
+                g = jnp.argmax(sl, axis=-1).astype(jnp.int32)  # [B, K+1]
+                acc_g = jnp.sum(
+                    jnp.cumprod(
+                        (drafts == g[:, :k_]).astype(jnp.int32), axis=1
+                    ),
+                    axis=1,
+                )
+                g_s, acc_s = speculative_sample_chain(
+                    sl, drafts, sampling, spec_rng
+                )
+                g = jnp.where(sspec_sel[:, None], g_s, g)
+                acc = jnp.where(
+                    sspec_sel, acc_s,
+                    jnp.where(spec_sel, acc_g, jnp.zeros_like(acc_g)),
+                ).astype(jnp.int32)
+                return g, acc, spec_any
+
+            def _chain_sample(l, m, step, s_rng, sampling, extras, counts,
+                              pmask, guided, gstate, want_lp, nb):
+                """One chained decode step's sampling tail — the plain
+                chunk body's exact semantics (guided mask -> penalized
+                sample -> count -> DFA advance) with the per-step seed
+                counter offset, masked to the rows whose window is still
+                open this step."""
+                l = l.astype(jnp.float32)
+                if guided is not None:
+                    l = _guided_mask(l, gstate, guided)
+                if extras is None:
+                    s_tok = sample_tokens(l, sampling, s_rng)
+                    lp_src = l
+                else:
+                    ex = extras._replace(
+                        counters=extras.counters + step + 1
+                    )
+                    s_tok = sample_tokens(
+                        l, sampling, s_rng, ex, counts, pmask
+                    )
+                    lp_src = (
+                        penalize_logits(l, ex, counts, pmask)
+                        if want_lp
+                        else l
+                    )
+                    counts = counts.at[jnp.arange(nb), s_tok].add(
+                        m.astype(jnp.int32)
+                    )
+                if guided is not None:
+                    gstate = _guided_advance(gstate, s_tok, m, guided)
+                lp = _lp_of(lp_src, s_tok, nb) if want_lp else None
+                return s_tok, counts, gstate, lp
+
+            def _stack_chain(sampled, lp, chain_out, want_lp):
+                """[B] step-0 outputs + [S-1, B] chained outputs -> step-major
+                [S, B] (and the lp triple likewise)."""
+                if want_lp:
+                    chain_toks, chain_lp = chain_out
+                    sampled = jnp.concatenate([sampled[None], chain_toks])
+                    lp = tuple(
+                        jnp.concatenate([a[None], b])
+                        for a, b in zip(lp, chain_lp)
+                    )
+                else:
+                    sampled = jnp.concatenate([sampled[None], chain_out])
+                return sampled, lp
+
             if cache_mode == "paged":
 
                 def _ragged_paged_step(params, tokens, tok_pos, tok_row,
@@ -1917,29 +2046,101 @@ class LLMEngineCore:
                                        block_q0, decode_mask, sampling, rng,
                                        lora_idx=None, extras=None,
                                        counts=None, pmask=None, guided=None,
-                                       gstate=None, want_lp=False):
+                                       gstate=None, want_lp=False,
+                                       spec=None, chain=None):
                     scale_kw = (
                         {"k_scales": k_scales, "v_scales": v_scales}
                         if paged_quant
                         else {}
+                    )
+                    logit_kw = (
+                        {"row_logit_idx": spec[3]} if spec is not None else {}
                     )
                     out = bundle.forward_ragged(
                         params, tokens, tok_pos, tok_row, tok_valid,
                         row_last, k_pools, v_pools, page_table, kv_lens,
                         row_starts, row_lens, write_page, write_offset,
                         block_rows, block_q0, lora_idx, **scale_kw,
+                        **logit_kw,
                     )
                     if paged_quant:
                         logits, k_pools, v_pools, k_scales, v_scales = out
                     else:
                         logits, k_pools, v_pools = out
+                    spec_g = spec_acc = None
+                    plain_mask = decode_mask
+                    if spec is not None:
+                        logits, spec_logits = logits
+                        spec_g, spec_acc, spec_any = _spec_accept(
+                            spec, spec_logits, sampling
+                        )
+                        plain_mask = decode_mask & ~spec_any
                     raw = logits.astype(jnp.float32)
                     sampled, counts, lp, gstate = _sample_rows(
-                        raw, decode_mask, sampling, rng, extras, counts,
+                        raw, plain_mask, sampling, rng, extras, counts,
                         pmask, guided, gstate, want_lp,
                     )
+                    if chain is not None:
+                        # multi-step decode rows: chain the sampled token
+                        # through S-1 further fused decode steps — the
+                        # pipelined chunk's scan, riding the SAME launch as
+                        # the mixed ragged pass (docs/ragged_attention.md)
+                        step_rngs, chain_mask, chain_wp, chain_wo = chain
+                        nb = sampled.shape[0]
+
+                        def body(carry, xs):
+                            (tok_c, k_p, v_p, k_s, v_s, counts_c,
+                             gstate_c, step) = carry
+                            s_rng, m, wp, wo = xs
+                            skw = (
+                                {"k_scales": k_s, "v_scales": v_s}
+                                if paged_quant
+                                else {}
+                            )
+                            if lora_idx is None:
+                                o = bundle.decode_paged(
+                                    params, tok_c, k_p, v_p, page_table,
+                                    kv_lens + step, wp, wo, **skw,
+                                )
+                            else:
+                                o = bundle.decode_paged(
+                                    params, tok_c, k_p, v_p, page_table,
+                                    kv_lens + step, wp, wo, lora_idx, **skw,
+                                )
+                            if paged_quant:
+                                l, k_p, v_p, k_s, v_s = o
+                            else:
+                                l, k_p, v_p = o
+                            s_tok, counts_c, gstate_c, lp_s = _chain_sample(
+                                l, m, step, s_rng, sampling, extras,
+                                counts_c, pmask, guided, gstate_c, want_lp,
+                                nb,
+                            )
+                            tok_next = jnp.where(m, s_tok, tok_c)
+                            out_s = (
+                                (tok_next, lp_s) if want_lp else tok_next
+                            )
+                            return (
+                                (tok_next, k_p, v_p, k_s, v_s, counts_c,
+                                 gstate_c, step + 1),
+                                out_s,
+                            )
+
+                        (
+                            (_, k_pools, v_pools, k_scales, v_scales,
+                             counts, gstate, _),
+                            chain_out,
+                        ) = jax.lax.scan(
+                            body,
+                            (sampled, k_pools, v_pools, k_scales, v_scales,
+                             counts, gstate, jnp.int32(0)),
+                            (step_rngs, chain_mask, chain_wp, chain_wo),
+                        )
+                        sampled, lp = _stack_chain(
+                            sampled, lp, chain_out, want_lp
+                        )
                     return (sampled, raw, k_pools, v_pools, k_scales,
-                            v_scales, counts, lp, gstate)
+                            v_scales, counts, lp, gstate, spec_g, spec_acc)
 
                 self._ragged_paged_jit = jax.jit(
                     _ragged_paged_step,
@@ -1956,17 +2157,93 @@ class LLMEngineCore:
                                        sampling, rng, lora_idx=None,
                                        extras=None, counts=None, pmask=None,
                                        guided=None, gstate=None,
-                                       want_lp=False):
+                                       want_lp=False, spec=None, chain=None):
+                    logit_kw = (
+                        {"logit_rel": spec[3]} if spec is not None else {}
+                    )
                     logits, cache = bundle.forward_ragged_dense(
                         params, tokens, start, last_rel, row_active, cache,
-                        lora_idx,
+                        lora_idx, **logit_kw,
                     )
+                    spec_g = spec_acc = None
+                    plain_mask = decode_mask
+                    if spec is not None:
+                        logits, spec_logits = logits
+                        spec_g, spec_acc, spec_any = _spec_accept(
+                            spec, spec_logits, sampling
+                        )
+                        plain_mask = decode_mask & ~spec_any
+                        # verify() contract: only the accepted prefix (plus
+                        # the pending token) advances the row's length; K/V
+                        # past it sit beyond ``length`` and are overwritten
+                        # by later writes at the same positions
+                        cache = dict(
+                            cache,
+                            length=jnp.where(
+                                spec_any,
+                                (start + 1 + spec_acc).astype(jnp.int32),
+                                cache["length"],
+                            ),
+                        )
                     raw = logits.astype(jnp.float32)
                     sampled, counts, lp, gstate = _sample_rows(
-                        raw, decode_mask, sampling, rng, extras, counts,
+                        raw, plain_mask, sampling, rng, extras, counts,
                         pmask, guided, gstate, want_lp,
                     )
-                    return sampled, raw, cache, counts, lp, gstate
+                    if chain is not None:
+                        step_rngs, chain_mask = chain
+                        nb = sampled.shape[0]
+
+                        def body(carry, xs):
+                            tok_c, cache_c, counts_c, gstate_c, step = carry
+                            s_rng, m = xs
+                            if lora_idx is None:
+                                l, cache_n = bundle.decode(
+                                    params, tok_c, cache_c
+                                )
+                            else:
+                                l, cache_n = bundle.decode(
+                                    params, tok_c, cache_c, lora_idx
+                                )
+                            # rows whose window is closed this step freeze
+                            # their length: the garbage K/V the batched
+                            # write left at the frozen position sits beyond
+                            # ``length`` and the next REAL token's write
+                            # overwrites it in full
+                            cache_n = dict(
+                                cache_n,
+                                length=jnp.where(
+                                    m, cache_n["length"], cache_c["length"]
+                                ),
+                            )
+                            s_tok, counts_c, gstate_c, lp_s = _chain_sample(
+                                l, m, step, s_rng, sampling, extras,
+                                counts_c, pmask, guided, gstate_c, want_lp,
+                                nb,
+                            )
+                            tok_next = jnp.where(m, s_tok, tok_c)
+                            out_s = (
+                                (tok_next, lp_s) if want_lp else tok_next
+                            )
+                            return (
+                                (tok_next, cache_n, counts_c, gstate_c,
+                                 step + 1),
+                                out_s,
+                            )
+
+                        (
+                            (_, cache, counts, gstate, _),
+                            chain_out,
+                        ) = jax.lax.scan(
+                            body,
+                            (sampled, cache, counts, gstate, jnp.int32(0)),
+                            (step_rngs, chain_mask),
+                        )
+                        sampled, lp = _stack_chain(
+                            sampled, lp, chain_out, want_lp
+                        )
+                    return (sampled, raw, cache, counts, lp, gstate,
+                            spec_g, spec_acc)
 
                 self._ragged_dense_jit = jax.jit(
                     _ragged_dense_step,
@@ -2965,6 +3242,8 @@ class LLMEngineCore:
                     "effective_budget": self._effective_token_budget(),
                     "prefill_jobs": len(self._prefill_jobs),
                     "steps": self.counters["ragged_steps"],
+                    "decode_steps": self._ragged_decode_steps,
+                    "decode_tokens": self.counters["ragged_decode_tokens"],
                 }
                 if self._ragged
                 else None
@@ -3032,6 +3311,14 @@ class LLMEngineCore:
                     "steps": self.counters["ragged_steps"],
                     "budget_utilization": self._hist_budget.snapshot(),
                     "step_rows": dict(self._step_rows),
+                    # multi-step decode rows + spec-as-row
+                    # (docs/ragged_attention.md): decode tokens advanced
+                    # per launch and the per-launch draft acceptance —
+                    # launches/decode_tokens is dispatches-per-decode-token
+                    "decode_steps": self._ragged_decode_steps,
+                    "decode_tokens": self.counters["ragged_decode_tokens"],
+                    "tokens_per_launch": self._hist_launch_tokens.snapshot(),
+                    "spec_acceptance": self._hist_spec_accept.snapshot(),
                 }
                 if self._ragged
                 else None
@@ -4259,6 +4546,57 @@ class LLMEngineCore:
 
     # -- ragged scheduler: token-budget admission (docs/ragged_attention.md) --
 
+    def _ragged_spec_wanted(self, active_mask: np.ndarray) -> bool:
+        """Spec-as-row routing (docs/ragged_attention.md): with speculation
+        on, eligible decode slots ride the ragged scheduler's mixed
+        launches as q=k+1 verify rows — the legacy serial scan
+        (_dispatch_spec_chunk) and its pipeline drain never run under the
+        ragged scheduler. Brownout stage 1+ parks speculation exactly like
+        the pipelined path: the verify slack and the k wasted positions
+        per reject are headroom an overloaded engine no longer has."""
+        if not (self._ragged and self._speculation) or not active_mask.any():
+            return False
+        if self._brownout is not None and self._brownout.stage >= 1:
+            return False
+        greedy, sampled = self._spec_eligible_mask(active_mask)
+        return bool(greedy.any() or sampled.any())
+
+    def _ngram_draft_rows(self, slots, hists) -> "np.ndarray":
+        """Host-side n-gram proposal for spec-verify rows ([len(slots), k]
+        draft tokens), mirroring the device proposer the legacy serial scan
+        ran in-jit: match the history's n-token tail against every earlier
+        window of the slot's token buffer, continue from the LAST match;
+        no-match rows draft the tail's last token repeated (a reject still
+        emits the bonus token). Host-side because the drafts become ragged
+        ROW CONTENT — they must be known before the launch is laid out."""
+        n_, k_ = self._spec_ngram, self._spec_k
+        buf_len = self._tokbuf.shape[1]
+        out = np.zeros((len(slots), k_), np.int32)
+        for i, (slot, hist) in enumerate(zip(slots, hists)):
+            buf = self._tokbuf[slot]
+            tail_pos = np.clip(hist - n_ + np.arange(n_), 0, buf_len - 1)
+            tail = buf[tail_pos]
+            # window must end before the tail starts (a previous
+            # occurrence, not the tail matching itself); only the hist
+            # tokens actually written participate — the scan is bounded by
+            # the generated length, not the buffer capacity (this runs on
+            # the loop thread every launch)
+            limit = hist - 2 * n_ + 1
+            best = -1
+            if limit > 0:
+                match = np.ones(limit, bool)
+                for j in range(n_):
+                    match &= buf[j : limit + j] == tail[j]
+                idx = np.nonzero(match)[0]
+                if idx.size:
+                    best = int(idx[-1])
+            if best >= 0:
+                pos = np.clip(best + n_ + np.arange(k_), 0, buf_len - 1)
+                out[i] = buf[pos]
+            else:
+                out[i] = tail[-1]
+        return out
+
     async def _ragged_admission_task(self, request: GenRequest, slot: int) -> None:
         """Ragged-mode admission: no standalone prefill dispatch — the
         prompt rides the loop's ragged launches as budget-bounded chunk
@@ -4403,18 +4741,44 @@ class LLMEngineCore:
 
     def _prepare_ragged(self, active_mask: np.ndarray,
                         epoch: int) -> Optional[dict]:
-        """Loop-thread half of a ragged step: sweep dead jobs, hand each
-        live job its token share under the step budget (class/arrival order
-        — the jobs list is in admission-pop order), and snapshot every
-        piece of shared host state the worker needs. Returns None when
-        nothing is dispatchable."""
+        """Loop-thread half of a ragged step: sweep dead jobs, classify the
+        live rows (docs/ragged_attention.md row taxonomy — plain decode
+        rows carrying a q=row_steps multi-token window, spec-verify rows
+        carrying a q=k+1 draft chain, prefill-chunk rows), hand each live
+        job its token share under the step budget (class/arrival order —
+        the jobs list is in admission-pop order), and snapshot every piece
+        of shared host state the worker needs. A q=N row is N tokens of
+        budget; admissions keep their PR-9 share (decode baseline is one
+        token per row) and only the LEFTOVER budget widens decode windows,
+        so saturating admission traffic sees the historical schedule while
+        steady-state decode amortizes the launch across up to
+        ``ragged_decode_steps`` tokens. Returns None when nothing is
+        dispatchable."""
         self._last_progress = time.monotonic()
         self._sweep_ragged_jobs()
         decode_mask = active_mask.copy()
         budget = self._effective_token_budget()
         n_decode = int(decode_mask.sum())
+        k_ = self._spec_k
+        # spec-as-row: eligible decode slots become q=k+1 verify rows in
+        # THIS mixed launch (host-drafted chain, device-verified, accepted
+        # at retire) — the serial spec scan never runs under this scheduler
+        spec_mask = np.zeros(self.max_batch, bool)
+        sspec_mask = np.zeros(self.max_batch, bool)
+        if self._ragged_spec_wanted(decode_mask):
+            greedy, sampled_m = self._spec_eligible_mask(decode_mask)
+            spec_mask, sspec_mask = greedy.copy(), sampled_m.copy()
+            # a verify row costs k extra budget tokens: demote rows
+            # (highest slot first) until the baseline fits the budget
+            spec_slots = [int(s) for s in np.nonzero(spec_mask | sspec_mask)[0]]
+            while spec_slots and n_decode + k_ * len(spec_slots) > budget:
+                drop = spec_slots.pop()
+                spec_mask[drop] = False
+                sspec_mask[drop] = False
+        spec_any = spec_mask | sspec_mask
+        n_spec = int(spec_any.sum())
         shares: List[tuple] = []
-        left = max(0, budget - n_decode)
+        left = max(0, budget - n_decode - k_ * n_spec)
         for job in list(self._prefill_jobs):
             if left <= 0:
                 break
@@ -4441,6 +4805,42 @@ class LLMEngineCore:
             left -= take
         if n_decode == 0 and not shares:
             return None
+        # multi-step decode windows from the LEFTOVER budget: the launch
+        # window buckets to a power of two (bounded compile keys, each
+        # warmed by llm/warmup.py) and every row clamps host-side to its
+        # own max-token / sequence bounds — a brownout stage-2 cap landing
+        # mid-stream clamps the window exactly like max_new_tokens does
+        plain_slots = [
+            int(s) for s in np.nonzero(decode_mask & ~spec_any)[0]
+        ]
+        launch_steps = 1
+        if plain_slots and self._ragged_steps_cap > 1 and left > 0:
+            launch_steps = decode_steps_bucket(
+                1 + left // len(plain_slots), cap=self._ragged_steps_cap
+            )
+        row_steps = np.zeros(self.max_batch, np.int32)
+        for slot in plain_slots:
+            request = self._slot_req[slot]
+            remaining_new = (
+                self._effective_max_new(request) - request.produced
+            )
+            remaining_len = self.max_seq_len - (
+                request.prompt_len + request.produced
+            )
+            row_steps[slot] = max(
+                1, min(launch_steps, remaining_new, remaining_len)
+            )
+        # drafts for the verify rows, proposed from the host token buffer
+        # (kept warm at every ragged retire)
+        drafts = None
+        if n_spec:
+            spec_slots = [int(s) for s in np.nonzero(spec_any)[0]]
+            hists = [
+                self._slot_req[s].prompt_len + self._slot_req[s].produced
+                for s in spec_slots
+            ]
+            drafts = np.zeros((self.max_batch, k_), np.int32)
+            drafts[spec_slots] = self._ngram_draft_rows(spec_slots, hists)
         want_lp = any(
             self._slot_req[s] is not None
             and self._slot_req[s].logprobs is not None
@@ -4483,6 +4883,35 @@ class LLMEngineCore:
                 job.slot for job, take in shares
                 if job.pos + take >= len(job.request.prompt_ids)
             ],
+            # multi-step / spec-as-row row taxonomy
+            # (docs/ragged_attention.md)
+            "spec_mask": spec_mask,
+            "sspec_mask": sspec_mask,
+            "spec_k": k_,
+            "drafts": drafts,
+            "row_steps": row_steps,
+            "launch_steps": launch_steps,
+            "step_rngs": (
+                jnp.stack([self._next_rng() for _ in range(launch_steps - 1)])
+                if launch_steps > 1
+                else None
+            ),
+            "spec_rng": self._next_rng() if n_spec else None,
+            # per-step window mask: step i runs for rows whose window is
+            # still open ([S-1, B]; host-known — EOS mid-window is masked
+            # at retire, max-token/seq bounds here)
+            "chain_mask": (
+                (
+                    np.arange(1, launch_steps)[:, None]
+                    < row_steps[None, :]
+                )
+                if launch_steps > 1
+                else None
+            ),
+            "used_tokens": (
+                int(row_steps.sum()) + (k_ + 1) * n_spec
+                + sum(t for _, t in shares)
+            ),
         }
         job_of = {job.slot: job for job, _ in shares}
         take_of = {job.slot: take for job, take in shares}
@@ -4490,13 +4919,24 @@ class LLMEngineCore:
             from ..ops.paged_attention import ragged_layout
 
             pool = self.paged_cache.pool
+            # layout lens reserve each row's WHOLE window in the flat token
+            # axis (a q=N decode row owns N positions: position 0 rides the
+            # mixed pass, positions 1.. are written by the in-launch chain);
+            # kernel row_lens count only the positions the ragged pass
+            # itself computes
+            span_lens = np.zeros(self.max_batch, np.int32)
             row_lens = np.zeros(self.max_batch, np.int32)
             for slot in np.nonzero(decode_mask)[0]:
-                row_lens[int(slot)] = 1
+                slot = int(slot)
+                if spec_any[slot]:
+                    span_lens[slot] = row_lens[slot] = k_ + 1
+                else:
+                    span_lens[slot] = row_steps[slot]
+                    row_lens[slot] = 1
             for slot, take in take_of.items():
-                row_lens[slot] = take
+                span_lens[slot] = row_lens[slot] = take
             starts, block_rows, block_q0, tpad = ragged_layout(
-                row_lens, self._ragged_qb, total=self._ragged_tpad
+                span_lens, self._ragged_qb, total=self._ragged_tpad
             )
             tokens = np.zeros(tpad, np.int32)
             tok_pos = np.zeros(tpad, np.int32)
@@ -4507,10 +4947,11 @@ class LLMEngineCore:
             pre_lens = np.zeros(self.max_batch, np.int32)
             spans: Dict[int, tuple] = {}
             for slot in range(self.max_batch):
-                n = int(row_lens[slot])
+                n = int(span_lens[slot])
                 if n == 0:
                     continue
                 s = int(starts[slot])
+                v = int(row_lens[slot])
                 pre = pool.slot_length(slot)
                 pre_lens[slot] = pre
                 if slot in job_of:
@@ -4518,19 +4959,37 @@ class LLMEngineCore:
                     tokens[s : s + n] = job.request.prompt_ids[
                         job.pos : job.pos + n
                     ]
+                elif spec_any[slot]:
+                    tokens[s] = self._next_token[slot]
+                    tokens[s + 1 : s + n] = drafts[slot]
                 else:
                     tokens[s] = self._next_token[slot]
                 spans[slot] = (s, n)
                 tok_pos[s : s + n] = pre + np.arange(n, dtype=np.int32)
                 tok_row[s : s + n] = slot
-                tok_valid[s : s + n] = True
-                row_last[slot] = s + n - 1
-                kv_lens[slot] = pre + n
+                # reserved multi-step positions stay invalid in the mixed
+                # pass: their tokens are sampled in-launch and their K/V
+                # written by the chained decode steps
+                tok_valid[s : s + v] = True
+                row_last[slot] = s + v - 1
+                kv_lens[slot] = pre + v
+            if n_spec:
+                row_logit_idx = np.zeros(
+                    (self.max_batch, k_ + 1), np.int32
+                )
+                for slot in range(self.max_batch):
+                    if row_lens[slot] > 0:
+                        row_logit_idx[slot] = starts[slot] + np.minimum(
+                            np.arange(k_ + 1), row_lens[slot] - 1
+                        )
+            else:
+                row_logit_idx = None
             plan.update(
                 tokens=tokens, tok_pos=tok_pos, tok_row=tok_row,
                 tok_valid=tok_valid, row_last=row_last, kv_lens=kv_lens,
                 pre_lens=pre_lens, row_starts=starts, row_lens=row_lens,
-                spans=spans,
+                span_lens=span_lens, spans=spans,
+                row_logit_idx=row_logit_idx,
                 write_page=np.zeros(tpad, np.int32),
                 write_offset=np.zeros(tpad, np.int32),
                 block_rows=(
@@ -4543,8 +5002,13 @@ class LLMEngineCore:
         else:
             # dense ragged: the rectangular chunk layout [B, C] — C buckets
             # to the next power of two of the widest chunk so traces stay
-            # bounded (log2(budget) shapes per variant)
+            # bounded (log2(budget) shapes per variant). Decode rows keep a
+            # 1-token chunk (their multi-step window chains through
+            # bundle.decode in the same launch); spec rows carry the whole
+            # k+1 candidate chain.
             c_need = max([take for _, take in shares], default=1)
+            if n_spec:
+                c_need = max(c_need, k_ + 1)
             c = 1
             while c < c_need:
                 c *= 2
@@ -4556,6 +5020,9 @@ class LLMEngineCore:
                 slot = int(slot)
                 request = self._slot_req[slot]
                 tokens[slot, 0] = self._next_token[slot]
+                if spec_any[slot]:
+                    tokens[slot, 1 : k_ + 1] = drafts[slot]
+                    last_rel[slot] = k_
                 # dense cache length = prompt_len + produced - 1 (the
                 # pending token's KV is written by the step consuming it)
                 start[slot] = request.prompt_len + request.produced - 1
@@ -4567,6 +5034,13 @@ class LLMEngineCore:
                 start[job.slot] = job.pos
                 last_rel[job.slot] = take - 1
                 row_active[job.slot] = True
+            if n_spec:
+                row_logit_idx = np.minimum(
+                    np.arange(k_ + 1)[None, :], last_rel[:, None]
+                ).astype(np.int32)
+                plan["row_logit_idx"] = row_logit_idx
+            else:
+                plan["row_logit_idx"] = None
             for job in self._prefill_jobs:
                 if not row_active[job.slot]:
                     # budget-starved job rows still get their garbage chunk
@@ -4598,8 +5072,16 @@ class LLMEngineCore:
         plan["tok_row"][s : s + n] = 0
         plan["tok_valid"][s : s + n] = False
         plan["row_lens"][slot] = 0
+        plan["span_lens"][slot] = 0
         plan["kv_lens"][slot] = plan["pre_lens"][slot]
         plan["row_last"][slot] = 0
+        plan["row_steps"][slot] = 0
+        plan["spec_mask"][slot] = False
+        plan["sspec_mask"][slot] = False
+        if plan["chain_mask"] is not None:
+            plan["chain_mask"][:, slot] = False
+        if plan["row_logit_idx"] is not None:
+            plan["row_logit_idx"][slot] = 0
         if plan["decode_mask"][slot]:
             plan["decode_mask"][slot] = False
             plan["exhausted"].append(slot)
@@ -4627,6 +5109,22 @@ class LLMEngineCore:
         use_extras = plan["use_extras"]
         gtables = plan["gtables"]
         want_lp = plan["want_lp"]
+        launch_steps = plan["launch_steps"]
+
+        def _spec_arrays():
+            # built AFTER any pool-exhaustion drops: _ragged_drop_row edits
+            # the host masks/indices in place and the device copies must
+            # see the post-drop state
+            if plan["row_logit_idx"] is None:
+                return None
+            return (
+                jnp.asarray(plan["spec_mask"].copy()),
+                jnp.asarray(plan["sspec_mask"].copy()),
+                jnp.asarray(plan["drafts"]),
+                jnp.asarray(plan["row_logit_idx"]),
+                plan["spec_rng"],
+            )
+
         if self.cache_mode == "paged":
             pool = self.paged_cache.pool
             for slot in list(plan["spans"]):
@@ -4642,6 +5140,37 @@ class LLMEngineCore:
                 for i, (page, offset) in enumerate(coords):
                     plan["write_page"][s + i] = page
                     plan["write_offset"][s + i] = offset
+            chain_arrays = None
+            if launch_steps > 1:
+                # multi-step decode rows: the reserved span positions 1..
+                # become the chained steps' per-step write coordinates —
+                # the mixed pass writes only position 0 (the others go to
+                # the null page there, exactly like any pad)
+                chain_wp = np.zeros(
+                    (launch_steps - 1, self.max_batch), np.int32
+                )
+                chain_wo = np.zeros(
+                    (launch_steps - 1, self.max_batch), np.int32
+                )
+                for slot, (s, n) in plan["spans"].items():
+                    if (
+                        not plan["decode_mask"][slot]
+                        or plan["spec_mask"][slot]
+                        or plan["sspec_mask"][slot]
+                        or n <= 1
+                    ):
+                        continue
+                    for i in range(1, n):
+                        chain_wp[i - 1, slot] = plan["write_page"][s + i]
+                        chain_wo[i - 1, slot] = plan["write_offset"][s + i]
+                        plan["write_page"][s + i] = 0
+                        plan["write_offset"][s + i] = 0
+                chain_arrays = (
+                    plan["step_rngs"],
+                    jnp.asarray(plan["chain_mask"].copy()),
+                    jnp.asarray(chain_wp),
+                    jnp.asarray(chain_wo),
+                )
             self.paged_cache.apply_pending_cow()
             page_table = pool.page_table(self._pages_per_seq)
             with self.paged_cache.dispatch_lock:
@@ -4649,6 +5178,7 @@ class LLMEngineCore:
                     sampled, logits,
                     self.paged_cache.k, self.paged_cache.v,
                     new_ks, new_vs, new_counts, lp, gstate_out,
+                    spec_g, spec_acc,
                 ) = self._ragged_paged_jit(
                     self.params,
                     jnp.asarray(plan["tokens"]),
@@ -4678,13 +5208,22 @@ class LLMEngineCore:
                     gtables,
                     plan["gstate"],
                     want_lp=want_lp,
+                    spec=_spec_arrays(),
+                    chain=chain_arrays,
                 )
                 if self._paged_quant:
                     self.paged_cache.k_scale = new_ks
                     self.paged_cache.v_scale = new_vs
         else:
+            chain_arrays = None
+            if launch_steps > 1:
+                chain_arrays = (
+                    plan["step_rngs"],
+                    jnp.asarray(plan["chain_mask"].copy()),
+                )
             (
                 sampled, logits, self.cache, new_counts, lp, gstate_out,
+                spec_g, spec_acc,
             ) = self._ragged_dense_jit(
                 self.params,
                 jnp.asarray(plan["tokens"]),
@@ -4702,6 +5241,8 @@ class LLMEngineCore:
                 gtables,
                 plan["gstate"],
                 want_lp=want_lp,
+                spec=_spec_arrays(),
+                chain=chain_arrays,
             )
         if use_extras:
             self._counts_dev = new_counts
@@ -4727,6 +5268,8 @@ class LLMEngineCore:
             "lp": lp,
             "gstate": gstate_out if gtables is not None else None,
             "finish_rows": finish,
+            "spec_g": spec_g,
+            "spec_acc": spec_acc,
         }
 
     async def _ragged_step(self, active_mask: np.ndarray, epoch: int) -> None:
@@ -4798,11 +5341,18 @@ class LLMEngineCore:
 
     def _retire_ragged(self, plan: dict, result: dict) -> None:
         """Loop-thread tail of a ragged step: decode emissions re-anchor
-        the host mirrors exactly like a pipelined retire; finishing
-        prefill jobs sample their first token (the legacy admission code
-        path) and activate their slot."""
+        the host mirrors exactly like a pipelined retire — a q=N decode
+        row emits its whole window in order under the MID-WINDOW EOS MASK
+        (a row finishing inside its window delivers the tokens up to the
+        stop and drops the surplus; the q=1 path simply stopped
+        launching), a spec-verify row emits its accepted chain after the
+        pool rolls its over-allocation back to what the verify kept, and
+        finishing prefill jobs sample their first token (the legacy
+        admission code path) and activate their slot."""
         t0 = time.perf_counter()
         sampled = np.asarray(result["sampled"])
+        if sampled.ndim == 1:
+            sampled = sampled[None]               # step-major [S, B]
         gstate_np = (
             np.array(result["gstate"]) if result["gstate"] is not None else None
         )
@@ -4811,6 +5361,24 @@ class LLMEngineCore:
             if result["lp"] is not None
             else None
         )
+        if lp_np is not None and lp_np[0].ndim == 1:
+            lp_np = tuple(a[None] for a in lp_np)  # step-major [S, B, ...]
+        spec_acc = (
+            np.asarray(result["spec_acc"])
+            if result["spec_acc"] is not None
+            else None
+        )
+        spec_g = (
+            np.asarray(result["spec_g"])
+            if result["spec_g"] is not None
+            else None
+        )
+        spec_any = plan["spec_mask"] | plan["sspec_mask"]
+        # the per-request retire fault on a MULTI-TOKEN row fails the
+        # request with its partial window delivered (all but the last
+        # token): the tokens were already sampled device-side and the
+        # failure is a host-emission failure, not a compute one
+        partial: Dict[int, BaseException] = {}
         if faults.active():
             try:
                 faults.fire("engine.decode.retire", requests=plan["requests"])
@@ -4820,12 +5388,22 @@ class LLMEngineCore:
                 self.counters["step_failures"] += 1
                 handled = False
                 for slot, request in enumerate(self._slot_req):
-                    if request is ex.request:
-                        self._fail_slot(slot, EngineStepError(
-                            "retire failed for this request: {}".format(ex)
-                        ))
-                        handled = True
-                        break
+                    if request is not ex.request:
+                        continue
+                    window = (
+                        int(spec_acc[slot]) + 1
+                        if spec_acc is not None and spec_any[slot]
+                        else int(plan["row_steps"][slot])
+                    )
+                    err = EngineStepError(
+                        "retire failed for this request: {}".format(ex)
+                    )
+                    if plan["decode_mask"][slot] and window > 1:
+                        partial[slot] = err
+                    else:
+                        self._fail_slot(slot, err)
+                    handled = True
+                    break
                 if not handled:
                     job = next(
                         (
@@ -4843,41 +5421,111 @@ class LLMEngineCore:
                 slot, MemoryError("kv page pool exhausted for this sequence")
             )
         decode_slots = [int(s) for s in np.nonzero(plan["decode_mask"])[0]]
-        for slot in decode_slots:
-            self._next_token[slot] = int(sampled[slot])
-            if gstate_np is not None:
-                self._gstate[slot] = int(gstate_np[slot])
-        for slot in decode_slots:
-            request = self._slot_req[slot]
-            if request is not None and self._tokbuf is not None:
-                # speculation history stays warm through ragged phases so
-                # the n-gram proposer drafts well when spec steps resume
-                idx = request.prompt_len + request.produced
-                if idx < self._tokbuf.shape[1]:
-                    self._tokbuf[slot, idx] = int(sampled[slot])
-            lp_entry = None
-            if (
-                lp_np is not None
-                and request is not None
-                and request.logprobs is not None
-            ):
+        plain_slots = [s for s in decode_slots if not spec_any[s]]
+        spec_slots = [s for s in decode_slots if spec_any[s]]
+        if spec_slots and self.cache_mode == "paged":
+            # roll each verify row's over-allocation back to the tokens the
+            # acceptance actually kept (pending + accepted drafts). BEFORE
+            # emission: _emit frees a finishing slot's pages entirely. A
+            # slot the retire fault already failed (its 1-token window made
+            # the failure immediate) freed its pages wholesale — nothing
+            # left to truncate
+            pool = self.paged_cache.pool
+            for slot in spec_slots:
+                if self._slot_req[slot] is None:
+                    continue
+                pool.truncate(
+                    slot,
+                    int(plan["pre_lens"][slot]) + 1 + int(spec_acc[slot]),
+                )
+        emitted_decode = 0
+
+        def _window_emit(slot, toks, lp_of_step):
+            """Emit one row's window in order; the mid-window EOS mask is
+            the break on a freed slot — _emit finishes the request on a
+            stop token / max-token / max-seq bound and the surplus never
+            reaches the stream. Returns tokens delivered."""
+            nonlocal emitted_decode
+            fail_err = partial.pop(slot, None)
+            delivered = 0
+            for i, tok in enumerate(toks):
+                if fail_err is not None and i == len(toks) - 1:
+                    self._fail_slot(slot, fail_err)
+                    return delivered
+                request = self._slot_req[slot]
+                if request is None:
+                    break                      # mid-window EOS mask
+                if self._tokbuf is not None:
+                    # speculation history stays warm through ragged phases
+                    # so the n-gram proposer keeps drafting well
+                    idx = request.prompt_len + request.produced
+                    if idx < self._tokbuf.shape[1]:
+                        self._tokbuf[slot, idx] = tok
+                self._emit(slot, tok, lp_of_step(i, request))
+                delivered += 1
+                emitted_decode += 1
+            return delivered
+
+        for slot in plain_slots:
+            n = int(plan["row_steps"][slot])
+            if n <= 0:
+                continue
+
+            def _lp_entry(i, request, slot=slot):
+                if lp_np is None or request.logprobs is None:
+                    return None
                 chosen, top_id, top_lp = lp_np
-                lp_entry = {
-                    "id": int(sampled[slot]),
-                    "logprob": float(chosen[slot]),
-                    "top_ids": top_id[slot].tolist(),
-                    "top_logprobs": top_lp[slot].tolist(),
+                return {
+                    "id": int(sampled[i, slot]),
+                    "logprob": float(chosen[i, slot]),
+                    "top_ids": top_id[i, slot].tolist(),
+                    "top_logprobs": top_lp[i, slot].tolist(),
                 }
-            self._emit(slot, int(sampled[slot]), lp_entry)
+
+            _window_emit(
+                slot, [int(sampled[i, slot]) for i in range(n)], _lp_entry
+            )
+            if self._slot_req[slot] is not None:
+                # the window's last token is the next launch's pending one
+                self._next_token[slot] = int(sampled[n - 1, slot])
+                if gstate_np is not None:
+                    self._gstate[slot] = int(gstate_np[slot])
+        accept_fracs = []
+        for slot in spec_slots:
+            acc = int(spec_acc[slot])
+            accept_fracs.append(acc / max(1, plan["spec_k"]))
+            _window_emit(
+                slot,
+                [int(spec_g[slot, i]) for i in range(acc + 1)],
+                lambda i, request: None,
+            )
+            if self._slot_req[slot] is not None:
+                self._next_token[slot] = int(spec_g[slot, acc])
+        for slot, err in partial.items():
+            # defensive: a deferred partial-window failure whose row never
+            # emitted (dropped between planning and retire) still fails
+            self._fail_slot(slot, err)
         failed = [j for j, _ in plan["failed_jobs"]]
         live_shares = [
             (j, t) for j, t in plan["shares"]
             if not any(j is f for f in failed)
         ]
         self.counters["ragged_steps"] += 1
-        self._step_rows["decode"] += len(decode_slots)
+        self.counters["ragged_decode_tokens"] += emitted_decode
+        self._step_rows["decode"] += len(plain_slots)
+        self._step_rows["spec_verify"] += len(spec_slots)
         self._step_rows["prefill"] += len(live_shares)
-        used = len(decode_slots) + sum(t for _, t in live_shares)
+        if plain_slots or spec_slots:
+            self._hist_launch_tokens.observe(emitted_decode)
+        if accept_fracs:
+            self._hist_spec_accept.observe(
+                sum(accept_fracs) / len(accept_fracs)
+            )
+        used = (
+            int(plan["row_steps"].sum())
+            + (plan["spec_k"] + 1) * len(spec_slots)
+            + sum(t for _, t in live_shares)
+        )
         self._hist_budget.observe(used / max(1, plan["budget"]))
         for job, err in plan["failed_jobs"]:
             self._fail_ragged_job(job, err)
@@ -5110,12 +5758,15 @@ class LLMEngineCore:
             # queue — the loop itself survives both and keeps serving
             step_epoch = self._recover_epoch
             try:
-                if self._prefill_jobs:
+                if self._prefill_jobs or self._ragged_spec_wanted(active_mask):
                     # ragged scheduling phase (docs/ragged_attention.md):
                     # drain the pipelined queue first (host mirrors must be
-                    # current — same rule as spec steps), then each
-                    # iteration is ONE mixed launch of every decode row
-                    # plus budget-bounded prefill-chunk rows
+                    # current — same rule the legacy spec step used), then
+                    # each iteration is ONE mixed launch of every decode
+                    # row (multi-step windows), spec-verify row, and
+                    # budget-bounded prefill-chunk row. With speculation
+                    # on, spec rows ride these launches — the serial
+                    # pipeline-draining spec scan never runs here.
                     if self._inflight:
                         await self._retire_oldest()
                     else:
@@ -5156,6 +5807,10 @@ class LLMEngineCore:
             self._spec_eligible_mask(active_mask)
             if self._speculation
             and active_mask.any()
+            # the ragged scheduler never takes the serial spec scan: spec
+            # rides its mixed launches as q=k+1 verify rows instead
+            # (_ragged_spec_wanted routes those phases to _ragged_step)
+            and not self._ragged
             # brownout stage 1+ parks speculation: the verify slack's page
             # over-allocation and the k wasted positions per reject are
             # exactly the headroom an overloaded engine no longer has

@@ -287,6 +287,14 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("step_token_budget")
                 else None
             ),
+            # multi-step ragged decode rows (docs/ragged_attention.md):
+            # max chained positions per decode row per mixed launch;
+            # unset inherits decode_steps, 1 restores q=1 rows
+            ragged_decode_steps=(
+                int(engine_cfg["ragged_decode_steps"])
+                if engine_cfg.get("ragged_decode_steps")
+                else None
+            ),
             lora_adapters=lora_adapters,
             prefix_cache=engine_cfg.get("prefix_cache"),
             prefix_block=int(engine_cfg.get("prefix_block", 64)),

@@ -594,6 +594,65 @@ def scenario_tier_promotion(ctx: ScenarioContext) -> None:
     sanitizer.check("tier-promotion", drained=True)
 
 
+def scenario_ragged_window_retire(ctx: ScenarioContext) -> None:
+    """Multi-step ragged retire (docs/ragged_attention.md): a q=4 decode
+    window's tokens are emitted IN ORDER under the mid-window EOS mask —
+    the row's request finishes at the stop token, its slot pages free, and
+    the surplus window tokens must never reach the stream (nor land after
+    a concurrent admission re-allocated the freed pages). Mutation
+    ``drop_window_eos_mask`` keeps emitting past the stop, exactly the
+    corruption blind window emission would allow."""
+    from .kv_sanitizer import KVSanitizer
+
+    pool = _pool(num_pages=5, page_size=4, max_slots=2)
+    pool.allocate(0, 8)                     # the decoding row's slot
+    eos = 99
+    window = [11, eos, 12, 13]              # q=4; EOS lands mid-window
+    stream: List[int] = []
+    state: Dict[str, Any] = {"finished": False}
+
+    def loop_retire():
+        # _retire_ragged._window_emit: token-by-token emission; _emit
+        # frees the slot at the stop token and the window loop must break
+        for tok in window:
+            if state["finished"] and not ctx.mutating(
+                "drop_window_eos_mask"
+            ):
+                break                       # the mid-window EOS mask
+            if state["finished"]:
+                # seeded defect: blind emission past the finish — the dead
+                # request's surplus tokens leak into the stream
+                stream.append(tok)
+                ctx.yield_point("engine.decode.retire")
+                continue
+            stream.append(tok)
+            ctx.yield_point("engine.decode.retire")
+            if tok == eos:
+                state["finished"] = True
+                pool.free(0)                # _emit frees the slot's pages
+                ctx.yield_point("engine.release")
+
+    def loop_admit():
+        # a concurrent admission takes whatever pages the finish freed
+        ctx.yield_point("engine.prefill")
+        try:
+            pool.allocate(1, 8)
+        except MemoryError:
+            pass
+        ctx.yield_point("engine.prefill")
+
+    ctx.spawn(loop_retire, "loop-retire")
+    ctx.spawn(loop_admit, "loop-admit")
+    ctx.run()
+    if eos in stream and stream[-1] != eos:
+        raise ScheduleViolation(
+            "window emission continued past the stop token: stream {} "
+            "(mid-window EOS mask dropped)".format(stream)
+        )
+    pool.free(1)
+    KVSanitizer(pool).check("ragged-window-retire", drained=True)
+
+
 SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "host_buffer_handoff": scenario_host_buffer_handoff,
     "quarantine_barrier": scenario_quarantine_barrier,
@@ -601,6 +660,7 @@ SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "stale_chain_commit": scenario_stale_chain_commit,
     "refcount_lock": scenario_refcount_lock,
     "tier_promotion": scenario_tier_promotion,
+    "ragged_window_retire": scenario_ragged_window_retire,
 }
 
 # seeded defect -> the scenario that must catch it (self_test proves each)
@@ -611,6 +671,7 @@ MUTATIONS: Dict[str, str] = {
     "drop_chain_reset": "stale_chain_commit",
     "drop_lock": "refcount_lock",
     "drop_tier_fence": "tier_promotion",
+    "drop_window_eos_mask": "ragged_window_retire",
 }
 
 

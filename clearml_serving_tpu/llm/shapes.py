@@ -28,7 +28,9 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-__all__ = ["pow2_bucket", "pad_to_multiple", "pad_pages"]
+__all__ = [
+    "pow2_bucket", "pad_to_multiple", "pad_pages", "decode_steps_bucket",
+]
 
 
 def pow2_bucket(n: int, lo: int = 1) -> int:
@@ -38,6 +40,22 @@ def pow2_bucket(n: int, lo: int = 1) -> int:
     bucket = max(1, int(lo))
     n = int(n)
     while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def decode_steps_bucket(n: int, cap: int = None) -> int:
+    """Largest power of two <= max(1, n), optionally capped: the multi-step
+    ragged decode-window bucketizer (docs/ragged_attention.md). The window a
+    launch can afford varies per step with the token budget and the live
+    row count — rounding DOWN to a power of two keeps the launch within
+    budget while collapsing the per-launch scan length to log2(decode_steps)
+    compile keys, each pre-compiled by the warmup sweep."""
+    n = max(1, int(n))
+    if cap is not None:
+        n = min(n, max(1, int(cap)))
+    bucket = 1
+    while bucket * 2 <= n:
         bucket *= 2
     return bucket
 

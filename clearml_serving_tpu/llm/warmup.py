@@ -194,6 +194,145 @@ def warmup_plan(engine, full: bool = True) -> List[Dict[str, Any]]:
     return plan
 
 
+def warm_ragged_variants(engine) -> int:
+    """Compile every (decode window, spec-row) ragged launch variant for
+    this engine's configuration with null-row operands — see the call site
+    in :func:`run_warmup`. Returns the number of launches run. Operand
+    construction mirrors ``engine._dispatch_ragged_device_inner`` one for
+    one (dtype-strong numpy uploads, same None-ness per variant); every
+    scatter lands in the dead null page / a frozen dense position, so the
+    pools/cache round-trip through the donated call value-unchanged."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    b = engine.max_batch
+    k_ = engine._spec_k
+    windows = []
+    p = 1
+    while p <= engine._ragged_steps_cap:
+        windows.append(p)
+        p *= 2
+    spec_opts = [False] + ([True] if engine._speculation else [])
+    sampling = engine._batch_sampling()
+    lora = (
+        jnp.asarray(np.zeros(b, np.int32)) if engine._lora_enabled else None
+    )
+    ran = 0
+
+    def key():
+        return engine._next_rng()
+
+    def spec_args(on):
+        if not on:
+            return None
+        return (
+            jnp.asarray(np.zeros(b, bool)),
+            jnp.asarray(np.zeros(b, bool)),
+            jnp.asarray(np.zeros((b, k_), np.int32)),
+            jnp.asarray(np.zeros((b, k_ + 1), np.int32)),
+            key(),
+        )
+
+    if engine.paged_cache is not None:
+        cache = engine.paged_cache
+        tpad = engine._ragged_tpad
+        nb = tpad // engine._ragged_qb
+        page_table = jnp.asarray(
+            np.zeros((b, engine._pages_per_seq), np.int32)
+        )
+        blocks = (
+            jnp.asarray(np.full(nb, -1, np.int32)),
+            jnp.asarray(np.zeros(nb, np.int32)),
+        ) if engine._ragged_on_tpu else (None, None)
+        for steps in windows:
+            for spec_on in spec_opts:
+                chain = None
+                if steps > 1:
+                    chain = (
+                        jnp.stack([key() for _ in range(steps - 1)]),
+                        jnp.asarray(np.zeros((steps - 1, b), bool)),
+                        jnp.asarray(np.zeros((steps - 1, b), np.int32)),
+                        jnp.asarray(np.zeros((steps - 1, b), np.int32)),
+                    )
+                with cache.dispatch_lock:
+                    (
+                        sampled, _logits, cache.k, cache.v,
+                        new_ks, new_vs, _counts, _lp, _gs, _sg, _sa,
+                    ) = engine._ragged_paged_jit(
+                        engine.params,
+                        jnp.asarray(np.zeros(tpad, np.int32)),
+                        jnp.asarray(np.zeros(tpad, np.int32)),
+                        jnp.asarray(np.zeros(tpad, np.int32)),
+                        jnp.asarray(np.zeros(tpad, bool)),
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        cache.k, cache.v, cache.k_scale, cache.v_scale,
+                        page_table,
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        jnp.asarray(np.zeros(tpad, np.int32)),
+                        jnp.asarray(np.zeros(tpad, np.int32)),
+                        blocks[0], blocks[1],
+                        jnp.asarray(np.zeros(b, bool)),
+                        sampling, key(), lora,
+                        None, None, None, None, None,
+                        want_lp=False,
+                        spec=spec_args(spec_on),
+                        chain=chain,
+                    )
+                    if engine._paged_quant:
+                        cache.k_scale = new_ks
+                        cache.v_scale = new_vs
+                jax.block_until_ready(sampled)
+                ran += 1
+    else:
+        # dense ragged: the rectangular chunk width C is its own compile
+        # key (pow2 of the widest row — admission takes up to the budget),
+        # so the full certification sweeps every reachable width per
+        # (window, spec) variant; spec variants start at the k+1-wide
+        # chunks serve guarantees them
+        from .shapes import pow2_bucket
+
+        widths = []
+        c = 1
+        cap = pow2_bucket(engine._step_token_budget)
+        while c <= cap:
+            widths.append(c)
+            c *= 2
+        for steps in windows:
+            for spec_on in spec_opts:
+                chain = None
+                if steps > 1:
+                    chain = (
+                        jnp.stack([key() for _ in range(steps - 1)]),
+                        jnp.asarray(np.zeros((steps - 1, b), bool)),
+                    )
+                for c in widths:
+                    if spec_on and c < k_ + 1:
+                        continue
+                    (
+                        sampled, _logits, engine.cache,
+                        _counts, _lp, _gs, _sg, _sa,
+                    ) = engine._ragged_dense_jit(
+                        engine.params,
+                        jnp.asarray(np.zeros((b, c), np.int32)),
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        jnp.asarray(np.zeros(b, np.int32)),
+                        jnp.asarray(np.zeros(b, bool)),
+                        engine.cache,
+                        jnp.asarray(np.zeros(b, bool)),
+                        sampling, key(), lora,
+                        None, None, None, None, None,
+                        want_lp=False,
+                        spec=spec_args(spec_on),
+                        chain=chain,
+                    )
+                    jax.block_until_ready(sampled)
+                    ran += 1
+    return ran
+
+
 async def run_warmup(
     engine,
     full: bool = True,
@@ -268,6 +407,19 @@ async def run_warmup(
         while p <= engine.max_batch:
             engine._gather_finish_jit(logits, jnp.zeros((p,), jnp.int32))
             p *= 2
+
+    # multi-step / spec-as-row ragged launch variants
+    # (docs/ragged_attention.md): the per-launch decode window buckets to a
+    # power of two (llm/shapes.decode_steps_bucket) and spec-verify rows
+    # toggle the k+1 logit-gather + acceptance trace — each (window, spec)
+    # pair is a distinct executable on the serve path. The traffic sweep
+    # above only reliably drives the q=1 no-spec launch (sequential
+    # requests rarely overlap), so the remaining variants warm DIRECTLY
+    # with null-row operands: every write coordinate targets the dead null
+    # page (page 0) / a dead position, every mask is False, and the pools
+    # round-trip through the donated call like any dispatch.
+    if full and engine._ragged:
+        warm_ragged_variants(engine)
 
     await engine.wait_drained()
     fenced = False

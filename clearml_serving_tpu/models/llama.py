@@ -1470,19 +1470,28 @@ def build(config: dict) -> SimpleNamespace:
         *,
         k_scales=None,  # [L, Hkv, N, P] f32 scale pools (kv_quant only)
         v_scales=None,
+        row_logit_idx=None,  # [R, W] int32 flat token indices to read
+                             # logits at (None = row_last only)
     ):
         """ONE forward step over a ragged mixed batch: each row is at an
-        arbitrary phase — decode rows contribute one query token, prefill
-        rows a prompt chunk — flattened into a token-major operand
-        (PAPERS.md "Ragged Paged Attention"). Every token embeds at its own
-        absolute position, writes its K/V into the paged pools at
-        host-precomputed (page, offset) coords — the same scatter as
-        decode_paged, with the chunk's quantized scales beside int8 pages —
-        and attends through ops.ragged_paged_attention with per-row causal
-        bounds. Returns (row logits [R, vocab] at each row's last real
-        token, updated pools); a decode row's logits are numerically the
-        decode path's logits, which is what the engine's ragged-vs-two-
-        dispatch byte-identity rests on."""
+        arbitrary phase — decode rows contribute one query token (plus
+        reserved multi-step pad positions), spec-verify rows a known
+        draft chain of q=k+1 candidate tokens, prefill rows a prompt
+        chunk — flattened into a token-major operand (PAPERS.md "Ragged
+        Paged Attention"). Every token embeds at its own absolute
+        position, writes its K/V into the paged pools at host-precomputed
+        (page, offset) coords — the same scatter as decode_paged, with
+        the chunk's quantized scales beside int8 pages — and attends
+        through ops.ragged_paged_attention with per-row causal bounds.
+        Returns (row logits [R, vocab] at each row's last real token,
+        updated pools); when ``row_logit_idx`` [R, W] is given, the
+        spec-verify gather ([R, W, vocab] logits at the W requested flat
+        positions per row — a draft chain needs logits at EVERY candidate
+        position, not just the last) is returned BESIDE the last-token
+        logits, whose compute path stays byte-for-byte the default one:
+        ((last, gathered), *pools). A decode row's logits are numerically
+        the decode path's logits, which is what the engine's
+        ragged-vs-two-dispatch byte-identity rests on."""
         from ..ops.paged_attention import ragged_paged_attention
 
         if kv_quant and k_scales is None:
@@ -1564,17 +1573,33 @@ def build(config: dict) -> SimpleNamespace:
             )
         last_x = x[:, 0][row_last][:, None]                    # [R, 1, dim]
         logits = _logits(params, last_x)[:, 0]                 # [R, vocab]
+        if row_logit_idx is not None:
+            # spec-verify gather: [R, W] flat indices -> [R, W, vocab].
+            # W is small (k+1), so the extra lm_head rows cost R*W matvecs,
+            # never a T-wide logits materialization. The last-token logits
+            # keep their own (unchanged) compute path so every non-verify
+            # consumer stays bitwise identical across spec/no-spec launches.
+            sel_x = x[:, 0][row_logit_idx]                     # [R, W, dim]
+            gathered = _logits(params, sel_x)                  # [R, W, vocab]
+            return ((logits, gathered),) + tuple(new_pools)
         return (logits,) + tuple(new_pools)
 
     def forward_ragged_dense(params, tokens, start, last_rel, row_active,
-                             cache, lora_idx=None):
+                             cache, lora_idx=None, *, logit_rel=None):
         """Dense-cache ragged step (docs/ragged_attention.md): the mixed
         batch takes the RECTANGULAR chunk layout — tokens [B, C] where
-        decode rows carry one real token, prefill rows a prompt chunk, and
+        decode rows carry one real token, spec-verify rows a known
+        draft chain (k+1 tokens), prefill rows a prompt chunk, and
         idle rows garbage their frozen length masks. Each row's chunk
         writes at its own absolute positions (the chunked-prefill layer
         loop) and attends causally over its slot's cache; logits return at
-        ``last_rel`` and lengths advance only where ``row_active``."""
+        ``last_rel`` — plus, when ``logit_rel`` [B, W] is given, the
+        spec-verify gather at the W requested chunk-relative positions per
+        row (``(last [B, vocab], gathered [B, W, vocab])``; the last-token
+        path stays byte-for-byte the default one) — and lengths advance
+        only where ``row_active`` (a spec caller re-clamps verify rows'
+        lengths to the accepted prefix itself, the :func:`verify`
+        contract)."""
         b, c = tokens.shape
         ffn_valid = (
             jnp.arange(c, dtype=jnp.int32)[None] <= last_rel[:, None]
@@ -1583,16 +1608,21 @@ def build(config: dict) -> SimpleNamespace:
             params, tokens, start, cache, ffn_kwargs={"valid": ffn_valid},
             lora_idx=lora_idx,
         )
-        last_x = jnp.take_along_axis(
-            x, last_rel[:, None, None].clip(0, c - 1), axis=1
-        )                                                      # [B, 1, dim]
-        last = _logits(params, last_x)[:, 0]                   # [B, vocab]
         new_len = jnp.maximum(
             cache["length"], start + last_rel + 1
         ).astype(jnp.int32)
         cache = dict(
             new_kv, length=jnp.where(row_active, new_len, cache["length"])
         )
+        last_x = jnp.take_along_axis(
+            x, last_rel[:, None, None].clip(0, c - 1), axis=1
+        )                                                      # [B, 1, dim]
+        last = _logits(params, last_x)[:, 0]                   # [B, vocab]
+        if logit_rel is not None:
+            sel_x = jnp.take_along_axis(
+                x, logit_rel[:, :, None].clip(0, c - 1), axis=1
+            )                                                  # [B, W, dim]
+            return (last, _logits(params, sel_x)), cache
         return last, cache
 
     def prepare_params(params):

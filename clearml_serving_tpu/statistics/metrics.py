@@ -397,7 +397,8 @@ class EngineLifecycleCollector(_KeyedCollector):
         step_rows = CounterMetricFamily(
             p + "_step_rows",
             "rows carried by ragged mixed launches, by phase "
-            "(prefill = admission chunk rows, decode = one-token rows)",
+            "(prefill = admission chunk rows, decode = multi-step token "
+            "windows, spec_verify = q=k+1 draft-chain verify rows)",
         )
         ragged_jobs = GaugeMetricFamily(
             p + "_ragged_prefill_jobs",
@@ -407,6 +408,20 @@ class EngineLifecycleCollector(_KeyedCollector):
             p + "_step_token_budget",
             "effective ragged step token budget (brownout stage 3 shrinks "
             "it)",
+        )
+        # multi-step decode rows + spec-as-row (docs/ragged_attention.md):
+        # tokens advanced per mixed launch (the dispatch-bubble
+        # amortization headline — 1/mean is dispatches-per-decode-token)
+        # and the per-launch accepted-draft fraction over verify rows
+        tokens_per_launch = HistogramMetricFamily(
+            p + "_decode_tokens_per_launch",
+            "decode tokens advanced per ragged mixed launch (multi-step "
+            "windows + accepted spec tokens)",
+        )
+        spec_accept = HistogramMetricFamily(
+            p + "_spec_acceptance_rate",
+            "per ragged launch: mean accepted-draft fraction over its "
+            "spec verify rows (accepted / spec_k)",
         )
         # paged KV pool capacity (docs/paged_kv_quant.md): bytes split by
         # kind (kv = data planes, scale = int8 dequant scale rows) plus an
@@ -521,6 +536,12 @@ class EngineLifecycleCollector(_KeyedCollector):
                     gauge(ragged_jobs, key, s, ragged["prefill_jobs"])
                 if "effective_budget" in ragged:
                     gauge(ragged_budget, key, s, ragged["effective_budget"])
+                snap = ragged.get("tokens_per_launch")
+                if snap:
+                    hist(tokens_per_launch, key, s, snap)
+                snap = ragged.get("spec_acceptance")
+                if snap:
+                    hist(spec_accept, key, s, snap)
             pipe = s.get("pipeline") or {}
             if pipe:
                 any_pipeline = True
@@ -590,6 +611,8 @@ class EngineLifecycleCollector(_KeyedCollector):
             yield step_rows
             yield ragged_jobs
             yield ragged_budget
+            yield tokens_per_launch
+            yield spec_accept
         if any_kv_pool:
             yield kv_pool_bytes
             yield kv_pool_dtype

@@ -285,6 +285,55 @@ def test_engine_warmup_fence_and_seeded_shape_drift(parts, monkeypatch):
         sentry.reset(strict=False)
 
 
+def test_warmup_covers_ragged_multistep_and_spec_rows(parts, monkeypatch):
+    """Multi-step / spec-as-row compile surface (docs/ragged_attention.md):
+    a ragged paged engine with speculation warms every (decode window,
+    spec-row) launch variant through warmup.warm_ragged_variants — novel
+    OVERLAPPING traffic (q=4 windows beside admission chunk rows, spec
+    verify rows in pure-decode phases) then compiles NOTHING under the
+    strict fence."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    monkeypatch.setenv("TPUSERVE_COMPILE_SENTRY", "strict")
+    sentry = compile_sentry.get()
+    sentry.reset(strict=True)
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=128,
+        prefill_buckets=[32, 64], eos_token_id=None, decode_steps=4,
+        ragged_decode_steps=4, cache_mode="paged", page_size=16,
+        scheduler="ragged", step_token_budget=32,
+        speculation="ngram", spec_k=2, spec_ngram=2, pipeline_depth=1,
+    )
+
+    async def run():
+        stats = await engine.warmup(full=True)
+        assert stats["fenced"]
+        # overlapped: a live decode stream rides q>1 windows while the
+        # long prompt admits as chunk rows of the same launches
+        a = GenRequest(
+            prompt_ids=[5, 9, 2, 17, 5, 9, 2], max_new_tokens=24
+        )
+        a_task = asyncio.get_running_loop().create_task(_collect(engine, a))
+        while a.produced < 2:
+            await asyncio.sleep(0.005)
+        await _collect(engine, GenRequest(
+            prompt_ids=[(i * 7 + 3) % 250 + 1 for i in range(40)],
+            max_new_tokens=6,
+        ))
+        await a_task
+        await engine.wait_drained()
+        ragged = engine.lifecycle_stats()["ragged"]
+        assert ragged["step_rows"]["spec_verify"] >= 1
+        assert ragged["tokens_per_launch"]["count"] >= 1
+        assert sentry.post_fence_compiles == 0, sentry.stats()["events"][-5:]
+
+    try:
+        asyncio.run(run())
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)
+
+
 def test_warmup_registry_covers_all_dispatch_paths_paged(parts, monkeypatch):
     """Full coverage certification: a paged+prefix-cache engine, the FULL
     warmup sweep, then novel random-length traffic with shared prefixes

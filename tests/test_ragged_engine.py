@@ -153,9 +153,11 @@ def test_ragged_prefix_cache_tail_chunks(parts, monkeypatch):
 
 
 def test_ragged_speculation_composes(parts):
-    """Spec decode runs in the pure-decode phases between admissions (the
-    jobs drain first); greedy streams stay identical to the plain ragged
-    engine."""
+    """Spec-as-row (ISSUE 13): under the ragged scheduler, speculation is a
+    ROW SHAPE — eligible slots ride the mixed launches as q=k+1 verify
+    rows instead of draining the pipeline into the legacy serial scan.
+    Greedy streams stay identical to the plain ragged engine (the verify
+    guarantee), and the launches actually carry spec_verify rows."""
     bundle, _, params = parts
     prompt = [5, 9, 2, 17, 5, 9, 2]
     plain = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
@@ -166,8 +168,151 @@ def test_ragged_speculation_composes(parts):
                    step_token_budget=12, speculation="ngram", spec_k=2,
                    spec_ngram=2)
     got = _staggered(spec, [prompt], n=8)
+    stats = spec.lifecycle_stats()["ragged"]
     spec.stop()
     assert got == want
+    assert stats["step_rows"]["spec_verify"] >= 1
+    assert stats["spec_acceptance"]["count"] >= 1
+
+
+def _overlapped(engine, n_a=24, n_b=8, seed_b=22):
+    """A greedy decode stream that is PROVABLY mid-flight when a seeded
+    long-prompt admission arrives — the mixed launches carry the decode
+    row beside the admission's chunk rows for several steps."""
+
+    async def run():
+        a = GenRequest(prompt_ids=list(SHORT), max_new_tokens=n_a)
+        a_task = asyncio.create_task(_collect_async(engine, a))
+        while a.produced < 2:
+            await asyncio.sleep(0.005)
+        b = GenRequest(
+            prompt_ids=list(LONG), max_new_tokens=n_b,
+            temperature=0.7 if seed_b is not None else 0.0, seed=seed_b,
+        )
+        out_b = [t async for t in engine.generate(b)]
+        out_a = await a_task
+        await engine.wait_drained()
+        return [out_a, out_b]
+
+    return asyncio.run(run())
+
+
+def test_ragged_multistep_byte_identity(parts, monkeypatch):
+    """Multi-step decode rows (ISSUE 13 tentpole): q=decode_steps windows
+    chain sampled tokens device-side inside ONE mixed launch. Greedy +
+    seeded streams at ragged window ∈ {2, 4} equal the q=1 ragged streams
+    AND the legacy two-dispatch streams exactly — dense + paged, armed
+    sanitizer."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    for cache_mode, depth in (("dense", 1), ("paged", 2)):
+        legacy = _engine(bundle, params, chunked_prefill_size=4,
+                         cache_mode=cache_mode, pipeline_depth=depth,
+                         decode_steps=4)
+        want = _overlapped(legacy)
+        legacy.stop()
+        for q in (1, 2, 4):
+            ragged = _engine(bundle, params, scheduler="ragged",
+                             step_token_budget=24, cache_mode=cache_mode,
+                             pipeline_depth=depth, decode_steps=4,
+                             ragged_decode_steps=q)
+            got = _overlapped(ragged)
+            stats = ragged.lifecycle_stats()["ragged"]
+            ragged.stop()
+            assert got == want, (cache_mode, depth, q)
+            if q > 1:
+                # the window actually engaged: some launch advanced a
+                # decode row by more than one token
+                snap = stats["tokens_per_launch"]
+                assert snap["count"] >= 1, (cache_mode, q)
+                assert snap["sum_ms"] > snap["count"], (cache_mode, q)
+
+
+def test_ragged_multistep_int8_kv(parts, monkeypatch):
+    """int8 KV through multi-step windows: the chained steps quantize each
+    token's K/V via the same _kv_store math as the q=1 path — streams
+    match the fully-chunked two-dispatch arm on both backends."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    _, qbundle, params = parts
+    for cache_mode in ("dense", "paged"):
+        a, b, _ = _ab(
+            qbundle, params, [SHORT, LONG], cache_mode=cache_mode,
+            legacy_kw={"decode_steps": 4},
+            ragged_kw={"decode_steps": 4, "ragged_decode_steps": 4},
+        )
+        assert a == b, cache_mode
+
+
+def test_ragged_multistep_logprobs(parts):
+    """Per-step logprob entries through a q=4 window equal the q=1 ones
+    (the lp triple is chained step-major through the in-launch scan)."""
+    bundle, _, params = parts
+
+    def run(q):
+        engine = _engine(bundle, params, cache_mode="paged",
+                         scheduler="ragged", step_token_budget=24,
+                         decode_steps=4, ragged_decode_steps=q)
+
+        async def go():
+            a = GenRequest(prompt_ids=list(SHORT), max_new_tokens=6,
+                           logprobs=2)
+            b = GenRequest(prompt_ids=list(LONG), max_new_tokens=4)
+
+            async def one(req, delay):
+                if delay:
+                    await asyncio.sleep(delay)
+                return [t async for t in engine.generate(req)]
+
+            outs = await asyncio.gather(one(a, 0), one(b, 0.05))
+            await engine.wait_drained()
+            return outs, list(a.logprob_entries)
+
+        outs, entries = asyncio.run(go())
+        engine.stop()
+        return outs, entries
+
+    outs1, entries1 = run(1)
+    outs4, entries4 = run(4)
+    assert outs1 == outs4
+    assert entries1 == entries4
+    assert len(entries1) == 6
+
+
+def test_spec_as_row_matches_legacy_spec(parts):
+    """Spec-as-row reproduces the legacy serial spec path's accepted
+    streams (greedy): the two-dispatch engine's draft-verify scan and the
+    ragged engine's in-launch verify rows emit identical tokens, and the
+    ragged engine never touches the serial scan path."""
+    bundle, _, params = parts
+    prompts = [[5, 9, 2, 17, 5, 9, 2], [3, 3, 7, 3, 3, 7, 3]]
+    legacy = _engine(bundle, params, cache_mode="paged",
+                     chunked_prefill_size=4, speculation="ngram",
+                     spec_k=2, spec_ngram=2)
+    want = _staggered(legacy, prompts, n=10)
+    legacy.stop()
+    ragged = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=12, speculation="ngram", spec_k=2,
+                     spec_ngram=2)
+
+    def boom(*a, **k):  # the drain-and-scan path must be dead here
+        raise AssertionError(
+            "legacy serial spec scan ran under the ragged scheduler"
+        )
+
+    ragged._dispatch_spec_paged_chunk = boom
+    ragged._dispatch_spec_chunk = boom
+    got = _staggered(ragged, prompts, n=10)
+    stats = ragged.lifecycle_stats()["ragged"]
+    ragged.stop()
+    assert got == want
+    assert stats["step_rows"]["spec_verify"] >= 1
+
+
+def test_ragged_decode_steps_validation(parts):
+    bundle, _, params = parts
+    with pytest.raises(ValueError, match="ragged_decode_steps"):
+        _engine(bundle, params, scheduler="ragged", step_token_budget=16,
+                decode_steps=2, ragged_decode_steps=8)
 
 
 def test_ragged_budget_validation(parts):
@@ -188,7 +333,13 @@ def test_ragged_health_and_stats_blocks(parts):
         assert h["ragged"]["step_token_budget"] == 16
         s = engine.lifecycle_stats()["ragged"]
         assert s["budget_utilization"]["count"] == 0
-        assert s["step_rows"] == {"prefill": 0, "decode": 0}
+        assert s["step_rows"] == {
+            "prefill": 0, "decode": 0, "spec_verify": 0,
+        }
+        assert s["decode_steps"] == 2        # inherited from decode_steps
+        assert s["decode_tokens"] == 0
+        assert s["tokens_per_launch"]["count"] == 0
+        assert s["spec_acceptance"]["count"] == 0
     finally:
         engine.stop()
     legacy = _engine(bundle, params)
@@ -257,6 +408,161 @@ def test_chaos_fault_mid_ragged_dispatch_isolates_job(parts, monkeypatch):
 
 async def _collect_async(engine, req):
     return [t async for t in engine.generate(req)]
+
+
+@pytest.mark.chaos
+def test_chaos_retire_fault_on_spec_row_stays_per_request(parts, monkeypatch):
+    """A per-request ``engine.decode.retire`` fault landing on a SPEC
+    verify row fails only that request — including the zero-accepted case
+    (window == 1, immediate fail): the failed slot's pages free wholesale
+    and the retire's truncate pass must skip it instead of raising out of
+    the step and failing the whole batch. The sibling stream completes
+    byte-identically and nothing leaks."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    marker = 201
+    # draft-hostile prompt (no n-gram repeats): acceptance ~1/vocab, so
+    # the faulted verify row's window is (almost surely) a single token
+    hostile = [marker, 7, 31, 5, 47, 13]
+    sibling = [3, 3, 7, 3, 3, 7, 3]
+
+    clean = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                    step_token_budget=16, speculation="ngram", spec_k=2,
+                    spec_ngram=2)
+    want = _staggered(clean, [sibling], n=10)[0]
+    clean.stop()
+
+    engine = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=16, speculation="ngram", spec_k=2,
+                     spec_ngram=2)
+    try:
+
+        async def tolerant(req):
+            out = []
+            try:
+                async for t in engine.generate(req):
+                    out.append(t)
+            except Exception as ex:
+                return out, ex
+            return out, None
+
+        async def run():
+            a = GenRequest(prompt_ids=list(hostile), max_new_tokens=10)
+            b = GenRequest(prompt_ids=list(sibling), max_new_tokens=10)
+            a_task = asyncio.create_task(tolerant(a))
+            b_task = asyncio.create_task(tolerant(b))
+            while a.produced < 1 or b.produced < 1:
+                await asyncio.sleep(0.005)
+            faults.configure([
+                {"point": "engine.decode.retire", "action": "raise",
+                 "match_token": marker, "times": 1},
+            ])
+            out_a, a_err = await asyncio.wait_for(a_task, 60)
+            out_b, b_err = await asyncio.wait_for(b_task, 60)
+            await engine.wait_drained()
+            return out_a, a_err, out_b, b_err
+
+        out_a, a_err, out_b, b_err = asyncio.run(run())
+        from clearml_serving_tpu.errors import EngineStepError
+
+        assert isinstance(a_err, EngineStepError)   # only the matched row
+        assert b_err is None
+        assert out_b == want                        # sibling untouched
+        assert engine.counters["step_failures"] == 1
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1  # nothing leaked
+    finally:
+        faults.clear()
+        engine.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_retire_fault_mid_multistep_window(parts, monkeypatch):
+    """A per-request ``engine.decode.retire`` fault landing on a q>1 decode
+    row fails ONLY that request, with its PARTIAL window delivered (all
+    but the last token — the tokens were already sampled device-side; the
+    failure is a host-emission failure): the delivered stream is a strict
+    prefix of the undisturbed run, the concurrent admission completes
+    untouched, and no pages leak under the armed sanitizer."""
+    monkeypatch.setenv("TPUSERVE_SANITIZE", "1")
+    bundle, _, params = parts
+    marker = SHORT[0]  # matches the DECODING request
+
+    clean = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                    step_token_budget=64, decode_steps=4,
+                    ragged_decode_steps=4, max_seq_len=160)
+    want = _overlapped(clean, n_a=48, n_b=12, seed_b=None)
+    clean.stop()
+
+    engine = _engine(bundle, params, cache_mode="paged", scheduler="ragged",
+                     step_token_budget=64, decode_steps=4,
+                     ragged_decode_steps=4, max_seq_len=160)
+    # deterministic window accounting: record the poisoned row's produced
+    # count and window size at the retire the fault fires in
+    seen = {}
+    real_retire = engine._retire_ragged
+
+    def spy(plan, result):
+        if faults.active() and not seen:
+            for slot, request in enumerate(engine._slot_req):
+                if request is not None and marker in request.prompt_ids:
+                    if plan["row_steps"][slot] > 1:
+                        seen["produced"] = request.produced
+                        seen["steps"] = int(plan["row_steps"][slot])
+        return real_retire(plan, result)
+
+    engine._retire_ragged = spy
+    try:
+
+        async def tolerant(req):
+            out = []
+            try:
+                async for t in engine.generate(req):
+                    out.append(t)
+            except Exception as ex:
+                return out, ex
+            return out, None
+
+        async def run():
+            a = GenRequest(prompt_ids=list(SHORT), max_new_tokens=48)
+            a_task = asyncio.create_task(tolerant(a))
+            while a.produced < 2:
+                await asyncio.sleep(0.005)
+            # the admission makes the loop take ragged steps; with this
+            # much budget the decode row rides them as a q=4 window —
+            # arm the poison only now, so it lands on a q>1 retire
+            b_task = asyncio.create_task(tolerant(
+                GenRequest(prompt_ids=list(LONG), max_new_tokens=12)
+            ))
+            # a outlives the admission (48 tokens): the poisoned retire is
+            # guaranteed to carry its decode row
+            while not engine._prefill_jobs:
+                await asyncio.sleep(0.002)
+            faults.configure([
+                {"point": "engine.decode.retire", "action": "raise",
+                 "match_token": marker, "times": 1},
+            ])
+            out_a, a_err = await asyncio.wait_for(a_task, 60)
+            out_b, b_err = await asyncio.wait_for(b_task, 60)
+            await engine.wait_drained()
+            return out_a, a_err, out_b, b_err
+
+        out_a, a_err, out_b, b_err = asyncio.run(run())
+        from clearml_serving_tpu.errors import EngineStepError
+
+        assert isinstance(a_err, EngineStepError)
+        assert b_err is None
+        # partial window: tokens before the poisoned launch plus all but
+        # the last token of its window, a strict prefix of the clean run
+        assert seen, "fault never landed on a q>1 window"
+        assert out_a == want[0][: seen["produced"] + seen["steps"] - 1]
+        assert seen["steps"] > 1
+        assert out_b == want[1]       # the admission was untouched
+        pool = engine.paged_cache.pool
+        assert pool.free_pages == pool.num_pages - 1  # nothing leaked
+    finally:
+        faults.clear()
+        engine.stop()
 
 
 @pytest.mark.chaos
@@ -367,9 +673,12 @@ def test_ragged_retire_reads_back_only_finishing_rows(parts, monkeypatch):
 
 def test_ragged_ab_artifact_schema():
     """benchmarks/RAGGED_AB_cpu.json (committed by ``bench.py --ragged-ab``)
-    carries the acceptance headline: byte-identical streams across
+    carries the acceptance headlines: byte-identical streams across
     schedulers and decode-stall-during-admission STRICTLY below the
-    two-dispatch arm (ISSUE 9 acceptance)."""
+    two-dispatch arm (ISSUE 9), plus the ISSUE-13 arms — the
+    ``--decode-steps`` q=1-vs-q=4 A/B (dispatches-per-decode-token < 0.5
+    at q=4, tok/s no worse than q=1, identical streams) and spec-as-row
+    vs the legacy serial scan (identical streams, acceptance measured)."""
     path = REPO / "benchmarks" / "RAGGED_AB_cpu.json"
     row = json.loads(path.read_text())
     assert row["metric"] == "llm_ragged_scheduler_ab_cpusmoke"
@@ -383,3 +692,21 @@ def test_ragged_ab_artifact_schema():
         assert row[arm]["admit_ttft_ms"] > 0
         assert row[arm]["ttft_p99_ms"] >= row[arm]["ttft_p50_ms"]
         assert 0 < row[arm]["occupancy"] <= row["batch"]
+    # ISSUE 13: multi-step decode rows kill the per-launch decode bubble
+    ds = row["decode_steps_ab"]
+    q = ds["decode_steps"]
+    assert ds["identical_tokens"] is True
+    assert ds["q{}".format(q)]["dispatches_per_decode_token"] < 0.5
+    assert (
+        ds["q{}".format(q)]["dispatches_per_decode_token"]
+        < ds["q1"]["dispatches_per_decode_token"]
+    )
+    assert ds["q{}".format(q)]["tok_s"] >= ds["q1"]["tok_s"]
+    # ISSUE 13: spec rides mixed launches as verify rows — stream
+    # identity with the legacy serial scan is the certified property
+    # (the CPU tok/s comparison is reference-path-bound by construction;
+    # see run_spec_row_ab's docstring)
+    sr = row["spec_row_ab"]
+    assert sr["identical_tokens"] is True
+    assert sr["spec_as_row"]["spec_verify_rows"] >= 1
+    assert 0 <= sr["spec_as_row"]["acceptance_mean"] <= 1

@@ -604,6 +604,112 @@ def test_brownout_stage3_shrinks_ragged_step_token_budget(parts):
         engine.stop()
 
 
+def test_brownout_stage3_budget_accounts_multi_token_rows(parts):
+    """ISSUE 13 satellite: a q=4 decode row is FOUR tokens of the step
+    budget. Under the stage-3 shrunken budget the planner collapses the
+    multi-step windows until the launch's token demand fits — decode
+    keeps draining, admissions keep their minimal chunk, and nothing
+    over-commits the brownout ceiling."""
+    import numpy as np
+
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=6, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, decode_steps=4, scheduler="ragged",
+        step_token_budget=64, cache_mode="paged",
+        brownout=True, brownout_dwell=120.0,
+    )
+    try:
+        for slot in range(6):
+            req = GenRequest(prompt_ids=[1, 2, 3], max_new_tokens=50)
+            req.prompt_len = 3
+            req.produced = 1
+            engine._slot_req[slot] = req
+            engine.paged_cache.pool.allocate(slot, 3)
+        active = np.ones(6, bool)
+        plan = engine._prepare_ragged(active, 0)
+        assert plan["launch_steps"] == 4
+        assert plan["used_tokens"] == 6 * 4
+        assert plan["used_tokens"] <= engine._effective_token_budget()
+        engine._brownout.stage = 3
+        engine._brownout._changed_at = time.monotonic()
+        eff = engine._effective_token_budget()
+        assert eff < 64
+        plan = engine._prepare_ragged(active, 0)
+        assert plan["launch_steps"] < 4, "windows must collapse at stage 3"
+        assert plan["used_tokens"] <= eff
+        engine._brownout.stage = 0
+        plan = engine._prepare_ragged(active, 0)
+        assert plan["launch_steps"] == 4  # restored with the stage drop
+    finally:
+        for slot in range(6):
+            engine._slot_req[slot] = None
+            engine.paged_cache.pool.free(slot)
+        engine.stop()
+
+
+def test_brownout_stage2_cap_clamps_ragged_window_midstream(parts):
+    """ISSUE 13 satellite: the stage-2 batch max_new_tokens cap clamps a
+    multi-step window MID-WINDOW — a batch row 30 tokens into a capped-
+    at-32 stream gets a 2-token window, not a full q=4 one (the window
+    never dispatches compute the cap will throw away)."""
+    import numpy as np
+
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, decode_steps=4, scheduler="ragged",
+        step_token_budget=64, cache_mode="paged",
+        brownout=True, brownout_batch_cap=32, brownout_dwell=120.0,
+    )
+    try:
+        req = GenRequest(
+            prompt_ids=[1, 2, 3], max_new_tokens=50, priority="batch"
+        )
+        req.prompt_len = 3
+        req.produced = 30
+        engine._slot_req[0] = req
+        engine.paged_cache.pool.allocate(0, 32)
+        active = np.array([True, False])
+        plan = engine._prepare_ragged(active, 0)
+        assert plan["row_steps"][0] == 4        # no cap: full window
+        engine._brownout.stage = 2
+        engine._brownout._changed_at = time.monotonic()
+        plan = engine._prepare_ragged(active, 0)
+        assert plan["row_steps"][0] == 2        # cap clamps mid-window
+    finally:
+        engine._slot_req[0] = None
+        engine.paged_cache.pool.free(0)
+        engine.stop()
+
+
+def test_brownout_stage2_cap_exact_with_multi_step_chunks(parts):
+    """Two-dispatch scheduler: the stage-2 cap landing MID-CHUNK of a
+    decode_steps=4 pipelined chunk still delivers exactly the cap (the
+    chunk's surplus tokens are dropped at retire) — the multi-token-chunk
+    analog of the ragged window clamp."""
+    bundle, params = parts
+
+    async def run():
+        engine = LLMEngineCore(
+            bundle, params, max_batch=2, max_seq_len=128,
+            prefill_buckets=[16], eos_token_id=None, decode_steps=4,
+            brownout=True, brownout_batch_cap=5, brownout_dwell=120.0,
+        )
+        engine._brownout.stage = 2
+        engine._brownout._changed_at = time.monotonic()
+        batch = GenRequest(
+            prompt_ids=[1, 2], max_new_tokens=50, priority="batch"
+        )
+        out_b = await _collect(engine, batch)
+        await engine.wait_drained()
+        assert len(out_b) == 5, "cap must bite mid-chunk, surplus dropped"
+        return engine
+
+    engine = asyncio.run(run())
+    engine.stop()
+
+
 def test_brownout_stage3_still_sets_gate_budget_on_two_dispatch(parts):
     """Legacy two-dispatch engines keep the historical gate hook: the
     stage transition shrinks the per-chunk segment budget to 1 and

@@ -525,7 +525,22 @@ def test_engine_ragged_metrics_exported():
                 "sum_ms": 4.25,
                 "count": 7,
             },
-            "step_rows": {"prefill": 9, "decode": 21},
+            "step_rows": {"prefill": 9, "decode": 21, "spec_verify": 4},
+            # multi-step / spec-as-row families (ISSUE 13)
+            "decode_steps": 4,
+            "decode_tokens": 57,
+            "tokens_per_launch": {
+                "buckets": [1, 2, 4, 8, 16, 32, 64],
+                "counts": [1, 1, 3, 2, 0, 0, 0, 0],
+                "sum_ms": 57.0,
+                "count": 7,
+            },
+            "spec_acceptance": {
+                "buckets": [0.0, 0.2, 0.4, 0.6, 0.8, 1.0],
+                "counts": [1, 0, 1, 0, 0, 2, 0],
+                "sum_ms": 2.5,
+                "count": 4,
+            },
         },
     }
     registry = CollectorRegistry()
@@ -536,6 +551,7 @@ def test_engine_ragged_metrics_exported():
 
     assert val("engine_step_rows_total", phase="prefill") == 9
     assert val("engine_step_rows_total", phase="decode") == 21
+    assert val("engine_step_rows_total", phase="spec_verify") == 4
     assert val("engine_ragged_prefill_jobs") == 2
     assert val("engine_step_token_budget") == 48
     # histogram: cumulative buckets + count/sum
@@ -546,6 +562,23 @@ def test_engine_ragged_metrics_exported():
     assert registry.get_sample_value(
         "engine_step_token_budget_utilization_count", {"model": "m1"}
     ) == 7
+    # decode tokens per launch: the dispatch-bubble amortization headline
+    assert registry.get_sample_value(
+        "engine_decode_tokens_per_launch_count", {"model": "m1"}
+    ) == 7
+    assert registry.get_sample_value(
+        "engine_decode_tokens_per_launch_sum", {"model": "m1"}
+    ) == 57.0
+    assert registry.get_sample_value(
+        "engine_decode_tokens_per_launch_bucket", {"model": "m1", "le": "4"}
+    ) == 5
+    # per-launch spec acceptance fraction
+    assert registry.get_sample_value(
+        "engine_spec_acceptance_rate_count", {"model": "m1"}
+    ) == 4
+    assert registry.get_sample_value(
+        "engine_spec_acceptance_rate_bucket", {"model": "m1", "le": "0.4"}
+    ) == 2
 
     # providers without the block (legacy scheduler) skip the families
     registry2 = CollectorRegistry()
@@ -573,6 +606,7 @@ def test_engine_ragged_metrics_exported():
     engine = LLMEngineCore(
         bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
         eos_token_id=None, scheduler="ragged", step_token_budget=8,
+        cache_mode="paged", speculation="ngram", spec_k=2, spec_ngram=2,
     )
     try:
         registry3 = CollectorRegistry()
@@ -581,22 +615,31 @@ def test_engine_ragged_metrics_exported():
         )
 
         async def run():
-            req = GenRequest(prompt_ids=[1, 2, 3, 4, 5], max_new_tokens=3)
+            # a repetitive prompt so the n-gram proposer drafts: decode
+            # rides the mixed launches as spec verify rows
+            req = GenRequest(prompt_ids=[1, 2, 3, 1, 2, 3], max_new_tokens=6)
             out = [t async for t in engine.generate(req)]
             await engine.wait_drained()
             return out
 
         out = asyncio.run(run())
-        assert len(out) == 3
+        assert len(out) == 6
 
         def rval(name, **labels):
             return registry3.get_sample_value(name, {"model": "llm", **labels})
 
         assert rval("engine_step_rows_total", phase="prefill") >= 1
+        assert rval("engine_step_rows_total", phase="spec_verify") >= 1
         assert rval("engine_step_token_budget") == 8
         assert rval("engine_ragged_prefill_jobs") == 0
         assert registry3.get_sample_value(
             "engine_step_token_budget_utilization_count", {"model": "llm"}
+        ) >= 1
+        assert registry3.get_sample_value(
+            "engine_decode_tokens_per_launch_count", {"model": "llm"}
+        ) >= 1
+        assert registry3.get_sample_value(
+            "engine_spec_acceptance_rate_count", {"model": "llm"}
         ) >= 1
     finally:
         engine.stop()
