@@ -1735,8 +1735,10 @@ def _pipeline_ab_smoke() -> None:
     print(json.dumps(row))
 
 
-def _loadtest(smoke: bool, replicas: int = 0) -> None:
-    """``--loadtest [--smoke] [--replicas N]``: loadtest harnesses.
+def _loadtest(smoke: bool, replicas: int = 0,
+              disaggregated: bool = False) -> None:
+    """``--loadtest [--smoke] [--replicas N] [--disaggregated]``:
+    loadtest harnesses.
 
     Without ``--replicas``: the SLO-aware-scheduling loadtest — open-loop
     Poisson mixed-trace replay against the real engine with priority
@@ -1755,11 +1757,22 @@ def _loadtest(smoke: bool, replicas: int = 0) -> None:
     (benchmarks/replica_loadtest.py; docs/replication.md). Headline:
     affine-hit rate, interactive p99 TTFT, aggregate goodput speedup,
     zero sanitizer/sentry violations, zero chaos 503s. Updates
-    benchmarks/LOADTEST_replicas_cpu.json."""
+    benchmarks/LOADTEST_replicas_cpu.json.
+
+    With ``--replicas N --disaggregated``: the disaggregated
+    prefill/decode loadtest — mono vs two-hybrid vs prefill/decode-split
+    replicas with the KV transport shipping admissions' prefix KV
+    (benchmarks/disagg_loadtest.py; docs/disaggregation.md). Headline:
+    ship hit rate >= 0.9, byte-identical streams, zero sanitizer/sentry
+    violations. Updates benchmarks/DISAGG_AB_cpu.json."""
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    if replicas and replicas > 1:
+    if disaggregated:
+        from benchmarks import disagg_loadtest
+
+        row = disagg_loadtest.run(smoke=smoke, replicas=replicas or 2)
+    elif replicas and replicas > 1:
         from benchmarks import replica_loadtest
 
         row = replica_loadtest.run(smoke=smoke, replicas=replicas)
@@ -1894,10 +1907,18 @@ if __name__ == "__main__":
             print("error: --replicas needs >= 2 (the replica loadtest "
                   "always runs its own single-replica arm)", file=sys.stderr)
             sys.exit(2)
+        disaggregated = "--disaggregated" in sys.argv or (
+            os.environ.get("BENCH_LOADTEST_DISAGG", "") in ("1", "true")
+        )
+        if disaggregated and replicas is None:
+            # the disaggregated harness needs a fleet; default to the
+            # committed artifact's 2-replica shape rather than erroring
+            replicas = 2
         _loadtest(
             "--smoke" in sys.argv
             or os.environ.get("BENCH_LOADTEST_SMOKE", "") in ("1", "true"),
             replicas=replicas or 0,
+            disaggregated=disaggregated,
         )
     else:
         try:

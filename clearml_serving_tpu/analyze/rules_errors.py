@@ -48,6 +48,8 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.release",
     "engine.kv.demote",
     "engine.kv.promote",
+    "engine.kv.ship",
+    "engine.kv.receive",
     "engine.compile.bucket",
     "router.pick",
     "router.eject",

@@ -82,6 +82,12 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "_promotions": (
         "dispatch_lock", ("paged_cache", "cache", "paged_kv", "kv_cache"),
     ),
+    # KV-transport receive-slab mailboxes (llm/kv_transport.py,
+    # docs/disaggregation.md): senders on replica loop threads, receivers
+    # on the group's receive worker
+    "_slabs": ("_lock", None),
+    "_slab_pages": ("_lock", None),
+    "_ship_seq": ("_lock", None),
     # SLO scheduler pending-queue state (engine._ClassedPendingQueue,
     # docs/slo_scheduling.md): per-class heaps + starvation counters
     "_heaps": ("_lock", None),

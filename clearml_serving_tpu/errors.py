@@ -126,6 +126,14 @@ class EngineStuckError(RequestError):
     default_retry_after = 5.0
 
 
+class HostTierAutoSizeError(ValueError):
+    """``engine.prefix_cache_host_mb: "auto"`` could not size the host KV
+    tier from /proc/meminfo (non-Linux platform or missing MemAvailable;
+    docs/kv_tiering.md). Raised at engine CONSTRUCTION — endpoint load
+    fails fast naming the knob instead of serving with a tier the operator
+    believes is enabled."""
+
+
 class UpstreamTimeoutError(RequestError):
     """gRPC upstream DEADLINE_EXCEEDED after the retry budget."""
 

@@ -35,6 +35,7 @@ import asyncio
 import contextlib
 import heapq
 import itertools
+import logging
 import os
 import threading
 import time
@@ -151,12 +152,32 @@ class GenRequest:
     # promised to replay. Every queue-exit path must release it
     # (engine._release_resume_pin)
     _resume_pin: Optional[Any] = None
+    # disaggregated prefill/decode (docs/disaggregation.md): the replica
+    # group sets _ship_to on the PREFILL leg's clone (destination decode
+    # replica name — the engine exports the committed prefix pages into a
+    # KV-transport shipment addressed there) and _shipped on the ORIGINAL
+    # request once the leg ran (the decode replica's admission then books
+    # the shipped prefix as a ship hit or a recompute)
+    _ship_to: Optional[str] = None
+    _shipped: bool = False
 
     def cancel(self) -> None:
         self.cancelled = True
 
 
+logger = logging.getLogger(__name__)
+
 _FINISHED = object()
+
+
+class _ShipShim:
+    """Carrier for fault matching on the ``engine.kv.receive`` seam: the
+    receive path has no GenRequest in hand (the group calls it before the
+    stream's admission), so the shim carries the prompt ids for
+    ``match_token`` selection (the router's ``_ReplicaShim`` pattern)."""
+
+    def __init__(self, prompt_ids):
+        self.prompt_ids = list(prompt_ids)
 
 # stop tokens honored by min_tokens suppression per request (requests with
 # more stop ids than this keep finishing on all of them — only the floor's
@@ -168,6 +189,13 @@ _STOP_SLOTS = 8
 # dispatch->sync->emit loop; 2 (default) overlaps chunk N's host readback +
 # emission with chunk N+1's device compute (docs/pipelined_decode.md)
 _DEFAULT_PIPELINE_DEPTH = 2
+
+# host-tier auto-sizing clamps (aux engine.prefix_cache_host_mb: "auto",
+# docs/kv_tiering.md): half of /proc/meminfo MemAvailable, bounded so a
+# tiny CI box still gets a usable tier and a 1 TiB host does not
+# preallocate absurd slabs
+_AUTO_HOST_TIER_MIN_BYTES = 64 << 20
+_AUTO_HOST_TIER_MAX_BYTES = 16 << 30
 
 
 def _env_pipeline_depth() -> int:
@@ -697,24 +725,44 @@ class LLMEngineCore:
         self.cache_mode = cache_mode
         # host-tier knob validation (docs/kv_tiering.md): a budget that
         # silently does nothing reads as "tiering on" to the operator —
-        # fail at construction (= endpoint load) naming the knob instead
-        if prefix_cache_host_bytes and not prefix_cache_host_pages:
+        # fail at construction (= endpoint load) naming the knob instead.
+        # "auto" sizes the tier from /proc/meminfo at construction
+        # (clamped; HostTierAutoSizeError names unsupported platforms).
+        host_auto = (
+            isinstance(prefix_cache_host_bytes, str)
+            and prefix_cache_host_bytes.strip().lower() == "auto"
+        )
+        if isinstance(prefix_cache_host_bytes, str) and not host_auto:
+            raise ValueError(
+                "prefix_cache_host_bytes (aux engine.prefix_cache_host_mb) "
+                "must be a size or 'auto': got {!r}".format(
+                    prefix_cache_host_bytes
+                )
+            )
+        if host_auto and prefix_cache_host_pages:
+            raise ValueError(
+                "prefix_cache_host_mb='auto' derives the host page count "
+                "itself; drop engine.prefix_cache_host_pages (or set an "
+                "explicit size)"
+            )
+        if prefix_cache_host_bytes and not host_auto \
+                and not prefix_cache_host_pages:
             raise ValueError(
                 "prefix_cache_host_bytes (aux engine.prefix_cache_host_mb) "
                 "is set but the host tier is disabled: set "
                 "prefix_cache_host_pages (aux "
                 "engine.prefix_cache_host_pages) to enable it"
             )
-        if prefix_cache_host_pages and (
+        if (prefix_cache_host_pages or host_auto) and (
             cache_mode != "paged"
             or not prefix_cache
             or not hasattr(bundle, "prefill_chunk")
         ):
             raise ValueError(
-                "prefix_cache_host_pages needs cache_mode='paged' and a "
-                "prefix_cache on a bundle with prefill_chunk (the host "
-                "tier spills the paged radix prefix cache; "
-                "docs/kv_tiering.md)"
+                "prefix_cache_host_pages (or prefix_cache_host_mb='auto') "
+                "needs cache_mode='paged' and a prefix_cache on a bundle "
+                "with prefill_chunk (the host tier spills the paged radix "
+                "prefix cache; docs/kv_tiering.md)"
             )
         # -- ragged scheduling (docs/ragged_attention.md) ------------------
         # resolved EARLY: the dense cache slack and the prefill gate both
@@ -1205,6 +1253,30 @@ class LLMEngineCore:
         # completed promotion DMAs are observed at retire boundaries
         self._tier_counters = {"reaps": 0}
 
+        # -- disaggregated prefill/decode (docs/disaggregation.md) ---------
+        # KV-transport endpoint + role, attached by the replica group
+        # (attach_kv_transport); None = monolithic engine, every ship/
+        # receive path short-circuits. Counters are plain GIL-atomic bumps:
+        # ships land on the loop thread (commit), receives on the group's
+        # receive worker, hit/recompute accounting on admission workers.
+        self._kv_transport = None
+        self.replica_role = "hybrid"
+        self._kv_ship_stats = {
+            "ships": 0,            # shipments exported + sent
+            "ship_pages": 0,
+            "ship_drops": 0,       # transport full / injected ship fault
+            "receives": 0,         # shipments imported into the cache
+            "receive_pages": 0,
+            "receive_empty": 0,    # nothing queued for the key
+            "receive_failures": 0, # fault/pool/geometry -> dropped
+            "hits": 0,             # shipped request admitted over the
+            "recomputes": 0,       # shipped prefix vs. recomputed it
+        }
+        # ship (export+send, loop thread) / receive (import, group worker)
+        # wall-time — engine_kv_ship_ms{direction} in statistics/metrics.py
+        self._hist_ship_ms = _MsHistogram()
+        self._hist_receive_ms = _MsHistogram()
+
         # -- compiled functions --------------------------------------------
         # frozen config the traced closures need is captured as LOCALS, not
         # read off self: a jitted function that closes over self bakes the
@@ -1315,6 +1387,22 @@ class LLMEngineCore:
                 # leaf-LRU eviction then spills to host RAM instead of
                 # dropping, and warm TTFT becomes capacity-planned
                 tier_backend = None
+                if host_auto:
+                    # size the tier from MemAvailable at CONSTRUCTION
+                    # (docs/kv_tiering.md): half of what the host reports,
+                    # clamped, converted through the true per-page bytes the
+                    # pools themselves define. Off-Linux the probe raises
+                    # the named HostTierAutoSizeError — endpoint load
+                    # fails fast instead of serving tierless.
+                    from .kv_cache import available_host_memory_bytes
+
+                    budget = available_host_memory_bytes() // 2
+                    budget = min(
+                        max(budget, _AUTO_HOST_TIER_MIN_BYTES),
+                        _AUTO_HOST_TIER_MAX_BYTES,
+                    )
+                    prefix_cache_host_pages = max(1, budget // page_bytes)
+                    prefix_cache_host_bytes = None  # budget = capacity
                 if prefix_cache_host_pages:
                     self.paged_cache.enable_host_tier(
                         int(prefix_cache_host_pages)
@@ -3175,7 +3263,10 @@ class LLMEngineCore:
         promotion DMAs (docs/kv_tiering.md). A no-op without a host tier;
         ``force`` blocks on stragglers (drain/stop)."""
         pc = self.paged_cache
-        if pc is None or pc.host_tier is None:
+        if pc is None or (pc.host_tier is None and self._kv_transport is None):
+            # transport imports ride the same promotion-fence records as
+            # host-tier re-onlines, so a transport-attached engine reaps
+            # even without a host tier (docs/disaggregation.md)
             return
         reaped = pc.reap_promotions(force=force)
         if reaped:
@@ -3219,6 +3310,178 @@ class LLMEngineCore:
             "page_bytes": page_bytes,
         }
 
+    # -- disaggregated prefill/decode (docs/disaggregation.md) -------------
+
+    def attach_kv_transport(self, endpoint, role: str = "hybrid") -> None:
+        """Wire a KV-transport endpoint (llm/kv_transport.py) and this
+        replica's role into the engine. Called by the replica group at
+        construction; requires the paged backend with a prefix cache —
+        the shipment payload IS the radix-storable prefix."""
+        if role not in ("prefill", "decode", "hybrid"):
+            raise ValueError(
+                "replica role must be prefill/decode/hybrid: got {!r}"
+                .format(role)
+            )
+        if endpoint is not None and (
+            self.cache_mode != "paged" or self._prefix is None
+        ):
+            raise ValueError(
+                "KV transport needs cache_mode='paged' and a prefix_cache "
+                "(the shipment payload is the radix-storable prefix; "
+                "docs/disaggregation.md)"
+            )
+        self._kv_transport = endpoint
+        self.replica_role = role
+
+    def _maybe_ship(self, request: GenRequest, slot: int) -> None:
+        """Ship-at-commit (loop thread): export the just-committed
+        admission's block-aligned prefix pages into a KV-transport
+        shipment addressed to ``request._ship_to`` (docs/disaggregation.md).
+        Best-effort by contract — an injected ``engine.kv.ship`` fault or
+        a full receive slab drops the shipment and the decode replica
+        recomputes; nothing here can fail the request."""
+        dst = request._ship_to
+        endpoint = self._kv_transport
+        if not dst or endpoint is None or self.paged_cache is None \
+                or self._prefix is None:
+            return
+        from .kv_transport import KVShipment, shipment_key
+
+        ids = request.prompt_ids
+        prefix_len = self._prefix.longest_prefix_len(len(ids))
+        if prefix_len < self._prefix.block:
+            return
+        t0 = time.perf_counter()
+        lora = self._slot_lora(request)
+        n_pages = prefix_len // self.paged_cache.pool.page_size
+        pages = self.paged_cache.pool.slot_pages(slot)[:n_pages]
+        try:
+            faults.fire("engine.kv.ship", request=request)
+            slabs = self.paged_cache.export_pages(pages)
+            sent = endpoint.send(dst, KVShipment(
+                key=shipment_key(ids, self._prefix.block, lora),
+                src=self.replica_id or "r?",
+                prefix_len=prefix_len,
+                page_size=self.paged_cache.pool.page_size,
+                lora=lora,
+                hk=slabs["hk"], hv=slabs["hv"],
+                hk_scale=slabs.get("hk_scale"),
+                hv_scale=slabs.get("hv_scale"),
+            ))
+        except faults.InjectedFault:
+            self._kv_ship_stats["ship_drops"] += 1
+            return
+        except Exception as ex:  # noqa: BLE001 - ship is best-effort by contract
+            # a REAL export/send failure (e.g. MemoryError staging the
+            # host slabs) must degrade exactly like an injected one:
+            # dropped + counted, never a failed commit on the loop thread
+            self._kv_ship_stats["ship_drops"] += 1
+            logger.warning(
+                "kv ship to %s dropped (%s: %s); decode-side recompute",
+                dst, type(ex).__name__, ex,
+            )
+            return
+        if not sent:
+            self._kv_ship_stats["ship_drops"] += 1
+            return
+        self._kv_ship_stats["ships"] += 1
+        self._kv_ship_stats["ship_pages"] += n_pages
+        self._hist_ship_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def receive_shipment(self, prompt_ids: List[int], lora: int = 0) -> dict:
+        """Receive-and-promote (docs/disaggregation.md): pop the shipment
+        for this prompt's prefix from the transport receive slab and
+        re-online it through the promote-under-dispatch-lock fence —
+        fresh device pages, the async host→device scatter ENQUEUED before
+        the page ids publish, the radix-cache attach last
+        (prefix_cache.store_shipped). The next admission's prefix lookup
+        then hits the shipped run.
+
+        Called by the replica group off the event loop (any thread is
+        safe: the tree lock and dispatch lock serialize against the
+        serving loop). Returns ``{"status": "imported"|"empty"|"failed"|
+        "off", "pages": n}`` — ``failed`` (injected ``engine.kv.receive``
+        fault, pool pressure, geometry mismatch) drops the shipment with
+        zero page leaks; the group then re-routes the stream to a
+        hybrid-capable sibling."""
+        endpoint = self._kv_transport
+        if endpoint is None or self.paged_cache is None \
+                or self._prefix is None:
+            return {"status": "off", "pages": 0}
+        from .kv_transport import shipment_key
+
+        key = shipment_key(prompt_ids, self._prefix.block, lora)
+        shipment = endpoint.recv(key)
+        if shipment is None:
+            self._kv_ship_stats["receive_empty"] += 1
+            return {"status": "empty", "pages": 0}
+        t0 = time.perf_counter()
+        try:
+            faults.fire(
+                "engine.kv.receive",
+                request=_ShipShim(prompt_ids),
+            )
+            pages = self._prefix.store_shipped(
+                prompt_ids, lora, shipment, self.paged_cache
+            )
+        except (faults.InjectedFault, MemoryError, ValueError) as ex:
+            # the shipment's slabs are plain host memory: dropping the
+            # reference IS the cleanup (no pool pages were published)
+            self._kv_ship_stats["receive_failures"] += 1
+            return {"status": "failed", "pages": 0, "error": repr(ex)[:200]}
+        self._kv_ship_stats["receives"] += 1
+        self._kv_ship_stats["receive_pages"] += pages
+        self._hist_receive_ms.observe((time.perf_counter() - t0) * 1e3)
+        return {"status": "imported", "pages": pages}
+
+    def _count_ship_outcome(self, request: GenRequest) -> None:
+        """Admission-time ship accounting on the decode replica: a request
+        the group marked ``_shipped`` either finds its whole storable
+        prefix resident (ship HIT — it recomputes none of the shipped KV)
+        or recomputes (transport drop, eviction, receive failure). The
+        hit-rate gauge is the disaggregation headline
+        (engine_kv_ship_hit_rate; benchmarks/DISAGG_AB_cpu.json asserts
+        >= 0.9 on the clean path). One-shot per request."""
+        if not request._shipped or self._prefix is None:
+            return
+        request._shipped = False
+        ids = request.prompt_ids
+        storable = self._prefix.longest_prefix_len(len(ids))
+        lora = self._slot_lora(request)
+        if storable >= self._prefix.block and (
+            self._prefix.match_len(ids, lora) >= storable
+        ):
+            self._kv_ship_stats["hits"] += 1
+        else:
+            self._kv_ship_stats["recomputes"] += 1
+
+    def _kv_ship_snapshot(self):
+        """KV-transport movement block shared by health() and
+        lifecycle_stats() (docs/disaggregation.md). None when no
+        transport is attached."""
+        if self._kv_transport is None:
+            return None
+        s = self._kv_ship_stats
+        judged = s["hits"] + s["recomputes"]
+        return {
+            "role": self.replica_role,
+            "ships": s["ships"],
+            "ship_pages": s["ship_pages"],
+            "ship_drops": s["ship_drops"],
+            "receives": s["receives"],
+            "receive_pages": s["receive_pages"],
+            "receive_empty": s["receive_empty"],
+            "receive_failures": s["receive_failures"],
+            "hits": s["hits"],
+            "recomputes": s["recomputes"],
+            "hit_rate": (
+                round(s["hits"] / judged, 4) if judged else None
+            ),
+            "ship_ms": self._hist_ship_ms.snapshot(),
+            "receive_ms": self._hist_receive_ms.snapshot(),
+            "transport": self._kv_transport.stats(),
+        }
+
     def health(self) -> dict:
         out = {
             "ready": self.is_ready,
@@ -3250,6 +3513,7 @@ class LLMEngineCore:
             ),
             "kv_pool": self._kv_pool_snapshot(),
             "kv_tier": self._kv_tier_snapshot(),
+            "kv_ship": self._kv_ship_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
@@ -3325,6 +3589,7 @@ class LLMEngineCore:
             ),
             "kv_pool": self._kv_pool_snapshot(),
             "kv_tier": self._kv_tier_snapshot(),
+            "kv_ship": self._kv_ship_snapshot(),
             "weights": {
                 "quant": self.weight_quant or "none",
                 "bytes": self._weight_bytes,
@@ -3740,6 +4005,9 @@ class LLMEngineCore:
             # chaos seam: delayed prefill (deadline tests) or a raised
             # admission failure (isolated by _admission_task's except path)
             faults.fire("engine.prefill", request=request)
+        # disaggregated ship-hit accounting (docs/disaggregation.md): book
+        # the shipped prefix's fate before the lookup consumes it
+        self._count_ship_outcome(request)
         ids = request.prompt_ids
         use_ring = (
             self._prefill_ring_jit is not None
@@ -4267,6 +4535,10 @@ class LLMEngineCore:
                     self._slot_lora(request),
                     self.paged_cache.pool.slot_pages(slot),
                 )
+                # disaggregated ship-at-commit (docs/disaggregation.md):
+                # the slot's pages now hold the whole prompt — export the
+                # storable prefix to the destination decode replica
+                self._maybe_ship(request, slot)
         else:
             self.cache = self._insert_jit(
                 self.cache,
@@ -4656,6 +4928,8 @@ class LLMEngineCore:
         stored buffers into (documented limitation)."""
         pos = 0
         try:
+            # disaggregated ship-hit accounting (docs/disaggregation.md)
+            self._count_ship_outcome(request)
             if self.cache_mode == "paged" and self._prefix is not None:
                 lora_i = self._slot_lora(request)
                 hit = self._prefix.lookup_pages(request.prompt_ids, lora_i)
@@ -5570,6 +5844,9 @@ class LLMEngineCore:
                     self._slot_lora(request),
                     self.paged_cache.pool.slot_pages(job.slot),
                 )
+                # disaggregated ship-at-commit, ragged scheduler's commit
+                # point (docs/disaggregation.md)
+                self._maybe_ship(request, job.slot)
             self._activate_slot(request, job.slot, first_id, first_lp)
         # retire-stage promotion reap, same rule as the pipelined retire
         self._reap_promotions()

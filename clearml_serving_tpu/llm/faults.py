@@ -71,6 +71,20 @@ Known points (ctx carried with each):
                          demoted suffix drops, the hit shortens to the
                          resident prefix, and the tail falls back to
                          recompute with zero page leaks.
+- ``engine.kv.ship``   — on the prefill replica's loop thread at commit,
+                         BEFORE the finished admission's prefix pages are
+                         exported into a KV-transport shipment
+                         (``request``; docs/disaggregation.md); a raise
+                         aborts the ship leak-free — nothing reaches the
+                         transport, and the decode replica falls back to
+                         recomputing the prefix.
+- ``engine.kv.receive`` — on the decode replica as a popped shipment is
+                         about to import (fresh device pages + the fenced
+                         host→device scatter + radix-cache attach;
+                         ``request`` carries the prompt ids); a raise
+                         drops the shipment with zero page leaks and the
+                         replica group re-routes the stream to a
+                         hybrid-capable sibling (recompute there).
 - ``engine.dispatch.prepare`` — on the loop thread at the end of
                          ``_prepare_dispatch`` (``requests``): the shared
                          host state is snapshotted, the worker-thread device
@@ -151,6 +165,8 @@ KNOWN_POINTS = frozenset({
     "engine.release",
     "engine.kv.demote",
     "engine.kv.promote",
+    "engine.kv.ship",
+    "engine.kv.receive",
     "engine.compile.bucket",
     "router.pick",
     "router.eject",

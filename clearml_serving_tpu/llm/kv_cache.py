@@ -368,6 +368,31 @@ class PagePool:
             return np.asarray(self._slot_len, np.int32)
 
 
+def available_host_memory_bytes(path: str = "/proc/meminfo") -> int:
+    """``MemAvailable`` from /proc/meminfo, in bytes — the input to the
+    host-tier auto-sizer (aux ``engine.prefix_cache_host_mb: "auto"``,
+    docs/kv_tiering.md). Raises :class:`errors.HostTierAutoSizeError`
+    (named, construction-time) on platforms without the file or without
+    the field: silently guessing a size would hide that the knob did
+    nothing."""
+    from ..errors import HostTierAutoSizeError
+
+    try:
+        with open(path) as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError as ex:
+        raise HostTierAutoSizeError(
+            "prefix_cache_host_mb='auto' needs {} (Linux); probe failed on "
+            "this platform: {}".format(path, ex)
+        )
+    raise HostTierAutoSizeError(
+        "prefix_cache_host_mb='auto': {} has no MemAvailable field on this "
+        "platform; set an explicit engine.prefix_cache_host_mb".format(path)
+    )
+
+
 class HostKVTier:
     """Preallocated host-RAM page tier behind the HBM pools
     (docs/kv_tiering.md).
@@ -657,50 +682,62 @@ class PagedKVCache:
         )
         return self.host_tier
 
-    def demote_pages(self, pages: List[int]) -> List[int]:
-        """Copy device pages (and, on int8 pools, their scale rows) into
-        freshly allocated host-tier pages; returns the host-tier page ids.
+    def export_pages(self, pages: List[int]) -> Dict[str, np.ndarray]:
+        """Synchronous device→host readback of ``pages`` (and, on int8
+        pools, their scale rows) into PAGE-MAJOR numpy slabs — ``hk``/``hv``
+        ``[n, L, Hkv, P, D]`` (+ ``hk_scale``/``hv_scale`` ``[n, L, Hkv,
+        P]``): the host-tier demote layout, which is also the KV-transport
+        shipment payload (llm/kv_transport.py, docs/disaggregation.md).
 
         The gather consumes the CURRENT pool handles under the dispatch
         lock, so it is ordered after every enqueued write by data
         dependency; the readback itself is synchronous (the host copy is
-        complete before the caller releases the device pages back to the
-        free list — a later re-allocation can never overwrite bytes the
-        tier still needs). Raises MemoryError when the tier is full; the
-        caller (radix cache eviction) then drops the run for real."""
+        complete before the caller releases or re-uses the device pages —
+        a later re-allocation can never overwrite bytes the caller still
+        needs). The victim list pads to a power of two with null-page
+        entries (llm/shapes.py) so the gather compiles once per power of
+        two, not once per count (tpuserve-analyze TPU601)."""
         import jax.numpy as jnp
 
+        n = len(pages)
+        idx = jnp.asarray(pad_pages(pages), jnp.int32)
+        with self.dispatch_lock:
+            k_slab = self.k[:, :, idx]          # [L, Hkv, n_pad, P, D]
+            v_slab = self.v[:, :, idx]
+            if self.kv_quant:
+                ks_slab = self.k_scale[:, :, idx]   # [L, Hkv, n_pad, P]
+                vs_slab = self.v_scale[:, :, idx]
+        # device->host readback OUTSIDE the dispatch lock: the gather
+        # outputs are immutable device arrays; only the (cheap) enqueue
+        # needed serializing against donating dispatches. Rows past the
+        # real count gathered the null page and are dropped here.
+        out = {
+            "hk": np.moveaxis(np.asarray(k_slab), 2, 0)[:n],
+            "hv": np.moveaxis(np.asarray(v_slab), 2, 0)[:n],
+        }
+        if self.kv_quant:
+            out["hk_scale"] = np.moveaxis(np.asarray(ks_slab), 2, 0)[:n]
+            out["hv_scale"] = np.moveaxis(np.asarray(vs_slab), 2, 0)[:n]
+        return out
+
+    def demote_pages(self, pages: List[int]) -> List[int]:
+        """Copy device pages (and, on int8 pools, their scale rows) into
+        freshly allocated host-tier pages; returns the host-tier page ids.
+
+        The gather/readback contract is :meth:`export_pages` (same slabs,
+        same fence). Raises MemoryError when the tier is full; the caller
+        (radix cache eviction) then drops the run for real."""
         tier = self.host_tier
         if tier is None:
             raise RuntimeError("demote_pages without an enabled host tier")
         host_ids = tier.allocate(len(pages))
         try:
-            # pad the victim list to a power-of-two with null-page entries
-            # (llm/shapes.py): the gather compiles once per power of two
-            # instead of once per demotion-round size — an unbucketed round
-            # would mint a fresh XLA program on the eviction path mid-serve
-            # (tpuserve-analyze TPU601; docs/static_analysis.md)
-            n = len(pages)
-            idx = jnp.asarray(pad_pages(pages), jnp.int32)
-            with self.dispatch_lock:
-                k_slab = self.k[:, :, idx]          # [L, Hkv, n_pad, P, D]
-                v_slab = self.v[:, :, idx]
-                if self.kv_quant:
-                    ks_slab = self.k_scale[:, :, idx]   # [L, Hkv, n_pad, P]
-                    vs_slab = self.v_scale[:, :, idx]
-            # device->host readback OUTSIDE the dispatch lock: the gather
-            # outputs are immutable device arrays; only the (cheap) enqueue
-            # needed serializing against donating dispatches. Rows past the
-            # real count gathered the null page and are dropped here.
-            tier.hk[host_ids] = np.moveaxis(np.asarray(k_slab), 2, 0)[:n]
-            tier.hv[host_ids] = np.moveaxis(np.asarray(v_slab), 2, 0)[:n]
+            slabs = self.export_pages(pages)
+            tier.hk[host_ids] = slabs["hk"]
+            tier.hv[host_ids] = slabs["hv"]
             if self.kv_quant:
-                tier.hk_scale[host_ids] = (
-                    np.moveaxis(np.asarray(ks_slab), 2, 0)[:n]
-                )
-                tier.hv_scale[host_ids] = (
-                    np.moveaxis(np.asarray(vs_slab), 2, 0)[:n]
-                )
+                tier.hk_scale[host_ids] = slabs["hk_scale"]
+                tier.hv_scale[host_ids] = slabs["hv_scale"]
         except BaseException:
             tier.free(host_ids)
             raise
@@ -718,8 +755,6 @@ class PagedKVCache:
         tier fence). Frees the host ids: the rows are STAGED into fresh
         arrays first, so the upload never aliases tier memory a later
         demotion may overwrite (the PR-4 zero-copy race class)."""
-        import jax.numpy as jnp
-
         tier = self.host_tier
         if tier is None:
             raise RuntimeError("promote_pages without an enabled host tier")
@@ -749,6 +784,26 @@ class PagedKVCache:
             ks_rows[:n] = tier.hk_scale[host_ids]
             vs_rows[:n] = tier.hv_scale[host_ids]
         tier.free(host_ids)
+        self._upload_pages(
+            k_rows, v_rows,
+            ks_rows if self.kv_quant else None,
+            vs_rows if self.kv_quant else None,
+            padded, len(pages),
+        )
+        self.promoted_pages += len(pages)
+
+    def _upload_pages(self, k_rows, v_rows, ks_rows, vs_rows,
+                      padded: List[int], n: int) -> None:
+        """Enqueue the async host→device page scatter shared by the tier
+        promotion and the KV-transport import (docs/kv_tiering.md,
+        docs/disaggregation.md): the donated write is only ENQUEUED under
+        the dispatch lock — dispatch returns in microseconds, the copy
+        proceeds in the background, and ordering for every later consumer
+        holds by data dependency on the rebound pool handles (the tier
+        fence). Rows must be PRIVATE staged copies padded to ``padded``'s
+        power-of-two length (rows past ``n`` scatter into dead page 0)."""
+        import jax.numpy as jnp
+
         page_ids = jnp.asarray(padded, jnp.int32)
         t_issue = time.perf_counter()
         with self.dispatch_lock:
@@ -769,11 +824,44 @@ class PagedKVCache:
                 self.v_scale = self._write_pages(self.v_scale, vs_dev, page_ids)
                 fence += [ks_dev, vs_dev]
             self._promotions.append({
-                "pages": len(pages),
+                "pages": n,
                 "t_issue": t_issue,
                 "fence": fence,
             })
-        self.promoted_pages += len(pages)
+
+    def import_pages(self, hk, hv, pages: List[int],
+                     hk_scale=None, hv_scale=None) -> None:
+        """Re-online SHIPPED page slabs (llm/kv_transport.py KVShipment
+        rows, ``[n, L, Hkv, P, D]`` page-major + scale rows on int8 pools)
+        into freshly allocated device pages via the same async
+        enqueue-before-publish fence as a host-tier promotion
+        (docs/disaggregation.md). The rows are staged into PRIVATE
+        power-of-two-padded buffers first — the upload never aliases the
+        transport slab, which the sender's mailbox may recycle (the PR-4
+        zero-copy race class) — and completion is observed at the engine's
+        retire boundaries (``reap_promotions``)."""
+        if len(pages) != int(hk.shape[0]):
+            raise ValueError(
+                "import of {} slab rows into {} device pages".format(
+                    hk.shape[0], len(pages)
+                )
+            )
+        self._require_scales(hk_scale, hv_scale)
+        n = len(pages)
+        padded = pad_pages(pages)
+        k_rows = np.zeros((len(padded),) + tuple(hk.shape[1:]), self.k.dtype)
+        v_rows = np.zeros_like(k_rows)
+        k_rows[:n] = hk
+        v_rows[:n] = hv
+        ks_rows = vs_rows = None
+        if self.kv_quant:
+            ks_rows = np.zeros(
+                (len(padded),) + tuple(hk_scale.shape[1:]), np.float32
+            )
+            vs_rows = np.zeros_like(ks_rows)
+            ks_rows[:n] = hk_scale
+            vs_rows[:n] = hv_scale
+        self._upload_pages(k_rows, v_rows, ks_rows, vs_rows, padded, n)
 
     def reap_promotions(self, force: bool = False) -> int:
         """Account promotion DMAs that completed (engine retire-stage

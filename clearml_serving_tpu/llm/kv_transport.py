@@ -1,0 +1,249 @@
+"""KVTransport: cross-replica shipping of prefilled KV pages
+(docs/disaggregation.md).
+
+Disaggregated prefill/decode splits the two jobs a serving replica does
+over role-specialized replicas: PREFILL replicas run the compute-bound
+admission (ragged admission rows, int4 weights), DECODE replicas run the
+bandwidth-bound token loop (batch-fill maximized, multi-step ragged
+rows), and the prefilled KV moves between them through this module. The
+payload is exactly what the host-RAM tier already serializes on its
+demote path (docs/kv_tiering.md): the prompt's block-aligned prefix as
+PAGE-MAJOR int8 (or bf16) page slabs plus, on quantized pools, the f32
+scale rows that share each page's lifecycle — 2x cheaper than bf16 to
+hold and transfer. A :class:`KVShipment` is that payload plus enough
+metadata for the receiver to validate geometry before touching its pool.
+
+The interface is STREAM-SHAPED on purpose: a sender addresses a
+destination replica by name and pushes one bounded message; a receiver
+pops by content key. The in-process :class:`SharedSlabTransport` backend
+(this PR) implements it as one bounded receive slab (a page-capacity
+mailbox) per destination replica; a process-group backend
+(parallel/multihost.py collectives) or a remote backend (gRPC stream /
+RDMA write into a registered receive slab) plugs in behind the same
+`send`/`recv` pair without touching the engine or the router.
+
+Delivery contract (the fallback matrix lives in docs/disaggregation.md):
+
+- ``send`` is BEST-EFFORT: a full receive slab drops the OLDEST
+  shipment first (the sender never blocks a serving loop on transport
+  backpressure), and a send that still does not fit is dropped and
+  counted. A dropped shipment is never an error — the decode replica
+  falls back to recomputing the prefix (the same drop-to-recompute
+  contract as a failed host-tier promotion).
+- ``recv`` is CONSUME-ONCE by content key: the decode replica's receive
+  path pops the shipment, imports the pages under its own dispatch-lock
+  fence (kv_cache.PagedKVCache.import_pages), and attaches them to its
+  radix prefix cache (prefix_cache.RadixPrefixCache.store_shipped). A
+  shipment nobody consumes ages out of the bounded mailbox.
+
+Content keys (:func:`shipment_key`) digest the storable block-aligned
+prefix — the same ``longest_prefix_len`` math the radix trie and the
+router's affinity key use — so the sender and receiver derive the same
+key from the same prompt independently, with no id handshake.
+
+This module is jax-free on purpose: payloads are numpy slabs, and the
+router/CLI processes must be able to import it without an accelerator
+runtime.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def shipment_key(prompt_ids: Sequence[int], block: int, lora: int = 0) -> bytes:
+    """Deterministic content key for a prompt's storable block-aligned
+    prefix: sender (at commit) and receiver (before admission) derive the
+    same key from the same prompt with no coordination. Mirrors
+    ``RadixPrefixCache.longest_prefix_len`` — the final token never ships
+    (it always computes live to seed decoding)."""
+    ids = list(prompt_ids)
+    depth = ((len(ids) - 1) // max(1, int(block))) * max(1, int(block))
+    digest = hashlib.blake2b(digest_size=16)
+    digest.update(struct.pack("<iI", int(lora), depth))
+    for token in ids[:depth]:
+        digest.update(struct.pack("<q", int(token)))
+    return digest.digest()
+
+
+@dataclass
+class KVShipment:
+    """One prompt's prefilled prefix KV, page-major (docs/disaggregation.md).
+
+    ``hk``/``hv`` are ``[N, L, Hkv, P, D]`` slabs (one row per shipped
+    page, the host-tier demote layout); quantized pools add the
+    ``[N, L, Hkv, P]`` f32 scale rows. ``prefix_len`` is the block-aligned
+    token count the pages cover (``N * page_size``)."""
+
+    key: bytes
+    src: str                       # sender replica name
+    prefix_len: int                # storable prefix tokens covered
+    page_size: int
+    lora: int
+    hk: np.ndarray                 # [N, L, Hkv, P, D]
+    hv: np.ndarray
+    hk_scale: Optional[np.ndarray] = None   # [N, L, Hkv, P] on int8 pools
+    hv_scale: Optional[np.ndarray] = None
+    seq: int = field(default=0, compare=False)
+
+    @property
+    def pages(self) -> int:
+        return int(self.hk.shape[0])
+
+    @property
+    def quantized(self) -> bool:
+        return self.hk_scale is not None
+
+    @property
+    def nbytes(self) -> int:
+        per = int(self.hk.nbytes) + int(self.hv.nbytes)
+        if self.hk_scale is not None:
+            per += int(self.hk_scale.nbytes) + int(self.hv_scale.nbytes)
+        return per
+
+
+class TransportEndpoint:
+    """One replica's handle on a transport: ``send`` addresses a peer by
+    name, ``recv`` pops from this replica's own receive slab. The engine
+    holds exactly one of these (``LLMEngineCore.attach_kv_transport``) —
+    it never sees the broker or the peer set."""
+
+    def __init__(self, transport: "SharedSlabTransport", name: str):
+        self._transport = transport
+        self.name = name
+
+    def send(self, dst: str, shipment: KVShipment) -> bool:
+        return self._transport.send(dst, shipment)
+
+    def recv(self, key: bytes) -> Optional[KVShipment]:
+        return self._transport.recv(self.name, key)
+
+    def stats(self) -> Dict[str, object]:
+        return self._transport.stats()
+
+
+class SharedSlabTransport:
+    """In-process KVTransport backend: one bounded receive slab per
+    destination replica (docs/disaggregation.md).
+
+    A "receive slab" is a page-capacity mailbox: shipments queue in
+    arrival order keyed by content, capacity is counted in PAGES (the
+    unit pool pressure is measured in everywhere else), and overflow
+    drops the OLDEST shipment first — the decode replica it was addressed
+    to simply recomputes, exactly like a failed host-tier promotion.
+    Remote backends replace this class, not its callers: the engine's
+    ship/receive paths and the router's role logic only consume the
+    ``TransportEndpoint`` surface."""
+
+    # lock-discipline registry (tpuserve-analyze TPU301): mailbox state is
+    # mutated only under self._lock — senders run on their replica's loop
+    # thread, receivers pop from the group's receive worker
+    __guarded_by__ = {"_lock": ("_slabs", "_slab_pages", "_ship_seq")}
+
+    def __init__(self, capacity_pages: int = 1024,
+                 max_shipments: int = 64):
+        if capacity_pages <= 0:
+            raise ValueError(
+                "kv transport needs a positive receive-slab capacity "
+                "(got {} pages)".format(capacity_pages)
+            )
+        self.capacity_pages = int(capacity_pages)
+        self.max_shipments = int(max_shipments)
+        self._lock = threading.Lock()
+        # dst name -> OrderedDict[key, KVShipment] (arrival order)
+        self._slabs: Dict[str, "OrderedDict[bytes, KVShipment]"] = {}
+        self._slab_pages: Dict[str, int] = {}
+        self._ship_seq = 0
+        # observability (GIL-atomic bumps; surfaced through stats())
+        self.sent = 0
+        self.sent_pages = 0
+        self.received = 0
+        self.received_pages = 0
+        self.dropped = 0           # evicted/oversized shipments
+        self.dropped_pages = 0
+
+    def register(self, name: str) -> TransportEndpoint:
+        with self._lock:
+            self._slabs.setdefault(name, OrderedDict())
+            self._slab_pages.setdefault(name, 0)
+        return TransportEndpoint(self, name)
+
+    def _drop_oldest(self, dst: str) -> None:  # tpuserve: ignore[TPU301] lock held by caller
+        _, old = self._slabs[dst].popitem(last=False)
+        self._slab_pages[dst] -= old.pages
+        self.dropped += 1
+        self.dropped_pages += old.pages
+
+    def send(self, dst: str, shipment: KVShipment) -> bool:
+        """Deliver ``shipment`` into ``dst``'s receive slab. Returns False
+        (counted drop) when the shipment exceeds the slab outright;
+        otherwise the oldest queued shipments age out until it fits. A
+        re-ship of the same key replaces the stale payload."""
+        if shipment.pages > self.capacity_pages:
+            self.dropped += 1
+            self.dropped_pages += shipment.pages
+            return False
+        with self._lock:
+            slab = self._slabs.get(dst)
+            if slab is None:
+                slab = self._slabs[dst] = OrderedDict()
+                self._slab_pages[dst] = 0
+            stale = slab.pop(shipment.key, None)
+            if stale is not None:
+                self._slab_pages[dst] -= stale.pages
+            while (
+                slab
+                and (
+                    self._slab_pages[dst] + shipment.pages
+                    > self.capacity_pages
+                    or len(slab) >= self.max_shipments
+                )
+            ):
+                self._drop_oldest(dst)
+            self._ship_seq += 1
+            shipment.seq = self._ship_seq
+            slab[shipment.key] = shipment
+            self._slab_pages[dst] += shipment.pages
+        self.sent += 1
+        self.sent_pages += shipment.pages
+        return True
+
+    def recv(self, dst: str, key: bytes) -> Optional[KVShipment]:
+        """Consume-once pop of ``dst``'s shipment for ``key`` (None when
+        nothing matching is queued — dropped, never sent, or already
+        consumed)."""
+        with self._lock:
+            slab = self._slabs.get(dst)
+            shipment = slab.pop(key, None) if slab is not None else None
+            if shipment is not None:
+                self._slab_pages[dst] -= shipment.pages
+        if shipment is not None:
+            self.received += 1
+            self.received_pages += shipment.pages
+        return shipment
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            queued = {
+                dst: {"shipments": len(slab),
+                      "pages": self._slab_pages.get(dst, 0)}
+                for dst, slab in self._slabs.items()
+            }
+        return {
+            "backend": "shared_slab",
+            "capacity_pages": self.capacity_pages,
+            "sent": self.sent,
+            "sent_pages": self.sent_pages,
+            "received": self.received,
+            "received_pages": self.received_pages,
+            "dropped": self.dropped,
+            "dropped_pages": self.dropped_pages,
+            "queued": queued,
+        }

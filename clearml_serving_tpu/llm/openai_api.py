@@ -318,8 +318,13 @@ class LLMEngineRequest(BaseEngineRequest):
                 if engine_cfg.get("prefix_cache_host_pages")
                 else None
             ),
+            # "auto" sizes the tier from /proc/meminfo at endpoint load
+            # (clamped; HostTierAutoSizeError names unsupported platforms)
             prefix_cache_host_bytes=(
-                int(float(engine_cfg["prefix_cache_host_mb"]) * (1 << 20))
+                "auto"
+                if str(engine_cfg.get("prefix_cache_host_mb", "")
+                       ).strip().lower() == "auto"
+                else int(float(engine_cfg["prefix_cache_host_mb"]) * (1 << 20))
                 if engine_cfg.get("prefix_cache_host_mb")
                 else None
             ),
@@ -393,6 +398,30 @@ class LLMEngineRequest(BaseEngineRequest):
                     n_replicas
                 )
             )
+        # replica roles (docs/disaggregation.md): aux engine.replica_roles
+        # dedicates replicas to prefill or decode and wires the KV
+        # transport between them. Accepts a list or a comma string;
+        # validated at ENDPOINT LOAD naming the knob.
+        raw_roles = engine_cfg.get("replica_roles")
+        replica_roles = None
+        if raw_roles is not None:
+            if isinstance(raw_roles, str):
+                replica_roles = [
+                    r.strip().lower() for r in raw_roles.split(",") if r.strip()
+                ]
+            elif isinstance(raw_roles, (list, tuple)):
+                replica_roles = [str(r).strip().lower() for r in raw_roles]
+            else:
+                raise ValueError(
+                    "aux engine.replica_roles must be a list (or comma "
+                    "string) of prefill/decode/hybrid: got {!r}"
+                    .format(raw_roles)
+                )
+            if n_replicas <= 1:
+                raise ValueError(
+                    "aux engine.replica_roles needs engine.replicas >= 2 "
+                    "(got {} replica)".format(n_replicas)
+                )
         if n_replicas > 1:
             from .replica import ReplicaGroup
 
@@ -424,6 +453,12 @@ class LLMEngineRequest(BaseEngineRequest):
                 ),
                 fleet_shed_stage=int(
                     engine_cfg.get("router_fleet_shed_stage", 3)
+                ),
+                roles=replica_roles,
+                kv_transport_pages=(
+                    int(engine_cfg["kv_transport_pages"])
+                    if engine_cfg.get("kv_transport_pages")
+                    else None
                 ),
             )
         else:

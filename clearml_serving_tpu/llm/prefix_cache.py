@@ -483,6 +483,123 @@ class RadixPrefixCache:
                 depth += self.block
             self._evict_over_budget()
 
+    def store_shipped(self, ids: List[int], lora: int,
+                      shipment, backend) -> int:
+        """Import a KV-transport shipment (llm/kv_transport.py KVShipment)
+        as RESIDENT nodes for the prompt's block-aligned prefix — the
+        receive half of disaggregated prefill/decode
+        (docs/disaggregation.md). ``backend`` is the PagedKVCache whose
+        ``import_pages`` enqueues the host→device scatter; the fence is
+        the host-tier promotion's, verbatim: fresh device pages are
+        allocated, the async upload is ENQUEUED under the dispatch lock
+        BEFORE the page ids become visible to any consumer (ordering then
+        holds by data dependency on the pool handles —
+        llm/schedule_explorer.py's ``kv_ship`` scenario models losing
+        it), and only then do the nodes attach.
+
+        Blocks already resident are SKIPPED (their pages may be shared
+        with live slots); demoted path nodes re-online from the shipment
+        (the demoted-suffix invariant survives attaching resident children
+        below). Returns device pages imported (0 = nothing missing).
+        Raises MemoryError on pool pressure and ValueError on geometry
+        mismatch — the caller drops the shipment and falls back to
+        recompute, zero leaks either way."""
+        import numpy as np
+
+        if self._pool is None:
+            raise ValueError("store_shipped needs the paged backend")
+        page_size = self._pool.page_size
+        if int(shipment.page_size) != page_size:
+            raise ValueError(
+                "shipment page size {} != pool page size {}".format(
+                    shipment.page_size, page_size
+                )
+            )
+        if bool(shipment.hk_scale is not None) != bool(
+            getattr(backend, "kv_quant", "")
+        ):
+            raise ValueError(
+                "shipment quantization does not match the pool (scales {}, "
+                "kv_quant {!r})".format(
+                    "present" if shipment.hk_scale is not None else "absent",
+                    getattr(backend, "kv_quant", ""),
+                )
+            )
+        p = min(self.longest_prefix_len(len(ids)), int(shipment.prefix_len))
+        if p < self.block:
+            return 0
+        ppb = self.block // page_size
+        with self._lock:
+            node, depth = self._walk(ids, lora)
+            now = self._clock
+            # one import job per missing block: demoted path nodes re-online
+            # (flip), absent blocks attach as new children
+            jobs: List[tuple] = []
+            for i, n in enumerate(self._path_nodes(node)):
+                if n.pages is None and n.host_pages is not None:
+                    jobs.append((i * self.block, n))
+            d = depth
+            while d + self.block <= p:
+                jobs.append((d, None))
+                d += self.block
+            if not jobs:
+                return 0
+            total = len(jobs) * ppb
+            fresh = self._pool.allocate_cache_pages(total)
+            rows = np.asarray(
+                [
+                    tok_depth // page_size + j
+                    for tok_depth, _ in jobs
+                    for j in range(ppb)
+                ],
+                np.int64,
+            )
+            try:
+                # fancy indexing COPIES the selected slab rows; the upload
+                # never aliases the transport mailbox's memory
+                backend.import_pages(
+                    shipment.hk[rows], shipment.hv[rows], fresh,
+                    shipment.hk_scale[rows]
+                    if shipment.hk_scale is not None else None,
+                    shipment.hv_scale[rows]
+                    if shipment.hv_scale is not None else None,
+                )
+            except BaseException:
+                self._pool.unref_pages(fresh)
+                raise
+            # the scatter is in the device queue: publish the page ids
+            i = 0
+            for tok_depth, existing in jobs:
+                pages = list(fresh[i * ppb : (i + 1) * ppb])
+                i += 1
+                if existing is not None:
+                    # demoted node re-onlines from the shipment
+                    if self._host is not None:
+                        self._host.free(existing.host_pages)
+                    self._host_pages -= len(existing.host_pages)
+                    self._host_bytes -= existing.nbytes
+                    existing.host_pages = None
+                    existing.pages = pages
+                    existing.last_used = now
+                    self._bytes += existing.nbytes
+                    self._pages += ppb
+                    self._n_resident += 1
+                    self._frontier_fix(existing)
+                    self._frontier_fix(existing.parent)
+                    continue
+                blk = tuple(ids[tok_depth : tok_depth + self.block])
+                child = _Node(node, blk)
+                child.pages = pages
+                child.nbytes = ppb * self._page_bytes
+                child.last_used = now
+                self._attach(node, child)
+                self._bytes += child.nbytes
+                self._pages += ppb
+                self._n_resident += 1
+                node = child
+            self._evict_over_budget()
+        return total
+
     def pin_run(self, ids: List[int], lora: int = 0) -> Optional[Dict[str, Any]]:
         """Protect the stored run for ``ids`` from eviction until
         unpin_run(). The preemptible batch lane relies on this: a preempted

@@ -169,6 +169,15 @@ class ReplicaGroup:
         spill_queue_depth: Optional[int] = None,
         spill_brownout_stage: int = 2,
         fleet_shed_stage: int = 3,
+        # disaggregated prefill/decode (docs/disaggregation.md): one role
+        # per engine ("prefill" | "decode" | "hybrid"); None/all-hybrid =
+        # the legacy every-replica-does-both fleet. Any non-hybrid role
+        # builds the in-process KV transport and wires every engine into
+        # it (aux engine.replica_roles).
+        roles: Optional[List[str]] = None,
+        # per-replica receive-slab capacity in pages (aux
+        # engine.kv_transport_pages); default: four full-prefix shipments
+        kv_transport_pages: Optional[int] = None,
     ):
         if not engines:
             raise ValueError("a replica group needs at least one engine")
@@ -178,6 +187,61 @@ class ReplicaGroup:
         ]
         prefix = engines[0]._prefix
         block = prefix.block if prefix is not None else 64
+        # -- replica roles + KV transport (docs/disaggregation.md) --------
+        role_map = None
+        self._disaggregated = False
+        self.transport = None
+        if roles is not None:
+            roles = [str(r) for r in roles]
+            if len(roles) != len(engines):
+                raise ValueError(
+                    "engine.replica_roles lists {} roles for {} replicas"
+                    .format(len(roles), len(engines))
+                )
+            for role in roles:
+                if role not in ("prefill", "decode", "hybrid"):
+                    raise ValueError(
+                        "engine.replica_roles entries must be prefill/"
+                        "decode/hybrid: got {!r}".format(role)
+                    )
+            self._disaggregated = any(r != "hybrid" for r in roles)
+            if self._disaggregated:
+                if not any(r in ("decode", "hybrid") for r in roles):
+                    raise ValueError(
+                        "engine.replica_roles needs at least one decode-"
+                        "capable (decode/hybrid) replica to serve streams"
+                    )
+                if not any(r in ("prefill", "hybrid") for r in roles):
+                    raise ValueError(
+                        "engine.replica_roles needs at least one prefill-"
+                        "capable (prefill/hybrid) replica"
+                    )
+                if prefix is None or engines[0].paged_cache is None:
+                    raise ValueError(
+                        "disaggregated replica roles need cache='paged' "
+                        "and a prefix_cache (the shipped payload is the "
+                        "radix-storable prefix; docs/disaggregation.md)"
+                    )
+                from .kv_transport import SharedSlabTransport
+
+                if kv_transport_pages is None:
+                    per_seq = engines[0].paged_cache.pool.pages_needed(
+                        engines[0].max_seq_len
+                    )
+                    kv_transport_pages = max(64, 4 * per_seq)
+                self.transport = SharedSlabTransport(
+                    capacity_pages=int(kv_transport_pages)
+                )
+            role_map = {
+                replica.name: role
+                for replica, role in zip(self.replicas, roles)
+            }
+            for replica, role in zip(self.replicas, roles):
+                replica.engine.attach_kv_transport(
+                    self.transport.register(replica.name)
+                    if self.transport is not None else None,
+                    role=role,
+                )
         # spill bound defaults to half the admission bound: deep enough
         # that transient bursts stay affine, shallow enough to redirect
         # before the affine member starts shedding. An EXPLICIT 0 disables
@@ -193,8 +257,14 @@ class ReplicaGroup:
             spill_queue_depth=spill_queue_depth,
             spill_brownout_stage=spill_brownout_stage,
             fleet_shed_stage=fleet_shed_stage,
+            roles=role_map,
         )
         self.failovers = 0
+        # disaggregation counters (mirrored in health()/lifecycle_stats())
+        self.ship_legs = 0          # prefill legs run
+        self.ship_leg_failures = 0  # leg failed -> decode-side recompute
+        self.ship_warm_skips = 0    # decode already held the prefix
+        self.receive_reroutes = 0   # receive failed -> hybrid re-route
 
     # -- single-engine surface (config/readonly) ----------------------------
 
@@ -332,6 +402,105 @@ class ReplicaGroup:
             and request.repetition_penalty == 1.0
         )
 
+    async def _disagg_preamble(self, request, decode_replica):
+        """Disaggregated prefill/decode, the ship lifecycle's group half
+        (docs/disaggregation.md):
+
+        1. Skip when the decode replica already holds the whole storable
+           prefix (repeat conversation turn — its radix cache is warm).
+        2. Run the PREFILL LEG: a plain one-token clone of the request on
+           a prefill-capable replica with ``_ship_to`` set — at its
+           commit, that engine exports the prefix pages into a transport
+           shipment addressed to the decode replica. KV does not depend
+           on sampling, so the clone strips guided/penalty state; its
+           single discarded token is the cost of role specialization.
+        3. RECEIVE on the decode replica (off the event loop): pop the
+           shipment and re-online it through the promote-under-dispatch-
+           lock fence. The stream's admission then hits the shipped
+           prefix and recomputes only the unshipped tail.
+
+        Every failure degrades, never fails the request: a failed leg or
+        empty shipment means decode-side recompute, a failed RECEIVE
+        re-routes the stream to a hybrid-capable sibling (counted).
+        Returns the (possibly re-routed) replica the stream must run on."""
+        import asyncio as _asyncio
+        import time as _time
+
+        engine = decode_replica.engine
+        prefix = getattr(engine, "_prefix", None)
+        if prefix is None or engine.paged_cache is None:
+            return decode_replica
+        ids = request.prompt_ids
+        storable = prefix.longest_prefix_len(len(ids))
+        if storable < prefix.block:
+            return decode_replica  # nothing shippable: too short
+        lora = engine._slot_lora(request)
+        if prefix.match_len(ids, lora) >= storable:
+            self.ship_warm_skips += 1
+            return decode_replica
+        pre = self.router.pick_prefill(request, exclude=decode_replica.name)
+        if pre is None:
+            # prefill class empty/browned out: hybrid degradation — the
+            # decode replica prefills for itself
+            return decode_replica
+        from .engine import GenRequest
+
+        # the leg is bounded by the ORIGINAL request's total budget (the
+        # _resume_clone convention): a wedged prefill replica must not
+        # stall the stream past its deadline. The deadline is usually
+        # UNRESOLVED here (the engine resolves it at its own generate),
+        # so fall back to the raw body budget when no monotonic deadline
+        # exists yet.
+        if request._deadline is not None:
+            leg_budget = max(0.05, request._deadline - _time.monotonic())
+        else:
+            leg_budget = request.total_timeout
+        ship_req = GenRequest(
+            prompt_ids=list(ids),
+            max_new_tokens=1,
+            priority=request.priority,
+            adapter=request.adapter,
+            total_timeout=leg_budget,
+        )
+        ship_req._ship_to = decode_replica.name
+        self.ship_legs += 1
+        try:
+            async for _ in pre.engine.generate(ship_req):
+                pass  # the leg's one token is discarded by design
+        except _asyncio.CancelledError:
+            ship_req.cancel()
+            raise
+        except Exception as ex:  # noqa: BLE001 - the leg is best-effort
+            self.ship_leg_failures += 1
+            logger.warning(
+                "prefill replica %s failed a ship leg (%s); decode-side "
+                "recompute on %s", pre.name, type(ex).__name__,
+                decode_replica.name,
+            )
+            return decode_replica
+        request._shipped = True
+        res = await _asyncio.to_thread(engine.receive_shipment, ids, lora)
+        if res.get("status") != "failed":
+            return decode_replica
+        # receive failure (injected engine.kv.receive fault, pool
+        # pressure, geometry mismatch): re-route the stream to a HYBRID
+        # sibling — a replica that can do both jobs takes it cold
+        self.receive_reroutes += 1
+        self.router.sweep()
+        for r in self.router.order_for(ids):
+            if (
+                r.name in self.router._ring_members
+                and r.name != decode_replica.name
+                and self.router.role_of(r.name) == "hybrid"
+            ):
+                logger.warning(
+                    "decode replica %s failed a shipment receive; "
+                    "re-routing the stream to hybrid %s",
+                    decode_replica.name, r.name,
+                )
+                return r
+        return decode_replica  # no hybrid available: recompute in place
+
     async def generate(self, request) -> AsyncIterator[int]:
         """Routed generation with failure drain: replica-level failures
         (stuck/unavailable) resume the stream on the next-choice sibling;
@@ -343,6 +512,12 @@ class ReplicaGroup:
         # set before the engine does: a pre-admission failover must not
         # leave the caller's usage accounting reading prompt_len == 0
         request.prompt_len = len(request.prompt_ids)
+        if self._disaggregated:
+            # disaggregated prefill/decode (docs/disaggregation.md): run
+            # the prefill leg + shipment receive first; may re-route the
+            # stream to a hybrid sibling on a receive failure
+            replica = await self._disagg_preamble(request, replica)
+            request._replica_name = replica.name
         emitted: List[int] = []
         base_lp = 0  # caller-side logprob entries at the last failover
         active = request
@@ -385,6 +560,11 @@ class ReplicaGroup:
                     if r.name in self.router._ring_members
                     and r.name not in tried
                 ]
+                # role-split fleets: resume on a decode-capable sibling
+                # when one exists; a lone prefill replica still beats a 503
+                candidates.sort(
+                    key=lambda r: self.router.role_of(r.name) == "prefill"
+                )
                 if not candidates:
                     raise failed
                 failed_name = replica.name
@@ -479,6 +659,24 @@ class ReplicaGroup:
             "queue_depth": sum(r.queue_depth for r in self.replicas),
             "active_slots": sum(r.engine.active_slots for r in self.replicas),
             "failovers": self.failovers,
+            "disaggregation": self._disagg_snapshot(),
+        }
+
+    def _disagg_snapshot(self) -> Optional[dict]:
+        """Group-level ship-lifecycle counters (docs/disaggregation.md);
+        None on a hybrid-only fleet. Engine-level movement/hit counters
+        live in each replica's ``kv_ship`` lifecycle block."""
+        if not self._disaggregated:
+            return None
+        return {
+            "roles": dict(self.router._roles),
+            "ship_legs": self.ship_legs,
+            "ship_leg_failures": self.ship_leg_failures,
+            "ship_warm_skips": self.ship_warm_skips,
+            "receive_reroutes": self.receive_reroutes,
+            "transport": (
+                self.transport.stats() if self.transport is not None else None
+            ),
         }
 
     def lifecycle_stats(self) -> dict:
@@ -491,6 +689,7 @@ class ReplicaGroup:
             "ring_size": stats["ring_size"],
             "router": stats,
             "failovers": self.failovers,
+            "disaggregation": self._disagg_snapshot(),
             "replicas": {
                 r.name: r.engine.lifecycle_stats() for r in self.replicas
             },

@@ -82,6 +82,8 @@ YIELD_POINTS = frozenset({
     "engine.watchdog",           # trip: epoch bump + in-flight failure
     "engine.drain",              # drained boundary before the leak audit
     "engine.release",            # slot teardown before page frees
+    "engine.kv.ship",            # prefill-commit export into the transport
+    "engine.kv.receive",         # decode-side shipment import + publish
 })
 
 # internal (non-engine) park labels the scheduler also accepts
@@ -653,6 +655,144 @@ def scenario_ragged_window_retire(ctx: ScenarioContext) -> None:
     KVSanitizer(pool).check("ragged-window-retire", drained=True)
 
 
+class _ModelShipBackend:
+    """Explorer-local model of the KV-transport import backend
+    (docs/disaggregation.md): page CONTENTS are plain ints riding real
+    numpy shipment slabs, and the device queue is a list of pending copy
+    ops. The ship fence — the real ``PagedKVCache.import_pages`` enqueues
+    the scatter under the dispatch lock BEFORE ``store_shipped`` publishes
+    the page ids, so any later consumer program is ordered after the copy
+    by data dependency — is modelled by ``flush()``. Mutation
+    ``drop_ship_fence`` defers the import op OUT of the queue (a late
+    "DMA thread" lands it eventually), exactly the stale read an unfenced
+    publish would allow."""
+
+    kv_quant = ""   # store_shipped's scale/quantization geometry check
+
+    def __init__(self, device_data: Dict[int, int], drop_fence: bool):
+        self.device_data = device_data
+        self.queue: List[list] = []
+        self.late: List[list] = []
+        self.drop_fence = drop_fence
+
+    def import_pages(self, hk, hv, pages, hk_scale=None, hv_scale=None):
+        op = [
+            (page, int(hk[j, 0, 0, 0, 0]))
+            for j, page in enumerate(pages)
+        ]
+        if self.drop_fence:
+            self.late.append(op)        # seeded defect: DMA enqueued late
+        else:
+            self.queue.append(op)       # the fence: enqueue before publish
+
+    def flush(self) -> None:
+        for op in self.queue:
+            for page, value in op:
+                self.device_data[page] = value
+        self.queue.clear()
+
+    def land_late(self) -> None:
+        self.queue.extend(self.late)
+        self.late = []
+
+
+def scenario_kv_ship(ctx: ScenarioContext) -> None:
+    """Disaggregated KV shipping (docs/disaggregation.md): a prefill
+    replica's shipment lands on the decode replica WHILE that replica's
+    concurrent admission looks the same prefix up and ``map_shared``'s
+    it. Whether the admission wins the race (miss — it recomputes) or
+    loses it (hit over the just-published shipped pages), a hit must read
+    the SHIPPED bytes: ``store_shipped`` enqueues the import scatter
+    before the page ids publish, so the consumer program is ordered after
+    the copy. Mutation ``drop_ship_fence`` lets the import land AFTER the
+    consumer read — the stale-page corruption an unfenced publish
+    allows."""
+    from .kv_sanitizer import KVSanitizer
+    from .kv_transport import KVShipment, SharedSlabTransport, shipment_key
+    from .prefix_cache import RadixPrefixCache
+
+    page = 4
+    pool = _pool(num_pages=9, page_size=page, max_slots=2)
+    device_data: Dict[int, int] = {
+        p: -1 for p in range(1, pool.num_pages)   # fresh pages: garbage
+    }
+    backend = _ModelShipBackend(device_data, ctx.mutating("drop_ship_fence"))
+    cache = RadixPrefixCache(block=page, pool=pool, page_bytes=8)
+    ids = list(range(9))                 # 9 tokens -> 8 storable (2 blocks)
+    expect = [101, 102]
+    hk = np.zeros((2, 1, 1, page, 1), np.int32)
+    hk[:, 0, 0, 0, 0] = expect           # page value rides slab row 0
+    transport = SharedSlabTransport(capacity_pages=8)
+    transport.register("decode")
+    shipment = KVShipment(
+        key=shipment_key(ids, page, 0), src="prefill", prefix_len=8,
+        page_size=page, lora=0, hk=hk, hv=hk.copy(),
+    )
+    sanitizer = KVSanitizer(pool, prefix_cache=cache)
+    state: Dict[str, Any] = {}
+
+    def receiver():
+        # the group's receive worker: pop + import + publish (bounded
+        # retry: the shipper may not have sent yet under this schedule)
+        got = None
+        for _ in range(6):
+            ctx.yield_point("engine.kv.receive")
+            got = transport.recv("decode", shipment.key)
+            if got is not None:
+                break
+        if got is not None:
+            cache.store_shipped(ids, 0, got, backend)
+            ctx.yield_point("engine.kv.receive")
+
+    def admit():
+        # the decode replica's concurrent admission: bounded lookup retry
+        # so most schedules reach the interesting hit-over-shipped-pages
+        # state; a final miss is the legitimate recompute path
+        hit = None
+        for _ in range(6):
+            ctx.yield_point("engine.prefill")
+            hit = cache.lookup_pages(ids)
+            if hit is not None:
+                break
+        if hit is None:
+            state["read"] = None        # won the race: recompute path
+            return
+        pool.map_shared(1, hit["pages"], hit["len"])
+        ctx.yield_point("engine.dispatch.prepare")
+        # the consumer device program: ordered after every enqueued copy
+        backend.flush()
+        state["read"] = [device_data.get(p, -1) for p in hit["pages"]]
+        cache.release(hit)
+        ctx.yield_point("engine.decode")
+
+    def dma():
+        # the fence-dropped copy lands eventually — too late for a
+        # consumer that already read
+        ctx.yield_point("engine.decode")
+        backend.land_late()
+        ctx.yield_point("engine.decode")
+
+    def shipper():
+        # the prefill replica's ship-at-commit export + send
+        transport.send("decode", shipment)
+        ctx.yield_point("engine.kv.ship")
+
+    ctx.spawn(shipper, "shipper")
+    ctx.spawn(receiver, "receiver")
+    ctx.spawn(admit, "admit")
+    ctx.spawn(dma, "dma")
+    ctx.run()
+    if state.get("read") is not None and state["read"] != expect:
+        raise ScheduleViolation(
+            "admission consumed {} instead of {} over shipped pages: the "
+            "import scatter was not fenced ahead of the consumer "
+            "program".format(state["read"], expect)
+        )
+    if pool.slot_pages(1):
+        pool.free(1)
+    sanitizer.check("kv-ship", drained=True)
+
+
 SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "host_buffer_handoff": scenario_host_buffer_handoff,
     "quarantine_barrier": scenario_quarantine_barrier,
@@ -661,6 +801,7 @@ SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "refcount_lock": scenario_refcount_lock,
     "tier_promotion": scenario_tier_promotion,
     "ragged_window_retire": scenario_ragged_window_retire,
+    "kv_ship": scenario_kv_ship,
 }
 
 # seeded defect -> the scenario that must catch it (self_test proves each)
@@ -672,6 +813,7 @@ MUTATIONS: Dict[str, str] = {
     "drop_lock": "refcount_lock",
     "drop_tier_fence": "tier_promotion",
     "drop_window_eos_mask": "ragged_window_retire",
+    "drop_ship_fence": "kv_ship",
 }
 
 

@@ -398,6 +398,33 @@ async def run_warmup(
             cow += 1
             p *= 2
 
+    # KV-transport movement programs (docs/disaggregation.md): a
+    # disaggregated engine's serve path adds the ship export (the
+    # host-tier demote gather verbatim, pow2-padded page lists) and the
+    # receive import (the promotion staging scatter, pow2-padded slabs) —
+    # both would otherwise compile at the first ship/receive mid-serve.
+    # Null-page round trips are dead by construction: the gather reads
+    # page 0 and the scatter writes it back, and the fence records reap
+    # below so the drained audit stays clean.
+    ship_buckets = 0
+    if full and cache is not None and (
+        getattr(engine, "_kv_transport", None) is not None
+    ):
+        max_pages = cache.pool.pages_needed(engine.max_seq_len)
+        p = 1
+        while True:
+            pages = [0] * p
+            slabs = cache.export_pages(pages)
+            cache.import_pages(
+                slabs["hk"], slabs["hv"], pages,
+                slabs.get("hk_scale"), slabs.get("hv_scale"),
+            )
+            ship_buckets += 1
+            if p >= max_pages:
+                break
+            p *= 2
+        cache.reap_promotions(force=True)
+
     # ragged finish-row gather: retire reads back only finishing admission
     # rows, padded to a power of two — warm every pad size directly
     if full and engine._ragged and engine._gather_finish_jit is not None:
@@ -435,5 +462,6 @@ async def run_warmup(
     return {
         "requests": len(plan) + 2 * len(extra_prompts or []),
         "cow_buckets": cow,
+        "ship_buckets": ship_buckets,
         "fenced": fenced,
     }

@@ -128,11 +128,31 @@ class ReplicaRouter:
         # fleet-wide brownout: every ring member at this stage sheds
         # best-effort at the router door
         fleet_shed_stage: int = 3,
+        # replica roles (docs/disaggregation.md): name -> "prefill" |
+        # "decode" | "hybrid" (missing = hybrid). Streams route to
+        # decode-capable members; the group's ship leg asks pick_prefill
+        # for a prefill-capable one. An empty role class degrades to
+        # hybrid routing (any ring member serves) instead of failing.
+        roles: Optional[Dict[str, str]] = None,
     ):
         self._replicas = list(replicas)
         self._names = [r.name for r in self._replicas]
         if len(set(self._names)) != len(self._names):
             raise ValueError("replica names must be unique: {}".format(self._names))
+        self._roles = {name: "hybrid" for name in self._names}
+        for name, role in (roles or {}).items():
+            if name not in self._roles:
+                raise ValueError(
+                    "role for unknown replica {!r} (replicas: {})".format(
+                        name, self._names
+                    )
+                )
+            if role not in ("prefill", "decode", "hybrid"):
+                raise ValueError(
+                    "replica role must be prefill/decode/hybrid: got {!r} "
+                    "for {}".format(role, name)
+                )
+            self._roles[name] = role
         self.block = int(block)
         self.affinity_blocks = int(affinity_blocks)
         self.spill_queue_depth = spill_queue_depth
@@ -227,6 +247,37 @@ class ReplicaRouter:
         ]
         return min(stages) if stages else 0
 
+    # -- roles (docs/disaggregation.md) -------------------------------------
+
+    def role_of(self, name: str) -> str:
+        return self._roles.get(name, "hybrid")
+
+    def _decode_capable(self, replica) -> bool:
+        return self.role_of(replica.name) in ("decode", "hybrid")
+
+    def _prefill_capable(self, replica) -> bool:
+        return self.role_of(replica.name) in ("prefill", "hybrid")
+
+    def pick_prefill(self, request,
+                     exclude: Optional[str] = None) -> Optional[Any]:
+        """The prefill replica for a disaggregated request's ship leg:
+        prefill-ROLE ring members first (specialization is the point),
+        then hybrids, each set in HRW order for the prompt; browned-out
+        members (stage >= the spill bound) are skipped — a degrading
+        prefill replica must not slow every stream's TTFT. Returns None
+        when nothing suitable remains (the caller degrades to hybrid:
+        the decode replica prefills for itself)."""
+        self.sweep()
+        order = [
+            r for r in self.order_for(request.prompt_ids)
+            if r.name in self._ring_members
+            and r.name != exclude
+            and self._prefill_capable(r)
+            and r.brownout_stage < self.spill_brownout_stage
+        ]
+        dedicated = [r for r in order if self.role_of(r.name) == "prefill"]
+        return (dedicated or order or [None])[0]
+
     # -- routing ------------------------------------------------------------
 
     def order_for(self, prompt_ids: Sequence[int]) -> List[Any]:
@@ -238,11 +289,20 @@ class ReplicaRouter:
         """Route one request: returns ``(replica, route)`` with ``route``
         in ``affine`` (HRW first choice), ``rebalance`` (first choice out
         of the ring — health/eject reroute), ``spill`` (first choice
-        overloaded, second strictly less pressured). Raises structured
-        errors when the fleet itself cannot take the request."""
+        overloaded, second strictly less pressured). With replica roles,
+        streams prefer DECODE-capable members (decode/hybrid); an empty
+        decode class degrades to any ring member (route ``rebalance``).
+        Raises structured errors when the fleet itself cannot take the
+        request."""
         self.sweep()
         order = self.order_for(request.prompt_ids)
-        ring = [r for r in order if r.name in self._ring_members]
+        candidates = [r for r in order if self._decode_capable(r)]
+        ring = [r for r in candidates if r.name in self._ring_members]
+        if not ring:
+            # decode class empty/ejected: hybrid degradation — any ring
+            # member takes the stream rather than shedding it
+            candidates = order
+            ring = [r for r in order if r.name in self._ring_members]
         if not ring:
             if any(r.warming for r in self._replicas):
                 raise EngineUnavailableError(
@@ -260,7 +320,9 @@ class ReplicaRouter:
                 "best-effort shed at the router".format(self.fleet_shed_stage),
                 shed_class="best_effort",
             )
-        affine = order[0]
+        # "affine" = HRW first choice AMONG role-eligible members: on a
+        # role-split fleet every stream would otherwise count rebalance
+        affine = candidates[0] if candidates else order[0]
         chosen = ring[0]
         route = "affine" if chosen is affine else "rebalance"
         if route == "affine" and len(ring) > 1:
@@ -299,6 +361,7 @@ class ReplicaRouter:
             "replicas": len(self._replicas),
             "ring_size": len(self._ring_members),
             "ring": self.ring(),
+            "roles": dict(self._roles),
             "requests": requests,
             "ejections": events["ejections"],
             "readmissions": events["readmissions"],

@@ -458,6 +458,30 @@ class EngineLifecycleCollector(_KeyedCollector):
             "promotion events: demoted runs re-onlined to HBM (async DMA "
             "on a host-tier hit, or by reference at a store)",
         )
+        # disaggregated prefill/decode (docs/disaggregation.md): pages
+        # moved through the KV transport (direction="out" = shipped at a
+        # prefill commit, "in" = imported on the decode replica), the
+        # per-operation wall time, and the decode-side ship hit rate —
+        # >= 0.9 is the clean-path headline (a shipped request's admission
+        # recomputes none of the shipped KV)
+        kv_ship_pages = CounterMetricFamily(
+            p + "_kv_ship_pages",
+            "KV pages moved through the cross-replica transport, by "
+            "direction (out = exported at a prefill-replica commit, in = "
+            "imported on a decode replica)",
+        )
+        kv_ship_ms = HistogramMetricFamily(
+            p + "_kv_ship_ms",
+            "per-shipment transport operation wall time (ms), by "
+            "direction (out = export+send at commit, in = receive+fenced "
+            "import)",
+        )
+        kv_ship_hit_rate = GaugeMetricFamily(
+            p + "_kv_ship_hit_rate",
+            "decode-replica ship hit rate: shipped requests whose "
+            "admission found the whole storable prefix resident / all "
+            "judged shipped requests (clean-path bound: >= 0.9)",
+        )
         # compile-surface discipline (docs/static_analysis.md TPU6xx): XLA
         # compilations observed by the compile sentry, split at the warmup
         # fence — phase="serve" must stay 0 on a zero-recompile-certified
@@ -489,6 +513,7 @@ class EngineLifecycleCollector(_KeyedCollector):
         any_pipeline = False
         any_kv_pool = False
         any_kv_tier = False
+        any_kv_ship = False
         any_slo = False
         any_ragged = False
         any_compile = False
@@ -512,6 +537,21 @@ class EngineLifecycleCollector(_KeyedCollector):
                     counter(kv_demotions, key, s, kv_tier["demotions"])
                 if "promotions" in kv_tier:
                     counter(kv_promotions, key, s, kv_tier["promotions"])
+            kv_ship = s.get("kv_ship") or {}
+            if kv_ship:
+                any_kv_ship = True
+                counter(kv_ship_pages, key, s,
+                        kv_ship.get("ship_pages", 0), direction="out")
+                counter(kv_ship_pages, key, s,
+                        kv_ship.get("receive_pages", 0), direction="in")
+                snap = kv_ship.get("ship_ms")
+                if snap:
+                    hist(kv_ship_ms, key, s, snap, direction="out")
+                snap = kv_ship.get("receive_ms")
+                if snap:
+                    hist(kv_ship_ms, key, s, snap, direction="in")
+                if kv_ship.get("hit_rate") is not None:
+                    gauge(kv_ship_hit_rate, key, s, kv_ship["hit_rate"])
             compile_block = s.get("compile") or {}
             if compile_block:
                 any_compile = True
@@ -621,6 +661,10 @@ class EngineLifecycleCollector(_KeyedCollector):
             yield kv_tier_bytes
             yield kv_demotions
             yield kv_promotions
+        if any_kv_ship:
+            yield kv_ship_pages
+            yield kv_ship_ms
+            yield kv_ship_hit_rate
         if any_compile:
             yield xla_compiles
             yield xla_compile_ms
@@ -661,21 +705,30 @@ class ReplicaRouterCollector(_KeyedCollector):
         )
         requests = CounterMetricFamily(
             p + "_requests_total",
-            "routing decisions, by replica and route (affine = HRW first "
-            "choice, spill = load-aware second choice, rebalance = "
-            "health/eject reroute); decisions can exceed served requests "
-            "when a stale pin re-routes between admission and generation",
-            labels=["model", "replica", "route"],
+            "routing decisions, by replica, route and role (affine = HRW "
+            "first choice, spill = load-aware second choice, rebalance = "
+            "health/eject reroute; role = the replica's prefill/decode/"
+            "hybrid specialization, docs/disaggregation.md); decisions "
+            "can exceed served requests when a stale pin re-routes "
+            "between admission and generation",
+            labels=["model", "replica", "route", "role"],
         )
         ejections = CounterMetricFamily(
             p + "_ejections_total",
             "ring ejections (engine not ready, or fault-forced via the "
-            "router.eject seam)", labels=["model", "replica"],
+            "router.eject seam)", labels=["model", "replica", "role"],
         )
         readmissions = CounterMetricFamily(
             p + "_readmissions_total",
             "ring re-admissions after recovery (each re-warmed through "
-            "the warmup gate first)", labels=["model", "replica"],
+            "the warmup gate first)",
+            labels=["model", "replica", "role"],
+        )
+        role_members = GaugeMetricFamily(
+            p + "_role_members",
+            "ring members currently serving, by replica role "
+            "(docs/disaggregation.md; hybrid-only fleets report every "
+            "member as hybrid)", labels=["model", "role"],
         )
         fleet_stage = GaugeMetricFamily(
             p + "_fleet_brownout_stage",
@@ -694,17 +747,34 @@ class ReplicaRouterCollector(_KeyedCollector):
             except Exception:
                 continue
             model = str(s.get("model") or key)
+            roles = s.get("roles") or {}
+
+            def role_of(name):
+                return str(roles.get(name, "hybrid"))
+
             if "ring_size" in s:
                 ring_size.add_metric([model], s["ring_size"])
             if "replicas" in s:
                 replicas.add_metric([model], s["replicas"])
             for name, routes in (s.get("requests") or {}).items():
                 for route, v in (routes or {}).items():
-                    requests.add_metric([model, str(name), str(route)], v)
+                    requests.add_metric(
+                        [model, str(name), str(route), role_of(name)], v
+                    )
             for name, v in (s.get("ejections") or {}).items():
-                ejections.add_metric([model, str(name)], v)
+                ejections.add_metric([model, str(name), role_of(name)], v)
             for name, v in (s.get("readmissions") or {}).items():
-                readmissions.add_metric([model, str(name)], v)
+                readmissions.add_metric([model, str(name), role_of(name)], v)
+            ring = set(s.get("ring") or [])
+            if ring or roles:
+                by_role = {}
+                for name in ring:
+                    by_role[role_of(name)] = by_role.get(role_of(name), 0) + 1
+                for role in ("prefill", "decode", "hybrid"):
+                    if role in by_role or role in roles.values():
+                        role_members.add_metric(
+                            [model, role], by_role.get(role, 0)
+                        )
             brown = s.get("fleet_brownout") or {}
             if "stage" in brown:
                 fleet_stage.add_metric([model], brown["stage"])
@@ -715,6 +785,7 @@ class ReplicaRouterCollector(_KeyedCollector):
         yield requests
         yield ejections
         yield readmissions
+        yield role_members
         yield fleet_stage
         yield fleet_sheds
 

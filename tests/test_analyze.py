@@ -641,11 +641,13 @@ def test_guarded_by_declarations_match_project_registry():
         PagedKVCache,
         PagePool,
     )
+    from clearml_serving_tpu.llm.kv_transport import SharedSlabTransport
     from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
     from clearml_serving_tpu.serving.replica_router import ReplicaRouter
 
     for cls in (PagePool, PagedKVCache, RadixPrefixCache,
-                _ClassedPendingQueue, HostKVTier, ReplicaRouter):
+                _ClassedPendingQueue, HostKVTier, ReplicaRouter,
+                SharedSlabTransport):
         for lock, attrs in cls.__guarded_by__.items():
             for attr in attrs:
                 entry = rules_locks.PROJECT_REGISTRY.get(attr)

@@ -159,3 +159,87 @@ def test_replica_artifact_internal_consistency():
     assert chaos["ring_recovered"] is True
     assert chaos["untouched_streams_identical"] is True
     assert chaos["failover_stream_identical"] is True
+
+
+# -- disaggregated prefill/decode artifact (benchmarks/DISAGG_AB_cpu.json,
+# docs/disaggregation.md; regenerated by
+# `bench.py --loadtest --replicas 2 --disaggregated --smoke`) ---------------
+
+from benchmarks import disagg_loadtest  # noqa: E402
+
+
+def _disagg_artifact():
+    return json.loads(
+        (REPO / "benchmarks" / "DISAGG_AB_cpu.json").read_text()
+    )
+
+
+def test_disagg_artifact_schema():
+    row = _disagg_artifact()
+    assert disagg_loadtest.SCHEMA_KEYS <= set(row), "missing top-level keys"
+    assert row["metric"].startswith("llm_disagg_loadtest")
+    assert row["replicas"] >= 2
+    assert len(row["arms"]) == 3
+    for arm in row["arms"]:
+        assert disagg_loadtest.ARM_KEYS <= set(arm), arm.keys()
+    assert [a["name"] for a in row["arms"]] == ["mono", "hybrid", "disagg"]
+    assert row["arms"][0]["replicas"] == 1
+    assert row["arms"][2]["replicas"] == row["replicas"]
+    assert "decode" in row["arms"][2]["roles"]
+    assert "prefill" in row["arms"][2]["roles"]
+    assert disagg_loadtest.HEADLINE_KEYS <= set(row["headline"])
+
+
+def test_disagg_artifact_headline_passes():
+    """The committed artifact must carry a PASSING ISSUE-14 headline:
+    ship hit rate >= 0.9 on the clean path (the decode replica's
+    admissions recompute none of the shipped KV), byte-identical streams
+    across all three arms, zero sanitizer violations, and zero
+    post-warmup compiles under the strict sentry."""
+    row = _disagg_artifact()
+    head = row["headline"]
+    assert head["ship_ok"] is True
+    assert head["ship_hit_rate"] >= head["ship_hit_bound"] == 0.9
+    assert head["streams_identical"] is True
+    assert head["post_warmup_compiles"] == 0
+    assert head["compile_sentry_mode"] in ("log", "monitoring")
+    assert head["sanitizer_checks"] > 0
+    assert head["sanitizer_violations"] == 0
+
+
+def test_disagg_artifact_internal_consistency():
+    row = _disagg_artifact()
+    a1, a2, a3 = row["arms"]
+    head = row["headline"]
+    # every arm replayed the same trace, and nothing was lost
+    assert a1["requests"] == a2["requests"] == a3["requests"]
+    for arm in row["arms"]:
+        assert arm["completed"] + arm["shed"] + arm["errors"] == arm["requests"]
+        assert arm["completed"] == arm["requests"], "clean path must complete"
+        assert arm["sanitizer_violations"] == 0
+        assert arm["post_warmup_compiles"] == 0
+    # only the disagg arm carries transport traffic; its clean path took
+    # no drops, no receive failures, no re-routes
+    assert a1["kv_ship"] is None and a1["disaggregation"] is None
+    assert a3["kv_ship"] is not None and a3["disaggregation"] is not None
+    ship = a3["kv_ship"]
+    dis = a3["disaggregation"]
+    assert head["ship_hit_rate"] == ship["hit_rate"]
+    assert ship["hits"] > 0 and ship["receives"] > 0
+    assert ship["ships"] == ship["receives"], "clean path: every shipment lands"
+    # the import attaches only MISSING blocks (earlier turns' blocks are
+    # already resident on the decode replica), so pages imported can be
+    # fewer than pages shipped — never more
+    assert 0 < ship["receive_pages"] <= ship["ship_pages"]
+    assert dis["ship_leg_failures"] == 0
+    assert dis["receive_reroutes"] == 0
+    assert dis["transport"]["dropped"] == 0
+    # every judged shipped request either hit or recomputed; the clean
+    # path's ship legs all produced a judged outcome
+    assert ship["hits"] + ship["recomputes"] == dis["ship_legs"]
+    # byte-identity columns restate the arms
+    assert a2["streams_identical_to_mono"] is True
+    assert a3["streams_identical_to_mono"] is True
+    assert head["goodput_tok_s_mono"] == a1["goodput_tok_s"]
+    assert head["goodput_tok_s_hybrid"] == a2["goodput_tok_s"]
+    assert head["goodput_tok_s_disagg"] == a3["goodput_tok_s"]

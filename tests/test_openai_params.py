@@ -1198,3 +1198,141 @@ def test_canary_weights_across_replica_groups(tmp_path):
         return True
 
     assert _run(mrp, fn)
+
+
+def test_replica_roles_knob_typo_fails_at_endpoint_load(tmp_path):
+    """aux engine.replica_roles is validated when the endpoint LOADS
+    (docs/disaggregation.md): a bad role value fails fast naming the
+    knob and the endpoint never registers."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="badroles"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="bad_roles",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "cache": "paged",
+                    "page_size": 16,
+                    "prefix_cache": 32,
+                    "prefix_block": 16,
+                    "replicas": 2,
+                    "replica_roles": ["prefill", "decoder"],  # typo
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "bad_roles", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "replica_roles" in text, (status, text)
+    assert "bad_roles" not in mrp._engine_processor_lookup
+
+
+def test_replica_roles_without_fleet_fails_at_endpoint_load(tmp_path):
+    """engine.replica_roles on a single-replica endpoint is a config
+    contradiction: fail at load naming both knobs."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="soloroles"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="solo_roles",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 1,
+                    "max_seq_len": 64,
+                    "prefill_buckets": [16],
+                    "replica_roles": "prefill,decode",
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "solo_roles", "prompt": [1, 2], "max_tokens": 2},
+        )
+        return r.status, await r.text()
+
+    status, text = _run(mrp, fn)
+    assert status == 422 and "replica_roles" in text, (status, text)
+
+
+def test_disaggregated_endpoint_serves_and_ships(tmp_path):
+    """aux engine.replicas=2 + engine.replica_roles=prefill,decode builds
+    a disaggregated fleet behind the endpoint: requests serve through the
+    role-aware router, the prefill replica ships every admission's prefix
+    KV to the decode replica, and /health carries the disaggregation
+    block (docs/disaggregation.md)."""
+    mrp = ModelRequestProcessor(
+        state_root=str(tmp_path), force_create=True, name="disagg"
+    )
+    mrp.add_endpoint(
+        ModelEndpoint(
+            engine_type="llm",
+            serving_url="disagg_llm",
+            auxiliary_cfg={
+                "engine": {
+                    "preset": "llama-tiny",
+                    "config": {"dtype": "float32"},
+                    "max_batch": 2,
+                    "max_seq_len": 128,
+                    "prefill_buckets": [32, 64],
+                    "cache": "paged",
+                    "page_size": 16,
+                    "prefix_cache": 64,
+                    "prefix_block": 16,
+                    "replicas": 2,
+                    "replica_roles": "prefill,decode",
+                    "kv_transport_pages": 32,
+                }
+            },
+        )
+    )
+    mrp.serialize()
+    mrp.deserialize(skip_sync=True)
+
+    async def fn(client):
+        prompt = [(3 + i * 7) % 90 + 1 for i in range(40)]
+        r = await client.post(
+            "/serve/openai/v1/completions",
+            json={"model": "disagg_llm", "prompt": prompt, "max_tokens": 2},
+        )
+        assert r.status == 200, await r.text()
+        group = mrp._engine_processor_lookup["disagg_llm"].engine
+        assert group.router.role_of("r0") == "prefill"
+        assert group.router.role_of("r1") == "decode"
+        assert group.transport is not None
+        assert group.transport.capacity_pages == 32
+        dis = group._disagg_snapshot()
+        assert dis["ship_legs"] == 1 and dis["ship_leg_failures"] == 0
+        decode = group.replicas[1].engine._kv_ship_snapshot()
+        assert decode["receives"] == 1 and decode["hits"] == 1
+        health = group.health()
+        assert health["disaggregation"]["roles"] == {
+            "r0": "prefill", "r1": "decode"
+        }
+        return True
+
+    assert _run(mrp, fn) is True

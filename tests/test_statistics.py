@@ -903,16 +903,177 @@ def test_replica_router_collector_exports_ring_and_routes():
 
     assert val("router_ring_size") == 1
     assert val("router_replicas") == 2
-    assert val("router_requests_total", replica="r0", route="affine") == 5
-    assert val("router_requests_total", replica="r0", route="spill") == 1
-    assert val("router_requests_total", replica="r1", route="rebalance") == 0
-    assert val("router_ejections_total", replica="r1") == 1
-    assert val("router_readmissions_total", replica="r1") == 1
+    # providers without a roles map default every member to role="hybrid"
+    assert val("router_requests_total", replica="r0", route="affine",
+               role="hybrid") == 5
+    assert val("router_requests_total", replica="r0", route="spill",
+               role="hybrid") == 1
+    assert val("router_requests_total", replica="r1", route="rebalance",
+               role="hybrid") == 0
+    assert val("router_ejections_total", replica="r1", role="hybrid") == 1
+    assert val("router_readmissions_total", replica="r1",
+               role="hybrid") == 1
     assert val("router_fleet_brownout_stage") == 2
     assert val("router_fleet_sheds_total", **{"class": "best_effort"}) == 4
     # the ring gauge reads live on the next scrape
     stats["ring_size"] = 2
     assert val("router_ring_size") == 2
+
+
+def test_replica_router_role_label_and_role_members():
+    """Role-split fleets (docs/disaggregation.md): the per-replica
+    router families carry the replica's role, and router_role_members
+    gauges the ring composition by role."""
+    from clearml_serving_tpu.statistics.metrics import register_replica_router
+
+    stats = {
+        "replicas": 2,
+        "ring_size": 2,
+        "ring": ["r0", "r1"],
+        "roles": {"r0": "prefill", "r1": "decode"},
+        "requests": {
+            "r0": {"affine": 1, "spill": 0, "rebalance": 0},
+            "r1": {"affine": 7, "spill": 0, "rebalance": 1},
+        },
+        "ejections": {"r0": 2, "r1": 0},
+        "readmissions": {"r0": 2, "r1": 0},
+        "fleet_sheds": {"best_effort": 0},
+        "fleet_brownout": {"stage": 0, "stages": {"r0": 0, "r1": 0}},
+    }
+    registry = CollectorRegistry()
+    register_replica_router(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("router_requests_total", replica="r1", route="affine",
+               role="decode") == 7
+    assert val("router_requests_total", replica="r0", route="affine",
+               role="prefill") == 1
+    assert val("router_ejections_total", replica="r0", role="prefill") == 2
+    assert val("router_role_members", role="prefill") == 1
+    assert val("router_role_members", role="decode") == 1
+    # a member leaving the ring moves the role gauge on the next scrape
+    stats["ring"] = ["r1"]
+    assert val("router_role_members", role="prefill") == 0
+
+
+def test_engine_kv_ship_metrics_exported():
+    """engine_kv_ship_pages_total{direction} / engine_kv_ship_ms /
+    engine_kv_ship_hit_rate from a synthetic lifecycle provider carrying
+    the kv_ship block (docs/disaggregation.md)."""
+    from clearml_serving_tpu.statistics.metrics import (
+        register_engine_lifecycle,
+    )
+
+    stats = {
+        "model": "m1",
+        "replica": "r1",
+        "queue_depth": 0,
+        "active_slots": 0,
+        "ready": 1,
+        "kv_ship": {
+            "role": "decode",
+            "ships": 0, "ship_pages": 0, "ship_drops": 0,
+            "receives": 4, "receive_pages": 9,
+            "receive_empty": 1, "receive_failures": 0,
+            "hits": 4, "recomputes": 1, "hit_rate": 0.8,
+            "ship_ms": {"buckets": [1, 5], "counts": [0, 0, 0],
+                        "sum_ms": 0.0},
+            "receive_ms": {"buckets": [1, 5], "counts": [2, 1, 1],
+                           "sum_ms": 12.5},
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(
+            name, {"model": "m1", "replica": "r1", **labels}
+        )
+
+    assert val("engine_kv_ship_pages_total", direction="out") == 0
+    assert val("engine_kv_ship_pages_total", direction="in") == 9
+    assert val("engine_kv_ship_hit_rate") == 0.8
+    assert val("engine_kv_ship_ms_count", direction="in") == 4
+    assert val("engine_kv_ship_ms_sum", direction="in") == 12.5
+    # counters move on the next scrape
+    stats["kv_ship"]["receive_pages"] = 12
+    assert val("engine_kv_ship_pages_total", direction="in") == 12
+
+
+def test_disagg_fleet_real_engine_end_to_end():
+    """End to end against a REAL prefill/decode-split group: the decode
+    replica's lifecycle provider exports the ship families after a
+    disaggregated request actually shipped (docs/disaggregation.md)."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+    from clearml_serving_tpu.llm.replica import ReplicaGroup
+    from clearml_serving_tpu.statistics.metrics import (
+        register_engine_lifecycle,
+        register_replica_router,
+    )
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engines = [
+        LLMEngineCore(
+            bundle, params, replica="r{}".format(i), max_batch=2,
+            max_seq_len=128, prefill_buckets=[32, 64], eos_token_id=None,
+            cache_mode="paged", page_size=16, prefix_cache=64,
+            prefix_block=16, num_pages=65,
+        )
+        for i in range(2)
+    ]
+    group = ReplicaGroup(engines, roles=["prefill", "decode"])
+    try:
+        registry = CollectorRegistry()
+        for replica in group.replicas:
+
+            def provider(engine=replica.engine):
+                s = engine.lifecycle_stats()
+                s["model"] = "fleet"
+                return s
+
+            register_engine_lifecycle(
+                provider, registry=registry, key="fleet@" + replica.name
+            )
+        register_replica_router(
+            lambda: dict(group.router.stats(), model="fleet"),
+            registry=registry, key="fleet",
+        )
+
+        async def run():
+            conv = [(5 + i * 3) % 90 + 1 for i in range(40)]
+            request = GenRequest(prompt_ids=conv, max_new_tokens=2)
+            async for _ in group.generate(request):
+                pass
+            await group.wait_drained()
+
+        asyncio.run(run())
+
+        def val(name, **labels):
+            return registry.get_sample_value(
+                name, {"model": "fleet", **labels}
+            )
+
+        assert val("engine_kv_ship_pages_total", replica="r0",
+                   direction="out") >= 1
+        assert val("engine_kv_ship_pages_total", replica="r1",
+                   direction="in") >= 1
+        assert val("engine_kv_ship_hit_rate", replica="r1") == 1.0
+        assert val("router_role_members", role="decode") == 1
+        assert val("router_role_members", role="prefill") == 1
+        assert val("router_requests_total", replica="r1", route="affine",
+                   role="decode") == 1
+    finally:
+        group.stop()
 
 
 def test_replica_fleet_real_engine_end_to_end():
@@ -984,7 +1145,7 @@ def test_replica_fleet_real_engine_end_to_end():
         assert val("router_ring_size") == 2
         home_id = home  # "r0"/"r1"
         assert val("router_requests_total", replica=home_id,
-                   route="affine") == 2
+                   route="affine", role="hybrid") == 2
     finally:
         group.stop()
 
