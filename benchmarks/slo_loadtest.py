@@ -64,6 +64,11 @@ HEADLINE_KEYS = {
     # sentry — the committed artifact asserts 0, so every number in it is
     # zero-recompile-certified (no mid-run compile stall hid in a tail)
     "post_warmup_compiles", "compile_sentry_mode",
+    # ownership certification (docs/static_analysis.md TPU7xx): lost
+    # releases found by the strict ownership ledger across every
+    # preemption/shed/deadline/cancel path the sweep exercised — the
+    # committed artifact asserts 0, so the run is leak-free-certified
+    "leaks", "ledger_mode",
 }
 
 # the mixed trace: weights sum to 1. Chat + tool loops share system
@@ -398,6 +403,11 @@ async def _run_async(smoke: bool) -> dict:
             sentry.stats_brief() if sentry is not None
             else {"mode": "off", "serve": -1, "fenced": False}
         )
+        ledger = engine._ledger
+        ledger_stats = (
+            ledger.stats() if ledger is not None
+            else {"strict": False, "leaks": -1, "double_releases": -1}
+        )
         loop_exc = None
         task = engine._loop_task
         if task is not None and task.done() and not task.cancelled():
@@ -451,6 +461,20 @@ async def _run_async(smoke: bool) -> dict:
             # counted AFTER llm/warmup.py's fence (tier-1 asserts 0)
             "post_warmup_compiles": sentry_stats.get("serve", -1),
             "compile_sentry_mode": sentry_stats.get("mode", "off"),
+            # leak-free certification (docs/static_analysis.md TPU7xx):
+            # lost releases + double frees found by the strict ownership
+            # ledger across the whole sweep (tier-1 asserts 0) — and the
+            # run itself FAILS on one in strict mode, so completing at
+            # all is the certificate
+            "leaks": (
+                ledger_stats.get("leaks", -1)
+                + ledger_stats.get("double_releases", 0)
+                if ledger_stats.get("leaks", -1) >= 0 else -1
+            ),
+            "ledger_mode": (
+                "strict" if ledger_stats.get("strict")
+                else ("count" if ledger is not None else "off")
+            ),
         },
         "warmup": warm,
     }
@@ -469,18 +493,28 @@ def run(smoke: bool = True, write_artifact: bool = True) -> dict:
     # environment would silently downgrade the certification run to
     # count-only mode while the docstring still claims strict
     os.environ["TPUSERVE_COMPILE_SENTRY"] = "strict"
+    # leak-free certification (docs/static_analysis.md TPU7xx): the strict
+    # ownership ledger fails the run on ANY lost release across the
+    # sweep's preemption/shed/deadline paths — the committed headline's
+    # `leaks: 0` is proven, not sampled
+    os.environ["TPUSERVE_LEDGER"] = "strict"
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    from clearml_serving_tpu.llm import compile_sentry
+    from clearml_serving_tpu.llm import compile_sentry, lifecycle_ledger
 
     if compile_sentry.enabled():
         # a fresh fence for THIS run (the sentry is process-wide and the
         # battery may have exercised it already in-process)
         compile_sentry.get().reset(strict=compile_sentry.strict_enabled())
+    if lifecycle_ledger.enabled():
+        # fresh books for THIS run, same reason
+        lifecycle_ledger.arm().reset(
+            strict=lifecycle_ledger.strict_enabled()
+        )
     row = asyncio.run(_run_async(smoke))
     if write_artifact:
         ARTIFACT.write_text(json.dumps(row, indent=2) + "\n")

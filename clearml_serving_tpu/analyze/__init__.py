@@ -32,6 +32,7 @@ import ast
 import io
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -42,6 +43,7 @@ __all__ = [
     "analyze_source",
     "analyze_file",
     "analyze_paths",
+    "expand_select",
     "iter_python_files",
 ]
 
@@ -175,6 +177,38 @@ RULES: Dict[str, Tuple[str, str]] = {
         "make the argument dynamic, or bucketize it first so the static "
         "key space is finite",
     ),
+    "TPU701": (
+        "declared acquire can leak: some path (usually an exception edge) "
+        "reaches the function exit without a matching release, "
+        "drop-to-recompute handler, or ownership transfer",
+        "release on the failure path (try/except + release + raise, or "
+        "try/finally), route through the registered drop handler, or "
+        "annotate a real ownership transfer with "
+        "`# tpuserve: ignore[TPU701] <where ownership went>`",
+    ),
+    "TPU702": (
+        "release not dominated by its acquire: a second matching release "
+        "on a path that already discharged the obligation (the "
+        "double-free / use-after-free shape)",
+        "release exactly once per acquire; guard the cleanup path so "
+        "recovery code cannot re-free what the normal path freed",
+    ),
+    "TPU703": (
+        "page-id publish not fence-ordered: freshly minted pool pages "
+        "become visible (`.pages = ...`) without the enqueue-before-"
+        "publish fence (import_pages/promote_pages) ordering their "
+        "payload first — the drop_ship_fence/drop_tier_fence defect class",
+        "enqueue the upload/scatter BEFORE assigning the page ids to any "
+        "shared structure; consumers are then ordered after the copy by "
+        "data dependency on the pool handles (docs/kv_tiering.md)",
+    ),
+    "TPU704": (
+        "transport shipment consumed twice, or its payload slabs reused "
+        "after the store_shipped attach consumed them (recv is a "
+        "consume-once pop; the import copies the slab rows it needs)",
+        "pop once per key and drop the handle after the attach; re-read "
+        "the imported pages through the radix cache, not the shipment",
+    ),
 }
 
 
@@ -304,17 +338,43 @@ def _filter_ignored(
 # -- driver -------------------------------------------------------------------
 
 
+def expand_select(select: Iterable[str]) -> Set[str]:
+    """Rule selector -> concrete rule codes. Accepts exact codes
+    (``TPU301``), family patterns (``TPU7xx``/``TPU3XX``), and bare family
+    prefixes (``TPU7``): CI and pre-commit runs select whole families as
+    the catalog grows. Unknown exact codes pass through (the caller may be
+    selecting against a newer catalog)."""
+    chosen: Set[str] = set()
+    for raw in select:
+        token = raw.strip().upper()
+        if not token:
+            continue
+        if token.endswith("XX") and len(token) > 2:
+            prefix = token[:-2]
+            chosen |= {c for c in RULES if c.startswith(prefix)}
+        elif token in RULES:
+            chosen.add(token)
+        else:
+            matches = {c for c in RULES if c.startswith(token)}
+            chosen |= matches or {token}
+    return chosen
+
+
 def analyze_source(
     source: str,
     path: str = "<string>",
     select: Optional[Iterable[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
-    """All findings for one module's source text (ignores already applied)."""
+    """All findings for one module's source text (ignores already applied).
+    ``timings`` (module name -> seconds) accumulates per-family analyzer
+    cost when provided (scripts/check.sh reports it)."""
     from . import (
         rules_async,
         rules_compile,
         rules_errors,
         rules_jit,
+        rules_lifecycle,
         rules_locks,
         rules_threads,
     )
@@ -329,22 +389,48 @@ def analyze_source(
                 "the analyzer (and the interpreter) cannot parse this file",
             )
         ]
+    chosen = expand_select(select) if select is not None else None
+    # family -> rule module: a selected run skips modules with no selected
+    # codes entirely (the CI fast lanes run one family, not all-then-drop)
+    modules = (
+        (rules_async, ("TPU1",)),
+        (rules_jit, ("TPU2",)),
+        (rules_locks, ("TPU3",)),
+        (rules_errors, ("TPU4",)),
+        (rules_threads, ("TPU5",)),
+        (rules_compile, ("TPU6",)),
+        (rules_lifecycle, ("TPU7",)),
+    )
     findings: List[Finding] = []
-    for mod in (rules_async, rules_jit, rules_locks, rules_errors,
-                rules_threads, rules_compile):
-        findings.extend(mod.check(tree, path, source))
+    for mod, prefixes in modules:
+        if chosen is not None and not any(
+            c.startswith(prefixes) for c in chosen
+        ):
+            continue
+        if timings is None:
+            findings.extend(mod.check(tree, path, source))
+        else:
+            t0 = time.perf_counter()
+            findings.extend(mod.check(tree, path, source))
+            name = mod.__name__.rsplit(".", 1)[-1]
+            timings[name] = timings.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
     ignores = _scope_ignores(tree, _ignore_map(source))
     findings = _filter_ignored(findings, ignores)
-    if select is not None:
-        chosen = {c.upper() for c in select}
+    if chosen is not None:
         findings = [f for f in findings if f.code in chosen]
     findings.sort(key=Finding.sort_key)
     return findings
 
 
-def analyze_file(path: str, select: Optional[Iterable[str]] = None) -> List[Finding]:
+def analyze_file(
+    path: str,
+    select: Optional[Iterable[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
-        return analyze_source(fh.read(), path, select=select)
+        return analyze_source(fh.read(), path, select=select, timings=timings)
 
 
 _SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", "build", "dist"}
@@ -366,9 +452,11 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def analyze_paths(
-    paths: Sequence[str], select: Optional[Iterable[str]] = None
+    paths: Sequence[str],
+    select: Optional[Iterable[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     findings: List[Finding] = []
     for path in iter_python_files(paths):
-        findings.extend(analyze_file(path, select=select))
+        findings.extend(analyze_file(path, select=select, timings=timings))
     return findings

@@ -3,6 +3,14 @@
 Exit status 0 when the tree is clean, 1 when any finding survives the
 inline ignores — tier-1 (scripts/check.sh) treats non-zero as a hard fail
 and prints the per-rule table so the offending invariant is obvious.
+
+``--select`` accepts exact codes and family patterns (``TPU7xx``/``TPU3``)
+so CI lanes can run one family; ``--changed-only`` restricts findings to
+lines a ``git diff`` against ``--diff-base`` (default HEAD) touched, so
+pre-commit runs stay proportional to the change, not the tree;
+``--timings`` prints per-family analyzer cost (scripts/check.sh reports
+it so the gate's latency stays visible as the rule count grows). Exit
+codes and ``--format json|github`` are identical in every mode.
 """
 
 from __future__ import annotations
@@ -10,10 +18,57 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
 from collections import Counter
+from typing import Dict, Optional, Set
 
 from . import RULES, analyze_paths
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(paths, base: str = "HEAD") -> Optional[Dict[str, Set[int]]]:
+    """abs path -> line numbers touched by ``git diff base`` (working tree
+    included). None when git is unavailable / not a repository — the
+    caller then falls back to a full run rather than silently passing."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        # absolute pathspecs: the diff runs from the repo root while the
+        # caller's paths are relative to ITS cwd — a relative pathspec
+        # would silently match nothing from a subdirectory and report the
+        # run clean
+        diff = subprocess.run(
+            ["git", "diff", "--unified=0", base, "--"]
+            + [os.path.abspath(p) for p in paths],
+            capture_output=True, text=True, check=True, cwd=top,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    out: Dict[str, Set[int]] = {}
+    current: Optional[Set[int]] = None
+    for line in diff.splitlines():
+        if line.startswith("+++ "):
+            name = line[4:]
+            if name.startswith("b/"):
+                name = name[2:]
+            if name == "/dev/null":
+                current = None
+            else:
+                current = out.setdefault(
+                    os.path.abspath(os.path.join(top, name)), set()
+                )
+        elif current is not None:
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                current.update(range(start, start + max(count, 1)))
+    return out
 
 
 def _default_root() -> str:
@@ -33,7 +88,22 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--select", default=None,
-        help="comma-separated rule codes to run (default: all)",
+        help="comma-separated rule codes or family patterns to run "
+        "(TPU301, TPU7xx, TPU5; default: all)",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="report only findings on lines touched by `git diff "
+        "<--diff-base>` (pre-commit/CI fast path; exit codes unchanged)",
+    )
+    parser.add_argument(
+        "--diff-base", default="HEAD",
+        help="base ref for --changed-only (default: HEAD — the working "
+        "tree's uncommitted changes; use origin/main for PR lanes)",
+    )
+    parser.add_argument(
+        "--timings", action="store_true",
+        help="print per-family analyzer wall time after the run",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
@@ -64,7 +134,30 @@ def main(argv=None) -> int:
         if args.select
         else None
     )
-    findings = analyze_paths(paths, select=select)
+    timings = {} if args.timings else None
+    findings = analyze_paths(paths, select=select, timings=timings)
+    if args.changed_only:
+        touched = changed_lines(paths, base=args.diff_base)
+        if touched is None:
+            print(
+                "tpuserve-analyze: --changed-only needs a git checkout; "
+                "running the full set instead", file=sys.stderr,
+            )
+        else:
+            findings = [
+                f for f in findings
+                if f.line in touched.get(os.path.abspath(f.path), ())
+            ]
+
+    def _print_timings() -> None:
+        if timings is None:
+            return
+        total = sum(timings.values())
+        print("\nper-family analyzer time:")
+        for name in sorted(timings, key=timings.get, reverse=True):
+            print("  {:<18} {:>7.1f} ms".format(name, timings[name] * 1e3))
+        print("  {:<18} {:>7.1f} ms".format("total", total * 1e3))
+
     if args.format == "json":
         # machine output: findings only, nothing else on stdout — a clean
         # tree prints zero lines and exits 0
@@ -106,12 +199,15 @@ def main(argv=None) -> int:
             "\nsilence a deliberate violation with "
             "`# tpuserve: ignore[CODE] reason` on the offending line."
         )
+        _print_timings()
         return 1
     print(
-        "tpuserve-analyze: clean ({} rule(s) over {})".format(
-            len(RULES), ", ".join(paths)
+        "tpuserve-analyze: clean ({} rule(s) over {}{})".format(
+            len(RULES), ", ".join(paths),
+            ", changed lines only" if args.changed_only else "",
         )
     )
+    _print_timings()
     return 0
 
 

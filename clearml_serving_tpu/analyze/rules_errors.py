@@ -50,6 +50,7 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.kv.promote",
     "engine.kv.ship",
     "engine.kv.receive",
+    "engine.ledger.leak",
     "engine.compile.bucket",
     "router.pick",
     "router.eject",

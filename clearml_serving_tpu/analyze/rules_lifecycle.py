@@ -1,0 +1,1045 @@
+"""TPU7xx — acquire/release ownership discipline over exception paths.
+
+The engine tier moves KV ownership through five hand-audited protocols —
+page refs/pins, slot quarantine, host-tier ids, promotion fences, transport
+shipments — and every "leak-free" claim in docs/kv_tiering.md and
+docs/disaggregation.md used to rest on manual review of the failure paths.
+This family makes acquire/release pairing a machine-checked invariant class
+(the seventh), the way TPU3xx did locks, TPU5xx thread affinity, and TPU6xx
+the compile surface.
+
+Per analyzed function the checker builds a statement-level CFG **with
+exception edges**: every statement containing a call/await/assert may raise
+into the enclosing handler chain (or out of the function), ``finally``
+blocks are routed on every exit kind, and early ``return``/``raise`` paths
+are explicit. Declared acquires are then walked path-by-path:
+
+- **TPU701** — an acquire reaches a function exit (normal or raising) on
+  some path without a matching release, drop-to-recompute handler, or
+  ownership escape. The classic shape: ``pages = pool.allocate(...)`` then
+  a fallible call before the ``pool.free`` — the exception path leaks.
+- **TPU702** — a second matching release on a path where the obligation was
+  already discharged (the double-free / use-after-free shape).
+- **TPU703** — freshly minted pool page ids (``allocate_cache_pages``)
+  published (``<node>.pages = ...``) without being dominated by the
+  enqueue-before-publish fence call (``import_pages`` / ``promote_pages``)
+  — the ``drop_ship_fence``/``drop_tier_fence`` defect class of
+  llm/schedule_explorer.py, caught at lint time.
+- **TPU704** — a transport shipment popped twice for the same key on one
+  path, or its payload slabs used again after the ``store_shipped`` attach
+  consumed them.
+
+Protocols are declared next to the code via ``__acquires__`` class
+annotations (sibling of ``__guarded_by__``/``__affine_to__``/
+``__compile_keys__``)::
+
+    class PagePool:
+        __acquires__ = {
+            "allocate": {"resource": "pages.slot",
+                         "releases": ("free", "truncate"),
+                         "drops": ("_free_slot_pages",)},
+        }
+
+mirrored in :data:`LIFECYCLE_REGISTRY` below (cross-module call sites are
+checked even when the declaring file is not being analyzed; the
+``__acquires__``/registry agreement is pinned by tests). Entries with
+``"static": False`` are cross-function protocols by design (quarantine,
+guided-grammar refs, long-lived cache refs): the static pass skips TPU701
+for them and the runtime ownership ledger (llm/lifecycle_ledger.py,
+``TPUSERVE_LEDGER=1|strict``) audits their pairing instead.
+
+Blind spots (all deliberate, all fail-open, all covered by the ledger):
+handles stored into attributes/containers, returned, or passed to any
+non-release call count as ownership transfers; pairing across functions and
+threads is invisible; aliased handles are not tracked. A silenced site
+carries ``# tpuserve: ignore[TPU701] <why ownership moved>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from . import Finding, RULES, dotted_name as _dotted
+
+# -- protocol registry --------------------------------------------------------
+#
+# acquire method name -> tuple of protocol entries. "releases" discharge the
+# obligation; "drops" are registered drop-to-recompute handlers (discharge
+# too, but documented as the degraded path); "static": False marks a
+# protocol whose pairing is cross-function by design — the runtime ledger
+# (llm/lifecycle_ledger.py) audits it, the static pass only uses the entry
+# for TPU702 matching and the __acquires__ consistency test.
+LIFECYCLE_REGISTRY: Dict[str, Tuple[Dict[str, Any], ...]] = {
+    # PagePool slot pages (kv_cache.py): allocate/extend/map_shared give a
+    # slot references; free/truncate drop them; the engine's deferred path
+    # is _free_slot_pages (quarantine barrier). "receivers" filters the
+    # obligation to receivers whose FINAL dotted component is listed (the
+    # rules_locks mechanism): `allocate`/`extend` are generic names —
+    # without the filter every list.extend in the tree would match.
+    "allocate": (
+        {"resource": "pages.slot", "releases": ("free", "truncate"),
+         "drops": ("_free_slot_pages",), "static": True,
+         "receivers": ("pool", "_pool", "page_pool", "pages")},
+        # HostKVTier id allocator shares the method name; same release name
+        {"resource": "host.pages", "releases": ("free",),
+         "drops": (), "static": True,
+         "receivers": ("host_tier", "_host", "tier", "host")},
+    ),
+    "extend": (
+        {"resource": "pages.slot", "releases": ("free", "truncate"),
+         "drops": ("_free_slot_pages",), "static": True,
+         "receivers": ("pool", "_pool", "page_pool")},
+    ),
+    "map_shared": (
+        {"resource": "pages.slot", "releases": ("free",),
+         "drops": ("_free_slot_pages",), "static": True,
+         "receivers": ("pool", "_pool", "page_pool")},
+    ),
+    # fresh cache-owned page mints (promotion / shipment import targets):
+    # the caller must attach them to cache nodes or unref on failure —
+    # and the publish is fence-ordered (TPU703)
+    "allocate_cache_pages": (
+        {"resource": "pages.ref", "releases": ("unref_pages",),
+         "drops": (), "static": True, "mint": True},
+    ),
+    # long-lived radix-cache references: acquired at store, released at
+    # node drop — cross-function by design, ledger-audited
+    "ref_pages": (
+        {"resource": "pages.ref", "releases": ("unref_pages",),
+         "drops": (), "static": False},
+    ),
+    # transient admission pins (sanitizer-attributed separately)
+    "pin_pages": (
+        {"resource": "pages.pin", "releases": ("unpin_pages",),
+         "drops": (), "static": True},
+    ),
+    # prefix-cache lookup hits: pinned on the caller's behalf; release()
+    # (or the engine's _release_prefix_hit) must run on every admission
+    # exit; uncount_hit is the recompute-fallback bookkeeping
+    "lookup_pages": (
+        {"resource": "prefix.hit",
+         "releases": ("release", "_release_prefix_hit"),
+         "drops": ("uncount_hit",), "static": True},
+    ),
+    # preemption resume pins (docs/slo_scheduling.md)
+    "pin_run": (
+        {"resource": "prefix.resume_pin",
+         "releases": ("unpin_run", "_release_resume_pin"),
+         "drops": (), "static": True},
+    ),
+    # engine slot quarantine (docs/pipelined_decode.md): acquired at a
+    # barriered free, released at the barrier retire — cross-function
+    "_quarantine_slot": (
+        {"resource": "slot.quarantine",
+         "releases": ("_release_quarantine",),
+         "drops": ("_discard_pipeline",), "static": False},
+    ),
+    # guided-grammar registry refs (llm/guided.py): taken at admission
+    # compile, dropped at slot release / admission failure — cross-function
+    "_ensure_grammar": (
+        {"resource": "guided.ref",
+         "releases": ("_deref_guided_key", "_deref_guided_request",
+                      "_release_guided"),
+         "drops": (), "static": False},
+    ),
+    # KV-transport shipments (llm/kv_transport.py): sent slabs live in the
+    # receive mailbox until the consume-once recv pops them (or capacity
+    # eviction drops the oldest) — cross-process pairing, ledger-audited;
+    # the static half of the shipment contract is TPU704
+    "send": (
+        {"resource": "transport.shipment",
+         "releases": ("recv", "_drop_oldest"),
+         "drops": (), "static": False,
+         "receivers": ("transport", "endpoint", "_transport",
+                       "_kv_transport", "ep")},
+    ),
+}
+
+# TPU703: the enqueue-before-publish fence protocol. Minted page ids
+# (acquire methods flagged "mint" above) must flow through one of these
+# calls before any publish-attribute assignment makes them visible.
+FENCE_CALLS: FrozenSet[str] = frozenset({
+    "import_pages", "promote_pages", "_upload_pages",
+})
+FENCE_PUBLISH_ATTRS: FrozenSet[str] = frozenset({"pages"})
+
+# TPU704: consume-once transport pops. Receiver-basename filtered (like
+# rules_locks' registry) so unrelated ``recv`` methods never match.
+RECV_RECEIVERS: Tuple[str, ...] = (
+    "transport", "endpoint", "_transport", "_kv_transport", "ep",
+)
+ATTACH_CALLS: FrozenSet[str] = frozenset({"store_shipped"})
+
+_EXIT_OK = -1
+_EXIT_RAISE = -2
+
+# obligation walk state: _HELD, or the node id of the release that first
+# discharged the obligation on this path (so a loop re-visiting its own
+# release is never a double-free, while a DIFFERENT second release is)
+_HELD = -1
+
+
+def file_declarations(tree: ast.AST) -> Dict[str, Tuple[Dict[str, Any], ...]]:
+    """``__acquires__`` class declarations in the analyzed file, normalized
+    to the registry entry shape. A declaration at the definition site is
+    merged with (not replacing) the project registry."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "__acquires__"
+                for t in stmt.targets
+            ):
+                continue
+            try:
+                decl = ast.literal_eval(stmt.value)
+            except (ValueError, SyntaxError):
+                continue
+            if not isinstance(decl, dict):
+                continue
+            for method, entry in decl.items():
+                if not isinstance(entry, dict):
+                    continue
+                normalized = {
+                    "resource": str(entry.get("resource", "?")),
+                    "releases": tuple(entry.get("releases", ())),
+                    "drops": tuple(entry.get("drops", ())),
+                    "static": bool(entry.get("static", True)),
+                }
+                if entry.get("mint"):
+                    normalized["mint"] = True
+                if "receivers" in entry:
+                    normalized["receivers"] = tuple(entry["receivers"])
+                out.setdefault(str(method), []).append(normalized)
+    return {m: tuple(v) for m, v in out.items()}
+
+
+def merged_registry(tree: ast.AST) -> Dict[str, Tuple[Dict[str, Any], ...]]:
+    registry = {m: tuple(v) for m, v in LIFECYCLE_REGISTRY.items()}
+    for method, entries in file_declarations(tree).items():
+        have = list(registry.get(method, ()))
+        for entry in entries:
+            if not any(
+                e["resource"] == entry["resource"]
+                and set(entry["releases"]) <= set(e["releases"])
+                for e in have
+            ):
+                have.append(entry)
+        registry[method] = tuple(have)
+    return registry
+
+
+# -- CFG ----------------------------------------------------------------------
+
+
+class _CFG:
+    """Statement-level control-flow graph of one function body.
+
+    Nodes are integers indexing ``stmts`` (the AST fragment whose events the
+    node carries; None = synthetic join). ``nsucc`` are normal-flow edges;
+    ``esucc`` are exception edges (taken when the node's evaluation raises —
+    the node's own effects are NOT applied on them, except releases, which
+    are assumed to take effect before any raise they trigger).
+    ``branch[n] = (test_expr, then_heads, else_heads_or_None)`` annotates
+    condition joins so the obligation walk can understand ``if handle is
+    None:`` vacuous-branch idioms (``None`` else-heads = no orelse: the
+    else path is every successor outside ``then_heads``).
+    """
+
+    def __init__(self) -> None:
+        self.stmts: List[Optional[ast.AST]] = []
+        self.nsucc: Dict[int, Set[int]] = {}
+        self.esucc: Dict[int, Set[int]] = {}
+        self.branch: Dict[
+            int, Tuple[ast.AST, Set[int], Optional[Set[int]]]
+        ] = {}
+        # loop join -> (first, last+1) node-id range of the loop body: a
+        # release inside the body discharges at the join (iterating the
+        # collection that holds the handles IS the release; zero
+        # iterations mean nothing was held)
+        self.loop_body: Dict[int, Tuple[int, int]] = {}
+
+    def node(self, stmt: Optional[ast.AST]) -> int:
+        nid = len(self.stmts)
+        self.stmts.append(stmt)
+        self.nsucc[nid] = set()
+        self.esucc[nid] = set()
+        return nid
+
+
+def _walk_skip_nested(root: ast.AST):
+    """ast.walk, but never descends into nested function/lambda bodies —
+    their statements run later, under their own CFG."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# builtins that cannot realistically raise on the engine's data (calling
+# them does not open an exception edge; anything else that LOOKS like a
+# call does)
+_SAFE_CALLS = frozenset({
+    "len", "int", "float", "str", "bool", "list", "dict", "tuple", "set",
+    "frozenset", "range", "sorted", "reversed", "min", "max", "sum", "abs",
+    "id", "repr", "isinstance", "enumerate", "zip", "print", "getattr",
+})
+# container mutators that cannot realistically raise either — plus the
+# ownership ledger's own instrumentation surface (llm/lifecycle_ledger.py:
+# owner() yields even when disarmed, request_tag() is a format call); the
+# leak net must not flag the paths its OWN bookkeeping wraps
+_SAFE_METHODS = frozenset({
+    "append", "appendleft", "add", "discard", "clear",
+    "owner", "request_tag",
+})
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    """Statements containing a call/await/assert can raise mid-evaluation.
+    (Pure name/constant/subscript statements — and a short list of
+    no-raise builtins/container mutators — are treated as non-raising: a
+    lint-level CFG, not a soundness proof.)"""
+    for node in _walk_skip_nested(stmt):
+        if isinstance(node, (ast.Await, ast.Assert)):
+            return True
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _SAFE_CALLS
+            ):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SAFE_METHODS
+            ):
+                continue
+            return True
+    return False
+
+
+class _Builder:
+    """Builds a _CFG for one function. ``finally`` blocks are built once and
+    their exits fan out to the union of every continuation routed through
+    them (after-try, propagating raise, return, break/continue) — a merged
+    approximation that only ever ADDS paths, so the leak walk stays
+    conservative in the safe direction."""
+
+    def __init__(self, cfg: _CFG):
+        self.cfg = cfg
+        # innermost-first stack of (finally_entry, extra_continuations)
+        self.finallies: List[Tuple[int, Set[int]]] = []
+        # loop stack: (continue_target, after_loop_join)
+        self.loops: List[Tuple[int, int]] = []
+        self.raise_targets: List[int] = [_EXIT_RAISE]
+
+    # every statement that can raise gets edges to the current raise targets
+    def _wire_raise(self, nid: int, stmt: ast.AST) -> None:
+        if _may_raise(stmt):
+            self.cfg.esucc[nid] |= set(self.raise_targets)
+
+    def _edge(self, preds: Sequence[int], nid: int) -> None:
+        for p in preds:
+            self.cfg.nsucc[p].add(nid)
+
+    def _through_finally(self, target: int) -> int:
+        """Route an abrupt exit (return/break/continue/raise-to-outer)
+        through the innermost active finally, recording the ultimate
+        target as one of that finally's continuations."""
+        if not self.finallies:
+            return target
+        entry, extras = self.finallies[-1]
+        extras.add(target)
+        return entry
+
+    def seq(self, stmts: Sequence[ast.AST], preds: List[int]) -> List[int]:
+        """Wire ``stmts`` after ``preds``; returns the exits that flow to
+        whatever comes next."""
+        for stmt in stmts:
+            if not preds:
+                # unreachable tail (after return/raise): skip building it —
+                # dead code cannot leak
+                break
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _stmt(self, stmt: ast.AST, preds: List[int]) -> List[int]:
+        cfg = self.cfg
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            nid = cfg.node(None)  # definition runs; body analyzed separately
+            self._edge(preds, nid)
+            return [nid]
+        if isinstance(stmt, ast.Return):
+            nid = cfg.node(stmt)
+            self._edge(preds, nid)
+            self._wire_raise(nid, stmt)
+            cfg.nsucc[nid].add(self._through_finally(_EXIT_OK))
+            return []
+        if isinstance(stmt, ast.Raise):
+            nid = cfg.node(stmt)
+            self._edge(preds, nid)
+            # a bare or explicit raise goes to the innermost handler chain
+            for target in self.raise_targets:
+                cfg.nsucc[nid].add(target)
+            return []
+        if isinstance(stmt, ast.Break):
+            nid = cfg.node(stmt)
+            self._edge(preds, nid)
+            if self.loops:
+                _, after = self.loops[-1]
+                cfg.nsucc[nid].add(self._through_finally(after))
+            return []
+        if isinstance(stmt, ast.Continue):
+            nid = cfg.node(stmt)
+            self._edge(preds, nid)
+            if self.loops:
+                cont, _ = self.loops[-1]
+                cfg.nsucc[nid].add(self._through_finally(cont))
+            return []
+        if isinstance(stmt, ast.If):
+            join = cfg.node(stmt.test)
+            self._edge(preds, join)
+            self._wire_raise(join, stmt.test)
+            then_exits = self.seq(stmt.body, [join])
+            then_heads = set(cfg.nsucc[join])
+            if stmt.orelse:
+                else_exits = self.seq(stmt.orelse, [join])
+                else_heads: Optional[Set[int]] = (
+                    set(cfg.nsucc[join]) - then_heads
+                )
+            else:
+                else_exits = [join]  # falls through: join itself is an exit
+                else_heads = None
+            cfg.branch[join] = (stmt.test, then_heads, else_heads)
+            return then_exits + else_exits
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            if isinstance(stmt, ast.While):
+                # a while test re-evaluates every iteration: raise edges
+                # belong on the join
+                test: Optional[ast.AST] = stmt.test
+                join = cfg.node(test)
+                self._edge(preds, join)
+                self._wire_raise(join, test)
+            else:
+                # a for iterator evaluates ONCE, before the loop: give it
+                # its own node so its raise edge is not replayed per
+                # iteration
+                it = cfg.node(stmt.iter)
+                self._edge(preds, it)
+                self._wire_raise(it, stmt.iter)
+                join = cfg.node(None)
+                self._edge([it], join)
+            after = cfg.node(None)  # break target / loop exit join
+            self.loops.append((join, after))
+            body_start = len(cfg.stmts)
+            body_exits = self.seq(stmt.body, [join])
+            cfg.loop_body[join] = (body_start, len(cfg.stmts))
+            self.loops.pop()
+            self._edge(body_exits, join)  # back edge
+            exits = [join]
+            if stmt.orelse:
+                exits = self.seq(stmt.orelse, exits)
+            exits = exits + [after]
+            return exits
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # the header node carries only the context expressions — body
+            # statements get their own nodes (events must not double-count)
+            header = ast.copy_location(
+                ast.Tuple(
+                    elts=[item.context_expr for item in stmt.items],
+                    ctx=ast.Load(),
+                ),
+                stmt,
+            )
+            nid = cfg.node(header)
+            self._edge(preds, nid)
+            self._wire_raise(nid, header)
+            return self.seq(stmt.body, [nid])
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, preds)
+        # simple statement (Assign/Expr/AugAssign/Delete/Assert/...)
+        nid = cfg.node(stmt)
+        self._edge(preds, nid)
+        self._wire_raise(nid, stmt)
+        if isinstance(stmt, ast.Assert):
+            # a failing assert raises; already wired by _wire_raise
+            pass
+        return [nid]
+
+    def _try(self, stmt: ast.Try, preds: List[int]) -> List[int]:
+        cfg = self.cfg
+        outer_raise = list(self.raise_targets)
+        f_entry: Optional[int] = None
+        f_extras: Set[int] = set()
+        if stmt.finalbody:
+            f_entry = cfg.node(None)
+            self.finallies.append((f_entry, f_extras))
+        # handlers first, so the body knows where its exceptions land
+        handler_entries: List[int] = []
+        handler_exits: List[int] = []
+        for handler in stmt.handlers:
+            h_entry = cfg.node(None)
+            handler_entries.append(h_entry)
+            # exceptions inside a handler propagate outward (through the
+            # finally when present)
+            saved = self.raise_targets
+            self.raise_targets = (
+                [f_entry] if f_entry is not None else outer_raise
+            )
+            if f_entry is not None:
+                f_extras.update(outer_raise)
+            handler_exits += self.seq(handler.body, [h_entry])
+            self.raise_targets = saved
+        # the body raises into the handlers — or past them all (no handler
+        # matched) through the finally to the outer chain. A catch-all
+        # handler (`except:` / `except Exception` / `except BaseException`)
+        # closes the escape: every exception lands in a handler.
+        catch_all = any(
+            h.type is None
+            or _dotted(h.type) in ("Exception", "BaseException")
+            for h in stmt.handlers
+        )
+        body_raise: List[int] = list(handler_entries)
+        if f_entry is not None:
+            body_raise.append(f_entry)
+            f_extras.update(outer_raise)
+        elif not handler_entries:
+            body_raise = outer_raise
+        elif not catch_all:
+            body_raise += outer_raise  # unmatched exception type
+        saved = self.raise_targets
+        self.raise_targets = body_raise
+        body_exits = self.seq(stmt.body, preds)
+        self.raise_targets = saved
+        if stmt.orelse:
+            body_exits = self.seq(stmt.orelse, body_exits)
+        exits = body_exits + handler_exits
+        if f_entry is not None:
+            self.finallies.pop()
+            self._edge(exits, f_entry)
+            f_exits = self.seq(stmt.finalbody, [f_entry])
+            after = cfg.node(None)
+            self._edge(f_exits, after)
+            # merged continuations: everything routed through this finally
+            for target in f_extras:
+                for fx in f_exits:
+                    cfg.nsucc[fx].add(target)
+            return [after]
+        return exits
+
+
+def build_cfg(fn: ast.AST) -> Tuple[_CFG, int]:
+    """(cfg, entry node id) for a function's body."""
+    cfg = _CFG()
+    entry = cfg.node(None)
+    builder = _Builder(cfg)
+    exits = builder.seq(list(getattr(fn, "body", [])), [entry])
+    for nid in exits:
+        cfg.nsucc[nid].add(_EXIT_OK)
+    return cfg, entry
+
+
+# -- event extraction ---------------------------------------------------------
+
+
+def _calls_in(stmt: ast.AST) -> List[ast.Call]:
+    return [
+        node for node in _walk_skip_nested(stmt)
+        if isinstance(node, ast.Call)
+    ]
+
+
+def _arg_texts(call: ast.Call) -> List[str]:
+    out = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        text = _dotted(arg)
+        if text:
+            out.append(text)
+        elif isinstance(arg, ast.Constant):
+            # literal args distinguish `free(0)` from `free(1)` when the
+            # release matcher compares argument overlap
+            out.append(repr(arg.value))
+    return out
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {
+        n.id for n in _walk_skip_nested(expr)
+        if isinstance(n, ast.Name)
+    }
+
+
+class _Obligation:
+    __slots__ = ("method", "entries", "var", "recv", "args", "node",
+                 "line", "col", "releases", "drops")
+
+    def __init__(self, method: str, entries, var: Optional[str],
+                 recv: Optional[str], args: List[str], node: int,
+                 line: int, col: int):
+        self.method = method
+        self.entries = entries
+        self.var = var
+        self.recv = recv
+        self.args = args
+        self.node = node
+        self.line = line
+        self.col = col
+        self.releases = frozenset(
+            name for e in entries for name in e["releases"]
+        )
+        self.drops = frozenset(name for e in entries for name in e["drops"])
+
+    @property
+    def resource(self) -> str:
+        return "|".join(sorted({e["resource"] for e in self.entries}))
+
+    @property
+    def static(self) -> bool:
+        return any(e.get("static", True) for e in self.entries)
+
+
+def _find_obligations(cfg: _CFG, registry) -> List[_Obligation]:
+    out: List[_Obligation] = []
+    for nid, stmt in enumerate(cfg.stmts):
+        if stmt is None:
+            continue
+        var: Optional[str] = None
+        call: Optional[ast.Call] = None
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+                var = stmt.targets[0].id
+            else:
+                continue  # escape at birth (attribute/tuple target)
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+        if call is None or not isinstance(call.func, ast.Attribute):
+            continue
+        method = call.func.attr
+        entries = registry.get(method)
+        if not entries:
+            continue
+        recv = _dotted(call.func.value)
+        base = recv.split(".")[-1] if recv else None
+        matched = tuple(
+            e for e in entries
+            if "receivers" not in e or (
+                base is not None and base in e["receivers"]
+            )
+        )
+        if not matched:
+            continue
+        out.append(_Obligation(
+            method, matched, var, recv, _arg_texts(call), nid,
+            stmt.lineno, stmt.col_offset,
+        ))
+    return out
+
+
+def _release_matches(ob: _Obligation, call: ast.Call,
+                     names: FrozenSet[str]) -> bool:
+    """Does ``call`` discharge obligation ``ob``? (``names`` = releases or
+    drops to consider.)"""
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in names:
+        return False
+    recv = _dotted(call.func.value)
+    args = _arg_texts(call)
+    if ob.var is not None:
+        if ob.var in args:
+            return True
+        if recv == ob.var:  # handle.release() style
+            return True
+    if recv is not None and ob.recv is not None:
+        recv_match = (
+            recv == ob.recv
+            or recv.split(".")[-1] == ob.recv.split(".")[-1]
+        )
+        if recv_match:
+            if not args or not ob.args:
+                return True
+            return bool(set(args) & set(ob.args))
+    return False
+
+
+def _mentions_var(stmt: ast.AST, var: str) -> bool:
+    for node in _walk_skip_nested(stmt):
+        if isinstance(node, ast.Name) and node.id == var:
+            return True
+    return False
+
+
+def _escapes(stmt: ast.AST, ob: _Obligation) -> bool:
+    """Ownership leaves this function's hands (fail-open: the ledger covers
+    what the static pass can no longer see)."""
+    if ob.var is None:
+        return False
+    var = ob.var
+    if isinstance(stmt, (ast.Return, ast.Raise)):
+        return _mentions_var(stmt, var)
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, (ast.Yield,
+                                                              ast.YieldFrom)):
+        return _mentions_var(stmt, var)
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (
+            list(stmt.targets) if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+        )
+        value = stmt.value
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                if value is not None and _mentions_var(value, var):
+                    return True  # stashed into an attribute/container
+            if isinstance(t, ast.Name) and t.id == var:
+                return True  # rebound: the old handle is someone else's now
+            if isinstance(t, ast.Tuple) and any(
+                isinstance(e, ast.Name) and e.id == var for e in t.elts
+            ):
+                return True
+    # handed to any call that is not a matching release (checked first by
+    # the walker): conservative ownership transfer
+    for call in _calls_in(stmt):
+        if var in _arg_texts(call):
+            return True
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if _mentions_var(arg, var):
+                return True
+    return False
+
+
+def _none_branch(test: ast.AST, var: str) -> Optional[str]:
+    """Which If branch means ``var`` is None/falsy: "then", "else", or None
+    when the test says nothing about the handle."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        left_is_var = isinstance(test.left, ast.Name) and test.left.id == var
+        comp = test.comparators[0]
+        comp_none = isinstance(comp, ast.Constant) and comp.value is None
+        if left_is_var and comp_none:
+            if isinstance(test.ops[0], ast.Is):
+                return "then"
+            if isinstance(test.ops[0], ast.IsNot):
+                return "else"
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = test.operand
+        if isinstance(inner, ast.Name) and inner.id == var:
+            return "then"
+    if isinstance(test, ast.Name) and test.id == var:
+        return "else"
+    return None
+
+
+# -- rule walks ---------------------------------------------------------------
+
+
+def _walk_obligation(cfg: _CFG, ob: _Obligation, path: str,
+                     findings: List[Finding]) -> None:
+    reported: Set[Tuple[str, int]] = set()
+
+    def emit(code: str, line: int, col: int, detail: str) -> None:
+        if (code, line) in reported:
+            return
+        reported.add((code, line))
+        summary, hint = RULES[code]
+        findings.append(Finding(
+            code, path, line, col, "{} ({})".format(summary, detail), hint,
+        ))
+
+    stack: List[Tuple[int, int]] = [
+        (succ, _HELD) for succ in cfg.nsucc.get(ob.node, ())
+    ]
+    seen: Set[Tuple[int, int]] = set()
+    while stack:
+        nid, state = stack.pop()
+        if (nid, state) in seen:
+            continue
+        seen.add((nid, state))
+        if nid == _EXIT_OK or nid == _EXIT_RAISE:
+            if state == _HELD and ob.static:
+                kind = (
+                    "a raising path" if nid == _EXIT_RAISE
+                    else "a normal path"
+                )
+                emit(
+                    "TPU701", ob.line, ob.col,
+                    "{} from `{}` acquired here leaks on {}: no matching "
+                    "{} reaches the function exit".format(
+                        ob.resource, ob.method, kind,
+                        "/".join(sorted(ob.releases | ob.drops)) or "release",
+                    ),
+                )
+            continue
+        if nid == ob.node:
+            continue  # looped back to the acquire: a fresh obligation
+        stmt = cfg.stmts[nid]
+        next_state = state
+        discharged = False
+
+        def _same_release(at: int, here: int) -> bool:
+            """True when the path's recorded release covers this node: the
+            same statement, or a loop join whose body this release sits in
+            (the join discharged on the body's behalf)."""
+            if at == here:
+                return True
+            span = cfg.loop_body.get(at)
+            return span is not None and span[0] <= here < span[1]
+
+        if stmt is not None:
+            released_here = False
+            for call in _calls_in(stmt):
+                if _release_matches(ob, call, ob.releases):
+                    released_here = True
+                    if state != _HELD and not _same_release(state, nid):
+                        emit(
+                            "TPU702", stmt.lineno, stmt.col_offset,
+                            "second release of {} from the `{}` at line {} "
+                            "on one path".format(
+                                ob.resource, ob.method, ob.line
+                            ),
+                        )
+                    break
+                if _release_matches(ob, call, ob.drops):
+                    # drop-to-recompute handlers discharge but are
+                    # idempotent bookkeeping: never a TPU702
+                    released_here = True
+                    break
+            if released_here:
+                next_state = state if state != _HELD else nid
+            elif state == _HELD and _escapes(stmt, ob):
+                discharged = True
+        if (
+            state == _HELD
+            and not discharged
+            and next_state == _HELD
+            and nid in cfg.loop_body
+        ):
+            # a loop whose body releases the obligation discharges at the
+            # join: the collection iterated holds the handles, and a
+            # zero-iteration pass means nothing was held
+            lo, hi = cfg.loop_body[nid]
+            for body_nid in range(lo, hi):
+                body_stmt = cfg.stmts[body_nid]
+                if body_stmt is None:
+                    continue
+                if any(
+                    _release_matches(ob, call, ob.releases)
+                    or _release_matches(ob, call, ob.drops)
+                    for call in _calls_in(body_stmt)
+                ):
+                    next_state = nid
+                    break
+        if discharged:
+            continue
+        # branch joins understand `if handle is None:`-style vacuity: the
+        # branch where the handle is None acquired nothing, so the
+        # obligation is vacuous along it
+        branch = cfg.branch.get(nid)
+        if branch is not None and ob.var is not None and state == _HELD:
+            test, then_heads, else_heads = branch
+            vacuous = _none_branch(test, ob.var)
+            if vacuous is not None:
+                if vacuous == "then":
+                    dead = then_heads
+                elif else_heads is not None:
+                    dead = else_heads
+                else:  # no orelse: the else path is everything outside then
+                    dead = set(cfg.nsucc[nid]) - then_heads
+                for succ in cfg.nsucc[nid]:
+                    if succ not in dead:
+                        stack.append((succ, next_state))
+                for succ in cfg.esucc[nid]:
+                    stack.append((succ, next_state))
+                continue
+        for succ in cfg.nsucc[nid]:
+            stack.append((succ, next_state))
+        for succ in cfg.esucc[nid]:
+            # the raise interrupts this statement: releases still count
+            # (assumed ordered before anything that can raise); an escape
+            # already stopped this path above
+            stack.append((succ, next_state))
+
+
+def _walk_fence(cfg: _CFG, ob: _Obligation, path: str,
+                findings: List[Finding]) -> None:
+    """TPU703: minted page ids must pass an enqueue fence before publish."""
+    if ob.var is None:
+        return
+    # flow-insensitive taint: names derived from the minted ids
+    tainted: Set[str] = {ob.var}
+    changed = True
+    while changed:
+        changed = False
+        for stmt in cfg.stmts:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id not in tainted
+                for t in stmt.targets
+            ):
+                continue
+            if _names_in(stmt.value) & tainted:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        if t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+    reported: Set[int] = set()
+    stack: List[Tuple[int, bool]] = [
+        (succ, False) for succ in cfg.nsucc.get(ob.node, ())
+    ]
+    seen: Set[Tuple[int, bool]] = set()
+    while stack:
+        nid, fenced = stack.pop()
+        if (nid, fenced) in seen or nid in (_EXIT_OK, _EXIT_RAISE):
+            continue
+        seen.add((nid, fenced))
+        if nid == ob.node:
+            continue
+        stmt = cfg.stmts[nid]
+        stop = False
+        if stmt is not None:
+            for call in _calls_in(stmt):
+                if not isinstance(call.func, ast.Attribute):
+                    continue
+                attr = call.func.attr
+                texts = set(_arg_texts(call))
+                if attr in FENCE_CALLS and (texts & tainted or any(
+                    _names_in(a) & tainted
+                    for a in list(call.args)
+                    + [kw.value for kw in call.keywords]
+                )):
+                    fenced = True
+                if attr in ("unref_pages", "free") and texts & tainted:
+                    stop = True  # failure path returned the mint
+            if not fenced and isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr in FENCE_PUBLISH_ATTRS
+                        and _names_in(stmt.value) & tainted
+                        and stmt.lineno not in reported
+                    ):
+                        reported.add(stmt.lineno)
+                        summary, hint = RULES["TPU703"]
+                        findings.append(Finding(
+                            "TPU703", path, stmt.lineno, stmt.col_offset,
+                            "{} (page ids minted at line {} published via "
+                            "`.{} =` before any {} fence enqueued their "
+                            "payload)".format(
+                                summary, ob.line, t.attr,
+                                "/".join(sorted(FENCE_CALLS)),
+                            ),
+                            hint,
+                        ))
+        if stop:
+            continue
+        for succ in cfg.nsucc[nid] | cfg.esucc[nid]:
+            stack.append((succ, fenced))
+
+
+def _walk_recv(cfg: _CFG, nid: int, stmt: ast.Assign, path: str,
+               findings: List[Finding]) -> None:
+    """TPU704: consume-once transport pops and attach-consumed payloads."""
+    call = stmt.value
+    var = stmt.targets[0].id  # validated by caller
+    recv = _dotted(call.func.value)
+    sig = (recv, call.func.attr, tuple(_arg_texts(call)))
+    reported: Set[int] = set()
+
+    def emit(line: int, col: int, detail: str) -> None:
+        if line in reported:
+            return
+        reported.add(line)
+        summary, hint = RULES["TPU704"]
+        findings.append(Finding(
+            "TPU704", path, line, col, "{} ({})".format(summary, detail),
+            hint,
+        ))
+
+    HELD, ATTACHED = 0, 1
+    stack: List[Tuple[int, int]] = [
+        (succ, HELD) for succ in cfg.nsucc.get(nid, ())
+    ]
+    seen: Set[Tuple[int, int]] = set()
+    while stack:
+        cur, state = stack.pop()
+        if (cur, state) in seen or cur in (_EXIT_OK, _EXIT_RAISE):
+            continue
+        seen.add((cur, state))
+        if cur == nid:
+            continue
+        cstmt = cfg.stmts[cur]
+        next_state = state
+        if cstmt is not None:
+            attached_here = False
+            for c in _calls_in(cstmt):
+                if not isinstance(c.func, ast.Attribute):
+                    continue
+                texts = _arg_texts(c)
+                if c.func.attr == "recv" and (
+                    _dotted(c.func.value), c.func.attr, tuple(texts)
+                ) == sig:
+                    emit(
+                        cstmt.lineno, cstmt.col_offset,
+                        "shipment for the same key popped again on a path "
+                        "that already consumed it at line {}".format(
+                            stmt.lineno
+                        ),
+                    )
+                if c.func.attr in ATTACH_CALLS and var in texts:
+                    attached_here = True
+            if attached_here:
+                next_state = ATTACHED
+            elif state == ATTACHED and _mentions_var(cstmt, var):
+                emit(
+                    cstmt.lineno, cstmt.col_offset,
+                    "shipment `{}` used after its store_shipped attach "
+                    "consumed the payload slabs".format(var),
+                )
+            # rebinding the handle starts a fresh shipment
+            if isinstance(cstmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == var
+                for t in cstmt.targets
+            ):
+                continue
+        for succ in cfg.nsucc[cur] | cfg.esucc[cur]:
+            stack.append((succ, next_state))
+
+
+def check(tree: ast.AST, path: str, source: str) -> List[Finding]:
+    registry = merged_registry(tree)
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cfg, _entry = build_cfg(fn)
+        for ob in _find_obligations(cfg, registry):
+            _walk_obligation(cfg, ob, path, findings)
+            if any(e.get("mint") for e in ob.entries):
+                _walk_fence(cfg, ob, path, findings)
+        # TPU704 obligations: `v = <transport-ish>.recv(...)`
+        for nid, stmt in enumerate(cfg.stmts):
+            if not (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "recv"
+            ):
+                continue
+            recv = _dotted(stmt.value.func.value)
+            if recv is None or recv.split(".")[-1] not in RECV_RECEIVERS:
+                continue
+            _walk_recv(cfg, nid, stmt, path, findings)
+    return findings

@@ -49,7 +49,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import compile_sentry, faults, kv_sanitizer
+from . import compile_sentry, faults, kv_sanitizer, lifecycle_ledger
 from .shapes import decode_steps_bucket
 from ..errors import (
     DeadlineExceededError,
@@ -607,6 +607,24 @@ class LLMEngineCore:
         # prompt scoring runs only for completions echo+logprobs requests:
         # one compile per prefill bucket on first use, sentry-attributed
         "lazy": ("_score_prompt_jit",),
+    }
+
+    # ownership-discipline registry (tpuserve-analyze TPU7xx,
+    # docs/static_analysis.md): the engine's two cross-function protocols.
+    # Quarantined slots release at the barrier retire (or the pipeline-
+    # discard paths); grammar refs release at slot teardown / admission
+    # failure. Both pair across functions by design ("static": False), so
+    # the runtime ownership ledger audits them at the drain boundary.
+    __acquires__ = {
+        "_quarantine_slot": {"resource": "slot.quarantine",
+                             "releases": ("_release_quarantine",),
+                             "drops": ("_discard_pipeline",),
+                             "static": False},
+        "_ensure_grammar": {"resource": "guided.ref",
+                            "releases": ("_deref_guided_key",
+                                         "_deref_guided_request",
+                                         "_release_guided"),
+                            "static": False},
     }
 
     def __init__(
@@ -2388,6 +2406,40 @@ class LLMEngineCore:
             compile_sentry.get() if compile_sentry.enabled() else None
         )
 
+        # runtime ownership ledger (llm/lifecycle_ledger.py): armed via
+        # TPUSERVE_LEDGER=1|strict. Records every declared acquire/release
+        # with owner + site, audits pairing per request at emit/fail/cancel
+        # and globally at drain — the dynamic half of the TPU7xx ownership
+        # discipline (docs/static_analysis.md), covering the static pass's
+        # declared blind spots (cross-function, cross-thread transfers).
+        self._ledger = (
+            lifecycle_ledger.arm() if lifecycle_ledger.enabled() else None
+        )
+
+    def _ledger_domains(self) -> list:
+        """The primitives whose drain-zero entries THIS engine audits
+        (co-hosted replica engines share one process-wide ledger)."""
+        domains = [self]
+        if self.paged_cache is not None:
+            domains += [self.paged_cache, self.paged_cache.pool]
+            if self.paged_cache.host_tier is not None:
+                domains.append(self.paged_cache.host_tier)
+        if self._prefix is not None:
+            domains.append(self._prefix)
+        return domains
+
+    def _ledger_audit_request(self, request: "GenRequest",
+                              where: str) -> None:
+        """Per-request pairing audit at a request exit boundary (emit
+        finish / fail / cancel): every request-scoped acquire attributed
+        to this request must have been released. Strict mode raises on
+        the loop thread — the structured step-failure path handles it,
+        exactly like a sanitizer violation."""
+        if self._ledger is not None and request is not None:
+            self._ledger.audit_request(
+                lifecycle_ledger.request_tag(request), where=where
+            )
+
     def _sanitize(self, where: str, drained: bool = False) -> None:
         if self._sanitizer is not None:
             self._sanitizer.check(
@@ -2397,6 +2449,12 @@ class LLMEngineCore:
             # strict-mode violations surface here, on the loop thread,
             # through the structured step-failure path (like the sanitizer)
             self._compile_sentry.check(where=where)
+        if self._ledger is not None:
+            self._ledger.check(
+                where=where,
+                drained=drained and not self._inflight,
+                domains=self._ledger_domains(),
+            )
 
     def _sentry_scope(self, phase: str, **ctx):
         """Thread-local compile attribution for a dispatch/prefill worker
@@ -2526,6 +2584,7 @@ class LLMEngineCore:
             if entry is not None:
                 entry["refs"] += 1
                 request._guided_key = key
+                self._ledger_guided_acquire(key, request)
                 return entry
         # the O(V) token byte table is per-tokenizer: build once, reuse for
         # every grammar (compile AND device walk share it)
@@ -2543,6 +2602,7 @@ class LLMEngineCore:
             if entry is not None:  # raced another admission; reuse theirs
                 entry["refs"] += 1
                 request._guided_key = key
+                self._ledger_guided_acquire(key, request)
                 return entry
             if self._gtok_bytes is None:
                 self._gtok_bytes = token_bytes
@@ -2584,6 +2644,7 @@ class LLMEngineCore:
             self._grammars[key] = entry
             self._append_guided_tables_locked(grammar)
             request._guided_key = key
+            self._ledger_guided_acquire(key, request)
             return entry
 
     def _append_guided_tables_locked(self, grammar) -> None:
@@ -2624,29 +2685,49 @@ class LLMEngineCore:
                 self._guided_dirty = False
             return (self._gmask_dev, self._gbyte_dev) + self._gtok_dev
 
-    def _release_guided(self, slot: int) -> None:
+    def _release_guided(self, slot: int, request: GenRequest = None) -> None:
         """Slot freed: clear its DFA state and deref its grammar. The key is
         captured at commit time in _slot_guided_key because _slot_req[slot]
-        is already None on some finish paths."""
+        is already None on some finish paths (callers that still hold the
+        request pass it so the ledger discharges ITS slab on a grammar key
+        shared by concurrent requests)."""
         self._gstate[slot] = -1
         key = self._slot_guided_key[slot]
         if key is None:
             return
         self._slot_guided_key[slot] = None
-        self._deref_guided_key(key)
+        self._deref_guided_key(key, request=request)
 
     def _deref_guided_request(self, request: GenRequest) -> None:
         """Admission failed/dropped before its slot commit: return the
         grammar ref taken by _ensure_grammar."""
         if request._guided_key is not None:
             key, request._guided_key = request._guided_key, None
-            self._deref_guided_key(key)
+            self._deref_guided_key(key, request=request)
 
-    def _deref_guided_key(self, key: str) -> None:
+    def _deref_guided_key(self, key: str,
+                          request: GenRequest = None) -> None:
         with self._guided_lock:
             entry = self._grammars.get(key)
             if entry is not None:
                 entry["refs"] -= 1
+                if self._ledger is not None:
+                    lifecycle_ledger.release(
+                        "guided.ref", key=key, domain=self,
+                        owner=(
+                            lifecycle_ledger.request_tag(request)
+                            if request is not None else None
+                        ),
+                    )
+
+    def _ledger_guided_acquire(self, key: str, request: "GenRequest") -> None:
+        """One grammar-registry ref taken on the request's behalf
+        (_ensure_grammar's three take paths share this record)."""
+        if self._ledger is not None:
+            lifecycle_ledger.acquire(
+                "guided.ref", key=key, domain=self,
+                owner=lifecycle_ledger.request_tag(request),
+            )
 
     @property
     def adapter_names(self) -> List[str]:
@@ -3137,9 +3218,12 @@ class LLMEngineCore:
             # not LRU it away (the resume would then recompile a fresh
             # full-length prefill on the serving loop). A prior leg's pin
             # is impossible here: it was released at this leg's admission
-            request._resume_pin = self._prefix.pin_run(
-                history, self._slot_lora(request)
-            )
+            with lifecycle_ledger.owner(
+                lifecycle_ledger.request_tag(request)
+            ):
+                request._resume_pin = self._prefix.pin_run(
+                    history, self._slot_lora(request)
+                )
         # the queue-wait budget restarts for the resume leg: the request
         # already proved admissible once, and expiring it for time spent
         # GENERATING would punish the preempted class twice
@@ -3152,7 +3236,7 @@ class LLMEngineCore:
             time.monotonic() + qt if qt is not None else None
         )
         self._slot_req[slot] = None
-        self._release_guided(slot)  # no-op for victims; kept for symmetry
+        self._release_guided(slot, request)  # no-op for victims; kept for symmetry
         self._free_slot_pages(slot)
         self._pending.put_nowait(request)
         self._wake_loop()
@@ -3519,10 +3603,21 @@ class LLMEngineCore:
                 "bytes": self._weight_bytes,
             },
             "compile": self._compile_snapshot(),
+            "ledger": self._ledger_snapshot(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id
         return out
+
+    def _ledger_snapshot(self):
+        """Ownership-ledger block shared by health() and lifecycle_stats()
+        (docs/static_analysis.md TPU7xx). None when the ledger is unarmed.
+        The ledger is process-wide (co-hosted replica engines record into
+        one), so counters are fleet totals — per-entry attribution lives
+        in the owner/site records, not the counters."""
+        if self._ledger is None:
+            return None
+        return self._ledger.stats()
 
     def _compile_snapshot(self):
         """Compile-sentry block shared by health() and lifecycle_stats()
@@ -3595,6 +3690,7 @@ class LLMEngineCore:
                 "bytes": self._weight_bytes,
             },
             "compile": self._compile_snapshot(),
+            "ledger": self._ledger_snapshot(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id
@@ -3682,7 +3778,7 @@ class LLMEngineCore:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
-                self._release_guided(slot)
+                self._release_guided(slot, request)
                 # pool pages deliberately NOT freed here: a worker thread may
                 # be mutating the pool mid-dispatch; the loop reclaims them at
                 # the next safe boundary (_finish_recovery)
@@ -3715,8 +3811,9 @@ class LLMEngineCore:
         request.error = err
         request.out_queue.put_nowait(_FINISHED)
         self._slot_req[slot] = None
-        self._release_guided(slot)
+        self._release_guided(slot, request)
         self._free_slot_pages(slot)
+        self._ledger_audit_request(request, "fail")
 
     # -- pipelined decode: slot-reuse barrier ---------------------------------
 
@@ -3741,10 +3838,21 @@ class LLMEngineCore:
         the dead request's leftover tokens at that chunk's retire."""
         barrier = self._pipeline_barrier(slot)
         if barrier is not None:
-            self._quarantine[slot] = barrier
+            self._quarantine_slot(slot, barrier)
             return
         if self.paged_cache is not None:
             self.paged_cache.pool.free(slot)
+
+    def _quarantine_slot(self, slot: int, barrier: int) -> None:
+        """Defer a freed slot's page release to the retire of in-flight
+        chunk ``barrier`` (the declared acquire of the ``slot.quarantine``
+        protocol: _release_quarantine / the pipeline-discard paths are its
+        releases, and the ownership ledger audits the pairing — a slot
+        stuck in quarantine at drain is a lost free). Loop-thread only."""
+        self._quarantine[slot] = barrier
+        if self._ledger is not None:
+            lifecycle_ledger.acquire("slot.quarantine", key=slot,
+                                     domain=self)
 
     def _release_quarantine(self, retired_seq: int) -> None:
         """Retire point: slots whose barrier has passed become reusable and
@@ -3752,6 +3860,9 @@ class LLMEngineCore:
         for slot, barrier in list(self._quarantine.items()):
             if barrier <= retired_seq:
                 del self._quarantine[slot]
+                if self._ledger is not None:
+                    lifecycle_ledger.release("slot.quarantine", key=slot,
+                                             domain=self, all_of_key=True)
                 if (
                     self.paged_cache is not None
                     and self._slot_req[slot] is None
@@ -3774,6 +3885,10 @@ class LLMEngineCore:
         self._inflight.clear()
         pending = list(self._quarantine)
         self._quarantine.clear()
+        if self._ledger is not None:
+            for slot in pending:
+                lifecycle_ledger.release("slot.quarantine", key=slot,
+                                         domain=self, all_of_key=True)
         self._reset_device_chains()
         if self.paged_cache is not None and dropped:
             await asyncio.to_thread(self._wait_chunks, dropped)
@@ -4292,7 +4407,10 @@ class LLMEngineCore:
         GATHERED into the dense mini-cache layout as the compute input for
         the tail's prefill_chunk; that transient is dropped after admission.
         Returns (last_logits, mini_cache) or None (miss / doesn't fit)."""
-        hit = self._prefix.lookup_pages(ids, lora_i)
+        with lifecycle_ledger.owner(lifecycle_ledger.request_tag(request)):
+            # hit + pin acquires attributed to this request: the ledger's
+            # per-request audit at emit/fail/cancel proves they released
+            hit = self._prefix.lookup_pages(ids, lora_i)
         if hit is None:
             return None
         try:
@@ -4300,7 +4418,10 @@ class LLMEngineCore:
             bucket = self._prefix_bucket(prefix_len, len(ids))
             page_size = self.paged_cache.pool.page_size
             if bucket is None or bucket % page_size:
-                self._prefix.release(hit)
+                with lifecycle_ledger.owner(
+                    lifecycle_ledger.request_tag(request)
+                ):
+                    self._prefix.release(hit)
                 self._prefix.uncount_hit(hit)  # recomputed cold
                 return None
             # pad the page list with the null page to the bucket's page count
@@ -4324,7 +4445,13 @@ class LLMEngineCore:
                 gate_bypass=request.priority == "interactive",
             )
         except BaseException:
-            self._prefix.release(hit)
+            # release() is pop-idempotent by construction: re-entering here
+            # after a raise out of the release/uncount pair above re-pops
+            # nothing
+            with lifecycle_ledger.owner(
+                lifecycle_ledger.request_tag(request)
+            ):
+                self._prefix.release(hit)  # tpuserve: ignore[TPU702] release() pops; re-release is a no-op
             raise
         request._prefix_hit = hit
         return last_logits, cache
@@ -4334,7 +4461,10 @@ class LLMEngineCore:
         paged lookup took on the shared pages. No-op otherwise."""
         hit, request._prefix_hit = request._prefix_hit, None
         if hit is not None and self._prefix is not None:
-            self._prefix.release(hit)
+            with lifecycle_ledger.owner(
+                lifecycle_ledger.request_tag(request)
+            ):
+                self._prefix.release(hit)
 
     def _release_resume_pin(self, request: GenRequest) -> None:
         """Drop the eviction pin a preemption took on the request's stored
@@ -4344,6 +4474,16 @@ class LLMEngineCore:
         cancelled, engine stop). No-op otherwise."""
         pin, request._resume_pin = request._resume_pin, None
         if pin is not None and self._prefix is not None:
+            if faults.active():
+                try:
+                    # chaos seam: an injected raise models a teardown bug
+                    # that drops the handle WITHOUT running the unpin — a
+                    # lost free no page audit can see (node pins are not
+                    # page refcounts). The armed ownership ledger must
+                    # name it at the drain audit (tests/test_chaos.py).
+                    faults.fire("engine.ledger.leak", request=request)
+                except faults.InjectedFault:
+                    return
             self._prefix.unpin_run(pin)
 
     def _commit_admission(self, request: GenRequest, slot: int, first_id: int, mini_cache, first_lp=None) -> None:
@@ -4555,8 +4695,9 @@ class LLMEngineCore:
             # consumer is gone — free the slot (and its KV pages) early
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
-            self._release_guided(slot)
+            self._release_guided(slot, request)
             self._free_slot_pages(slot)
+            self._ledger_audit_request(request, "cancel")
             return
         if (
             request._deadline is not None
@@ -4596,7 +4737,7 @@ class LLMEngineCore:
         ):
             request.out_queue.put_nowait(_FINISHED)
             self._slot_req[slot] = None
-            self._release_guided(slot)
+            self._release_guided(slot, request)
             try:
                 # chaos seam: an injected raise here models a teardown
                 # bug that loses the slot's page references — the armed
@@ -4607,6 +4748,7 @@ class LLMEngineCore:
                 self._free_slot_pages(slot)  # recycle (or quarantine) pages
             except faults.InjectedFault:
                 pass
+            self._ledger_audit_request(request, "emit-finish")
 
     def _drain_ready(self, err: BaseException) -> None:
         """Fail every completed-but-uncommitted admission (loop is exiting)."""
@@ -4629,7 +4771,7 @@ class LLMEngineCore:
                 request.error = err
                 request.out_queue.put_nowait(_FINISHED)
                 self._slot_req[slot] = None
-                self._release_guided(slot)
+                self._release_guided(slot, request)
 
     def _spec_eligible_mask(self, active_mask: np.ndarray):
         """(greedy_mask, sampled_mask): greedy_mask — slots the greedy
@@ -4763,55 +4905,65 @@ class LLMEngineCore:
                     pool.truncate(s, int(lengths0[s]))
                 return None
             extended.append(slot)
-        self.paged_cache.apply_pending_cow()
-        page_table = pool.page_table(self._pages_per_seq)
-        tail, use_extras, gtables = self._spec_common_args(
-            active_mask, spec_mask, sspec_mask, sampling
-        )
-        with self.paged_cache.dispatch_lock:
-            # pool handles read under the lock: a racing donating dispatch
-            # would invalidate a handle grabbed outside it
-            if self._paged_quant:
-                cachelike = (
-                    self.paged_cache.k,
-                    self.paged_cache.v,
-                    self.paged_cache.k_scale,
-                    self.paged_cache.v_scale,
-                    jnp.asarray(page_table),
-                    jnp.asarray(lengths0),
-                )
-            else:
-                cachelike = (
-                    self.paged_cache.k,
-                    self.paged_cache.v,
-                    jnp.asarray(page_table),
-                    jnp.asarray(lengths0),
-                )
-            (tokbuf, pending, new_pools, gs, accs, new_counts,
-             gstate_out, lp) = self._spec_paged_jit(
-                self.params,
-                # copies: worker-thread upload of loop-owned host mirrors
-                # (tpuserve-analyze TPU502)
-                jnp.asarray(self._tokbuf.copy()),
-                jnp.asarray(self._next_token.copy()),
-                cachelike,
-                *tail,
-                want_lp=want_lp,
-                with_sspec=bool(sspec_mask.any()),
+        try:
+            self.paged_cache.apply_pending_cow()
+            page_table = pool.page_table(self._pages_per_seq)
+            tail, use_extras, gtables = self._spec_common_args(
+                active_mask, spec_mask, sspec_mask, sampling
             )
-            self.paged_cache.k = new_pools[0]
-            self.paged_cache.v = new_pools[1]
-            if self._paged_quant:
-                self.paged_cache.k_scale = new_pools[2]
-                self.paged_cache.v_scale = new_pools[3]
-        lp_np = self._spec_commit_state(
-            tokbuf, new_counts, gstate_out, lp, use_extras, gtables
-        )
-        gs_np, accs_np = np.asarray(gs), np.asarray(accs)
+            with self.paged_cache.dispatch_lock:
+                # pool handles read under the lock: a racing donating dispatch
+                # would invalidate a handle grabbed outside it
+                if self._paged_quant:
+                    cachelike = (
+                        self.paged_cache.k,
+                        self.paged_cache.v,
+                        self.paged_cache.k_scale,
+                        self.paged_cache.v_scale,
+                        jnp.asarray(page_table),
+                        jnp.asarray(lengths0),
+                    )
+                else:
+                    cachelike = (
+                        self.paged_cache.k,
+                        self.paged_cache.v,
+                        jnp.asarray(page_table),
+                        jnp.asarray(lengths0),
+                    )
+                (tokbuf, pending, new_pools, gs, accs, new_counts,
+                 gstate_out, lp) = self._spec_paged_jit(
+                    self.params,
+                    # copies: worker-thread upload of loop-owned host mirrors
+                    # (tpuserve-analyze TPU502)
+                    jnp.asarray(self._tokbuf.copy()),
+                    jnp.asarray(self._next_token.copy()),
+                    cachelike,
+                    *tail,
+                    want_lp=want_lp,
+                    with_sspec=bool(sspec_mask.any()),
+                )
+                self.paged_cache.k = new_pools[0]
+                self.paged_cache.v = new_pools[1]
+                if self._paged_quant:
+                    self.paged_cache.k_scale = new_pools[2]
+                    self.paged_cache.v_scale = new_pools[3]
+            lp_np = self._spec_commit_state(
+                tokbuf, new_counts, gstate_out, lp, use_extras, gtables
+            )
+            gs_np, accs_np = np.asarray(gs), np.asarray(accs)
+            appended = gs_np.shape[0] + accs_np.sum(axis=0)          # [B]
+        except BaseException:
+            # tpuserve-analyze TPU701: the speculative over-allocation must
+            # roll back on EVERY exit — a dispatch failure here would
+            # otherwise strand the slack pages on the surviving slots until
+            # the next retire (slot_len inflated past what was ever
+            # written). The armed ownership ledger audits exactly this.
+            for slot in extended:
+                pool.truncate(slot, int(lengths0[slot]))
+            raise
         # roll back each slot's over-allocation to the tokens actually
         # written: rounds*(1 token) + accepted drafts. Must happen BEFORE
         # emission — _emit frees a finishing slot's pages entirely.
-        appended = gs_np.shape[0] + accs_np.sum(axis=0)          # [B]
         for slot in extended:
             pool.truncate(slot, int(lengths0[slot]) + int(appended[slot]))
         return gs_np, accs_np, np.asarray(pending), lp_np
@@ -4927,12 +5079,18 @@ class LLMEngineCore:
         ragged mode skips prefix reuse: there is no mini cache to assemble
         stored buffers into (documented limitation)."""
         pos = 0
+        hit = None
         try:
             # disaggregated ship-hit accounting (docs/disaggregation.md)
             self._count_ship_outcome(request)
             if self.cache_mode == "paged" and self._prefix is not None:
                 lora_i = self._slot_lora(request)
-                hit = self._prefix.lookup_pages(request.prompt_ids, lora_i)
+                with lifecycle_ledger.owner(
+                    lifecycle_ledger.request_tag(request)
+                ):
+                    hit = self._prefix.lookup_pages(
+                        request.prompt_ids, lora_i
+                    )
                 if hit is not None:
                     plen = hit["len"]
                     page_size = self.paged_cache.pool.page_size
@@ -4940,19 +5098,42 @@ class LLMEngineCore:
                         0 < plen < len(request.prompt_ids)
                         and plen % page_size == 0
                     ):
-                        self.paged_cache.pool.map_shared(
+                        # the mapped prefix pages ride the slot's table
+                        # from here: _emit/_fail_ragged_job (and the
+                        # except arm below) free the slot — cross-function
+                        # pairing the ownership ledger audits at drain
+                        self.paged_cache.pool.map_shared(  # tpuserve: ignore[TPU701] pages ride the slot table
                             slot, list(hit["pages"]), plen
                         )
                         pos = plen
-                        self._prefix.release(hit)
+                        with lifecycle_ledger.owner(
+                            lifecycle_ledger.request_tag(request)
+                        ):
+                            self._prefix.release(hit)
                     else:
                         # whole-prompt or misaligned hit: recompute cold
                         # (at least one tail token must produce logits)
-                        self._prefix.release(hit)
+                        with lifecycle_ledger.owner(
+                            lifecycle_ledger.request_tag(request)
+                        ):
+                            self._prefix.release(hit)
                         self._prefix.uncount_hit(hit)
         except Exception as ex:
             self._release_resume_pin(request)
             self._deref_guided_request(request)
+            # a raise between the lookup/map_shared above and the job's
+            # activation would otherwise strand resources on a slot no job
+            # owns (the less-traveled teardown path the ownership ledger
+            # flagged): drop the hit's pin — release() is pop-idempotent,
+            # so a hit the happy path already released is a no-op — and
+            # free the slot (its table is authoritative: a plain free
+            # reclaims whatever was mapped, nothing when nothing was)
+            if hit is not None:
+                with lifecycle_ledger.owner(
+                    lifecycle_ledger.request_tag(request)
+                ):
+                    self._prefix.release(hit)  # tpuserve: ignore[TPU702] release() pops; re-release is a no-op
+            self._free_ragged_slot(slot)
             request.error = ex
             request.out_queue.put_nowait(_FINISHED)
             self._admitting.discard(slot)
@@ -4982,6 +5163,7 @@ class LLMEngineCore:
             request.error = err
         request.out_queue.put_nowait(_FINISHED)
         self._free_ragged_slot(job.slot)
+        self._ledger_audit_request(request, "fail-ragged")
 
     def _abort_ragged_jobs(self, err: BaseException) -> None:
         for job in list(self._prefill_jobs):
@@ -5404,7 +5586,11 @@ class LLMEngineCore:
             for slot in list(plan["spans"]):
                 s, n = plan["spans"][slot]
                 try:
-                    pool.extend(slot, n)
+                    # surplus rides the slot: _retire_ragged truncates to
+                    # what the window kept; _ragged_recover rolls back to
+                    # pre_lens on a tripped step (cross-function pairing
+                    # the ownership ledger audits)
+                    pool.extend(slot, n)  # tpuserve: ignore[TPU701] rolled back at retire/recover
                 except MemoryError:
                     self._ragged_drop_row(plan, slot)
                     continue
@@ -5884,6 +6070,10 @@ class LLMEngineCore:
             # pages recycle (skipped on hard cancellation = teardown)
             dropped = list(self._inflight)
             self._inflight.clear()
+            if self._ledger is not None:
+                for slot in self._quarantine:
+                    lifecycle_ledger.release("slot.quarantine", key=slot,
+                                             domain=self, all_of_key=True)
             self._quarantine.clear()
             self._reset_device_chains()
             if self.paged_cache is not None and dropped:
@@ -6361,7 +6551,11 @@ class LLMEngineCore:
             slot = int(slot)
             start = pool.slot_length(slot)
             try:
-                pool.extend(slot, n)
+                # the chunk's decode_steps tokens land in these pages at
+                # retire; a failed step frees them with the slot in the
+                # loop's recovery (cross-function pairing the ownership
+                # ledger audits)
+                pool.extend(slot, n)  # tpuserve: ignore[TPU701] consumed by the chunk; recovery frees the slot
             except MemoryError:
                 active_mask[slot] = False
                 exhausted.append(slot)

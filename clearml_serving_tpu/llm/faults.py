@@ -17,6 +17,12 @@ Known points (ctx carried with each):
                          device step (``requests`` = active GenRequests);
                          ``match_token`` poisons only the request whose
                          prompt contains that token; ``delay`` = stuck loop.
+- ``engine.decode.stall`` — at the top of a speculative decode dispatch
+                         (``requests``), before any page over-allocation;
+                         ``delay`` models a slow spec round wedging the
+                         loop (the watchdog's view of a stuck spec scan),
+                         ``raise`` fails the dispatch before it touches
+                         the pool.
 - ``engine.decode.retire`` — on the loop thread at chunk retirement, after
                          the device->host sync and before emission
                          (``requests``); ``match_token`` fails only the
@@ -85,6 +91,17 @@ Known points (ctx carried with each):
                          drops the shipment with zero page leaks and the
                          replica group re-routes the stream to a
                          hybrid-capable sibling (recompute there).
+- ``engine.ledger.leak`` — at the preemption resume-pin teardown
+                         (``_release_resume_pin``), AFTER the handle is
+                         detached from the request and BEFORE the
+                         underlying unpin runs (``request``); a raise
+                         models a lost free — the handle drops, the unpin
+                         never fires, and the armed ownership ledger
+                         (llm/lifecycle_ledger.py, TPUSERVE_LEDGER) must
+                         name the leaked ``prefix.resume_pin`` and its
+                         acquire site at the drain audit. Node pins are
+                         invisible to page refcount accounting, so this
+                         leak class is the ledger's alone.
 - ``engine.dispatch.prepare`` — on the loop thread at the end of
                          ``_prepare_dispatch`` (``requests``): the shared
                          host state is snapshotted, the worker-thread device
@@ -167,6 +184,7 @@ KNOWN_POINTS = frozenset({
     "engine.kv.promote",
     "engine.kv.ship",
     "engine.kv.receive",
+    "engine.ledger.leak",
     "engine.compile.bucket",
     "router.pick",
     "router.eject",

@@ -56,6 +56,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import lifecycle_ledger as _ledger
+
 from .shapes import pad_pages, pow2_bucket
 
 
@@ -76,6 +78,34 @@ class PagePool:
     __guarded_by__ = {
         "_lock": ("_free", "_slot_pages", "_slot_len", "_refs",
                   "_pending_cow", "_pins"),
+    }
+
+    # ownership-discipline registry (tpuserve-analyze TPU7xx,
+    # docs/static_analysis.md): every declared acquire must reach a
+    # matching release / drop-to-recompute handler on ALL paths (exception
+    # edges included). Mirrored in analyze/rules_lifecycle.py
+    # LIFECYCLE_REGISTRY (consistency-tested); "static": False entries are
+    # cross-function protocols the runtime ownership ledger
+    # (llm/lifecycle_ledger.py) audits instead.
+    __acquires__ = {
+        "allocate": {"resource": "pages.slot",
+                     "releases": ("free", "truncate"),
+                     "drops": ("_free_slot_pages",),
+                     "receivers": ("pool", "_pool", "page_pool", "pages")},
+        "extend": {"resource": "pages.slot",
+                   "releases": ("free", "truncate"),
+                   "drops": ("_free_slot_pages",),
+                   "receivers": ("pool", "_pool", "page_pool")},
+        "map_shared": {"resource": "pages.slot", "releases": ("free",),
+                       "drops": ("_free_slot_pages",),
+                       "receivers": ("pool", "_pool", "page_pool")},
+        "allocate_cache_pages": {"resource": "pages.ref",
+                                 "releases": ("unref_pages",),
+                                 "mint": True},
+        "ref_pages": {"resource": "pages.ref", "releases": ("unref_pages",),
+                      "static": False},
+        "pin_pages": {"resource": "pages.pin",
+                      "releases": ("unpin_pages",)},
     }
 
     def __init__(self, num_pages: int, page_size: int, max_slots: int):
@@ -138,6 +168,9 @@ class PagePool:
             new = [self._pop_free() for _ in range(max(0, need))]
             self._slot_pages[slot].extend(new)
             self._slot_len[slot] = tokens
+            if new and _ledger.armed():
+                _ledger.acquire("pages.slot", key=slot, n=len(new),
+                                domain=self)
             return new
 
     def extend(self, slot: int, extra_tokens: int = 1) -> List[int]:
@@ -175,6 +208,9 @@ class PagePool:
                 self._unref(page)
             self._slot_pages[slot] = []
             self._slot_len[slot] = 0
+            if _ledger.armed():
+                _ledger.release("pages.slot", key=slot, domain=self,
+                                all_of_key=True)
 
     def truncate(self, slot: int, tokens: int) -> None:
         """Shrink a sequence to `tokens`, dropping this slot's references to
@@ -194,6 +230,9 @@ class PagePool:
             for page in reversed(surplus):
                 self._unref(page)
             self._slot_len[slot] = tokens
+            if surplus and _ledger.armed():
+                _ledger.release("pages.slot", key=slot, n=len(surplus),
+                                domain=self)
 
     # -- sharing (prefix cache) --------------------------------------------
 
@@ -210,6 +249,8 @@ class PagePool:
                     )
             for page in pages:
                 self._refs[page] += 1
+            if pages and _ledger.armed():
+                _ledger.acquire("pages.ref", n=len(pages), domain=self)
 
     def unref_pages(self, pages: List[int]) -> int:
         """Drop one reference per page; returns how many were freed."""
@@ -218,6 +259,8 @@ class PagePool:
             for page in pages:
                 if self._unref(page):
                     freed += 1
+            if pages and _ledger.armed():
+                _ledger.release("pages.ref", n=len(pages), domain=self)
         return freed
 
     def pin_pages(self, pages: List[int]) -> None:
@@ -235,6 +278,11 @@ class PagePool:
             for page in pages:
                 self._refs[page] += 1
                 self._pins[page] = self._pins.get(page, 0) + 1
+            if pages and _ledger.armed():
+                # keyed by the exact page run: concurrent admissions' pins
+                # must not discharge each other's entries
+                _ledger.acquire("pages.pin", key=tuple(pages),
+                                n=len(pages), domain=self)
 
     def unpin_pages(self, pages: List[int]) -> int:
         """Drop one transient reference per page; returns pages freed."""
@@ -256,6 +304,9 @@ class PagePool:
                     self._pins[page] = count - 1
                 if self._unref(page):
                     freed += 1
+            if pages and _ledger.armed():
+                _ledger.release("pages.pin", key=tuple(pages),
+                                n=len(pages), domain=self)
         return freed
 
     def snapshot(self) -> Dict[str, object]:
@@ -297,6 +348,9 @@ class PagePool:
                 self._refs[page] += 1
             self._slot_pages[slot] = list(pages)
             self._slot_len[slot] = tokens
+            if pages and _ledger.armed():
+                _ledger.acquire("pages.slot", key=slot, n=len(pages),
+                                domain=self)
 
     def allocate_cache_pages(self, n: int) -> List[int]:
         """Pop ``n`` free pages with one reference each, to be owned by the
@@ -311,7 +365,10 @@ class PagePool:
                     "page pool exhausted: promotion needs {} pages, {} "
                     "free".format(n, len(self._free))
                 )
-            return [self._pop_free() for _ in range(n)]
+            fresh = [self._pop_free() for _ in range(n)]
+            if fresh and _ledger.armed():
+                _ledger.acquire("pages.ref", n=len(fresh), domain=self)
+            return fresh
 
     def drain_pending_cow(self) -> List[Tuple[int, int]]:
         with self._lock:
@@ -419,6 +476,13 @@ class HostKVTier:
     # list (the PR-4 aliasing rule).
     __guarded_by__ = {"_lock": ("_free", "_used")}
 
+    # ownership-discipline registry (tpuserve-analyze TPU7xx): host ids
+    # pair allocate/free; the radix cache owns them at steady state
+    __acquires__ = {
+        "allocate": {"resource": "host.pages", "releases": ("free",),
+                     "receivers": ("host_tier", "_host", "tier", "host")},
+    }
+
     def __init__(self, num_pages: int, page_size: int, n_layers: int,
                  n_kv_heads: int, head_dim: int, dtype, quantized: bool):
         self.num_pages = int(num_pages)
@@ -470,6 +534,8 @@ class HostKVTier:
                 )
             ids = [self._free.pop() for _ in range(n)]
             self._used.update(ids)
+            if ids and _ledger.armed():
+                _ledger.acquire("host.pages", n=len(ids), domain=self)
             return ids
 
     def free(self, ids: List[int]) -> None:
@@ -481,6 +547,8 @@ class HostKVTier:
                     )
                 self._used.discard(hid)
                 self._free.append(hid)
+            if ids and _ledger.armed():
+                _ledger.release("host.pages", n=len(ids), domain=self)
 
     def snapshot(self) -> Dict[str, object]:
         """Consistent copy of the id bookkeeping for the KV sanitizer."""
@@ -828,6 +896,8 @@ class PagedKVCache:
                 "t_issue": t_issue,
                 "fence": fence,
             })
+            if _ledger.armed():
+                _ledger.acquire("kv.promotion", domain=self)
 
     def import_pages(self, hk, hv, pages: List[int],
                      hk_scale=None, hv_scale=None) -> None:
@@ -886,6 +956,8 @@ class PagedKVCache:
                 ]
                 for r in records:
                     self._promotions.remove(r)
+            if records and _ledger.armed():
+                _ledger.release("kv.promotion", n=len(records), domain=self)
         reaped = 0
         for rec in records:
             t_reap = time.perf_counter()
@@ -985,7 +1057,10 @@ class PagedKVCache:
         slot's pages via donated jitted writes (plus [L, S, Hkv] scales on
         int8 pools)."""
         self.pool.free(slot)
-        self.pool.allocate(slot, length)
+        # the pages ride the slot's table from here; a failed admission
+        # frees the slot in the engine (cross-function pairing the
+        # ownership ledger audits at drain)
+        self.pool.allocate(slot, length)  # tpuserve: ignore[TPU701] pages ride the slot table
         self._scatter_pages(
             self.pool.slot_pages(slot), k_stack, v_stack, k_scales, v_scales
         )
@@ -1006,8 +1081,8 @@ class PagedKVCache:
                 "shared prefix length {} is not page-aligned".format(prefix_len)
             )
         self.pool.free(slot)
-        self.pool.map_shared(slot, shared_pages, prefix_len)
-        tail_pages = self.pool.allocate(slot, length)
+        self.pool.map_shared(slot, shared_pages, prefix_len)  # tpuserve: ignore[TPU701] pages ride the slot table
+        tail_pages = self.pool.allocate(slot, length)  # tpuserve: ignore[TPU701] pages ride the slot table
         if tail_pages:
             self._scatter_pages(
                 tail_pages, k_tail, v_tail, k_scales_tail, v_scales_tail
@@ -1021,7 +1096,7 @@ class PagedKVCache:
 
         self._require_scales(k_scale, v_scale)
         length = self.pool.slot_length(slot)
-        self.pool.extend(slot, 1)
+        self.pool.extend(slot, 1)  # tpuserve: ignore[TPU701] pages ride the slot table
         self.apply_pending_cow()
         ((page, offset),) = self.pool.token_coords(slot, length, 1)
         with self.dispatch_lock:

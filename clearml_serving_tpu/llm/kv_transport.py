@@ -57,6 +57,8 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from . import lifecycle_ledger as _ledger
+
 
 def shipment_key(prompt_ids: Sequence[int], block: int, lora: int = 0) -> bytes:
     """Deterministic content key for a prompt's storable block-aligned
@@ -147,6 +149,18 @@ class SharedSlabTransport:
     # thread, receivers pop from the group's receive worker
     __guarded_by__ = {"_lock": ("_slabs", "_slab_pages", "_ship_seq")}
 
+    # ownership-discipline registry (tpuserve-analyze TPU7xx): a sent
+    # shipment sits in the destination mailbox until the consume-once
+    # recv pops it (or capacity eviction drops the oldest). The pairing
+    # crosses replicas, so the static pass leaves it to the runtime
+    # ownership ledger; TPU704 pins the consume-once half.
+    __acquires__ = {
+        "send": {"resource": "transport.shipment",
+                 "releases": ("recv", "_drop_oldest"), "static": False,
+                 "receivers": ("transport", "endpoint", "_transport",
+                               "_kv_transport", "ep")},
+    }
+
     def __init__(self, capacity_pages: int = 1024,
                  max_shipments: int = 64):
         if capacity_pages <= 0:
@@ -176,10 +190,12 @@ class SharedSlabTransport:
         return TransportEndpoint(self, name)
 
     def _drop_oldest(self, dst: str) -> None:  # tpuserve: ignore[TPU301] lock held by caller
-        _, old = self._slabs[dst].popitem(last=False)
+        key, old = self._slabs[dst].popitem(last=False)
         self._slab_pages[dst] -= old.pages
         self.dropped += 1
         self.dropped_pages += old.pages
+        if _ledger.armed():
+            _ledger.release("transport.shipment", key=key, domain=self)
 
     def send(self, dst: str, shipment: KVShipment) -> bool:
         """Deliver ``shipment`` into ``dst``'s receive slab. Returns False
@@ -211,6 +227,12 @@ class SharedSlabTransport:
             shipment.seq = self._ship_seq
             slab[shipment.key] = shipment
             self._slab_pages[dst] += shipment.pages
+            if _ledger.armed():
+                if stale is not None:
+                    _ledger.release("transport.shipment", key=shipment.key,
+                                    domain=self)
+                _ledger.acquire("transport.shipment", key=shipment.key,
+                                domain=self)
         self.sent += 1
         self.sent_pages += shipment.pages
         return True
@@ -224,6 +246,9 @@ class SharedSlabTransport:
             shipment = slab.pop(key, None) if slab is not None else None
             if shipment is not None:
                 self._slab_pages[dst] -= shipment.pages
+                if _ledger.armed():
+                    _ledger.release("transport.shipment", key=key,
+                                    domain=self)
         if shipment is not None:
             self.received += 1
             self.received_pages += shipment.pages

@@ -64,6 +64,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import faults
+from . import lifecycle_ledger as _ledger
 
 
 class _Node:
@@ -109,6 +110,18 @@ class RadixPrefixCache:
     __guarded_by__ = {
         "_lock": ("_roots", "_leaf_nodes", "_n_nodes", "_clock",
                   "_frontier", "_n_resident", "_host_pages", "_host_bytes"),
+    }
+
+    # ownership-discipline registry (tpuserve-analyze TPU7xx,
+    # docs/static_analysis.md): lookup hits carry a pin the caller MUST
+    # release(); pin_run holds survive until unpin_run. Mirrored in
+    # analyze/rules_lifecycle.py LIFECYCLE_REGISTRY (consistency-tested).
+    __acquires__ = {
+        "lookup_pages": {"resource": "prefix.hit",
+                         "releases": ("release", "_release_prefix_hit"),
+                         "drops": ("uncount_hit",)},
+        "pin_run": {"resource": "prefix.resume_pin",
+                    "releases": ("unpin_run", "_release_resume_pin")},
     }
 
     def __init__(
@@ -409,8 +422,14 @@ class RadixPrefixCache:
             pages: List[int] = []
             for n in path:
                 pages.extend(n.pages)
-            self._pool.pin_pages(pages)  # pin for the admission in flight
-        return {"len": depth, "pages": pages, "tier": tier}
+            # ownership of the pin transfers to the returned hit: the
+            # caller MUST release() it (the engine's _release_prefix_hit
+            # paths; the ownership ledger audits the pairing per request)
+            self._pool.pin_pages(pages)  # tpuserve: ignore[TPU701] pin rides the returned hit
+        hit = {"len": depth, "pages": pages, "tier": tier}
+        if _ledger.armed():
+            _ledger.acquire("prefix.hit", key=id(hit), domain=self)
+        return hit
 
     def release(self, hit: Dict[str, Any]) -> None:
         """Drop a lookup_pages() pin (after slot mapping took its own refs,
@@ -418,6 +437,8 @@ class RadixPrefixCache:
         pages = hit.pop("pages", None) if hit else None
         if pages:
             self._pool.unpin_pages(pages)
+            if _ledger.armed():
+                _ledger.release("prefix.hit", key=id(hit), domain=self)
 
     def store_pages(self, ids: List[int], lora: int, slot_pages: List[int]) -> None:
         """Store the prompt's block-aligned prefix by REFERENCE to the
@@ -546,15 +567,19 @@ class RadixPrefixCache:
                 return 0
             total = len(jobs) * ppb
             fresh = self._pool.allocate_cache_pages(total)
-            rows = np.asarray(
-                [
-                    tok_depth // page_size + j
-                    for tok_depth, _ in jobs
-                    for j in range(ppb)
-                ],
-                np.int64,
-            )
             try:
+                # EVERYTHING between the mint and the publish sits under
+                # this unref-on-failure guard (tpuserve-analyze TPU701: a
+                # raise out of the row gather used to leak the fresh pages
+                # — the mint must reach a release on the exception path)
+                rows = np.asarray(
+                    [
+                        tok_depth // page_size + j
+                        for tok_depth, _ in jobs
+                        for j in range(ppb)
+                    ],
+                    np.int64,
+                )
                 # fancy indexing COPIES the selected slab rows; the upload
                 # never aliases the transport mailbox's memory
                 backend.import_pages(
@@ -627,13 +652,17 @@ class RadixPrefixCache:
             nodes = self._path_nodes(node)
             for n in nodes:
                 n.pinned += 1
-            return {
+            handle = {
                 "nodes": nodes,
                 "len": depth,
                 "host_nodes": sum(
                     1 for n in nodes if n.host_pages is not None
                 ),
             }
+            if _ledger.armed():
+                _ledger.acquire("prefix.resume_pin", key=id(handle),
+                                domain=self)
+            return handle
 
     def unpin_run(self, handle: Optional[Dict[str, Any]]) -> None:
         """Release a pin_run() hold; eviction deferred by the pin (the cache
@@ -643,6 +672,9 @@ class RadixPrefixCache:
         with self._lock:
             for n in handle.pop("nodes", ()):
                 n.pinned = max(0, n.pinned - 1)
+            if _ledger.armed():
+                _ledger.release("prefix.resume_pin", key=id(handle),
+                                domain=self)
             self._evict_over_budget()
 
     # -- eviction / tiering --------------------------------------------------

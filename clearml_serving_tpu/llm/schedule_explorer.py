@@ -294,7 +294,7 @@ def scenario_host_buffer_handoff(ctx: ScenarioContext) -> None:
         )
 
 
-def scenario_quarantine_barrier(ctx: ScenarioContext) -> None:
+def scenario_quarantine_barrier(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
     """A slot freed at retire N is quarantined until every older in-flight
     chunk retires: its pages must never be re-allocated under a pending
     device write (docs/pipelined_decode.md). Mutation ``drop_quarantine``
@@ -351,7 +351,7 @@ def scenario_quarantine_barrier(ctx: ScenarioContext) -> None:
     KVSanitizer(pool).check("quarantine-barrier", drained=True)
 
 
-def scenario_pin_balance(ctx: ScenarioContext) -> None:
+def scenario_pin_balance(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
     """Preemption/prefix-hit pins must balance: every pin_pages has a
     matching unpin on every queue-exit path, or the armed sanitizer's drain
     audit reports pins outliving the requests that took them. Mutation
@@ -518,7 +518,7 @@ class _ModelTierBackend:
         self.late = []
 
 
-def scenario_tier_promotion(ctx: ScenarioContext) -> None:
+def scenario_tier_promotion(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
     """KV tiering (docs/kv_tiering.md): an eviction DEMOTES a cached run to
     the host tier while a concurrent admission looks the same run up and
     map_shared's it. The admission must end up reading the run's original
@@ -596,7 +596,7 @@ def scenario_tier_promotion(ctx: ScenarioContext) -> None:
     sanitizer.check("tier-promotion", drained=True)
 
 
-def scenario_ragged_window_retire(ctx: ScenarioContext) -> None:
+def scenario_ragged_window_retire(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
     """Multi-step ragged retire (docs/ragged_attention.md): a q=4 decode
     window's tokens are emitted IN ORDER under the mid-window EOS mask —
     the row's request finishes at the stop token, its slot pages free, and
@@ -696,7 +696,7 @@ class _ModelShipBackend:
         self.late = []
 
 
-def scenario_kv_ship(ctx: ScenarioContext) -> None:
+def scenario_kv_ship(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
     """Disaggregated KV shipping (docs/disaggregation.md): a prefill
     replica's shipment lands on the decode replica WHILE that replica's
     concurrent admission looks the same prefix up and ``map_shared``'s
@@ -793,6 +793,74 @@ def scenario_kv_ship(ctx: ScenarioContext) -> None:
     sanitizer.check("kv-ship", drained=True)
 
 
+def scenario_ledger_pairing(ctx: ScenarioContext) -> None:  # tpuserve: ignore[TPU701] pairing crosses scenario threads by design
+    """Ownership-ledger pairing (docs/static_analysis.md TPU7xx): an
+    admission takes a prefix-hit pin while a concurrent teardown frees the
+    storing slot, and the REAL armed ledger (llm/lifecycle_ledger.py) must
+    prove every acquire released at the drained boundary. Mutation
+    ``drop_release_on_raise`` makes the admission's failure path skip its
+    release() — the exception-path leak class TPU701 catches statically
+    and the ledger catches at runtime; mutation ``double_free`` makes the
+    teardown free the slot twice — the release-after-free class TPU702
+    catches statically and the ledger reports as a double release."""
+    from . import lifecycle_ledger
+    from .kv_sanitizer import KVSanitizer
+    from .prefix_cache import RadixPrefixCache
+
+    was_armed = lifecycle_ledger.armed()
+    prior_strict = lifecycle_ledger.get().strict  # BEFORE arm mutates it
+    ledger = lifecycle_ledger.arm(strict=True)
+    ledger.reset(strict=True)   # fresh books for the scenario's primitives
+    pool = _pool(num_pages=9, page_size=4, max_slots=2)
+    cache = RadixPrefixCache(block=4, pool=pool, page_bytes=8)
+    ids = list(range(8))
+    pool.allocate(0, 8)
+    cache.store_pages(ids, 0, pool.slot_pages(0))
+    mark = ledger.stats()
+    try:
+
+        def admission():
+            with ledger.owner("req:scenario"):
+                hit = cache.lookup_pages(ids)
+            ctx.yield_point("engine.prefill")
+            # the admission fails mid-flight: its exception path must
+            # still release the pinned hit
+            if not ctx.mutating("drop_release_on_raise"):
+                cache.release(hit)
+            ctx.yield_point("engine.prefill")
+
+        def teardown():
+            ctx.yield_point("engine.decode.retire")
+            pool.free(0)
+            if ctx.mutating("double_free"):
+                # seeded defect: recovery re-frees what the normal path
+                # freed — with the slot's entry gone, the ledger's books
+                # see a release that was never acquired
+                lifecycle_ledger.release(
+                    "pages.slot", key=0, domain=pool, all_of_key=False
+                )
+            ctx.yield_point("engine.release")
+
+        ctx.spawn(admission, "admit")
+        ctx.spawn(teardown, "loop")
+        ctx.run()
+        stats = ledger.stats()
+        if stats["double_releases"] > mark["double_releases"]:
+            raise ScheduleViolation(
+                "ownership ledger recorded a release never acquired "
+                "(double free) during the scenario"
+            )
+        # drained boundary: the scenario's transient resources must be gone
+        ledger.check("ledger-pairing", drained=True, domains=[pool, cache])
+        KVSanitizer(pool, prefix_cache=cache).check(
+            "ledger-pairing", drained=True
+        )
+    finally:
+        ledger.reset(strict=prior_strict)
+        if not was_armed:
+            lifecycle_ledger.disarm()
+
+
 SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "host_buffer_handoff": scenario_host_buffer_handoff,
     "quarantine_barrier": scenario_quarantine_barrier,
@@ -802,6 +870,7 @@ SCENARIOS: Dict[str, Callable[[ScenarioContext], None]] = {
     "tier_promotion": scenario_tier_promotion,
     "ragged_window_retire": scenario_ragged_window_retire,
     "kv_ship": scenario_kv_ship,
+    "ledger_pairing": scenario_ledger_pairing,
 }
 
 # seeded defect -> the scenario that must catch it (self_test proves each)
@@ -814,6 +883,8 @@ MUTATIONS: Dict[str, str] = {
     "drop_tier_fence": "tier_promotion",
     "drop_window_eos_mask": "ragged_window_retire",
     "drop_ship_fence": "kv_ship",
+    "drop_release_on_raise": "ledger_pairing",
+    "double_free": "ledger_pairing",
 }
 
 
@@ -836,6 +907,7 @@ def explore(scenario: str, schedules: int = 16, seed: int = 0,
             )
         )
     from .kv_sanitizer import KVSanitizerError
+    from .lifecycle_ledger import LedgerError
 
     mutations = frozenset({mutate}) if mutate else frozenset()
     violations = []
@@ -844,7 +916,7 @@ def explore(scenario: str, schedules: int = 16, seed: int = 0,
         ctx = ScenarioContext(rng, mutations, scenario=scenario, seed=seed)
         try:
             SCENARIOS[scenario](ctx)
-        except (ScheduleViolation, KVSanitizerError) as ex:
+        except (ScheduleViolation, KVSanitizerError, LedgerError) as ex:
             ctx._stamp(ex)
             # the armed KV sanitizer is part of the net: its invariant
             # failures count as caught violations, with the schedule trace

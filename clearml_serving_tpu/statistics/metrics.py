@@ -498,6 +498,24 @@ class EngineLifecycleCollector(_KeyedCollector):
             "per-compilation XLA compile time (ms) observed by the "
             "compile sentry",
         )
+        # ownership discipline (docs/static_analysis.md TPU7xx): the
+        # runtime ledger's live holds and its leak findings — a nonzero
+        # leak total on an armed engine is a lost release on some
+        # exception path, named (resource + acquire site) in the ledger's
+        # violation records
+        ledger_outstanding = GaugeMetricFamily(
+            p + "_ledger_outstanding",
+            "resources currently held per the ownership ledger "
+            "(TPUSERVE_LEDGER), by resource class (cache-scoped classes "
+            "are legitimately nonzero at idle; request-scoped classes "
+            "drain to zero)",
+        )
+        ledger_leaks = CounterMetricFamily(
+            p + "_ledger_leaks_total",
+            "lost releases found by the ownership ledger's request-exit "
+            "and drain audits (each names the leaked resource and its "
+            "acquire site in lifecycle_stats()[\"ledger\"])",
+        )
 
         def _hist_buckets(snap):
             """Engine _MsHistogram snapshot -> prometheus cumulative
@@ -517,6 +535,7 @@ class EngineLifecycleCollector(_KeyedCollector):
         any_slo = False
         any_ragged = False
         any_compile = False
+        any_ledger = False
         for key, s in rows:
             kv_pool = s.get("kv_pool") or {}
             if kv_pool:
@@ -552,6 +571,15 @@ class EngineLifecycleCollector(_KeyedCollector):
                     hist(kv_ship_ms, key, s, snap, direction="in")
                 if kv_ship.get("hit_rate") is not None:
                     gauge(kv_ship_hit_rate, key, s, kv_ship["hit_rate"])
+            ledger_block = s.get("ledger") or {}
+            if ledger_block:
+                any_ledger = True
+                for resource, v in (
+                    ledger_block.get("outstanding") or {}
+                ).items():
+                    gauge(ledger_outstanding, key, s, v, resource=resource)
+                if "leaks" in ledger_block:
+                    counter(ledger_leaks, key, s, ledger_block["leaks"])
             compile_block = s.get("compile") or {}
             if compile_block:
                 any_compile = True
@@ -668,6 +696,9 @@ class EngineLifecycleCollector(_KeyedCollector):
         if any_compile:
             yield xla_compiles
             yield xla_compile_ms
+        if any_ledger:
+            yield ledger_outstanding
+            yield ledger_leaks
         if any_grpc:
             yield grpc
 

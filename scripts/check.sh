@@ -51,12 +51,15 @@ if [ "$advisory_rc" -ne 0 ]; then
   fi
 fi
 
-# one pass runs every rule family, TPU1xx..TPU6xx — including the
-# compile-surface rules (TPU601-604: bucketizer discipline, __compile_keys__
-# closed world, warmup-registry coverage; docs/static_analysis.md). CI
-# (.github/workflows/checks.yml) invokes this same script; use
-# `--format github` there for inline diff annotations.
+# one pass runs every rule family, TPU1xx..TPU7xx — including the
+# compile-surface rules (TPU601-604) and the ownership-discipline rules
+# (TPU701-704: acquire/release pairing over exception paths;
+# docs/static_analysis.md). --timings keeps the per-family analyzer cost
+# visible as the catalog grows (the gate must stay a pre-commit-scale
+# tool, not a CI-only one). CI (.github/workflows/checks.yml) invokes
+# this same script; use `--format github` there for inline diff
+# annotations, and `--changed-only` for the PR fast lane.
 echo "== tpuserve-analyze =="
-python -m clearml_serving_tpu.analyze "${paths[@]}" || rc=1
+python -m clearml_serving_tpu.analyze --timings "${paths[@]}" || rc=1
 
 exit $rc

@@ -78,6 +78,24 @@ def armed_compile_sentry(monkeypatch):
         compile_sentry._sentry.reset(strict=False)
 
 
+@pytest.fixture(autouse=True)
+def armed_ledger(monkeypatch):
+    """The ownership ledger rides along in count mode (docs/
+    static_analysis.md TPU7xx): every chaos engine records acquire/release
+    pairing through its recovery paths, proving the bookkeeping itself is
+    inert under faults. Count mode, not strict — several tests here leak
+    DELIBERATELY (that is what they test), and their own assertions own
+    the failure; the strict end-to-end case lives in
+    tests/test_lifecycle_ledger.py."""
+    monkeypatch.setenv("TPUSERVE_LEDGER", "1")
+    from clearml_serving_tpu.llm import lifecycle_ledger
+
+    lifecycle_ledger.arm(strict=False).reset(strict=False)
+    yield
+    lifecycle_ledger.get().reset(strict=False)
+    lifecycle_ledger.disarm()
+
+
 def _make_engine(bundle, params, **kwargs):
     kwargs.setdefault("max_batch", 4)
     kwargs.setdefault("max_seq_len", 128)

@@ -71,6 +71,11 @@ def test_artifact_internal_consistency():
     # hides a mid-run compile stall
     assert head["post_warmup_compiles"] == 0
     assert head["compile_sentry_mode"] in ("log", "monitoring")
+    # leak-free certification (docs/static_analysis.md TPU7xx): the run
+    # completed under the STRICT ownership ledger with zero lost releases
+    # across every preemption/shed/deadline path the sweep exercised
+    assert head["leaks"] == 0
+    assert head["ledger_mode"] == "strict"
     assert row["warmup"]["fenced"] is True
     # headline fields restate the curves they were derived from
     at_2x = loads[-1]["classes"]["interactive"]

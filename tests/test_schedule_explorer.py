@@ -36,9 +36,73 @@ def test_yield_points_are_registered_fault_points():
 
 
 def test_new_engine_seams_accept_chaos_specs():
-    for point in ("engine.dispatch.prepare", "engine.watchdog", "engine.drain"):
+    for point in ("engine.dispatch.prepare", "engine.watchdog", "engine.drain",
+                  "engine.ledger.leak"):
         faults.configure([{"point": point, "action": "delay", "delay": 0.0}])
     faults.clear()
+
+
+def test_seam_registries_three_way_consistency():
+    """ONE test pins the whole seam vocabulary (the PR-11-era gap: seams
+    added to faults.py were not forced through every registry):
+
+    1. analyzer fallback == runtime registry (a detached-fixture analysis
+       must validate against the same point set CI validates against);
+    2. explorer yield points ⊆ the registry (a scenario can only park on
+       seams chaos specs can also target);
+    3. every ``faults.fire("<literal>")`` call site in the source tree
+       names a registered point (the dynamic twin of analyzer TPU403);
+    4. every registered point is documented in faults.py's module
+       docstring (an undocumented seam is untargetable in practice).
+    """
+    import ast
+    import os
+
+    from clearml_serving_tpu.analyze import rules_errors
+    from clearml_serving_tpu.llm import faults as faults_mod
+
+    # (1) + (2)
+    assert rules_errors.FALLBACK_POINTS == faults.KNOWN_POINTS
+    assert YIELD_POINTS <= faults.KNOWN_POINTS
+
+    # (3) every fire() literal in the tree is registered
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(faults_mod.__file__)))
+    fired = set()
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, name), encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                attr = func.attr if isinstance(func, ast.Attribute) else (
+                    func.id if isinstance(func, ast.Name) else None
+                )
+                if attr != "fire":
+                    continue
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    fired.add(first.value)
+    unregistered = fired - faults.KNOWN_POINTS
+    assert not unregistered, (
+        "fire() call sites name unregistered points: {}".format(
+            sorted(unregistered)
+        )
+    )
+
+    # (4) the docstring documents every registered point
+    doc = faults_mod.__doc__ or ""
+    undocumented = {p for p in faults.KNOWN_POINTS if p not in doc}
+    assert not undocumented, (
+        "registered fault points missing from the faults.py docstring: "
+        "{}".format(sorted(undocumented))
+    )
 
 
 def test_unknown_yield_point_is_rejected():

@@ -824,6 +824,87 @@ def test_engine_compile_metrics_exported(monkeypatch):
         sentry.reset(strict=False)
 
 
+def test_engine_ledger_metrics_exported(monkeypatch):
+    """Ownership-discipline observability (docs/static_analysis.md TPU7xx):
+    the lifecycle collector exports engine_ledger_outstanding{resource} and
+    engine_ledger_leaks_total from the provider's ``ledger`` block — from a
+    synthetic provider AND end to end against a real engine with the
+    ownership ledger armed."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "ledger": {
+            "strict": True, "acquires": 40, "releases": 37,
+            "leaks": 2, "double_releases": 1, "violations": 3,
+            "outstanding": {"pages.slot": 0, "pages.ref": 3,
+                            "prefix.resume_pin": 1},
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_ledger_outstanding", resource="pages.ref") == 3
+    assert val("engine_ledger_outstanding", resource="prefix.resume_pin") == 1
+    assert val("engine_ledger_outstanding", resource="pages.slot") == 0
+    assert val("engine_ledger_leaks_total") == 2
+    # unarmed providers (ledger None) export no ledger families
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "ledger": None},
+        registry=registry2, key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_ledger_leaks_total", {"model": "m2"}
+    ) is None
+
+    # end to end against a REAL engine with the ledger armed: the engine's
+    # lifecycle_stats carries the live block, and a pool acquire in the
+    # process surfaces in the outstanding gauge
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm import lifecycle_ledger
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+
+    monkeypatch.setenv("TPUSERVE_LEDGER", "1")
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, cache_mode="paged",
+        page_size=16, prefill_buckets=[16], eos_token_id=None,
+    )
+    try:
+        assert engine._ledger is not None
+        engine._ledger.reset(strict=False)
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+        engine.paged_cache.pool.allocate(0, 20)  # 2 pages outstanding
+        assert registry3.get_sample_value(
+            "engine_ledger_outstanding",
+            {"model": "llm", "resource": "pages.slot"},
+        ) == 2
+        assert registry3.get_sample_value(
+            "engine_ledger_leaks_total", {"model": "llm"}
+        ) == 0
+        engine.paged_cache.pool.free(0)
+        assert registry3.get_sample_value(
+            "engine_ledger_outstanding",
+            {"model": "llm", "resource": "pages.slot"},
+        ) == 0
+    finally:
+        engine.stop()
+        lifecycle_ledger.get().reset(strict=False)
+        lifecycle_ledger.disarm()
+
+
 def test_replica_label_on_lifecycle_families():
     """Replica fleets (docs/replication.md): a provider that reports a
     ``replica`` id gets the replica label on ITS samples (two replicas of
