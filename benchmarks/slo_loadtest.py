@@ -69,6 +69,12 @@ HEADLINE_KEYS = {
     # preemption/shed/deadline/cancel path the sweep exercised — the
     # committed artifact asserts 0, so the run is leak-free-certified
     "leaks", "ledger_mode",
+    # sharding certification (docs/static_analysis.md TPU8xx): implicit
+    # device<->host transfers found by the strict sharding sentry's
+    # loop-boundary audits across the whole sweep — the committed artifact
+    # asserts 0, so every number in it was produced without a silent host
+    # round-trip or layout drift on the serve path
+    "implicit_transfers", "unplanned_reshards", "shard_sentry_mode",
 }
 
 # the mixed trace: weights sum to 1. Chat + tool loops share system
@@ -408,6 +414,12 @@ async def _run_async(smoke: bool) -> dict:
             ledger.stats() if ledger is not None
             else {"strict": False, "leaks": -1, "double_releases": -1}
         )
+        shard = engine._shard_sentry
+        shard_stats = (
+            shard.stats_brief() if shard is not None
+            else {"strict": False, "implicit_transfers": -1,
+                  "unplanned_reshards": -1}
+        )
         loop_exc = None
         task = engine._loop_task
         if task is not None and task.done() and not task.cancelled():
@@ -475,6 +487,17 @@ async def _run_async(smoke: bool) -> dict:
                 "strict" if ledger_stats.get("strict")
                 else ("count" if ledger is not None else "off")
             ),
+            # sharding certification (docs/static_analysis.md TPU8xx):
+            # silent host materializations / layout drift found by the
+            # strict sharding sentry's loop-boundary audits (tier-1
+            # asserts 0) — strict mode fails the run on one, so
+            # completing at all is the certificate
+            "implicit_transfers": shard_stats.get("implicit_transfers", -1),
+            "unplanned_reshards": shard_stats.get("unplanned_reshards", -1),
+            "shard_sentry_mode": (
+                "strict" if shard_stats.get("strict")
+                else ("count" if shard is not None else "off")
+            ),
         },
         "warmup": warm,
     }
@@ -498,13 +521,22 @@ def run(smoke: bool = True, write_artifact: bool = True) -> dict:
     # sweep's preemption/shed/deadline paths — the committed headline's
     # `leaks: 0` is proven, not sampled
     os.environ["TPUSERVE_LEDGER"] = "strict"
+    # sharding certification (docs/static_analysis.md TPU8xx): the strict
+    # sharding sentry fails the run on ANY implicit device<->host transfer
+    # or unplanned reshard its loop-boundary audits find — the committed
+    # headline's `implicit_transfers: 0` is proven, not sampled
+    os.environ["TPUSERVE_SHARD_SENTRY"] = "strict"
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    from clearml_serving_tpu.llm import compile_sentry, lifecycle_ledger
+    from clearml_serving_tpu.llm import (
+        compile_sentry,
+        lifecycle_ledger,
+        sharding_sentry,
+    )
 
     if compile_sentry.enabled():
         # a fresh fence for THIS run (the sentry is process-wide and the
@@ -514,6 +546,11 @@ def run(smoke: bool = True, write_artifact: bool = True) -> dict:
         # fresh books for THIS run, same reason
         lifecycle_ledger.arm().reset(
             strict=lifecycle_ledger.strict_enabled()
+        )
+    if sharding_sentry.enabled():
+        # a fresh spec table for THIS run, same reason
+        sharding_sentry.arm().reset(
+            strict=sharding_sentry.strict_enabled()
         )
     row = asyncio.run(_run_async(smoke))
     if write_artifact:

@@ -209,6 +209,41 @@ RULES: Dict[str, Tuple[str, str]] = {
         "pop once per key and drop the handle after the attach; re-read "
         "the imported pages through the radix cache, not the shipment",
     ),
+    "TPU801": (
+        "mesh-axis literal not in the parallel/mesh.py __mesh_axes__ "
+        "registry (a typo'd axis in a PartitionSpec/collective fails at "
+        "trace time on multi-chip hardware we rarely reach)",
+        "use a declared axis, or add the new axis to parallel/mesh.py "
+        "__mesh_axes__ (and its docstring) so every sharding rule and "
+        "kernel agrees on the vocabulary",
+    ),
+    "TPU802": (
+        "serve-path jit surface without sharding declarations: a class "
+        "declaring serve-role `__compile_keys__` must declare "
+        "`__shardings__` naming the sharding builder covering each "
+        "donated/sharded operand family, and every named builder must be "
+        "in parallel/sharding.py's __sharding_builders__ registry",
+        "declare `__shardings__ = {\"params\": "
+        "\"parallel.sharding.llama_param_sharding\", ...}` next to "
+        "__compile_keys__, and register new builders in "
+        "parallel/sharding.py __sharding_builders__",
+    ),
+    "TPU803": (
+        "multihost-unsafe host access: np.asarray/device_get/.tolist()/"
+        "int() on a value tainted as sharded-global (deadlocks or reads "
+        "one shard's garbage under more than one process)",
+        "read through .addressable_shards (per-host data), or annotate a "
+        "declared-replicated read with `# tpuserve: ignore[TPU803] <why "
+        "it is replicated>`",
+    ),
+    "TPU804": (
+        "silent replication fallback in a sharding builder: a path "
+        "returns a replicated spec for an operand other paths shard "
+        "(replicate-instead-of-shard defeats TP memory scaling with no "
+        "error)",
+        "annotate the fallback with `# tpuserve: ignore[TPU804] <why "
+        "this operand must replicate>`, or shard it",
+    ),
 }
 
 
@@ -376,6 +411,7 @@ def analyze_source(
         rules_jit,
         rules_lifecycle,
         rules_locks,
+        rules_sharding,
         rules_threads,
     )
 
@@ -400,6 +436,7 @@ def analyze_source(
         (rules_threads, ("TPU5",)),
         (rules_compile, ("TPU6",)),
         (rules_lifecycle, ("TPU7",)),
+        (rules_sharding, ("TPU8",)),
     )
     findings: List[Finding] = []
     for mod, prefixes in modules:

@@ -76,6 +76,56 @@ def _default_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _sarif(findings) -> dict:
+    """SARIF 2.1.0 document: one run, rule metadata from the catalog,
+    one result per finding. `--format sarif` exists for the code-scanning
+    upload lane in .github/workflows/checks.yml."""
+    rules = [
+        {
+            "id": code,
+            "shortDescription": {"text": RULES[code][0]},
+            "help": {"text": "fix: {}".format(RULES[code][1])},
+        }
+        for code in sorted(RULES)
+    ]
+    results = []
+    for f in findings:
+        message = f.message
+        if f.hint:
+            message += " fix: {}".format(f.hint)
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": os.path.relpath(f.path).replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": max(1, f.col + 1),
+                    },
+                },
+            }],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tpuserve-analyze",
+                    "informationUri":
+                        "docs/static_analysis.md",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m clearml_serving_tpu.analyze",
@@ -113,12 +163,15 @@ def main(argv=None) -> int:
         help="suppress per-finding output; only the summary table",
     )
     parser.add_argument(
-        "--format", choices=("human", "json", "github"), default="human",
+        "--format", choices=("human", "json", "github", "sarif"),
+        default="human",
         help="json = one finding object per line "
         "(rule/file/line/col/message/fix) for CI diff annotation; "
         "github = GitHub Actions workflow-command annotations "
         "(::error file=...,line=...) rendered inline on the PR diff; "
-        "exit codes are identical to human output",
+        "sarif = one SARIF 2.1.0 run (rule metadata from the catalog) "
+        "for code-scanning upload; exit codes are identical to human "
+        "output",
     )
     args = parser.parse_args(argv)
 
@@ -163,6 +216,13 @@ def main(argv=None) -> int:
         # tree prints zero lines and exits 0
         for finding in findings:
             print(json.dumps(finding.as_dict(), sort_keys=True))
+        return 1 if findings else 0
+    if args.format == "sarif":
+        # one SARIF 2.1.0 run: rule metadata comes from the catalog so
+        # code-scanning UIs show the summary + fix-it hint next to each
+        # result; the whole doc goes to stdout (CI redirects it to the
+        # upload artifact). Exit codes match every other format.
+        print(json.dumps(_sarif(findings), sort_keys=True))
         return 1 if findings else 0
     if args.format == "github":
         # GitHub Actions workflow commands: one ::error per finding (the

@@ -52,6 +52,7 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.kv.receive",
     "engine.ledger.leak",
     "engine.compile.bucket",
+    "engine.shard.drift",
     "router.pick",
     "router.eject",
     "grpc.call",

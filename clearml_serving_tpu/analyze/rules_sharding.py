@@ -1,0 +1,526 @@
+"""TPU8xx: sharding / mesh discipline (docs/static_analysis.md).
+
+The multi-process roadmap item turns every mesh-axis name, sharding
+annotation, and host/device transfer into a distributed-correctness
+contract: a typo'd axis in a ``PartitionSpec`` fails at trace time on
+hardware we rarely reach, a host read of a sharded-global array deadlocks
+(or reads one shard's garbage) the moment there is more than one process,
+and a silent replicate-instead-of-shard fallback defeats TP memory scaling
+without any error at all. These rules machine-check the protocol the
+``parallel/`` package declares next to its code:
+
+- TPU801 — mesh-axis closed world: every axis literal reaching a
+  ``PartitionSpec``/``P(...)`` constructor (including local spec-forwarding
+  helpers), a named collective (``psum``/``all_gather``/``ppermute``/...),
+  or an ``axis_name=`` parameter default must appear in the axis registry
+  ``parallel/mesh.py`` declares via its ``__mesh_axes__`` literal.
+- TPU802 — sharding declarations: a class whose ``__compile_keys__``
+  declares serve-path jit entries must also declare ``__shardings__``
+  (operand family -> sharding-builder dotted name), every named builder
+  must exist in the ``parallel/sharding.py`` ``__sharding_builders__``
+  registry, and every registered builder must be defined in that module.
+- TPU803 — multihost-unsafe host access: ``jax.device_get`` /
+  ``np.asarray`` / ``.tolist()`` / ``int()``-style host materialization of
+  a value tainted as sharded-global (produced by ``shard_params``,
+  ``device_put``-with-sharding, ``with_sharding_constraint``, or a global
+  collective like ``broadcast_one_to_all``), outside a readback that goes
+  through ``.addressable_shards`` — annotate declared-replicated reads.
+- TPU804 — silent replication fallback: inside a declared sharding
+  builder, a path that returns a replicated spec (``None`` / bare ``P()``)
+  from a function that also returns real axis names must be annotated with
+  the reason, so "misaligned projections replicate instead" stops being
+  something only a comment knows.
+
+Like every family here: stdlib ``ast`` only, no jax import, no import of
+the code under analysis. Cross-module registries are parsed from source
+(the same pattern rules_errors uses for ``faults.KNOWN_POINTS``), with
+in-module literal fallbacks kept in sync by tests/test_analyze_sharding.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from . import Finding, dotted_name
+
+# -- cross-module registries (parsed from source; literal fallbacks) ----------
+
+# mirror of parallel/mesh.py __mesh_axes__ (tests pin the agreement both ways)
+MESH_AXES: FrozenSet[str] = frozenset({"dp", "tp", "sp", "ep", "pp"})
+
+# mirror of parallel/sharding.py __sharding_builders__ (tests pin both ways)
+SHARDING_REGISTRY: Tuple[str, ...] = (
+    "llama_param_sharding",
+    "llama_cache_sharding",
+    "llama_quantized_param_sharding",
+    "shard_params",
+    "replicated",
+    "batch_sharding",
+)
+
+_axes_cache: Dict[str, FrozenSet[str]] = {}
+_builders_cache: Dict[str, Tuple[str, ...]] = {}
+
+
+def _find_up(path: str, rel: str) -> Optional[str]:
+    """Nearest ``rel`` (e.g. ``parallel/mesh.py``) above ``path``."""
+    directory = os.path.dirname(os.path.abspath(path))
+    for _ in range(8):
+        candidate = os.path.join(directory, *rel.split("/"))
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(directory)
+        if parent == directory:
+            break
+        directory = parent
+    return None
+
+
+def _parse_literal_assign(path: str, name: str):
+    """The ast-literal value of a module-level ``name = <literal>`` in
+    ``path`` (None when absent/unparseable)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == name for t in node.targets
+        ):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and value.args:
+            value = value.args[0]  # frozenset({...}) / tuple([...])
+        try:
+            return ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return None
+    return None
+
+
+def _mesh_axes(path: str) -> FrozenSet[str]:
+    """``__mesh_axes__`` parsed from the parallel/mesh.py nearest ``path``."""
+    candidate = _find_up(path, "parallel/mesh.py")
+    if candidate is None:
+        return MESH_AXES
+    if candidate not in _axes_cache:
+        value = _parse_literal_assign(candidate, "__mesh_axes__")
+        _axes_cache[candidate] = (
+            frozenset(str(v) for v in value) if value else MESH_AXES
+        )
+    return _axes_cache[candidate]
+
+
+def _sharding_builders(path: str) -> Tuple[str, ...]:
+    """``__sharding_builders__`` parsed from parallel/sharding.py."""
+    candidate = _find_up(path, "parallel/sharding.py")
+    if candidate is None:
+        return SHARDING_REGISTRY
+    if candidate not in _builders_cache:
+        value = _parse_literal_assign(candidate, "__sharding_builders__")
+        _builders_cache[candidate] = (
+            tuple(str(v) for v in value) if value else SHARDING_REGISTRY
+        )
+    return _builders_cache[candidate]
+
+
+# -- TPU801: mesh-axis closed world -------------------------------------------
+
+_SPEC_CTORS = frozenset({"PartitionSpec", "P"})
+# jax collectives whose string-literal arguments are mesh-axis names
+_COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "ppermute", "all_to_all", "axis_index", "pvary", "pbroadcast",
+})
+
+
+def _basename(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _spec_helper_names(tree: ast.AST) -> Set[str]:
+    """Local spec-forwarding helpers: functions that pass their own
+    ``*varargs`` into a ``P(...)``/``PartitionSpec(...)`` call (or into
+    another such helper) — ``parallel/sharding.py``'s ``ns``/``col``
+    pattern. Calls to these are checked like direct ``P(...)`` calls."""
+    helpers: Set[str] = set()
+    # fixpoint over at most the nesting depth of helper chains (2 passes
+    # cover ns -> col; keep a small bound for pathological trees)
+    for _ in range(4):
+        added = False
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in helpers or node.args.vararg is None:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                base = _basename(call)
+                if base not in _SPEC_CTORS and base not in helpers:
+                    continue
+                if any(
+                    isinstance(a, ast.Starred)
+                    and isinstance(a.value, ast.Name)
+                    and a.value.id == node.args.vararg.arg
+                    for a in call.args
+                ):
+                    helpers.add(node.name)
+                    added = True
+                    break
+        if not added:
+            break
+    return helpers
+
+
+def _axis_literals(expr: ast.AST):
+    """(node, axis) for every string constant in a spec/collective argument
+    expression (tuples of axes included)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node, node.value
+
+
+def _check_axes(tree: ast.AST, path: str) -> List[Finding]:
+    axes = _mesh_axes(path)
+    helpers = _spec_helper_names(tree)
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, axis: str, where: str) -> None:
+        findings.append(Finding(
+            "TPU801", path, node.lineno, node.col_offset,
+            "axis {!r} in {} is not in the mesh-axis registry "
+            "(parallel/mesh.py __mesh_axes__: {})".format(
+                axis, where, ", ".join(sorted(axes))
+            ),
+            "use a declared axis, or add the new axis to "
+            "parallel/mesh.py __mesh_axes__ (and its docstring) so every "
+            "sharding rule and kernel agrees on the vocabulary",
+        ))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            base = _basename(node)
+            if base in _SPEC_CTORS or base in helpers:
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    for lit, axis in _axis_literals(arg):
+                        if axis not in axes:
+                            flag(lit, axis, "a PartitionSpec")
+            elif base in _COLLECTIVES:
+                args = list(node.args) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("axis_name", "axis_index_groups") and
+                    kw.arg == "axis_name"
+                ]
+                for arg in args:
+                    for lit, axis in _axis_literals(arg):
+                        if axis not in axes:
+                            flag(lit, axis, "collective {}()".format(base))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # axis defaults: `def ring_attention(..., axis_name="sp")`
+            spec = node.args
+            for args, defaults in (
+                (spec.args + spec.posonlyargs, spec.defaults),
+                (spec.kwonlyargs, spec.kw_defaults),
+            ):
+                names = args[-len(defaults):] if defaults else []
+                for arg, default in zip(names, defaults):
+                    if (
+                        arg is not None and default is not None
+                        and arg.arg in ("axis_name", "axis_names")
+                    ):
+                        for lit, axis in _axis_literals(default):
+                            if axis not in axes:
+                                flag(lit, axis,
+                                     "the {} default of {}()".format(
+                                         arg.arg, node.name))
+    return findings
+
+
+# -- TPU802: sharding declarations for serve-path jit entries ----------------
+
+
+def _dict_literal(node: ast.AST) -> Optional[ast.Dict]:
+    return node if isinstance(node, ast.Dict) else None
+
+
+def _class_dunder(cls: ast.ClassDef, name: str) -> Optional[ast.Assign]:
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+        ):
+            return stmt
+    return None
+
+
+def _check_shardings(tree: ast.AST, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    builders = frozenset(_sharding_builders(path))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        compile_keys = _class_dunder(node, "__compile_keys__")
+        shardings = _class_dunder(node, "__shardings__")
+        serves = False
+        if compile_keys is not None:
+            d = _dict_literal(compile_keys.value)
+            if d is not None:
+                serves = any(
+                    isinstance(k, ast.Constant) and k.value == "serve"
+                    for k in d.keys
+                )
+        if serves and shardings is None:
+            findings.append(Finding(
+                "TPU802", path, node.lineno, node.col_offset,
+                "class {} declares serve-path jit entries "
+                "(__compile_keys__) but no __shardings__ registry naming "
+                "the sharding builder covering each donated/sharded "
+                "operand family".format(node.name),
+                "declare `__shardings__ = {\"params\": "
+                "\"parallel.sharding.llama_param_sharding\", ...}` next "
+                "to __compile_keys__ (docs/static_analysis.md TPU8xx)",
+            ))
+        if shardings is not None:
+            d = _dict_literal(shardings.value)
+            if d is None:
+                findings.append(Finding(
+                    "TPU802", path, shardings.lineno, shardings.col_offset,
+                    "__shardings__ must be a dict literal (the analyzer "
+                    "parses it from source without importing)",
+                    "use a literal {family: \"builder.dotted.name\"} dict",
+                ))
+                continue
+            for value in d.values:
+                if not (
+                    isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                ):
+                    findings.append(Finding(
+                        "TPU802", path, value.lineno, value.col_offset,
+                        "__shardings__ values must be string dotted names "
+                        "of sharding builders",
+                        "name the builder as a string, e.g. "
+                        "\"parallel.sharding.llama_param_sharding\"",
+                    ))
+                    continue
+                builder = value.value.rsplit(".", 1)[-1]
+                if builder not in builders:
+                    findings.append(Finding(
+                        "TPU802", path, value.lineno, value.col_offset,
+                        "__shardings__ names builder {!r} which is not in "
+                        "the parallel/sharding.py __sharding_builders__ "
+                        "registry ({})".format(
+                            builder, ", ".join(sorted(builders))
+                        ),
+                        "add the builder to parallel/sharding.py "
+                        "__sharding_builders__ (and define it there), or "
+                        "fix the name",
+                    ))
+
+    # the registry module itself: every declared builder must be defined
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__sharding_builders__"
+                for t in node.targets
+            )
+        ):
+            continue
+        try:
+            declared = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            findings.append(Finding(
+                "TPU802", path, node.lineno, node.col_offset,
+                "__sharding_builders__ must be a literal tuple of builder "
+                "names (the analyzer parses it from source)",
+                "keep the registry a literal",
+            ))
+            continue
+        defined = {
+            n.name for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for name in declared:
+            if str(name) not in defined:
+                findings.append(Finding(
+                    "TPU802", path, node.lineno, node.col_offset,
+                    "__sharding_builders__ declares {!r} but no such "
+                    "function is defined in this module".format(name),
+                    "define the builder here or drop the stale registry "
+                    "entry",
+                ))
+    return findings
+
+
+# -- TPU803: multihost-unsafe host access ------------------------------------
+
+# calls whose result is a sharded-GLOBAL value: host-materializing it
+# without going through addressable_shards (or a declared replicated spec)
+# deadlocks or reads one shard's garbage under more than one process
+_TAINT_SOURCES = frozenset({
+    "shard_params", "with_sharding_constraint", "broadcast_one_to_all",
+    "device_put",
+})
+# host-materialization sinks
+_SINK_CALLS = frozenset({"asarray", "device_get", "array"})
+_SINK_METHODS = frozenset({"tolist", "item", "__array__"})
+_SINK_CASTS = frozenset({"int", "float", "bool"})
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """The root Name id under subscripts/attribute chains."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _check_host_access(tree: ast.AST, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted: Set[str] = set()
+        shard_read: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                base = _basename(node.value)
+                if base in _TAINT_SOURCES:
+                    if base == "device_put" and len(node.value.args) < 2:
+                        continue  # device_put without a sharding is local
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            tainted.add(target.id)
+            elif isinstance(node, ast.Attribute) and (
+                node.attr == "addressable_shards"
+            ):
+                name = _base_name(node.value)
+                if name:
+                    shard_read.add(name)
+        if not tainted:
+            continue
+        safe = tainted - shard_read
+
+        def flag(node: ast.AST, name: str, sink: str) -> None:
+            findings.append(Finding(
+                "TPU803", path, node.lineno, node.col_offset,
+                "{} host-materializes {!r}, a sharded-global value: under "
+                "more than one process this deadlocks (cross-host gather) "
+                "or reads one shard's local garbage".format(sink, name),
+                "read through .addressable_shards (per-host data), or "
+                "annotate a declared-replicated read with "
+                "`# tpuserve: ignore[TPU803] <why it is replicated>`",
+            ))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            base = _basename(node)
+            if base in _SINK_CALLS and node.args:
+                name = _base_name(node.args[0])
+                if name in safe:
+                    flag(node, name, "{}()".format(base))
+            elif base in _SINK_CASTS and len(node.args) == 1:
+                name = _base_name(node.args[0])
+                if name in safe:
+                    flag(node, name, "{}()".format(base))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SINK_METHODS
+            ):
+                name = _base_name(node.func.value)
+                if name in safe:
+                    flag(node, name, ".{}()".format(node.func.attr))
+    return findings
+
+
+# -- TPU804: silent replication fallback --------------------------------------
+
+
+def _return_kinds(fn: ast.AST, axes: FrozenSet[str]):
+    """(axis_returns, fallback_returns) for one function body, not
+    descending into nested functions (each is classified on its own)."""
+    axis_rets: List[ast.Return] = []
+    fallback_rets: List[ast.Return] = []
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Return):
+            value = node.value
+            if value is None or (
+                isinstance(value, ast.Constant) and value.value is None
+            ):
+                fallback_rets.append(node)
+            elif (
+                isinstance(value, ast.Call)
+                and _basename(value) in (_SPEC_CTORS | {"replicated"})
+                and not value.args and not value.keywords
+            ):
+                fallback_rets.append(node)
+            elif any(
+                axis in axes for _n, axis in _axis_literals(value)
+            ):
+                axis_rets.append(node)
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+    return axis_rets, fallback_rets
+
+
+def _check_replication_fallback(tree: ast.AST, path: str) -> List[Finding]:
+    # only modules that declare themselves sharding-builder registries
+    if not (
+        isinstance(tree, ast.Module)
+        and any(
+            isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name)
+                and t.id == "__sharding_builders__"
+                for t in n.targets
+            )
+            for n in tree.body
+        )
+    ):
+        return []
+    axes = _mesh_axes(path)
+    findings: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        axis_rets, fallback_rets = _return_kinds(fn, axes)
+        if not (axis_rets and fallback_rets):
+            continue
+        for ret in fallback_rets:
+            findings.append(Finding(
+                "TPU804", path, ret.lineno, ret.col_offset,
+                "sharding builder path in {}() silently falls back to a "
+                "replicated spec for an operand other paths shard — "
+                "replicate-instead-of-shard defeats TP memory scaling "
+                "with no error".format(fn.name),
+                "annotate the fallback with `# tpuserve: ignore[TPU804] "
+                "<why this operand must replicate>` so the reason is "
+                "machine-visible, or shard it",
+            ))
+    return findings
+
+
+# -- entry point ---------------------------------------------------------------
+
+
+def check(tree: ast.AST, path: str, source: str) -> List[Finding]:
+    findings = _check_axes(tree, path)
+    findings += _check_shardings(tree, path)
+    findings += _check_host_access(tree, path)
+    findings += _check_replication_fallback(tree, path)
+    return findings

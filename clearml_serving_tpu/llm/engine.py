@@ -49,7 +49,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import compile_sentry, faults, kv_sanitizer, lifecycle_ledger
+from . import (
+    compile_sentry,
+    faults,
+    kv_sanitizer,
+    lifecycle_ledger,
+    sharding_sentry,
+)
 from .shapes import decode_steps_bucket
 from ..errors import (
     DeadlineExceededError,
@@ -68,6 +74,10 @@ from .sampling import (
 )
 
 _DEFAULT_PREFILL_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048]
+
+# per-engine tag for the process-wide sharding sentry's spec table:
+# co-hosted replica engines must not alias each other's array paths
+_ENGINE_IDS = itertools.count()
 
 
 @dataclass
@@ -607,6 +617,20 @@ class LLMEngineCore:
         # prompt scoring runs only for completions echo+logprobs requests:
         # one compile per prefill bucket on first use, sentry-attributed
         "lazy": ("_score_prompt_jit",),
+    }
+
+    # sharding registry (tpuserve-analyze TPU802, docs/static_analysis.md):
+    # the sharding builder covering each donated/sharded operand family the
+    # serve-path jit entries above consume. Every builder named here must be
+    # in parallel/sharding.py's __sharding_builders__ closed world; the
+    # runtime sharding sentry (llm/sharding_sentry.py) audits the live
+    # arrays against what these builders declared at init.
+    __shardings__ = {
+        "params": "parallel.sharding.llama_param_sharding",
+        "params_quantized": "parallel.sharding.llama_quantized_param_sharding",
+        "kv_cache": "parallel.sharding.llama_cache_sharding",
+        "tokens": "parallel.sharding.batch_sharding",
+        "host_state": "parallel.sharding.replicated",
     }
 
     # ownership-discipline registry (tpuserve-analyze TPU7xx,
@@ -2416,6 +2440,74 @@ class LLMEngineCore:
             lifecycle_ledger.arm() if lifecycle_ledger.enabled() else None
         )
 
+        # runtime sharding sentry (llm/sharding_sentry.py): armed via
+        # TPUSERVE_SHARD_SENTRY=1|strict. At every loop boundary it audits
+        # the live KV pools and chained device state (plus the params tree
+        # at init/drain) against the specs the __shardings__ builders gave
+        # them at init, counting implicit device<->host transfers and
+        # unplanned reshards per launch; strict mode raises
+        # ShardSentryError through the structured step-failure path — the
+        # dynamic half of the TPU8xx sharding discipline
+        # (docs/static_analysis.md).
+        self._shard_sentry = (
+            sharding_sentry.arm() if sharding_sentry.enabled() else None
+        )
+        # co-hosted replica engines share the process-wide sentry: a
+        # per-engine path prefix keeps their spec tables disjoint
+        self._shard_prefix = "engine[{}]".format(next(_ENGINE_IDS))
+        if self._shard_sentry is not None:
+            self._shard_sentry.audit(
+                self._shard_audit_entries(params=True), where="init"
+            )
+
+    def _shard_audit_entries(self, params: bool = False) -> list:
+        """(path, value, declared) entries for the sharding sentry's
+        boundary audit: chained device state and the KV pools every
+        boundary; the params tree only at init and drain boundaries (it
+        never rebinds mid-serve, and walking it per step is wasted work).
+        """
+        p = self._shard_prefix
+        entries = [
+            (p + "._next_token_dev", self._next_token_dev, None),
+            (p + "._gstate_dev", self._gstate_dev, None),
+        ]
+        if self.paged_cache is not None:
+            entries += [
+                (p + ".paged_cache.k", self.paged_cache.k, None),
+                (p + ".paged_cache.v", self.paged_cache.v, None),
+                (p + ".paged_cache.k_scale", self.paged_cache.k_scale, None),
+                (p + ".paged_cache.v_scale", self.paged_cache.v_scale, None),
+            ]
+        elif self.cache is not None:
+            entries += [
+                (p + ".cache.{}".format(k), v, None)
+                for k, v in self.cache.items()
+            ]
+        if params:
+            import jax as _jax
+
+            for path, leaf in _jax.tree_util.tree_leaves_with_path(
+                self.params
+            ):
+                entries.append(
+                    (p + ".params" + _jax.tree_util.keystr(path), leaf, None)
+                )
+        if faults.active():
+            # seeded-defect seam (llm/faults.py engine.shard.drift): swap a
+            # host-materialized copy in for the chained decode row, exactly
+            # the silent device->host round-trip the sentry exists to catch
+            # — the self-test proves strict mode raises on it
+            try:
+                faults.fire("engine.shard.drift")
+            except faults.InjectedFault:
+                drifted = (
+                    np.asarray(self._next_token_dev)
+                    if self._next_token_dev is not None
+                    else np.zeros(self.max_batch, np.int32)
+                )
+                entries.append((p + "._next_token_dev", drifted, None))
+        return entries
+
     def _ledger_domains(self) -> list:
         """The primitives whose drain-zero entries THIS engine audits
         (co-hosted replica engines share one process-wide ledger)."""
@@ -2455,15 +2547,30 @@ class LLMEngineCore:
                 drained=drained and not self._inflight,
                 domains=self._ledger_domains(),
             )
+        if self._shard_sentry is not None:
+            self._shard_sentry.audit(
+                self._shard_audit_entries(params=drained), where=where
+            )
+            # strict-mode sharding violations surface here too, on the
+            # loop thread, naming array path + declared vs actual spec
+            self._shard_sentry.check(where=where)
 
+    @contextlib.contextmanager
     def _sentry_scope(self, phase: str, **ctx):
-        """Thread-local compile attribution for a dispatch/prefill worker
-        (no-op unless the sentry is armed)."""
-        if self._compile_sentry is None:
-            return contextlib.nullcontext()
-        return self._compile_sentry.context(
-            phase=phase, depth=self.pipeline_depth, **ctx
-        )
+        """Thread-local launch attribution for a dispatch/prefill worker
+        (no-op unless a sentry is armed): the compile sentry tags the
+        compiles and the sharding sentry tags the transfer/reshard
+        violations this thread's launches surface."""
+        with contextlib.ExitStack() as stack:
+            if self._compile_sentry is not None:
+                stack.enter_context(self._compile_sentry.context(
+                    phase=phase, depth=self.pipeline_depth, **ctx
+                ))
+            if self._shard_sentry is not None:
+                stack.enter_context(self._shard_sentry.context(
+                    phase=phase, depth=self.pipeline_depth, **ctx
+                ))
+            yield
 
     async def warmup(self, full: bool = True) -> dict:
         """Compile the serve loop's XLA key space ahead of traffic: drive
@@ -3604,6 +3711,7 @@ class LLMEngineCore:
             },
             "compile": self._compile_snapshot(),
             "ledger": self._ledger_snapshot(),
+            "sharding": self._shard_snapshot(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id
@@ -3628,6 +3736,16 @@ class LLMEngineCore:
         if self._compile_sentry is None:
             return None
         return self._compile_sentry.stats_brief()
+
+    def _shard_snapshot(self):
+        """Sharding-sentry block shared by health() and lifecycle_stats()
+        (docs/static_analysis.md TPU8xx). None when the sentry is unarmed.
+        The sentry is process-wide (co-hosted engines audit into one spec
+        table under per-engine path prefixes), so counters are fleet
+        totals — per-violation attribution lives in the event records."""
+        if self._shard_sentry is None:
+            return None
+        return self._shard_sentry.stats_brief()
 
     def lifecycle_stats(self) -> dict:
         """Scrape-time snapshot for statistics.metrics' lifecycle collector
@@ -3691,6 +3809,7 @@ class LLMEngineCore:
             },
             "compile": self._compile_snapshot(),
             "ledger": self._ledger_snapshot(),
+            "sharding": self._shard_snapshot(),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id

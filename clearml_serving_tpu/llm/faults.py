@@ -71,6 +71,17 @@ Known points (ctx carried with each):
                          (llm/compile_sentry.py) must count post-fence and,
                          in strict mode, raise on. Proven caught by the
                          sentry self-test in tests/test_compile_sentry.py.
+- ``engine.shard.drift`` — inside the engine's sharding-sentry audit-entry
+                         builder (``_shard_audit_entries``); a raise swaps a
+                         HOST-MATERIALIZED numpy copy in for the chained
+                         decode row — the seeded implicit-transfer defect of
+                         the sharding discipline (docs/static_analysis.md
+                         TPU8xx): the armed sharding sentry
+                         (llm/sharding_sentry.py) must count it as an
+                         implicit device->host transfer and, in strict mode,
+                         raise naming the array path and declared-vs-actual
+                         spec. Proven caught by the sentry self-test in
+                         tests/test_sharding_sentry.py.
 - ``engine.kv.promote`` — as a lookup on a demoted run is about to allocate
                          device pages and enqueue the host→device re-online
                          DMA (``pages``); a raise aborts the promotion — the
@@ -186,6 +197,7 @@ KNOWN_POINTS = frozenset({
     "engine.kv.receive",
     "engine.ledger.leak",
     "engine.compile.bucket",
+    "engine.shard.drift",
     "router.pick",
     "router.eject",
     "grpc.call",

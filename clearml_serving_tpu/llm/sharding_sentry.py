@@ -1,0 +1,363 @@
+"""Runtime sharding sentry: audit live arrays against their declared specs
+(docs/static_analysis.md TPU8xx — the dynamic net behind the static rules).
+
+The sharding invariant says every long-lived device array the serve loop
+touches — the params tree, the KV/scale pools, the chained decode state —
+keeps the sharding its registered builder (``parallel/sharding.py``,
+declared through the engine's ``__shardings__`` annotation) gave it at
+init, and never silently round-trips through the host. The static rules
+prove the declarations exist and the axis vocabulary is closed; this
+sentry proves the INVARIANT ITSELF at runtime: armed with
+``TPUSERVE_SHARD_SENTRY=1`` (count) or ``=strict`` (raise), the engine
+audits its live arrays at every loop boundary (the same
+check-at-the-boundary shape as the KV sanitizer / compile sentry /
+ownership ledger), counts and attributes two violation classes per launch
+using thread-local launch contexts (the compile sentry's context
+plumbing):
+
+- **implicit transfer** — an audited entry is host-materialized (a
+  ``np.ndarray`` where the baseline was a device array, or vice versa):
+  the silent device<->host round-trip that becomes a cross-host gather
+  (or one shard's garbage) the moment there is more than one process;
+- **unplanned reshard** — an entry's live sharding spec no longer equals
+  what was declared (or first captured) for its path: a jit output or a
+  stray ``device_put`` quietly moved data off the builder's layout.
+
+In strict mode the engine raises :class:`ShardSentryError` at the next
+loop boundary naming the array path and declared-vs-actual spec, through
+the same structured step-failure path as the sanitizer.
+
+Spec canonicalization is deliberately device-blind: a ``NamedSharding``
+canonicalizes to its PartitionSpec tuple, anything else to its sharding
+class name — so single-device placement churn across the 8 virtual CPU
+devices never flags, while spec drift and host materialization always do.
+``jax.transfer_guard`` is probe-detected only (it is inert on the CPU
+backend) and reported via ``stats()["mode"]``; the sentry never installs
+a global guard — the engine's registered readback sites do legitimate
+host reads every step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+ENV = "TPUSERVE_SHARD_SENTRY"
+
+# keep full per-violation attribution for the most recent N events; the
+# counters are unbounded
+_MAX_EVENTS = 256
+
+_HOST = "host(ndarray)"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV, "") not in ("", "0")
+
+
+def strict_enabled() -> bool:
+    return os.environ.get(ENV, "") == "strict"
+
+
+class ShardSentryError(AssertionError):
+    """A sharding-discipline violation under strict mode: names the array
+    path, the declared (or init-captured) spec, and what the audit found."""
+
+    def __init__(self, message: str, path: str = "", declared: str = "",
+                 actual: str = "", kind: str = ""):
+        super().__init__(message)
+        self.path = path
+        self.declared = declared
+        self.actual = actual
+        self.kind = kind
+
+
+def _probe_mode() -> str:
+    """Which enforcement net is available. ``jax.transfer_guard`` is inert
+    on the CPU backend (no raise on host reads), so the sentry's primary
+    net is spec-conformance + host-materialization auditing; the probe
+    only reports whether a real guard WOULD be available on this backend.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jnp.zeros((2,), jnp.float32)
+        with jax.transfer_guard_device_to_host("disallow"):
+            np.asarray(x)
+        return "audit"           # guard inert: conformance auditing only
+    except Exception:
+        return "transfer-guard"  # guard functional on this backend
+
+
+class ShardingSentry:
+    """Process-wide sharding auditor (one per process: the declared-spec
+    table is global state shared by every engine in tests). Thread-safe;
+    attribution context is thread-local so dispatch workers tag the
+    violations their own launches surface."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = bool(strict)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._mode = _probe_mode()
+        # path -> canonical spec: explicit declares and first-audit
+        # baselines land here; every later audit compares against it
+        self.declared: Dict[str, str] = {}
+        self.audits = 0
+        self.arrays_checked = 0
+        self.implicit_transfers = 0
+        self.unplanned_reshards = 0
+        self.events: List[Dict[str, Any]] = []
+        self.violations: List[Dict[str, Any]] = []
+
+    # -- spec canonicalization --------------------------------------------
+
+    @staticmethod
+    def _canon_spec(spec: Any, mesh: Any) -> str:
+        """Equivalence-aware canonical form of a PartitionSpec: GSPMD
+        normalizes specs as they flow through jit outputs — entries on
+        size-1 mesh axes drop (sharding 1-way IS replication) and trailing
+        ``None`` entries are omitted — so syntactic equality over the raw
+        tuple would flag every donated rebind on a partly-degenerate mesh
+        as a reshard. Size-1 axes collapse to None and trailing Nones
+        strip before rendering."""
+        sizes = dict(getattr(mesh, "shape", None) or {})
+        norm = []
+        for entry in tuple(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(
+                a for a in axes
+                if a is not None and int(sizes.get(a, 2)) > 1
+            )
+            if not kept:
+                norm.append(None)
+            elif len(kept) == 1:
+                norm.append(kept[0])
+            else:
+                norm.append(kept)
+        while norm and norm[-1] is None:
+            norm.pop()
+        return "P({})".format(", ".join(repr(e) for e in norm))
+
+    @classmethod
+    def _canon(cls, value: Any) -> Optional[str]:
+        """Device-blind canonical spec for a live value: host ndarrays are
+        ``host(ndarray)``, NamedShardings their normalized PartitionSpec,
+        other shardings their class name, everything else unauditable
+        (None)."""
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return _HOST
+        sharding = getattr(value, "sharding", None)
+        if sharding is None:
+            return None
+        spec = getattr(sharding, "spec", None)
+        if spec is not None:
+            return cls._canon_spec(spec, getattr(sharding, "mesh", None))
+        return type(sharding).__name__
+
+    @classmethod
+    def _canon_declared(cls, declared: Any) -> Optional[str]:
+        """Canonical form of a DECLARED sharding (a NamedSharding /
+        PartitionSpec a builder produced, not a live array)."""
+        if declared is None:
+            return None
+        if isinstance(declared, str):
+            return declared
+        spec = getattr(declared, "spec", None)
+        if spec is not None:
+            return cls._canon_spec(spec, getattr(declared, "mesh", None))
+        if isinstance(declared, tuple):
+            return cls._canon_spec(declared, None)
+        return type(declared).__name__
+
+    # -- attribution context ----------------------------------------------
+
+    @contextlib.contextmanager
+    def context(self, **ctx):
+        """Tag violations surfaced by audits on THIS thread (the engine
+        wraps its dispatch workers: phase, dispatch seq, pipeline depth —
+        the compile sentry's context plumbing, reused)."""
+        prev = getattr(self._tls, "ctx", None)
+        self._tls.ctx = dict(prev or {}, **ctx)
+        try:
+            yield
+        finally:
+            self._tls.ctx = prev
+
+    # -- declare / audit / check ------------------------------------------
+
+    def declare(self, path: str, sharding: Any) -> None:
+        """Pin ``path``'s expected spec explicitly (the engine declares its
+        builder outputs at init; undeclared paths baseline on first audit).
+        """
+        want = self._canon_declared(sharding)
+        if want is None:
+            return
+        with self._lock:
+            self.declared[path] = want
+
+    def audit(
+        self,
+        entries: Iterable[Tuple[str, Any, Any]],
+        where: str = "",
+    ) -> int:
+        """Check ``(path, value, declared)`` entries against the spec
+        table. ``declared=None`` means "use the table, baselining on first
+        sight"; ``value=None`` entries are skipped (unallocated state).
+        Returns the number of NEW violations this audit found."""
+        ctx = dict(getattr(self._tls, "ctx", None) or {})
+        found = 0
+        with self._lock:
+            self.audits += 1
+            for path, value, declared in entries:
+                if value is None:
+                    continue
+                actual = self._canon(value)
+                if actual is None:
+                    continue
+                self.arrays_checked += 1
+                want = (
+                    self._canon_declared(declared)
+                    if declared is not None
+                    else self.declared.get(path)
+                )
+                if want is None:
+                    self.declared[path] = actual
+                    continue
+                if declared is not None:
+                    self.declared.setdefault(path, want)
+                if actual == want:
+                    continue
+                kind = (
+                    "implicit_transfer"
+                    if (actual == _HOST) != (want == _HOST)
+                    else "unplanned_reshard"
+                )
+                if kind == "implicit_transfer":
+                    self.implicit_transfers += 1
+                else:
+                    self.unplanned_reshards += 1
+                event = {
+                    "kind": kind,
+                    "path": path,
+                    "declared": want,
+                    "actual": actual,
+                    "where": where,
+                    "context": ctx,
+                }
+                self.events.append(event)
+                del self.events[:-_MAX_EVENTS]
+                if self.strict:
+                    self.violations.append(event)
+                found += 1
+        return found
+
+    def check(self, where: str = "") -> None:
+        """Raise the first pending strict violation (engine loop
+        boundaries call this the way they call the KV sanitizer)."""
+        with self._lock:
+            if not (self.strict and self.violations):
+                return
+            v = self.violations[0]
+        raise ShardSentryError(
+            "sharding sentry: {} on {} — declared {} but the audit found "
+            "{}{}{}; a silently host-materialized or resharded array is a "
+            "multihost deadlock (docs/static_analysis.md TPU8xx)".format(
+                v["kind"], v["path"], v["declared"], v["actual"],
+                " at {}".format(where or v["where"])
+                if (where or v["where"]) else "",
+                " (context: {})".format(v["context"]) if v["context"] else "",
+            ),
+            path=v["path"], declared=v["declared"], actual=v["actual"],
+            kind=v["kind"],
+        )
+
+    # -- stats / reset -----------------------------------------------------
+
+    def reset(self, strict: Optional[bool] = None) -> None:
+        """Drop the spec table and all accumulated state (tests; a new
+        engine's init re-declares its builder outputs)."""
+        with self._lock:
+            self.declared = {}
+            self.audits = 0
+            self.arrays_checked = 0
+            self.implicit_transfers = 0
+            self.unplanned_reshards = 0
+            self.events = []
+            self.violations = []
+            if strict is not None:
+                self.strict = bool(strict)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "strict": self.strict,
+                "audits": self.audits,
+                "arrays_checked": self.arrays_checked,
+                "implicit_transfers": self.implicit_transfers,
+                "unplanned_reshards": self.unplanned_reshards,
+                "declared_paths": len(self.declared),
+                "violations": len(self.violations),
+                "events": [dict(e) for e in self.events],
+            }
+
+    def stats_brief(self) -> Dict[str, Any]:
+        """The lifecycle_stats()/health() "sharding" block (and what the
+        metrics collector reads): counters only, no event list."""
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "strict": self.strict,
+                "audits": self.audits,
+                "arrays_checked": self.arrays_checked,
+                "implicit_transfers": self.implicit_transfers,
+                "unplanned_reshards": self.unplanned_reshards,
+                "declared_paths": len(self.declared),
+                "violations": len(self.violations),
+            }
+
+
+# -- module singleton ---------------------------------------------------------
+
+_sentry: Optional[ShardingSentry] = None
+_guard = threading.Lock()
+# fast gate: hot paths ask armed() before building audit entry lists
+_armed = False
+
+
+def get() -> ShardingSentry:
+    """The process-wide sentry (strictness from the env at creation; tests
+    flip ``.strict`` / call ``.reset()``)."""
+    global _sentry
+    with _guard:
+        if _sentry is None:
+            _sentry = ShardingSentry(strict=strict_enabled())
+        return _sentry
+
+
+def arm(strict: Optional[bool] = None) -> ShardingSentry:
+    """Idempotent arm (engine init, chaos fixtures, the loadtest)."""
+    global _armed
+    sentry = get()
+    if strict is not None:
+        sentry.strict = bool(strict)
+    with _guard:
+        _armed = True
+    return sentry
+
+
+def armed() -> bool:
+    return _armed
+
+
+def disarm() -> None:
+    global _armed
+    with _guard:
+        _armed = False

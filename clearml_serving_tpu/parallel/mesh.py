@@ -21,7 +21,13 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-AXES = ("dp", "tp", "sp", "ep", "pp")
+# mesh-axis closed world (tpuserve-analyze TPU801): THE axis registry. Every
+# axis literal in a PartitionSpec/collective anywhere in the tree must come
+# from this literal — the analyzer parses it from source (no jax import), so
+# keep it a literal tuple and document new axes in the docstring above.
+__mesh_axes__ = ("dp", "tp", "sp", "ep", "pp")
+
+AXES = __mesh_axes__
 
 
 def make_mesh(

@@ -31,6 +31,31 @@ OP_NOOP = 0
 OP_RUN = 1
 OP_STOP = 2
 
+# op-code closed world: the declared registry of every step op a follower
+# can replay. recv() validates against it, so an op this module cannot name
+# (version skew between host 0 and a follower, or header corruption) raises
+# UnknownBroadcastOp instead of silently desyncing the follower loop — a
+# follower that skips a step host 0 executed deadlocks the slice on the
+# next cross-host collective with no diagnostic.
+_OP_NAMES = {0: "noop", 1: "run", 2: "stop"}
+
+
+class UnknownBroadcastOp(RuntimeError):
+    """Host 0 broadcast an op code outside the declared closed world."""
+
+
+def _check_op(op: int) -> int:
+    if op not in _OP_NAMES:
+        raise UnknownBroadcastOp(
+            "broadcast op {} is not in the declared op registry {} — "
+            "host 0 and this follower disagree on the step protocol "
+            "(version skew?); refusing to guess (a silently skipped step "
+            "deadlocks the slice on the next collective)".format(
+                op, _OP_NAMES
+            )
+        )
+    return op
+
 
 class BroadcastChannel:
     """Host-0 -> all-hosts step channel over the global device set."""
@@ -78,13 +103,17 @@ class BroadcastChannel:
         header = multihost_utils.broadcast_one_to_all(
             np.zeros(2, np.int64), is_source=self._is_source
         )
-        op, nbytes = int(header[0]), int(header[1])
+        # broadcast_one_to_all returns a fully-replicated global value —
+        # every host holds the identical header/payload, so the host reads
+        # below are multihost-safe by construction
+        op, nbytes = int(header[0]), int(header[1])  # tpuserve: ignore[TPU803] header is replicated (broadcast result)
+        op = _check_op(op)
         payload = b""
         if nbytes:
             buf = multihost_utils.broadcast_one_to_all(
                 np.zeros(self._bucket(nbytes), np.uint8), is_source=self._is_source
             )
-            payload = np.asarray(buf, np.uint8)[:nbytes].tobytes()
+            payload = np.asarray(buf, np.uint8)[:nbytes].tobytes()  # tpuserve: ignore[TPU803] buf is replicated (broadcast result)
         return op, payload
 
 
@@ -151,10 +180,10 @@ def follower_loop(
     """
     chan = channel or BroadcastChannel()
     while True:
-        op, payload = chan.recv()
+        op, payload = chan.recv()  # raises UnknownBroadcastOp on skew
         if op == OP_STOP:
             return
-        if op != OP_RUN:
+        if op == OP_NOOP:
             continue
         key, inputs = pickle.loads(payload)
         fn = resolve(key)

@@ -19,6 +19,21 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
+# sharding-builder registry (tpuserve-analyze TPU802): the closed world of
+# functions allowed to produce shardings for engine operand families. Every
+# name a `__shardings__` class annotation cites must appear here, and every
+# name here must be defined in this module — the analyzer parses the literal
+# from source and tests/test_analyze_sharding.py round-trips it both ways.
+__sharding_builders__ = (
+    "llama_param_sharding",
+    "llama_cache_sharding",
+    "llama_quantized_param_sharding",
+    "shard_params",
+    "replicated",
+    "batch_sharding",
+)
+
+
 def llama_param_sharding(
     mesh, params: Dict[str, Any], n_kv_heads: int = None, n_heads: int = None
 ) -> Dict[str, Any]:
@@ -42,7 +57,7 @@ def llama_param_sharding(
         # None (caller didn't say) keeps the historical always-shard rule
         if heads is None or tp <= 1 or int(heads) % tp == 0:
             return "tp"
-        return None
+        return None  # tpuserve: ignore[TPU804] a tp boundary inside a head would split the RoPE rotate-half across chips (and hit the XLA:CPU concat-over-sharded-axis miscompile); misaligned projections replicate by design
 
     q_tp = head_tp(n_heads)
     kv_tp = head_tp(n_kv_heads)

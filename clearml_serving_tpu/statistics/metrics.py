@@ -516,6 +516,24 @@ class EngineLifecycleCollector(_KeyedCollector):
             "and drain audits (each names the leaked resource and its "
             "acquire site in lifecycle_stats()[\"ledger\"])",
         )
+        # sharding discipline (docs/static_analysis.md TPU8xx): the
+        # runtime sharding sentry's boundary audits and the two violation
+        # classes — either counter moving on an armed engine is a silent
+        # device<->host round-trip or layout drift that becomes a
+        # cross-host gather (or one shard's garbage) under multi-process
+        shard_audits = CounterMetricFamily(
+            p + "_shard_audits_total",
+            "loop-boundary sharding audits run by the sharding sentry "
+            "(TPUSERVE_SHARD_SENTRY)",
+        )
+        shard_violations = CounterMetricFamily(
+            p + "_shard_violations_total",
+            "sharding-discipline violations found by the sentry, by kind "
+            "(implicit_transfer = silent host materialization, "
+            "unplanned_reshard = live spec drifted off the declared "
+            "builder layout); each names the array path in "
+            "lifecycle_stats()[\"sharding\"]",
+        )
 
         def _hist_buckets(snap):
             """Engine _MsHistogram snapshot -> prometheus cumulative
@@ -536,6 +554,7 @@ class EngineLifecycleCollector(_KeyedCollector):
         any_ragged = False
         any_compile = False
         any_ledger = False
+        any_shard = False
         for key, s in rows:
             kv_pool = s.get("kv_pool") or {}
             if kv_pool:
@@ -580,6 +599,17 @@ class EngineLifecycleCollector(_KeyedCollector):
                     gauge(ledger_outstanding, key, s, v, resource=resource)
                 if "leaks" in ledger_block:
                     counter(ledger_leaks, key, s, ledger_block["leaks"])
+            shard_block = s.get("sharding") or {}
+            if shard_block:
+                any_shard = True
+                if "audits" in shard_block:
+                    counter(shard_audits, key, s, shard_block["audits"])
+                for kind in ("implicit_transfers", "unplanned_reshards"):
+                    if kind in shard_block:
+                        counter(
+                            shard_violations, key, s, shard_block[kind],
+                            kind=kind.rstrip("s"),
+                        )
             compile_block = s.get("compile") or {}
             if compile_block:
                 any_compile = True
@@ -699,6 +729,9 @@ class EngineLifecycleCollector(_KeyedCollector):
         if any_ledger:
             yield ledger_outstanding
             yield ledger_leaks
+        if any_shard:
+            yield shard_audits
+            yield shard_violations
         if any_grpc:
             yield grpc
 

@@ -51,10 +51,13 @@ if [ "$advisory_rc" -ne 0 ]; then
   fi
 fi
 
-# one pass runs every rule family, TPU1xx..TPU7xx — including the
-# compile-surface rules (TPU601-604) and the ownership-discipline rules
-# (TPU701-704: acquire/release pairing over exception paths;
-# docs/static_analysis.md). --timings keeps the per-family analyzer cost
+# one pass runs every rule family, TPU1xx..TPU8xx — including the
+# compile-surface rules (TPU601-604), the ownership-discipline rules
+# (TPU701-704: acquire/release pairing over exception paths) and the
+# sharding/mesh-discipline rules (TPU801-804: mesh-axis closed world,
+# __shardings__ declarations, multihost-unsafe host access, silent
+# replication fallbacks; docs/static_analysis.md). --timings keeps the
+# per-family analyzer cost
 # visible as the catalog grows (the gate must stay a pre-commit-scale
 # tool, not a CI-only one). CI (.github/workflows/checks.yml) invokes
 # this same script; use `--format github` there for inline diff

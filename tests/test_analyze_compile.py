@@ -207,6 +207,7 @@ def test_tpu603_undeclared_jit_entry():
         import jax
         class E:
             __compile_keys__ = {"serve": ()}
+            __shardings__ = {"params": "llama_param_sharding"}
             def __init__(self):
                 self._rogue_jit = jax.jit(lambda x: x)
     """
@@ -218,6 +219,7 @@ def test_tpu603_serve_entry_missing_from_warmup_registry():
         import jax
         class E:
             __compile_keys__ = {"serve": ("_never_warmed_jit",)}
+            __shardings__ = {"params": "llama_param_sharding"}
             def __init__(self):
                 self._never_warmed_jit = jax.jit(lambda x: x)
     """
@@ -232,6 +234,7 @@ def test_tpu603_covered_serve_entry_is_fine():
         import jax
         class E:
             __compile_keys__ = {"serve": ("_decode_chunk_jit",)}
+            __shardings__ = {"params": "llama_param_sharding"}
             def __init__(self):
                 self._decode_chunk_jit = jax.jit(lambda x: x)
     """
@@ -244,6 +247,7 @@ def test_tpu603_jit_suffix_convention_counts_without_jit_call():
     src = """
         class E:
             __compile_keys__ = {"serve": ()}
+            __shardings__ = {"params": "llama_param_sharding"}
             def __init__(self):
                 self._sneaky_jit = sample_tokens
     """
@@ -257,6 +261,7 @@ def test_tpu603_reads_registry_from_real_warmup_py():
         import jax
         class E:
             __compile_keys__ = {"serve": ("_gather_finish_jit",)}
+            __shardings__ = {"params": "llama_param_sharding"}
             def __init__(self):
                 self._gather_finish_jit = jax.jit(lambda x: x)
     """

@@ -423,7 +423,7 @@ def test_file_declarations_parse_from_source():
 def test_every_tpu7_code_is_in_the_catalog():
     for code in ("TPU701", "TPU702", "TPU703", "TPU704"):
         assert code in RULES
-    assert len(RULES) == 24, sorted(RULES)
+    assert len(RULES) == 28, sorted(RULES)
 
 
 # -- tree gate (family-selected) ----------------------------------------------

@@ -1,0 +1,454 @@
+"""tpuserve-analyze TPU8xx (analyze/rules_sharding.py): per-rule fixtures
+(positive / negative / ignore), the registry round-trip gates pinning the
+``__mesh_axes__`` / ``__sharding_builders__`` / ``__shardings__``
+declarations to the code both ways, source-mutation gates proving the
+committed annotations are load-bearing, and the CLI's ``--format sarif``
+mode (the code-scanning upload artifact).
+
+The tree-wide zero-findings acceptance gate lives in test_analyze.py (it
+runs every family); here a family-selected pass pins that TPU8xx alone is
+clean, so a future failure names the family immediately.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from clearml_serving_tpu.analyze import (
+    RULES,
+    analyze_paths,
+    analyze_source,
+    expand_select,
+)
+from clearml_serving_tpu.analyze import rules_sharding
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(PKG_ROOT, "clearml_serving_tpu")
+# a detached fixture path: _find_up never reaches parallel/mesh.py from
+# here, so the in-module fallback registries apply (the round-trip tests
+# below pin those fallbacks to the real files)
+DETACHED = os.path.join(os.sep, "nonexistent", "llm", "fixture.py")
+
+
+def codes(source, path=DETACHED, select=None):
+    return [
+        f.code
+        for f in analyze_source(textwrap.dedent(source), path, select=select)
+    ]
+
+
+# -- TPU801: mesh-axis closed world -------------------------------------------
+
+
+def test_tpu801_unknown_axis_in_partition_spec():
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        def spec():
+            return P("dp", "tensor")
+    """
+    assert codes(src) == ["TPU801"]
+
+
+def test_tpu801_declared_axes_are_fine():
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        def spec():
+            return P(("dp", "sp"), None, "tp")
+    """
+    assert codes(src) == []
+
+
+def test_tpu801_collective_axis_literal():
+    src = """
+        from jax import lax
+
+        def reduce(x):
+            return lax.psum(x, "tq")
+    """
+    assert codes(src) == ["TPU801"]
+
+
+def test_tpu801_axis_name_default():
+    src = """
+        def ring(q, k, v, axis_name="sq"):
+            return q
+    """
+    assert codes(src) == ["TPU801"]
+
+
+def test_tpu801_spec_forwarding_helper_is_checked():
+    # the ns/col pattern from parallel/sharding.py: a local helper that
+    # forwards *axes into P(...) is checked like a direct P(...) call
+    src = """
+        from jax.sharding import PartitionSpec as P
+
+        def ns(mesh, *axes):
+            return P(*axes)
+
+        def spec(mesh):
+            return ns(mesh, "dp", "tensor_parallel")
+    """
+    assert codes(src) == ["TPU801"]
+
+
+def test_tpu801_non_axis_strings_elsewhere_are_fine():
+    src = """
+        def log(msg):
+            print("prefill", msg, sep="|")
+    """
+    assert codes(src) == []
+
+
+def test_tpu801_ignore_comment():
+    src = """
+        from jax import lax
+
+        def reduce(x):
+            return lax.psum(x, "model")  # tpuserve: ignore[TPU801] external-library axis vocabulary
+    """
+    assert codes(src) == []
+
+
+# -- TPU802: sharding declarations for serve-path jit entries ----------------
+
+
+def test_tpu802_serve_class_without_shardings():
+    src = """
+        class Engine:
+            __compile_keys__ = {"serve": ("prefill", "decode")}
+    """
+    assert codes(src) == ["TPU802"]
+
+
+def test_tpu802_serve_class_with_shardings_is_fine():
+    src = """
+        class Engine:
+            __compile_keys__ = {"serve": ("prefill", "decode")}
+            __shardings__ = {
+                "params": "parallel.sharding.llama_param_sharding",
+                "kv_cache": "parallel.sharding.llama_cache_sharding",
+            }
+    """
+    assert codes(src) == []
+
+
+def test_tpu802_unregistered_builder_name():
+    src = """
+        class Engine:
+            __compile_keys__ = {"serve": ("prefill",)}
+            __shardings__ = {
+                "params": "parallel.sharding.mystery_sharding",
+            }
+    """
+    assert codes(src) == ["TPU802"]
+
+
+def test_tpu802_non_serve_class_needs_no_shardings():
+    src = """
+        class Offline:
+            __compile_keys__ = {"warmup": ("compile_all",)}
+    """
+    assert codes(src) == []
+
+
+def test_tpu802_registry_module_declares_undefined_builder():
+    src = """
+        __sharding_builders__ = ("real_builder", "ghost_builder")
+
+        def real_builder(mesh):
+            return None
+    """
+    assert codes(src) == ["TPU802"]
+
+
+# -- TPU803: multihost-unsafe host access ------------------------------------
+
+
+def test_tpu803_host_read_of_sharded_global():
+    src = """
+        import numpy as np
+
+        def publish(mesh, params):
+            sharded = shard_params(mesh, params)
+            return np.asarray(sharded)
+    """
+    assert codes(src) == ["TPU803"]
+
+
+def test_tpu803_tolist_and_int_sinks():
+    src = """
+        def peek(mesh, tokens, spec):
+            g = device_put(tokens, spec)
+            return g.tolist(), int(g)
+    """
+    assert codes(src) == ["TPU803", "TPU803"]
+
+
+def test_tpu803_addressable_shards_readback_is_fine():
+    src = """
+        import numpy as np
+
+        def local_view(mesh, params):
+            sharded = shard_params(mesh, params)
+            return np.asarray(sharded.addressable_shards[0].data)
+    """
+    assert codes(src) == []
+
+
+def test_tpu803_local_device_put_is_fine():
+    # device_put without a sharding argument is a local placement, not a
+    # sharded-global taint source
+    src = """
+        import numpy as np
+
+        def place(tokens):
+            local = device_put(tokens)
+            return np.asarray(local)
+    """
+    assert codes(src) == []
+
+
+def test_tpu803_ignore_comment():
+    src = """
+        import numpy as np
+
+        def replicated_read(mesh, params):
+            state = broadcast_one_to_all(params)
+            return np.asarray(state)  # tpuserve: ignore[TPU803] broadcast result is replicated
+    """
+    assert codes(src) == []
+
+
+# -- TPU804: silent replication fallback --------------------------------------
+
+_BUILDER_MODULE = """
+    __sharding_builders__ = ("param_sharding",)
+
+    def param_sharding(mesh, name, shape):
+        if shape[-1] % mesh.shape["tp"] == 0:
+            return ("tp",)
+        {fallback}
+"""
+
+
+def test_tpu804_silent_replication_fallback():
+    src = _BUILDER_MODULE.format(fallback="return None")
+    assert codes(src) == ["TPU804"]
+
+
+def test_tpu804_annotated_fallback_is_fine():
+    src = _BUILDER_MODULE.format(
+        fallback="return None  "
+        "# tpuserve: ignore[TPU804] misaligned projections replicate"
+    )
+    assert codes(src) == []
+
+
+def test_tpu804_only_applies_to_builder_registry_modules():
+    # the same shape outside a __sharding_builders__ module is not a
+    # sharding builder and must not flag
+    src = """
+        def pick(mesh, shape):
+            if shape[-1] % 2 == 0:
+                return ("tp",)
+            return None
+    """
+    assert codes(src) == []
+
+
+# -- registry round-trips: declarations match the code, both ways -------------
+
+
+def test_mesh_axes_round_trip():
+    """rules_sharding.MESH_AXES (the detached-fixture fallback), the
+    parsed-from-source ``__mesh_axes__``, and the runtime mesh module all
+    agree — registry drift fails here, not at trace time on hardware."""
+    from clearml_serving_tpu.parallel import mesh
+
+    assert frozenset(mesh.__mesh_axes__) == rules_sharding.MESH_AXES
+    assert frozenset(mesh.AXES) == rules_sharding.MESH_AXES
+    parsed = rules_sharding._mesh_axes(
+        os.path.join(PKG_DIR, "llm", "engine.py")
+    )
+    assert parsed == rules_sharding.MESH_AXES
+
+
+def test_sharding_builders_round_trip():
+    """__sharding_builders__ <-> SHARDING_REGISTRY <-> actual function
+    definitions in parallel/sharding.py, in both directions."""
+    from clearml_serving_tpu.parallel import sharding
+
+    declared = tuple(sharding.__sharding_builders__)
+    assert declared == rules_sharding.SHARDING_REGISTRY
+    parsed = rules_sharding._sharding_builders(
+        os.path.join(PKG_DIR, "llm", "engine.py")
+    )
+    assert parsed == declared
+    for name in declared:
+        assert callable(getattr(sharding, name)), (
+            "registry declares {!r} but parallel/sharding.py does not "
+            "define it".format(name)
+        )
+
+
+def test_engine_shardings_resolve_to_registered_builders():
+    """The engine's __shardings__ annotation names real registered
+    builders (the runtime mirror of the TPU802 static check)."""
+    from clearml_serving_tpu.llm.engine import LLMEngineCore
+    from clearml_serving_tpu.parallel import sharding
+
+    shardings = LLMEngineCore.__shardings__
+    assert "params" in shardings and "kv_cache" in shardings
+    for family, dotted in shardings.items():
+        builder = dotted.rsplit(".", 1)[-1]
+        assert builder in sharding.__sharding_builders__, (
+            "__shardings__[{!r}] names unregistered builder {!r}".format(
+                family, builder
+            )
+        )
+
+
+def test_drift_fault_point_registered_everywhere():
+    """The seeded-defect seam for the sharding sentry exists in both the
+    runtime fault registry and the analyzer's TPU403 fallback mirror."""
+    from clearml_serving_tpu.analyze import rules_errors
+    from clearml_serving_tpu.llm import faults
+
+    assert "engine.shard.drift" in faults.KNOWN_POINTS
+    assert "engine.shard.drift" in rules_errors.FALLBACK_POINTS
+
+
+def test_every_tpu8_code_is_in_the_catalog():
+    for code in ("TPU801", "TPU802", "TPU803", "TPU804"):
+        assert code in RULES
+
+
+def test_expand_select_tpu8xx():
+    assert expand_select(["TPU8xx"]) == {
+        "TPU801", "TPU802", "TPU803", "TPU804",
+    }
+
+
+# -- tree gate (family-selected) ----------------------------------------------
+
+
+def test_tree_is_clean_under_tpu8xx():
+    findings = analyze_paths([PKG_DIR], select=["TPU8xx"])
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+# -- source-mutation gates: the committed annotations are load-bearing --------
+
+
+def _mutate(path, old, new):
+    with open(path, encoding="utf-8") as fh:
+        source = fh.read()
+    mutated = source.replace(old, new)
+    assert mutated != source, "mutation target not found in {}".format(path)
+    return source, mutated
+
+
+def test_mutation_ring_attention_axis_default_is_checked():
+    """Typo'ing ring_attention's axis_name default ("sp" -> "sq") fails
+    TPU801 at lint time instead of at trace time on hardware."""
+    path = os.path.join(PKG_DIR, "parallel", "ring_attention.py")
+    source, mutated = _mutate(
+        path, 'axis_name: str = "sp"', 'axis_name: str = "sq"'
+    )
+    assert "TPU801" in [f.code for f in analyze_source(mutated, path)]
+    assert "TPU801" not in [f.code for f in analyze_source(source, path)]
+
+
+def test_mutation_deleting_replication_annotation_fails_the_tree():
+    """The head_tp replication-fallback annotation is load-bearing, not
+    decorative: stripping it resurfaces TPU804."""
+    path = os.path.join(PKG_DIR, "parallel", "sharding.py")
+    source, mutated = _mutate(
+        path,
+        "# tpuserve: ignore[TPU804] a tp boundary inside a head",
+        "# a tp boundary inside a head",
+    )
+    assert "TPU804" in [f.code for f in analyze_source(mutated, path)]
+    assert "TPU804" not in [f.code for f in analyze_source(source, path)]
+
+
+def test_mutation_deleting_broadcast_annotation_fails_the_tree():
+    """multihost.py's recv() host reads are safe only because the
+    broadcast result is replicated — stripping the annotation resurfaces
+    TPU803."""
+    path = os.path.join(PKG_DIR, "parallel", "multihost.py")
+    source, mutated = _mutate(
+        path,
+        "# tpuserve: ignore[TPU803] header is replicated",
+        "# header is replicated",
+    )
+    assert "TPU803" in [f.code for f in analyze_source(mutated, path)]
+    assert "TPU803" not in [f.code for f in analyze_source(source, path)]
+
+
+def test_mutation_deleting_engine_shardings_fails_the_tree():
+    """Dropping the engine's __shardings__ registry resurfaces TPU802:
+    the serve-path jit entries would have no declared operand layouts."""
+    path = os.path.join(PKG_DIR, "llm", "engine.py")
+    source, mutated = _mutate(path, "__shardings__", "__shardings_off__")
+    assert "TPU802" in [f.code for f in analyze_source(mutated, path)]
+    assert "TPU802" not in [f.code for f in analyze_source(source, path)]
+
+
+# -- CLI: --select TPU8xx and --format sarif ----------------------------------
+
+
+def _run_cli(args, cwd=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "clearml_serving_tpu.analyze"] + args,
+        capture_output=True, text=True, env=env,
+        cwd=cwd or PKG_ROOT,
+    )
+
+
+def test_cli_select_tpu8xx_clean_with_timings():
+    proc = _run_cli(
+        ["--select", "TPU8xx", "--timings", "clearml_serving_tpu/parallel"]
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+    assert "rules_sharding" in proc.stdout  # per-family timing table
+
+
+def test_cli_sarif_output(tmp_path):
+    """--format sarif emits a valid SARIF 2.1.0 document: the full rule
+    catalog in tool.driver.rules, one result per finding with a physical
+    location, exit code 1 on findings / 0 clean."""
+    dirty = tmp_path / "mod.py"
+    dirty.write_text(textwrap.dedent("""
+        from jax.sharding import PartitionSpec as P
+
+        def spec():
+            return P("tensor_parallel")
+    """))
+    proc = _run_cli(["--format", "sarif", str(dirty)], cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpuserve-analyze"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(RULES) == rule_ids
+    results = run["results"]
+    assert any(r["ruleId"] == "TPU801" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("mod.py")
+    assert loc["region"]["startLine"] >= 1
+
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    proc = _run_cli(["--format", "sarif", str(clean)], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["runs"][0]["results"] == []

@@ -96,6 +96,27 @@ def armed_ledger(monkeypatch):
     lifecycle_ledger.disarm()
 
 
+@pytest.fixture(autouse=True)
+def armed_shard_sentry(monkeypatch):
+    """The sharding sentry rides along in count mode (docs/
+    static_analysis.md TPU8xx): every chaos engine audits its live arrays
+    against the declared builder specs through the recovery paths, proving
+    failure handling never silently host-materializes or reshards the
+    chained state. Count mode, not strict — fault recovery is allowed to
+    fail requests, not to drift layouts; each test's teardown asserts the
+    audit stayed clean."""
+    monkeypatch.setenv("TPUSERVE_SHARD_SENTRY", "1")
+    from clearml_serving_tpu.llm import sharding_sentry
+
+    sharding_sentry.arm(strict=False).reset(strict=False)
+    yield
+    stats = sharding_sentry.get().stats()
+    sharding_sentry.get().reset(strict=False)
+    sharding_sentry.disarm()
+    assert stats["implicit_transfers"] == 0, stats["events"][:5]
+    assert stats["unplanned_reshards"] == 0, stats["events"][:5]
+
+
 def _make_engine(bundle, params, **kwargs):
     kwargs.setdefault("max_batch", 4)
     kwargs.setdefault("max_seq_len", 128)

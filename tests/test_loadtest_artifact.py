@@ -76,6 +76,14 @@ def test_artifact_internal_consistency():
     # across every preemption/shed/deadline path the sweep exercised
     assert head["leaks"] == 0
     assert head["ledger_mode"] == "strict"
+    # sharding certification (docs/static_analysis.md TPU8xx): the run
+    # completed under the STRICT sharding sentry with zero implicit
+    # device<->host transfers and zero unplanned reshards across every
+    # loop-boundary audit — no number in this artifact was produced by a
+    # silently host-materialized or drifted array
+    assert head["implicit_transfers"] == 0
+    assert head["unplanned_reshards"] == 0
+    assert head["shard_sentry_mode"] == "strict"
     assert row["warmup"]["fenced"] is True
     # headline fields restate the curves they were derived from
     at_2x = loads[-1]["classes"]["interactive"]

@@ -1,0 +1,368 @@
+"""Runtime sharding-sentry suite (llm/sharding_sentry.py;
+docs/static_analysis.md TPU8xx).
+
+Proves the dynamic half of the sharding discipline end to end:
+
+- spec canonicalization is GSPMD-equivalence-aware: jit outputs drop
+  PartitionSpec entries on size-1 mesh axes and strip trailing Nones, so
+  the sentry must treat ``P(None, 'dp', None, 'tp', None)`` on a dp=1
+  mesh as equal to ``P(None, None, None, 'tp')`` — syntactic equality
+  would false-flag every donated rebind on a partly-degenerate mesh;
+- the audit baselines paths on first sight, classifies mismatches into
+  implicit transfers (host materialization) vs unplanned reshards, tags
+  them with the thread-local launch context, and raises in strict mode
+  through the engine's loop-boundary check;
+- a real engine (dense and meshed) serves traffic under STRICT with zero
+  violations — the declared builder layouts survive the serve loop;
+- the SEEDED DRIFT DEFECT — ``engine.shard.drift`` swaps a
+  host-materialized copy in for the chained decode row — is proven
+  caught: strict raises ShardSentryError naming the array path and
+  declared-vs-actual spec, and the counter attributes it as an implicit
+  transfer (acceptance criterion).
+"""
+
+import asyncio
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from clearml_serving_tpu import models
+from clearml_serving_tpu.llm import faults, sharding_sentry
+from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+from clearml_serving_tpu.llm.sharding_sentry import (
+    ShardingSentry,
+    ShardSentryError,
+)
+from clearml_serving_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def parts():
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    return bundle, params
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.clear()
+    yield
+    faults.clear()
+    # the singleton is process-wide: never leave strictness (or a stale
+    # spec table) behind for unrelated suites
+    if sharding_sentry._sentry is not None:
+        sharding_sentry._sentry.reset(strict=False)
+    sharding_sentry.disarm()
+
+
+async def _collect(engine, req):
+    out = []
+    async for token in engine.generate(req):
+        out.append(token)
+    return out
+
+
+class _FakeMesh:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+# -- spec canonicalization ----------------------------------------------------
+
+
+def test_canon_spec_drops_size1_axes_and_trailing_nones():
+    mesh = _FakeMesh({"dp": 1, "tp": 2, "sp": 4})
+    canon = ShardingSentry._canon_spec
+    # GSPMD-normalized and builder-declared forms of the same layout agree
+    assert canon(("dp", None, "tp", None), mesh) == canon(
+        (None, None, "tp"), mesh
+    )
+    # sharding 1-way IS replication: a dp-only spec on dp=1 is replicated
+    assert canon(("dp",), mesh) == canon((), mesh) == "P()"
+    # live axes survive, including inside tuple entries
+    assert canon((("dp", "sp"), "tp"), mesh) == "P('sp', 'tp')"
+    assert canon((("tp", "sp"),), mesh) == "P(('tp', 'sp'))"
+    # an unknown mesh (None) keeps every named axis
+    assert canon(("dp", None), None) == "P('dp')"
+
+
+def test_canon_detects_host_and_named_shardings():
+    x = jnp.ones((4,))
+    assert ShardingSentry._canon(np.ones((4,))) == sharding_sentry._HOST
+    assert ShardingSentry._canon(x) == type(x.sharding).__name__
+    mesh = make_mesh({"tp": 2, "sp": 4})
+    sharded = jax.device_put(
+        jnp.ones((8, 8)), NamedSharding(mesh, P("sp", "tp"))
+    )
+    assert ShardingSentry._canon(sharded) == "P('sp', 'tp')"
+    # plain python values are unauditable, not violations
+    assert ShardingSentry._canon(3.5) is None
+    assert ShardingSentry._canon_declared(NamedSharding(mesh, P("tp"))) == (
+        "P('tp')"
+    )
+
+
+# -- audit / baseline / strict ------------------------------------------------
+
+
+def test_audit_baselines_then_counts_violation_kinds():
+    sentry = ShardingSentry(strict=False)
+    dev = jnp.ones((4,))
+    host = np.ones((4,))
+    mesh = make_mesh({"tp": 2, "sp": 4})
+    a = jax.device_put(jnp.ones((8, 8)), NamedSharding(mesh, P("sp", "tp")))
+    b = jax.device_put(jnp.ones((8, 8)), NamedSharding(mesh, P("tp", None)))
+
+    assert sentry.audit([("e.row", dev, None), ("e.kv", a, None)]) == 0
+    assert sentry.stats()["declared_paths"] == 2
+    # same specs again: clean
+    assert sentry.audit([("e.row", dev, None), ("e.kv", a, None)]) == 0
+    # host materialization of a device-baselined path: implicit transfer
+    assert sentry.audit([("e.row", host, None)]) == 1
+    # spec drift on a device path: unplanned reshard
+    assert sentry.audit([("e.kv", b, None)]) == 1
+    stats = sentry.stats()
+    assert stats["implicit_transfers"] == 1
+    assert stats["unplanned_reshards"] == 1
+    assert stats["violations"] == 0  # non-strict: counted, never pending
+    sentry.check()  # and never raises
+    kinds = {e["kind"] for e in stats["events"]}
+    assert kinds == {"implicit_transfer", "unplanned_reshard"}
+
+
+def test_strict_check_raises_with_path_and_specs():
+    sentry = ShardingSentry(strict=True)
+    dev = jnp.ones((4,))
+    sentry.declare("engine[0].row", type(dev.sharding).__name__)
+    sentry.audit([("engine[0].row", np.ones((4,)), None)], where="post-step")
+    with pytest.raises(ShardSentryError) as exc:
+        sentry.check(where="post-step")
+    msg = str(exc.value)
+    assert "engine[0].row" in msg and "host(ndarray)" in msg
+    assert "post-step" in msg and "TPU8xx" in msg
+    assert exc.value.kind == "implicit_transfer"
+    assert exc.value.actual == "host(ndarray)"
+    # reset clears the pending violation and the spec table
+    sentry.reset(strict=True)
+    assert sentry.stats()["declared_paths"] == 0
+    sentry.check()
+
+
+def test_thread_context_attribution():
+    sentry = ShardingSentry(strict=False)
+    sentry.declare("e.row", "P('tp')")
+
+    def worker():
+        with sentry.context(phase="decode", seq=17):
+            sentry.audit([("e.row", np.ones((2,)), None)], where="step")
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    events = sentry.stats()["events"]
+    assert events and events[0]["context"] == {"phase": "decode", "seq": 17}
+    assert events[0]["where"] == "step"
+
+
+def test_explicit_declared_entry_wins_over_baseline():
+    sentry = ShardingSentry(strict=False)
+    host = np.ones((3,))
+    # an entry-supplied declared spec pins the table on first audit: the
+    # live host value immediately violates it (no silent baseline)
+    assert sentry.audit([("e.kv", host, "P('tp')")]) == 1
+    assert sentry.stats()["implicit_transfers"] == 1
+
+
+def test_singleton_arm_disarm_and_env(monkeypatch):
+    monkeypatch.delenv(sharding_sentry.ENV, raising=False)
+    assert not sharding_sentry.enabled()
+    monkeypatch.setenv(sharding_sentry.ENV, "1")
+    assert sharding_sentry.enabled() and not sharding_sentry.strict_enabled()
+    monkeypatch.setenv(sharding_sentry.ENV, "strict")
+    assert sharding_sentry.enabled() and sharding_sentry.strict_enabled()
+    sentry = sharding_sentry.arm(strict=False)
+    assert sharding_sentry.armed() and sentry is sharding_sentry.get()
+    sharding_sentry.disarm()
+    assert not sharding_sentry.armed()
+
+
+# -- engine integration: strict serve stays clean -----------------------------
+
+
+def test_engine_strict_serve_is_clean(parts, monkeypatch):
+    """Tier-1 acceptance path: a dense engine under STRICT audits its
+    chained decode state, cache and params tree at every loop boundary
+    and finishes traffic with zero implicit transfers / reshards; the
+    health() and lifecycle_stats() surfaces expose the counters."""
+    monkeypatch.setenv("TPUSERVE_SHARD_SENTRY", "strict")
+    sentry = sharding_sentry.get()
+    sentry.reset(strict=True)
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16, 32], eos_token_id=None, decode_steps=1,
+    )
+    assert engine._shard_sentry is sentry
+
+    async def run():
+        await _collect(engine, GenRequest(
+            prompt_ids=[7, 8, 9], max_new_tokens=4
+        ))
+        await _collect(engine, GenRequest(
+            prompt_ids=[5] * 14, max_new_tokens=2
+        ))
+        await engine.wait_drained()
+
+    try:
+        asyncio.run(run())
+        stats = sentry.stats()
+        assert stats["audits"] > 0 and stats["arrays_checked"] > 0
+        assert stats["implicit_transfers"] == 0
+        assert stats["unplanned_reshards"] == 0
+        assert stats["violations"] == 0
+        block = engine.lifecycle_stats()["sharding"]
+        assert block["strict"] and block["implicit_transfers"] == 0
+        assert engine.health()["sharding"]["audits"] == block["audits"]
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)
+
+
+def test_meshed_engine_strict_serve_is_clean(parts, monkeypatch):
+    """The GSPMD-normalization case that motivated equivalence-aware
+    canonicalization: on a dp=1,tp=2,sp=4 mesh, jit outputs rebind the
+    donated cache with size-1 axes dropped and trailing Nones stripped —
+    the sentry must see those as the declared builder layout, not as a
+    reshard per step."""
+    monkeypatch.setenv("TPUSERVE_SHARD_SENTRY", "strict")
+    sentry = sharding_sentry.get()
+    sentry.reset(strict=True)
+    bundle, params = parts
+    mesh = make_mesh({"dp": 1, "tp": 2, "sp": 4})
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16, 32], eos_token_id=None, decode_steps=1,
+        mesh=mesh,
+    )
+
+    async def run():
+        await _collect(engine, GenRequest(
+            prompt_ids=[3, 5, 7, 9], max_new_tokens=4
+        ))
+        await engine.wait_drained()
+
+    try:
+        asyncio.run(run())
+        stats = sentry.stats()
+        assert stats["arrays_checked"] > 0
+        assert stats["unplanned_reshards"] == 0, stats["events"][:5]
+        assert stats["implicit_transfers"] == 0, stats["events"][:5]
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)
+
+
+def test_engine_unarmed_has_no_sentry_overhead(parts, monkeypatch):
+    monkeypatch.delenv("TPUSERVE_SHARD_SENTRY", raising=False)
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16], eos_token_id=None, decode_steps=1,
+    )
+    try:
+        assert engine._shard_sentry is None
+        assert engine.lifecycle_stats()["sharding"] is None
+        assert engine.health()["sharding"] is None
+    finally:
+        engine.stop()
+
+
+# -- the seeded drift defect --------------------------------------------------
+
+
+def test_seeded_shard_drift_is_caught_strict(parts, monkeypatch):
+    """Acceptance criterion: `engine.shard.drift` swaps a host-materialized
+    numpy copy in for the chained decode row — strict mode fails the
+    in-flight request with ShardSentryError naming the path and
+    declared-vs-actual, and the counter attributes an implicit transfer."""
+    monkeypatch.setenv("TPUSERVE_SHARD_SENTRY", "strict")
+    sentry = sharding_sentry.get()
+    sentry.reset(strict=True)
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16, 32], eos_token_id=None, decode_steps=1,
+    )
+
+    async def run():
+        # clean request first: baselines every path on real device specs
+        await _collect(engine, GenRequest(
+            prompt_ids=[7, 8, 9], max_new_tokens=2
+        ))
+        await engine.wait_drained()
+        assert sentry.stats()["implicit_transfers"] == 0
+        faults.configure([
+            {"point": "engine.shard.drift", "action": "raise",
+             "times": 1, "message": "host drift"},
+        ])
+        with pytest.raises(ShardSentryError) as exc:
+            await _collect(engine, GenRequest(
+                prompt_ids=[4] * 12, max_new_tokens=12
+            ))
+        msg = str(exc.value)
+        assert "_next_token_dev" in msg
+        assert "host(ndarray)" in msg
+        assert exc.value.kind == "implicit_transfer"
+
+    try:
+        asyncio.run(run())
+        stats = sentry.stats()
+        assert stats["implicit_transfers"] >= 1
+        assert any(
+            e["kind"] == "implicit_transfer"
+            and e["path"].endswith("._next_token_dev")
+            for e in stats["events"]
+        )
+        assert engine.lifecycle_stats()["sharding"]["violations"] >= 1
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)
+
+
+def test_seeded_drift_count_mode_counts_without_failing(parts, monkeypatch):
+    """Count mode (TPUSERVE_SHARD_SENTRY=1): the same seeded drift is
+    counted and attributed but the request completes — the production
+    monitoring posture."""
+    monkeypatch.setenv("TPUSERVE_SHARD_SENTRY", "1")
+    sentry = sharding_sentry.get()
+    sentry.reset(strict=False)
+    bundle, params = parts
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64,
+        prefill_buckets=[16], eos_token_id=None, decode_steps=1,
+    )
+
+    async def run():
+        faults.configure([
+            {"point": "engine.shard.drift", "action": "raise",
+             "times": 1, "message": "host drift"},
+        ])
+        out = await _collect(engine, GenRequest(
+            prompt_ids=[7, 8, 9], max_new_tokens=4
+        ))
+        assert len(out) == 4  # request completed despite the violation
+        await engine.wait_drained()
+
+    try:
+        asyncio.run(run())
+        assert sentry.stats()["implicit_transfers"] >= 1
+        assert sentry.stats()["violations"] == 0
+    finally:
+        engine.stop()
+        sentry.reset(strict=False)

@@ -302,6 +302,48 @@ def test_engine_pipeline_metrics_exported():
     ) is None
 
 
+def test_engine_sharding_metrics_exported():
+    """Sharding-discipline observability (docs/static_analysis.md TPU8xx):
+    the lifecycle collector exports the sentry's audit counter and the two
+    violation classes from the provider's ``sharding`` block; providers
+    without the block (sentry unarmed) keep the historical families only."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "sharding": {
+            "mode": "audit",
+            "strict": False,
+            "audits": 12,
+            "arrays_checked": 57,
+            "implicit_transfers": 1,
+            "unplanned_reshards": 0,
+            "declared_paths": 25,
+            "violations": 0,
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    assert val("engine_shard_audits_total") == 12
+    assert val("engine_shard_violations_total",
+               kind="implicit_transfer") == 1
+    assert val("engine_shard_violations_total",
+               kind="unplanned_reshard") == 0
+
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {"queue_depth": 1, "sharding": None},
+        registry=registry2, key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_shard_audits_total", {"model": "m2"}
+    ) is None
+
+
 def test_engine_slo_metrics_exported():
     """SLO-scheduling observability (docs/slo_scheduling.md): per-class
     queue depths, per-(reason, class) sheds, the preemption counter and the
