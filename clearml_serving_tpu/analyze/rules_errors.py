@@ -53,6 +53,9 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.ledger.leak",
     "engine.compile.bucket",
     "engine.shard.drift",
+    "transport.wire.send",
+    "transport.wire.recv",
+    "replica.proc.crash",
     "router.pick",
     "router.eject",
     "grpc.call",

@@ -154,6 +154,25 @@ LIFECYCLE_REGISTRY: Dict[str, Tuple[Dict[str, Any], ...]] = {
          "receivers": ("transport", "endpoint", "_transport",
                        "_kv_transport", "ep")},
     ),
+    # socket KV-wire peer connections (llm/kv_wire.py): cached per
+    # destination by the sender, dropped on any wire failure or close()
+    # — cross-function by design, ledger-audited
+    "_connect": (
+        {"resource": "transport.wire.conn",
+         "releases": ("_drop_conn", "_close_conn", "close"),
+         "drops": (), "static": False,
+         "receivers": ("transport", "endpoint", "_transport",
+                       "_kv_transport", "ep", "self")},
+    ),
+    # process-replica worker subprocesses (serving/process_replica.py):
+    # spawned by the supervisor, reaped on stop or crash-restart —
+    # cross-function by design, ledger-audited
+    "_spawn": (
+        {"resource": "replica.worker_proc",
+         "releases": ("_reap", "stop"),
+         "drops": (), "static": False,
+         "receivers": ("self", "replica", "supervisor")},
+    ),
 }
 
 # TPU703: the enqueue-before-publish fence protocol. Minted page ids

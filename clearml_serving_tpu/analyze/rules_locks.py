@@ -88,6 +88,20 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "_slabs": ("_lock", None),
     "_slab_pages": ("_lock", None),
     "_ship_seq": ("_lock", None),
+    # socket KV-wire backend (llm/kv_wire.py): the per-peer connection
+    # cache is shared between the sender's loop thread and close()
+    "_conns": ("_lock", ("self", "transport", "endpoint", "_kv_transport",
+                         "ep")),
+    # process-replica control plane (serving/process_replica.py): the
+    # blocking sync channel is shared between the serving loop, to_thread
+    # receive workers, and the Prometheus scrape thread
+    "_sync_sock": ("_sync_lock", ("self", "proxy", "client", "_client",
+                                  "engine")),
+    # process-replica supervisor state (serving/process_replica.py): the
+    # worker Popen handle and restart budget are rebound by both the
+    # supervisor thread (crash/restart) and the serving loop (stop)
+    "_proc": ("_lock", ("self", "replica")),
+    "_restarts_left": ("_lock", ("self", "replica")),
     # SLO scheduler pending-queue state (engine._ClassedPendingQueue,
     # docs/slo_scheduling.md): per-class heaps + starvation counters
     "_heaps": ("_lock", None),

@@ -116,6 +116,10 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # docs/replication.md): sweeps/picks rebind an immutable frozenset on
     # the serving loop; the scrape thread reads snapshots by reference
     "_ring_members": (LOOP, ("self", "router", "_router")),
+    # process-replica supervision (serving/process_replica.py): the
+    # heartbeat miss counter is owned by the dedicated supervisor thread —
+    # loop-side code reads liveness through is_ready snapshots only
+    "_hb_misses": (WORKER, ("self", "replica")),
     # model_request_processor daemon-shared registries: read on the serving
     # event loop; the sync daemon swaps them only through the zero-downtime
     # drain protocol (annotated at the definition sites)

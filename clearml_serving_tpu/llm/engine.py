@@ -1436,9 +1436,21 @@ class LLMEngineCore:
                     # pools themselves define. Off-Linux the probe raises
                     # the named HostTierAutoSizeError — endpoint load
                     # fails fast instead of serving tierless.
-                    from .kv_cache import available_host_memory_bytes
+                    from .kv_cache import (
+                        available_host_memory_bytes,
+                        cohosted_worker_processes,
+                    )
 
-                    budget = available_host_memory_bytes() // 2
+                    # the half-of-MemAvailable heuristic is PER HOST, not
+                    # per process: co-hosted process-backend workers
+                    # (TPUSERVE_COHOSTED_PROCS, serving/process_replica.py)
+                    # each run this same sizer against the same meminfo
+                    # reading, so the budget divides by the fleet width or
+                    # a 2-worker fleet over-commits host RAM 2x
+                    budget = (
+                        available_host_memory_bytes() // 2
+                        // cohosted_worker_processes()
+                    )
                     budget = min(
                         max(budget, _AUTO_HOST_TIER_MIN_BYTES),
                         _AUTO_HOST_TIER_MAX_BYTES,
@@ -3712,6 +3724,13 @@ class LLMEngineCore:
             "compile": self._compile_snapshot(),
             "ledger": self._ledger_snapshot(),
             "sharding": self._shard_snapshot(),
+            # certificate block like compile/ledger/sharding: None when
+            # unarmed. Needed over the process-backend health RPC — the
+            # parent cannot reach a worker engine's _sanitizer directly
+            "sanitizer": (
+                self._sanitizer.stats()
+                if self._sanitizer is not None else None
+            ),
         }
         if self.replica_id is not None:
             out["replica"] = self.replica_id

@@ -127,6 +127,28 @@ Known points (ctx carried with each):
 - ``engine.drain``     — on the loop thread at the drained boundary, before
                          the drained sanitizer audit; a raise fails the loop
                          through the structured step-failure path.
+- ``transport.wire.send`` — in the socket KV-transport backend
+                         (llm/kv_wire.py) before a shipment is framed and
+                         written to the destination replica's listener; a
+                         raise drops the shipment sender-side (counted wire
+                         send failure, ``send`` returns False) and the
+                         decode replica recomputes — the same
+                         drop-to-recompute contract as a full receive slab.
+- ``transport.wire.recv`` — on the receiving endpoint's listener thread
+                         before a received frame is decoded/validated; a
+                         raise drops the frame leak-free (nothing was
+                         attached — the slabs are views into the frame
+                         buffer), nacks the sender, and the stream falls
+                         back to recompute. The same path truncated or
+                         geometry-lying frames take via WireFormatError.
+- ``replica.proc.crash`` — in the process-replica supervisor's heartbeat
+                         (serving/process_replica.py) with the replica
+                         INDEX as the shim's ``prompt_ids`` (the
+                         ``router.eject`` convention); ``match_token:
+                         <index>`` SIGKILLs exactly that worker process —
+                         the chaos suite's handle for a real worker death
+                         (EOF mid-stream -> history-as-prompt failover,
+                         ejection, bounded restart-with-rewarm).
 - ``router.pick``      — in the replica router as a route decision is
                          about to return its pick (``request``;
                          serving/replica_router.py, docs/replication.md);
@@ -198,6 +220,9 @@ KNOWN_POINTS = frozenset({
     "engine.ledger.leak",
     "engine.compile.bucket",
     "engine.shard.drift",
+    "transport.wire.send",
+    "transport.wire.recv",
+    "replica.proc.crash",
     "router.pick",
     "router.eject",
     "grpc.call",

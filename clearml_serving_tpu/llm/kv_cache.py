@@ -50,6 +50,7 @@ Promotion completion is observed at the engine's retire boundaries
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -448,6 +449,23 @@ def available_host_memory_bytes(path: str = "/proc/meminfo") -> int:
         "prefix_cache_host_mb='auto': {} has no MemAvailable field on this "
         "platform; set an explicit engine.prefix_cache_host_mb".format(path)
     )
+
+
+def cohosted_worker_processes() -> int:
+    """How many engine worker processes share this host's RAM — the
+    divisor for ``prefix_cache_host_mb: "auto"``. Each process sizes its
+    tier independently from the same ``MemAvailable`` reading, so without
+    the divide a 2-worker fleet claims half of host memory TWICE
+    (over-commit the OOM killer settles later, not the sizer). The
+    process-fleet builder (serving/process_replica.py) exports the fleet
+    width as ``TPUSERVE_COHOSTED_PROCS`` into every worker; unset or
+    malformed reads as 1 (the single-process in-heap backend)."""
+    raw = os.environ.get("TPUSERVE_COHOSTED_PROCS", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        return 1
+    return max(1, n)
 
 
 class HostKVTier:

@@ -110,6 +110,24 @@ class KVShipment:
             per += int(self.hk_scale.nbytes) + int(self.hv_scale.nbytes)
         return per
 
+    # -- wire codec (llm/kv_wire.py; docs/disaggregation.md) ---------------
+
+    def to_wire(self) -> bytes:
+        """One self-validating frame for the socket backend (header:
+        geometry/dtype/lora/content key; body: the raw slabs)."""
+        from .kv_wire import shipment_to_wire
+
+        return shipment_to_wire(self)
+
+    @staticmethod
+    def from_wire(frame) -> "KVShipment":
+        """Decode + validate a frame into a shipment whose slabs are
+        zero-copy views; raises ``kv_wire.WireFormatError`` (before any
+        attach) on truncation or geometry/dtype/key lies."""
+        from .kv_wire import shipment_from_wire
+
+        return shipment_from_wire(frame)
+
 
 class TransportEndpoint:
     """One replica's handle on a transport: ``send`` addresses a peer by

@@ -85,6 +85,8 @@ RESOURCES: Dict[str, Dict[str, Any]] = {
     "slot.quarantine": {"scope": "engine", "drain_zero": True},
     "kv.promotion": {"scope": "engine", "drain_zero": True},
     "transport.shipment": {"scope": "cache", "drain_zero": False},
+    "transport.wire.conn": {"scope": "cache", "drain_zero": False},
+    "replica.worker_proc": {"scope": "engine", "drain_zero": False},
     "guided.ref": {"scope": "request", "drain_zero": True},
 }
 

@@ -422,7 +422,98 @@ class LLMEngineRequest(BaseEngineRequest):
                     "aux engine.replica_roles needs engine.replicas >= 2 "
                     "(got {} replica)".format(n_replicas)
                 )
-        if n_replicas > 1:
+        # replica backend (docs/replication.md): "inprocess" = N engines
+        # on this heap (the default), "process" = supervised worker
+        # subprocesses (serving/process_replica.py). Validated at ENDPOINT
+        # LOAD like every other fleet knob.
+        replica_backend = str(
+            engine_cfg.get("replica_backend", "inprocess")
+        ).strip().lower()
+        if replica_backend not in ("inprocess", "process"):
+            raise ValueError(
+                "aux engine.replica_backend must be inprocess/process: got "
+                "{!r}".format(engine_cfg.get("replica_backend"))
+            )
+        # KV transport backend for disaggregated fleets
+        # (docs/disaggregation.md): in-heap shared slabs or the socket
+        # wire (llm/kv_wire.py). The process backend always uses sockets
+        # (its workers have no shared heap).
+        kv_transport_backend = str(
+            engine_cfg.get("kv_transport_backend", "shared")
+        ).strip().lower()
+        if kv_transport_backend not in ("shared", "socket"):
+            raise ValueError(
+                "aux engine.kv_transport_backend must be shared/socket: "
+                "got {!r}".format(engine_cfg.get("kv_transport_backend"))
+            )
+        if replica_backend == "process":
+            if n_replicas <= 1:
+                raise ValueError(
+                    "aux engine.replica_backend=process needs "
+                    "engine.replicas >= 2 (got {})".format(n_replicas)
+                )
+            if self._model_local_path:
+                raise EndpointModelError(
+                    "engine.replica_backend=process needs an engine.preset "
+                    "model: worker processes rebuild the model from the "
+                    "preset spec, and a local-path bundle cannot be "
+                    "re-materialized in them yet (docs/replication.md)"
+                )
+            if lora_adapters:
+                raise ValueError(
+                    "engine.replica_backend=process does not support LoRA "
+                    "adapters yet: the adapter registry is not shipped to "
+                    "worker processes (docs/replication.md)"
+                )
+            from ..serving.process_replica import build_process_fleet
+
+            # JSON-safe engine kwargs only: the worker rebuilds tokenizer-
+            # dependent pieces (eos id rides along as plain data) and owns
+            # its own mesh; anything unserializable stays parent-side
+            worker_engine_cfg = {}
+            for key, value in engine_kwargs.items():
+                if key in ("tokenizer", "mesh", "lora_adapters"):
+                    continue
+                try:
+                    json.dumps(value)
+                except (TypeError, ValueError):
+                    continue
+                worker_engine_cfg[key] = value
+            self.engine = build_process_fleet(
+                {
+                    "arch": engine_cfg.get("arch", "llama"),
+                    "config": {
+                        "preset": engine_cfg["preset"],
+                        **(engine_cfg.get("config") or {}),
+                        **cfg_overrides,
+                    },
+                    "seed": int(engine_cfg.get("seed", 0)),
+                },
+                worker_engine_cfg,
+                n_replicas,
+                roles=replica_roles,
+                warmup_mode=warmup_mode,
+                affinity_blocks=int(
+                    engine_cfg.get("router_affinity_blocks", 4)
+                ),
+                spill_queue_depth=(
+                    int(engine_cfg["router_spill_queue_depth"])
+                    if engine_cfg.get("router_spill_queue_depth") is not None
+                    else None
+                ),
+                spill_brownout_stage=int(
+                    engine_cfg.get("router_spill_stage", 2)
+                ),
+                fleet_shed_stage=int(
+                    engine_cfg.get("router_fleet_shed_stage", 3)
+                ),
+                kv_transport_pages=(
+                    int(engine_cfg["kv_transport_pages"])
+                    if engine_cfg.get("kv_transport_pages")
+                    else None
+                ),
+            )
+        elif n_replicas > 1:
             from .replica import ReplicaGroup
 
             engines = [
@@ -460,6 +551,7 @@ class LLMEngineRequest(BaseEngineRequest):
                     if engine_cfg.get("kv_transport_pages")
                     else None
                 ),
+                kv_transport_backend=kv_transport_backend,
             )
         else:
             self.engine = LLMEngineCore(bundle, params, **engine_kwargs)
@@ -506,7 +598,12 @@ class LLMEngineRequest(BaseEngineRequest):
             return provider
 
         def _register_prefix(engine, key, replica=None):
-            if engine._prefix is None:
+            prefix = getattr(engine, "_prefix", None)
+            if prefix is None or not hasattr(prefix, "stats"):
+                # process-backend proxies expose a routing-only prefix
+                # probe (block size + match lengths over the RPC) with no
+                # stats surface — the real cache lives in the worker and
+                # reports through the health RPC, not this collector
                 return None
             # hit rate / shared pages / CoW visible from day one on the
             # same Prometheus registry the serving process already exports.

@@ -178,9 +178,19 @@ class ReplicaGroup:
         # per-replica receive-slab capacity in pages (aux
         # engine.kv_transport_pages); default: four full-prefix shipments
         kv_transport_pages: Optional[int] = None,
+        # KV transport backend (aux engine.kv_transport_backend,
+        # docs/disaggregation.md): "shared" = in-heap slab mailboxes,
+        # "socket" = the wire-framed socket backend (llm/kv_wire.py) —
+        # same mailbox semantics, shipments cross a real byte boundary
+        kv_transport_backend: str = "shared",
     ):
         if not engines:
             raise ValueError("a replica group needs at least one engine")
+        if kv_transport_backend not in ("shared", "socket"):
+            raise ValueError(
+                "engine.kv_transport_backend must be shared/socket: got "
+                "{!r}".format(kv_transport_backend)
+            )
         self.replicas = [
             EngineReplica(i, engine, warmup_mode=warmup_mode)
             for i, engine in enumerate(engines)
@@ -222,16 +232,23 @@ class ReplicaGroup:
                         "and a prefix_cache (the shipped payload is the "
                         "radix-storable prefix; docs/disaggregation.md)"
                     )
-                from .kv_transport import SharedSlabTransport
-
                 if kv_transport_pages is None:
                     per_seq = engines[0].paged_cache.pool.pages_needed(
                         engines[0].max_seq_len
                     )
                     kv_transport_pages = max(64, 4 * per_seq)
-                self.transport = SharedSlabTransport(
-                    capacity_pages=int(kv_transport_pages)
-                )
+                if kv_transport_backend == "socket":
+                    from .kv_wire import SocketSlabFabric
+
+                    self.transport = SocketSlabFabric(
+                        capacity_pages=int(kv_transport_pages)
+                    )
+                else:
+                    from .kv_transport import SharedSlabTransport
+
+                    self.transport = SharedSlabTransport(
+                        capacity_pages=int(kv_transport_pages)
+                    )
             role_map = {
                 replica.name: role
                 for replica, role in zip(self.replicas, roles)
@@ -242,22 +259,54 @@ class ReplicaGroup:
                     if self.transport is not None else None,
                     role=role,
                 )
+        self._finish_init(
+            self.replicas,
+            block=block,
+            role_map=role_map,
+            disaggregated=self._disaggregated,
+            transport=self.transport,
+            spill_queue_depth=spill_queue_depth,
+            spill_brownout_stage=spill_brownout_stage,
+            fleet_shed_stage=fleet_shed_stage,
+            affinity_blocks=affinity_blocks,
+            replica_backend="inprocess",
+            max_pending_hint=engines[0].max_pending,
+            runtime=None,
+        )
+
+    def _finish_init(self, replicas, *, block, role_map, disaggregated,
+                     transport, spill_queue_depth, spill_brownout_stage,
+                     fleet_shed_stage, affinity_blocks, replica_backend,
+                     max_pending_hint, runtime):
+        """Shared construction tail: router + counters. Called by
+        ``__init__`` (in-process engines) and by the process-fleet builder
+        (serving/process_replica.py, docs/replication.md), which assembles
+        its ring from worker subprocesses and has no engine objects in
+        hand — each proxy replica arrives pre-built."""
+        self.replicas = replicas
+        self._disaggregated = bool(disaggregated)
+        self.transport = transport
+        # which replica backend runs this fleet ("inprocess" | "process");
+        # exported on the router's stats for the info-gauge metric
+        self.replica_backend = str(replica_backend)
+        self._process_runtime = runtime
         # spill bound defaults to half the admission bound: deep enough
         # that transient bursts stay affine, shallow enough to redirect
         # before the affine member starts shedding. An EXPLICIT 0 disables
         # queue-depth spill (maps to the router's None spelling).
-        if spill_queue_depth is None and engines[0].max_pending:
-            spill_queue_depth = max(2, int(engines[0].max_pending) // 2)
+        if spill_queue_depth is None and max_pending_hint:
+            spill_queue_depth = max(2, int(max_pending_hint) // 2)
         elif spill_queue_depth is not None and int(spill_queue_depth) <= 0:
             spill_queue_depth = None
         self.router = ReplicaRouter(
-            self.replicas,
+            replicas,
             block=block,
             affinity_blocks=affinity_blocks,
             spill_queue_depth=spill_queue_depth,
             spill_brownout_stage=spill_brownout_stage,
             fleet_shed_stage=fleet_shed_stage,
             roles=role_map,
+            replica_backend=self.replica_backend,
         )
         self.failovers = 0
         # disaggregation counters (mirrored in health()/lifecycle_stats())
@@ -636,6 +685,15 @@ class ReplicaGroup:
     def stop(self) -> None:
         for replica in self.replicas:
             replica.engine.stop()
+        # the socket fabric holds OS resources (accept threads, unix
+        # paths, a tmpdir); the in-heap slab backend has nothing to close
+        if self.transport is not None and hasattr(self.transport, "close"):
+            self.transport.close()
+        runtime = getattr(self, "_process_runtime", None)
+        if runtime is not None:
+            # process backend: join supervisors, reap workers, drop the
+            # control listener + spec/socket directory
+            runtime.close()
         self.router.sweep()
 
     async def wait_drained(self, timeout: float = 30.0) -> None:

@@ -22,6 +22,7 @@ executable runs.
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Callable, Optional, Tuple
 
@@ -199,3 +200,37 @@ def follower_loop(
         except BaseException as ex:  # a follower must never desync the loop
             if on_error is not None:
                 on_error(key, ex)
+
+
+def configure_process_devices(devices: Optional[dict]) -> None:
+    """Apply a worker spec's device block before the first jax device use.
+
+    Process-backend replicas (serving/process_replica.py,
+    docs/replication.md) run one engine per OS process, each owning its own
+    device mesh. On a real slice that partitioning comes from the platform
+    (each controller process sees its local chips); on CPU hosts it has to
+    be conjured — ``cpu_devices`` forces ``jax_num_cpu_devices`` so a worker
+    gets the same N-device mesh the in-process test fixtures configure.
+
+    Must run before anything touches ``jax.devices()``: the XLA CPU client
+    is created once per process and never re-reads the flag. Call it first
+    thing in the worker main, before the engine module is imported.
+    """
+    block = devices or {}
+    n = int(block.get("cpu_devices") or 0)
+    if n > 0:
+        # env first: it works even on jax builds without the explicit
+        # config knob (same fallback ladder as tests/conftest.py), and the
+        # worker main calls this before jax is ever imported
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count={}".format(n)
+            ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except AttributeError:
+            pass

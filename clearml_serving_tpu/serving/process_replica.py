@@ -1,0 +1,1563 @@
+"""Process-backend engine replicas: one worker OS process per ring member.
+
+The in-process replica fleet (llm/replica.py, docs/replication.md) runs N
+``LLMEngineCore`` instances on one Python heap — honest enough for routing
+and failover semantics, but every replica shares one GIL, one XLA client,
+and one blast radius: a wedged C++ callback or a heap corruption takes the
+whole fleet down. This module is the production shape: each replica is a
+**supervised worker subprocess** owning its own engine on its own device
+mesh (``parallel.multihost.configure_process_devices`` — on CPU hosts each
+worker gets a private ``jax_num_cpu_devices`` mesh; on a real slice the
+platform hands each controller process its local chips).
+
+``ProcessEngineReplica`` satisfies the exact ``EngineReplica`` surface the
+router and group consume — begin_warm/health/generate/stop/wait_drained,
+streamed tokens and lifecycle stats — by proxying over a length-prefixed
+JSON control channel on a UNIX socket:
+
+- an **async channel**: id-multiplexed request frames; ``generate`` streams
+  ``{"id", "tok"}`` frames back, ``warmup``/``drain``/``ping`` are single
+  request/reply exchanges. The parent side demuxes on a reader thread into
+  per-call queues, so streams survive being consumed from different event
+  loops (tests run one ``asyncio.run`` per request).
+- a **sync channel**: blocking request/reply for the engine's synchronous
+  surface (check_admission, validate, receive_shipment, health, lifecycle
+  stats, score_prompt). One outstanding call at a time under
+  ``_sync_lock``; loop-affine ops are re-dispatched onto the worker's own
+  event loop via ``run_coroutine_threadsafe`` so the engine's declared
+  thread discipline (docs/static_analysis.md TPU5xx) holds inside the
+  worker too.
+
+Liveness is a supervisor THREAD per replica: heartbeat pings on the async
+channel feed ``is_ready``; a missed-heartbeat budget or a dead process
+marks the proxy not-ready — the router's next sweep ejects it, streams in
+flight fail with ``EngineUnavailableError`` and the group resumes them
+history-as-prompt on a sibling, exactly like the in-process watchdog path.
+A crashed worker gets a bounded **restart-with-rewarm**: respawn, fresh
+handshake, and ``invalidate_warm()`` so the ring-entry warmup gate
+(llm/warmup.py) re-certifies before the router re-admits it.
+
+Errors cross the boundary BY NAME: the worker serializes
+``type(ex).__name__`` + message + the structured fields (retry_after,
+stage, shed_class) and the parent reconstructs the class from
+``clearml_serving_tpu.errors`` — a 429 stays a 429 with its Retry-After
+across the process hop.
+
+Chaos seam: ``replica.proc.crash`` (llm/faults.py) fires in the supervisor
+tick with the replica INDEX as the shim prompt — ``match_token: 1`` SIGKILLs
+exactly worker r1, the real-signal version of the in-process kill tests.
+
+Known limits (validated with named errors, queued in ROADMAP.md): guided
+decoding (the grammar compiler needs the tokenizer, which stays in the
+parent) and LoRA adapter registries are not yet shipped to workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import queue as _queue
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+from .. import errors as _errors
+from ..errors import EngineUnavailableError
+from ..llm import faults
+from ..llm import lifecycle_ledger as _ledger
+
+logger = logging.getLogger(__name__)
+
+# handshake budget: a worker imports jax, builds the model, and constructs
+# the engine before it can connect — minutes on a busy 1-core CI host
+_DEFAULT_STARTUP_TIMEOUT = 300.0
+_SYNC_CALL_TIMEOUT = 60.0
+
+
+# -- framing (shared by both sides) -----------------------------------------
+#
+# [u32 little-endian frame length][UTF-8 JSON payload] — the same length-
+# prefixed discipline as the KV wire (llm/kv_wire.py), minus the binary
+# body: control frames are small and structured, JSON keeps them
+# debuggable with strace alone.
+
+
+def _send_frame_sock(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame_sock(sock: socket.socket) -> Optional[dict]:
+    """One frame, or None on EOF/timeout/closed socket (a truncated frame
+    is a dead peer, not a protocol state worth distinguishing)."""
+    head = _read_exact(sock, 4)
+    if head is None:
+        return None
+    (length,) = struct.unpack("<I", head)
+    body = _read_exact(sock, length)
+    if body is None:
+        return None
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return None
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort JSON sanitizer for health/lifecycle payloads: numpy
+    scalars/arrays, bytes, sets, and non-string dict keys all appear in
+    engine snapshots and must not kill the control channel."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (bytes, bytearray)):
+        return bytes(obj).hex()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return _jsonable(item())
+        except (TypeError, ValueError):
+            pass
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        try:
+            return _jsonable(tolist())
+        except (TypeError, ValueError):
+            pass
+    return repr(obj)
+
+
+# -- errors over the wire ---------------------------------------------------
+
+
+def _err_to_dict(ex: BaseException) -> dict:
+    out = {"name": type(ex).__name__, "message": str(ex)}
+    for field in ("retry_after", "stage", "shed_class"):
+        val = getattr(ex, field, None)
+        if val is not None:
+            out[field] = val
+    return out
+
+
+def _err_from_dict(d: dict) -> BaseException:
+    """Reconstruct a worker-side error by class name against the project's
+    error module — the structured fields (Retry-After, deadline stage,
+    shed class) survive the hop, so the front's HTTP mapping is identical
+    to the in-process backend. Unknown names degrade to RuntimeError."""
+    name = str(d.get("name", ""))
+    message = str(d.get("message", ""))
+    cls = getattr(_errors, name, None)
+    if isinstance(cls, type) and issubclass(cls, Exception):
+        kwargs = {}
+        if issubclass(cls, _errors.RequestError) and d.get("retry_after") is not None:
+            kwargs["retry_after"] = d["retry_after"]
+        if name == "DeadlineExceededError" and d.get("stage"):
+            kwargs["stage"] = d["stage"]
+        if name == "EngineOverloadedError" and d.get("shed_class"):
+            kwargs["shed_class"] = d["shed_class"]
+        try:
+            return cls(message, **kwargs)
+        except TypeError:
+            try:
+                return cls(message)
+            except TypeError:
+                pass
+    if name in ("InjectedFault", "MemoryError", "ValueError"):
+        # receive/admission fault classes the group's degradation paths
+        # catch by type: preserve the category even without the module
+        return {"MemoryError": MemoryError, "ValueError": ValueError}.get(
+            name, RuntimeError
+        )(message)
+    return RuntimeError("{}: {}".format(name, message) if name else message)
+
+
+# -- request serialization --------------------------------------------------
+
+_REQ_FIELDS = (
+    "max_new_tokens", "temperature", "top_k", "top_p", "stop_token_ids",
+    "presence_penalty", "frequency_penalty", "repetition_penalty", "seed",
+    "logprobs", "adapter", "min_tokens", "priority",
+)
+
+
+def _req_to_wire(request) -> dict:
+    """A GenRequest as a JSON dict of REMAINING budgets (the group's
+    ``_resume_clone`` deadline convention: resolved monotonic deadlines do
+    not cross process clocks, so the wire carries what is left of each)."""
+    if getattr(request, "guided", None) is not None:
+        raise ValueError(
+            "guided decoding is not supported on process-backend replicas "
+            "yet (the grammar compiler needs the tokenizer, which lives in "
+            "the parent; docs/replication.md)"
+        )
+    d = {f: getattr(request, f) for f in _REQ_FIELDS}
+    d["prompt_ids"] = [int(t) for t in request.prompt_ids]
+    if request.logit_bias:
+        d["logit_bias"] = {str(k): float(v) for k, v in request.logit_bias.items()}
+    now = time.monotonic()
+
+    def _remaining(deadline, fallback):
+        if deadline is not None:
+            return max(0.05, deadline - now)
+        return fallback
+
+    d["queue_timeout"] = _remaining(request._queue_deadline, request.queue_timeout)
+    d["ttft_timeout"] = _remaining(request._ttft_deadline, request.ttft_timeout)
+    d["total_timeout"] = _remaining(request._deadline, request.total_timeout)
+    d["ship_to"] = request._ship_to
+    # the group's post-ship marker: the decode worker's admission judges
+    # the ship outcome (hit vs recompute) from it, so the hit-rate
+    # headline survives the process boundary
+    d["shipped"] = bool(request._shipped)
+    return d
+
+
+def _req_from_wire(d: dict):
+    from ..llm.engine import GenRequest
+
+    bias = d.get("logit_bias")
+    request = GenRequest(
+        prompt_ids=[int(t) for t in d["prompt_ids"]],
+        logit_bias=(
+            {int(k): float(v) for k, v in bias.items()} if bias else None
+        ),
+        **{f: d.get(f) for f in _REQ_FIELDS if f in d},
+    )
+    request._ship_to = d.get("ship_to")
+    request._shipped = bool(d.get("shipped"))
+    return request
+
+
+# -- parent-side channels ---------------------------------------------------
+
+
+class _AsyncChannel:
+    """Parent half of the id-multiplexed channel. A daemon reader thread
+    demuxes reply frames into per-call queues; consumers poll those from
+    whatever event loop is current (``asyncio.to_thread``), so one stream
+    is not pinned to the loop that opened the channel. Channel death fails
+    every outstanding call with ``EngineUnavailableError`` — the group's
+    failover then resumes streams history-as-prompt on a sibling."""
+
+    def __init__(self, sock: socket.socket, name: str):
+        self._sock = sock
+        self._name = name
+        self._send_lock = threading.Lock()
+        self._calls_lock = threading.Lock()
+        self._calls: Dict[int, "_queue.Queue"] = {}
+        self._ids = itertools.count(1)
+        self.dead = False
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name="proc-replica-{}-reader".format(name),
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        while True:
+            frame = _recv_frame_sock(self._sock)
+            if frame is None:
+                break
+            with self._calls_lock:
+                q = self._calls.get(frame.get("id"))
+            if q is not None:
+                q.put(frame)
+        self.dead = True
+        with self._calls_lock:
+            pending = list(self._calls.values())
+        fail = {"err": {"name": "EngineUnavailableError",
+                        "message": "worker control channel lost"}}
+        for q in pending:
+            q.put(dict(fail))
+
+    def submit(self, op: str, **fields) -> Tuple[int, "_queue.Queue"]:
+        if self.dead:
+            raise EngineUnavailableError(
+                "replica {} worker control channel lost".format(self._name)
+            )
+        fid = next(self._ids)
+        q: "_queue.Queue" = _queue.Queue()
+        with self._calls_lock:
+            self._calls[fid] = q
+        try:
+            with self._send_lock:
+                _send_frame_sock(self._sock, {"id": fid, "op": op, **fields})
+        except OSError:
+            self.dead = True
+            with self._calls_lock:
+                self._calls.pop(fid, None)
+            raise EngineUnavailableError(
+                "replica {} worker control channel lost".format(self._name)
+            )
+        return fid, q
+
+    def finish(self, fid: int) -> None:
+        with self._calls_lock:
+            self._calls.pop(fid, None)
+
+    def notify(self, op: str, **fields) -> None:
+        """Fire-and-forget (cancel/exit): send errors only mark the channel
+        dead — the supervisor owns escalation."""
+        try:
+            with self._send_lock:
+                _send_frame_sock(self._sock, {"op": op, **fields})
+        except OSError:
+            self.dead = True
+
+    def call_blocking(self, op: str, timeout: float, **fields) -> dict:
+        fid, q = self.submit(op, **fields)
+        try:
+            frame = q.get(True, timeout)
+        except _queue.Empty:
+            raise EngineUnavailableError(
+                "replica {} worker {} timed out after {:.1f}s".format(
+                    self._name, op, timeout
+                )
+            )
+        finally:
+            self.finish(fid)
+        if "err" in frame:
+            raise _err_from_dict(frame["err"])
+        return frame
+
+    async def call(self, op: str, timeout: float, **fields) -> dict:
+        return await asyncio.to_thread(self.call_blocking, op, timeout, **fields)
+
+    def close(self) -> None:
+        self.dead = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _SyncChannel:
+    """Parent half of the blocking request/reply channel: one outstanding
+    call at a time — the serving loop's pre-admission checks, to_thread
+    receive workers, and the Prometheus scrape thread all share it."""
+
+    __guarded_by__ = {"_sync_lock": ("_sync_sock",)}
+
+    def __init__(self, sock: socket.socket, name: str):
+        self._sync_lock = threading.Lock()
+        self._sync_sock: Optional[socket.socket] = sock
+        self._name = name
+        self.dead = False
+
+    def call(self, op: str, timeout: float = _SYNC_CALL_TIMEOUT, **fields) -> dict:
+        with self._sync_lock:
+            sock = self._sync_sock
+            if self.dead or sock is None:
+                raise EngineUnavailableError(
+                    "replica {} worker sync channel lost".format(self._name)
+                )
+            try:
+                sock.settimeout(timeout)
+                _send_frame_sock(sock, {"id": 0, "op": op, **fields})
+                frame = _recv_frame_sock(sock)
+            except OSError:
+                frame = None
+            if frame is None:
+                self.dead = True
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._sync_sock = None
+                raise EngineUnavailableError(
+                    "replica {} worker sync channel lost during {}".format(
+                        self._name, op
+                    )
+                )
+        if "err" in frame:
+            raise _err_from_dict(frame["err"])
+        return frame
+
+    def close(self) -> None:
+        with self._sync_lock:
+            self.dead = True
+            if self._sync_sock is not None:
+                try:
+                    self._sync_sock.close()
+                except OSError:
+                    pass
+                self._sync_sock = None
+
+
+class ProcessFleetControl:
+    """The parent's control listener: workers connect back to it twice
+    (async + sync channel), identify themselves with one handshake frame,
+    and ``wait_for`` hands the paired sockets to the owning replica. The
+    accept loop keeps running for the fleet's lifetime — a restarted
+    worker re-handshakes through the same path."""
+
+    def __init__(self, base_dir: str):
+        self.path = os.path.join(base_dir, "control.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.path)
+        self._listener.listen(32)
+        self._cond = threading.Condition()
+        self._pending: Dict[str, Dict[str, Tuple[socket.socket, dict]]] = {}
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="proc-fleet-accept"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            # the handshake frame is read inline: it is the first thing a
+            # worker writes, and a worker that connects without one is
+            # broken anyway (short timeout keeps a dead accept cheap)
+            conn.settimeout(30.0)
+            frame = _recv_frame_sock(conn)
+            if (
+                not frame
+                or frame.get("channel") not in ("sync", "async")
+                or not frame.get("name")
+            ):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            conn.settimeout(None)
+            with self._cond:
+                slot = self._pending.setdefault(str(frame["name"]), {})
+                slot[str(frame["channel"])] = (conn, frame)
+                self._cond.notify_all()
+
+    def wait_for(self, name: str, timeout: float) -> Dict[str, Tuple[socket.socket, dict]]:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                slot = self._pending.get(name)
+                if slot and "sync" in slot and "async" in slot:
+                    return self._pending.pop(name)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closing:
+                    raise EngineUnavailableError(
+                        "replica {} worker did not handshake within "
+                        "{:.0f}s".format(name, timeout)
+                    )
+                self._cond.wait(min(remaining, 1.0))
+
+    def close(self) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        for slot in leftovers:
+            for sock, _ in slot.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+
+# -- the engine proxy -------------------------------------------------------
+
+
+class _QueueDepthShim:
+    """Duck-typed ``engine._pending``: the router reads ``qsize()`` only."""
+
+    def __init__(self, proxy: "ProcessEngineProxy"):
+        self._proxy = proxy
+
+    def qsize(self) -> int:
+        return int(self._proxy._stats.get("queue_depth", 0))
+
+
+class _PrefixProbe:
+    """Duck-typed ``engine._prefix`` for the group's disaggregation
+    preamble: ``block``/``longest_prefix_len`` are pure config math
+    (mirroring RadixPrefixCache), ``match_len`` is a sync RPC — a lost
+    channel reads as a cold cache (0), which degrades to recompute."""
+
+    def __init__(self, proxy: "ProcessEngineProxy", block: int):
+        self._proxy = proxy
+        self.block = int(block)
+
+    def longest_prefix_len(self, n_tokens: int) -> int:
+        return ((int(n_tokens) - 1) // self.block) * self.block
+
+    def match_len(self, ids, lora: int = 0) -> int:
+        try:
+            frame = self._proxy._require_sync().call(
+                "match_len", ids=[int(t) for t in ids], lora=int(lora)
+            )
+            return int(frame.get("n", 0))
+        except Exception:  # tpuserve: ignore[TPU401] cold-cache degradation: an unreachable worker ships nothing and recomputes
+            return 0
+
+
+class _BundleShim:
+    """The slice of ``engine.bundle`` the serving front reads through the
+    group facade (vocab-size range checks); the real bundle stays in the
+    worker."""
+
+    def __init__(self, config: dict):
+        self.config = dict(config)
+
+
+class _PagedMarker:
+    """Truthy stand-in for ``engine.paged_cache`` on the parent side: the
+    group/router only None-check it; the real pool lives in the worker."""
+
+    pool = None
+
+    def __bool__(self) -> bool:
+        return True
+
+
+class ProcessEngineProxy:
+    """The engine surface ``EngineReplica``/group/router consume, served
+    over the worker's control channels. Constructed cold; ``attach``
+    wires the channels + hello config after the worker handshakes."""
+
+    def __init__(self, name: str, spec: dict):
+        self.replica_id = name
+        self._name = name
+        self._spec = spec
+        self._sync: Optional[_SyncChannel] = None
+        self._async: Optional[_AsyncChannel] = None
+        self._hello: dict = {}
+        self._stats: dict = {}
+        self._alive = False
+        self._stopped = False
+        self._pending = _QueueDepthShim(self)
+        self._prefix: Optional[_PrefixProbe] = None
+        self.paged_cache = None
+        self._adapter_index: Dict[str, int] = {}
+        self.adapter_names: List[str] = []
+        self.max_seq_len = 0
+        self.max_batch = 0
+        self.logprobs_k = 0
+        self.max_pending: Optional[int] = None
+        self.pid: Optional[int] = None
+        self.bundle: Optional[_BundleShim] = None
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, sync_chan: _SyncChannel, async_chan: _AsyncChannel,
+               hello: dict) -> None:
+        self._sync = sync_chan
+        self._async = async_chan
+        self._hello = dict(hello)
+        self.max_seq_len = int(hello.get("max_seq_len", 0))
+        self.max_batch = int(hello.get("max_batch", 0))
+        self.logprobs_k = int(hello.get("logprobs_k", 0))
+        self.max_pending = hello.get("max_pending")
+        self._adapter_index = {
+            str(k): int(v) for k, v in (hello.get("adapter_index") or {}).items()
+        }
+        self.adapter_names = [str(n) for n in (hello.get("adapters") or [])]
+        block = hello.get("prefix_block")
+        self._prefix = _PrefixProbe(self, int(block)) if block else None
+        self.paged_cache = _PagedMarker() if hello.get("paged") else None
+        self.bundle = _BundleShim(
+            {"vocab_size": int(hello.get("vocab_size", 0))}
+        )
+        self.pid = hello.get("pid")
+        self._stats = {}
+        self._alive = True
+
+    def _require_sync(self) -> _SyncChannel:
+        chan = self._sync
+        if chan is None:
+            raise EngineUnavailableError(
+                "replica {} worker is not connected".format(self._name)
+            )
+        return chan
+
+    def _require_async(self) -> _AsyncChannel:
+        chan = self._async
+        if chan is None:
+            raise EngineUnavailableError(
+                "replica {} worker is not connected".format(self._name)
+            )
+        return chan
+
+    def _note_pong(self, pong: dict) -> None:
+        self._stats = dict(pong)
+        self._alive = True
+
+    # -- readiness + router-consumed state ----------------------------------
+
+    @property
+    def is_ready(self) -> bool:
+        chan = self._async
+        return (
+            not self._stopped
+            and self._alive
+            and chan is not None
+            and not chan.dead
+        )
+
+    @property
+    def active_slots(self) -> int:
+        return int(self._stats.get("active_slots", 0))
+
+    def _brownout_snapshot(self) -> dict:
+        return {"stage": int(self._stats.get("brownout_stage", 0))}
+
+    def _slot_lora(self, request) -> int:
+        # mirror of LLMEngineCore._slot_lora against the hello's registry
+        return self._adapter_index.get(request.adapter or "", 0)
+
+    # -- request path -------------------------------------------------------
+
+    def validate(self, request) -> None:
+        payload = _req_to_wire(request)  # raises the named guided error
+        self._require_sync().call("validate", req=payload)
+
+    def check_admission(self, request, reserve: int = 0) -> None:
+        payload = _req_to_wire(request)
+        self._require_sync().call(
+            "check_admission", req=payload, reserve=int(reserve)
+        )
+
+    async def generate(self, request) -> AsyncIterator[int]:
+        payload = _req_to_wire(request)
+        chan = self._require_async()
+        fid, q = chan.submit("generate", req=payload)
+        request.prompt_len = len(request.prompt_ids)
+        cancel_sent = False
+        finished = False
+        try:
+            while True:
+                try:
+                    frame = await asyncio.to_thread(q.get, True, 0.5)
+                except _queue.Empty:
+                    if request.cancelled and not cancel_sent:
+                        chan.notify("cancel", gen=fid)
+                        cancel_sent = True
+                    if chan.dead:
+                        finished = True
+                        raise EngineUnavailableError(
+                            "replica {} worker lost mid-stream".format(
+                                self._name
+                            )
+                        )
+                    continue
+                if "tok" in frame:
+                    if frame.get("first"):
+                        request.first_token_at = time.time()
+                    request.produced += 1
+                    yield int(frame["tok"])
+                elif "end" in frame:
+                    end = frame.get("end") or {}
+                    request.produced = int(end.get("produced", request.produced))
+                    if request.logprobs is not None:
+                        request.logprob_entries.extend(
+                            end.get("logprob_entries") or []
+                        )
+                    finished = True
+                    return
+                elif "err" in frame:
+                    finished = True
+                    raise _err_from_dict(frame["err"])
+        finally:
+            chan.finish(fid)
+            if not finished and not cancel_sent:
+                # consumer stopped early (GeneratorExit): free the worker's
+                # slot + KV pages promptly, same contract as request.cancel
+                chan.notify("cancel", gen=fid)
+
+    def receive_shipment(self, prompt_ids, lora: int = 0) -> dict:
+        try:
+            frame = self._require_sync().call(
+                "receive_shipment",
+                ids=[int(t) for t in prompt_ids],
+                lora=int(lora),
+            )
+            return dict(frame.get("result") or {})
+        except EngineUnavailableError as ex:
+            # the group treats a failed receive as re-route-or-recompute;
+            # a dead worker must degrade the same way, not raise
+            return {"status": "failed", "reason": str(ex)}
+
+    def score_prompt(self, prompt_ids, adapter: Optional[str] = None):
+        frame = self._require_sync().call(
+            "score_prompt",
+            ids=[int(t) for t in prompt_ids],
+            adapter=adapter,
+        )
+        return frame.get("result")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def warmup_rpc(self, full: bool) -> dict:
+        frame = await self._require_async().call(
+            "warmup", timeout=900.0, full=bool(full)
+        )
+        return dict(frame.get("result") or {})
+
+    async def wait_drained(self, timeout: float = 30.0) -> None:
+        chan = self._async
+        if chan is None or chan.dead:
+            return
+        try:
+            await chan.call("drain", timeout=timeout + 10.0, timeout_s=timeout)
+        except EngineUnavailableError:
+            return
+
+    def stop(self) -> None:
+        self._stopped = True
+        chan = self._async
+        if chan is not None and not chan.dead:
+            chan.notify("exit")
+
+    # -- observability ------------------------------------------------------
+
+    def _process_block(self) -> dict:
+        return {
+            "backend": "process",
+            "pid": self.pid,
+            "alive": self._alive,
+            "heartbeat": dict(self._stats),
+        }
+
+    def health(self) -> dict:
+        try:
+            frame = self._require_sync().call("health")
+            out = dict(frame.get("health") or {})
+        except Exception as ex:  # tpuserve: ignore[TPU401] a dead worker still gets a health row — that row IS the diagnostic
+            out = {"ready": False, "error": str(ex)}
+        out["process"] = self._process_block()
+        return out
+
+    def lifecycle_stats(self) -> dict:
+        try:
+            frame = self._require_sync().call("lifecycle")
+            out = dict(frame.get("stats") or {})
+        except Exception:  # tpuserve: ignore[TPU401] scrape path: a dead worker exports an empty block, not a scrape failure
+            out = {}
+        out["process"] = self._process_block()
+        return out
+
+
+# -- the supervised replica -------------------------------------------------
+
+
+class _ReplicaShim:
+    """Fault-match carrier for the ``replica.proc.crash`` seam: the
+    supervisor has no request in hand, so the replica INDEX rides as the
+    shim prompt (the router ejection seam's convention) — ``match_token:
+    1`` kills exactly worker r1."""
+
+    def __init__(self, index: int):
+        self.prompt_ids = [int(index)]
+
+
+class ProcessEngineReplica:
+    """An ``EngineReplica``-shaped ring member whose engine is a supervised
+    worker subprocess. Import note: this class deliberately does NOT
+    subclass ``EngineReplica`` — importing llm.replica pulls the engine
+    (and jax) into the worker bootstrap path before
+    ``configure_process_devices`` can run; the replica surface is small
+    and duck-typed everywhere (router + group consume properties only).
+    ``tests/test_process_replica.py`` pins the shared surface."""
+
+    __guarded_by__ = {"_lock": ("_proc", "_restarts_left")}
+    __affine_to__ = {"worker": ("_hb_misses",)}
+    __acquires__ = {
+        "_spawn": {
+            "resource": "replica.worker_proc",
+            "releases": ("_reap", "stop"),
+            "drops": (),
+            "static": False,
+            "receivers": ("self", "replica", "supervisor"),
+        },
+    }
+
+    def __init__(
+        self,
+        index: int,
+        spec: dict,
+        control: ProcessFleetControl,
+        *,
+        warmup_mode: str = "off",
+        heartbeat_interval: float = 0.5,
+        heartbeat_misses: int = 4,
+        max_restarts: int = 1,
+        startup_timeout: float = _DEFAULT_STARTUP_TIMEOUT,
+    ):
+        if warmup_mode not in ("off", "startup", "full"):
+            raise ValueError(
+                "replica warmup mode must be off/startup/full: got {!r}"
+                .format(warmup_mode)
+            )
+        self.index = int(index)
+        self.name = "r{}".format(index)
+        if spec.get("name") != self.name:
+            raise ValueError(
+                "worker spec name {!r} does not match ring slot {!r}"
+                .format(spec.get("name"), self.name)
+            )
+        self._spec = dict(spec)
+        self._control = control
+        self._warmup_mode = warmup_mode
+        self.warmed = warmup_mode == "off"
+        self.warmed_full = False
+        self.warm_result = {"requests": 0, "cow_buckets": 0}
+        self._warm_task: Optional[asyncio.Task] = None
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._restarts_left = int(max_restarts)
+        self._hb_misses = 0
+        self._hb_interval = float(heartbeat_interval)
+        self._hb_limit = int(heartbeat_misses)
+        self._startup_timeout = float(startup_timeout)
+        self.restarts = 0
+        self.engine = ProcessEngineProxy(self.name, self._spec)
+        self._supervisor: Optional[threading.Thread] = None
+        self._spawn()
+
+    # -- process lifecycle --------------------------------------------------
+
+    def _spawn(self) -> None:
+        env = dict(os.environ)
+        env.update({str(k): str(v) for k, v in (self._spec.get("env") or {}).items()})
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "clearml_serving_tpu.serving.process_replica",
+                "--spec", self._spec["spec_path"],
+            ],
+            env=env,
+        )
+        if _ledger.armed():
+            _ledger.acquire("replica.worker_proc", key=self.name, domain=self)
+        with self._lock:
+            self._proc = proc
+
+    def complete_startup(self) -> None:
+        """Block until the worker handshakes, then start supervision.
+        Separate from ``__init__`` so a fleet builder spawns every worker
+        first and overlaps their (expensive) engine bootstraps."""
+        self._attach_worker()
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True,
+            name="proc-replica-{}-supervisor".format(self.name),
+        )
+        self._supervisor.start()
+
+    def _attach_worker(self) -> None:
+        # chunked wait so a worker that dies during bootstrap (bad preset,
+        # import error) fails the builder in ~1s, not after the full
+        # startup timeout
+        deadline = time.monotonic() + self._startup_timeout
+        while True:
+            with self._lock:
+                proc = self._proc
+            if proc is not None and proc.poll() is not None:
+                raise EngineUnavailableError(
+                    "replica {} worker exited with rc={} before "
+                    "handshaking".format(self.name, proc.returncode)
+                )
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # one last zero-ish wait so wait_for raises the named error
+                remaining = 0.001
+            try:
+                slot = self._control.wait_for(
+                    self.name, min(1.0, max(0.001, remaining))
+                )
+                break
+            except EngineUnavailableError:
+                if deadline - time.monotonic() <= 0:
+                    raise
+        sync_sock, _ = slot["sync"]
+        async_sock, aframe = slot["async"]
+        self.engine.attach(
+            _SyncChannel(sync_sock, self.name),
+            _AsyncChannel(async_sock, self.name),
+            aframe.get("hello") or {},
+        )
+
+    def _reap(self) -> None:
+        with self._lock:
+            proc = self._proc
+            self._proc = None
+        for chan in (self.engine._sync, self.engine._async):
+            if chan is not None:
+                chan.close()
+        if proc is None:
+            return
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+        if _ledger.armed():
+            _ledger.release("replica.worker_proc", key=self.name, domain=self)
+
+    def _supervise(self) -> None:
+        """Heartbeat + crash supervision (dedicated daemon thread):
+        liveness feeds ``is_ready`` (the router's ejection input), a dead
+        or wedged worker gets the bounded restart-with-rewarm, and the
+        ``replica.proc.crash`` chaos seam SIGKILLs for real."""
+        while True:
+            time.sleep(self._hb_interval)
+            if self.engine._stopped:
+                self._shutdown_worker()
+                return
+            with self._lock:
+                proc = self._proc
+            if proc is not None and proc.poll() is not None:
+                if not self._maybe_restart(
+                    "exit code {}".format(proc.returncode)
+                ):
+                    return
+                continue
+            try:
+                faults.fire("replica.proc.crash", _ReplicaShim(self.index))
+            except faults.InjectedFault:
+                logger.warning(
+                    "replica %s: injected crash — SIGKILLing worker pid %s",
+                    self.name, self.engine.pid,
+                )
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                continue  # next tick takes the dead-process branch
+            chan = self.engine._async
+            if chan is None or chan.dead:
+                self._hb_misses += 1
+            else:
+                try:
+                    frame = chan.call_blocking(
+                        "ping", timeout=max(2.0, 4 * self._hb_interval)
+                    )
+                except Exception:  # tpuserve: ignore[TPU401] a failed ping IS the signal — counted against the miss budget below
+                    self._hb_misses += 1
+                else:
+                    self.engine._note_pong(frame.get("pong") or {})
+                    self._hb_misses = 0
+                    continue
+            if self._hb_misses >= self._hb_limit:
+                self.engine._alive = False
+                if proc is not None and proc.poll() is None:
+                    logger.error(
+                        "replica %s: %d missed heartbeats — killing wedged "
+                        "worker pid %s", self.name, self._hb_misses,
+                        self.engine.pid,
+                    )
+                    proc.kill()
+                if not self._maybe_restart("missed heartbeats"):
+                    return
+
+    def _maybe_restart(self, why: str) -> bool:
+        """Bounded restart-with-rewarm. Returns False when supervision
+        should end (budget exhausted, stop requested, restart failed) —
+        the proxy stays not-ready and the router keeps the slot ejected."""
+        self.engine._alive = False
+        self._reap()
+        if self.engine._stopped:
+            return False
+        with self._lock:
+            budget = self._restarts_left
+            if budget > 0:
+                self._restarts_left = budget - 1
+        if budget <= 0:
+            logger.error(
+                "replica %s worker died (%s); restart budget exhausted — "
+                "ejected for good", self.name, why,
+            )
+            return False
+        logger.warning(
+            "replica %s worker died (%s); restarting (%d restart(s) left)",
+            self.name, why, budget - 1,
+        )
+        # the warmup gate closes BEFORE the new worker serves: re-admission
+        # to the ring re-runs the same run_warmup gate as first entry
+        self.invalidate_warm()
+        try:
+            self._spawn()
+            self._attach_worker()
+        except Exception as ex:  # tpuserve: ignore[TPU401] a failed restart ends supervision with the slot ejected; the error is the log line
+            logger.error("replica %s restart failed: %s", self.name, ex)
+            return False
+        self._hb_misses = 0
+        self.restarts += 1
+        return True
+
+    def _shutdown_worker(self) -> None:
+        with self._lock:
+            proc = self._proc
+        if proc is not None:
+            try:
+                proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+        self._reap()
+
+    # -- EngineReplica surface (router + group consume) ---------------------
+
+    @property
+    def engine_ready(self) -> bool:
+        return bool(self.engine.is_ready)
+
+    @property
+    def serving_ready(self) -> bool:
+        return self.engine_ready and self.warmed
+
+    @property
+    def warming(self) -> bool:
+        return self._warm_task is not None and not self._warm_task.done()
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self.engine._pending.qsize())
+
+    @property
+    def brownout_stage(self) -> int:
+        snap = self.engine._brownout_snapshot()
+        return int((snap or {}).get("stage", 0))
+
+    def invalidate_warm(self) -> None:
+        if self._warmup_mode != "off":
+            self.warmed = False
+            self.warmed_full = False
+
+    def begin_warm(self) -> None:
+        if self.warmed or self.warming or not self.engine_ready:
+            return
+        if self._warmup_mode == "off":
+            self.warmed = True
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._warm_task = loop.create_task(self.ensure_warm())
+
+    async def ensure_warm(self, full: Optional[bool] = None) -> None:
+        """The warmup gate, RPC'd: the worker runs the exact same
+        ``run_warmup`` sweep (and fences its own compile sentry on a full
+        pass); the gate state machine up here is verbatim EngineReplica."""
+        if full is None:
+            full = self._warmup_mode == "full"
+        try:
+            self.warm_result = await self.engine.warmup_rpc(full=bool(full))
+        except Exception as ex:  # tpuserve: ignore[TPU401] warmup is best-effort by contract; failure falls back to lazy compiles and is logged
+            logger.warning(
+                "replica %s process warmup failed (will serve with lazy "
+                "compiles): %s", self.name, ex,
+            )
+        self.warmed = True
+        self.warmed_full = self.warmed_full or bool(full)
+
+    def health(self) -> dict:
+        out = self.engine.health()
+        out["replica"] = self.name
+        out["ring_state"] = (
+            "ready" if self.serving_ready
+            else ("warming" if self.warming else "ejected")
+        )
+        return out
+
+
+# -- fleet construction -----------------------------------------------------
+
+
+class _FleetRuntime:
+    """What the parent must tear down after the workers: the control
+    listener, the supervisor threads, and the socket/spec directory."""
+
+    def __init__(self, base_dir: str, control: ProcessFleetControl,
+                 replicas: List[ProcessEngineReplica]):
+        self.base_dir = base_dir
+        self.control = control
+        self.replicas = replicas
+
+    def close(self) -> None:
+        deadline = time.monotonic() + 20.0
+        for replica in self.replicas:
+            thread = replica._supervisor
+            if thread is not None and thread.is_alive():
+                thread.join(timeout=max(0.1, deadline - time.monotonic()))
+            # a supervisor that already exited (restart budget burned)
+            # leaves the reap to us
+            replica._reap()
+        self.control.close()
+        shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+def build_process_fleet(
+    model: dict,
+    engine_cfg: dict,
+    n_replicas: int,
+    *,
+    roles: Optional[List[str]] = None,
+    warmup_mode: str = "startup",
+    affinity_blocks: int = 4,
+    spill_queue_depth: Optional[int] = None,
+    spill_brownout_stage: int = 2,
+    fleet_shed_stage: int = 3,
+    kv_transport_pages: Optional[int] = None,
+    cpu_devices: Optional[int] = None,
+    heartbeat_interval: float = 0.5,
+    heartbeat_misses: int = 4,
+    max_restarts: int = 1,
+    startup_timeout: float = _DEFAULT_STARTUP_TIMEOUT,
+    env: Optional[dict] = None,
+):
+    """Build a ``ReplicaGroup`` whose members are worker subprocesses.
+
+    ``model`` is the preset spec workers rebuild from (``{"arch",
+    "config", "seed"}`` — config must include ``preset``; identical params
+    everywhere follows from the identical seed). ``engine_cfg`` is the
+    JSON-safe ``LLMEngineCore`` kwargs dict. Disaggregated ``roles`` wire
+    the workers' KV endpoints together over the socket slab transport
+    (llm/kv_wire.py) — ``engine.kv.ship``/``engine.kv.receive`` seams and
+    mailbox semantics are identical to the in-process fleet, so the chaos
+    suite runs unchanged against this backend."""
+    from ..llm.replica import ReplicaGroup
+
+    n_replicas = int(n_replicas)
+    if n_replicas < 1:
+        raise ValueError("a process fleet needs at least one replica")
+    if roles is not None and len(roles) != n_replicas:
+        raise ValueError(
+            "engine.replica_roles lists {} roles for {} replicas".format(
+                len(roles), n_replicas
+            )
+        )
+    names = ["r{}".format(i) for i in range(n_replicas)]
+    base_dir = tempfile.mkdtemp(prefix="tpuserve-proc-")
+    control = ProcessFleetControl(base_dir)
+    disaggregated = roles is not None and any(r != "hybrid" for r in roles)
+    wire_addrs: Dict[str, str] = {}
+    wire_capacity = 0
+    if disaggregated:
+        page_size = int(engine_cfg.get("page_size") or 16)
+        per_seq = -(-int(engine_cfg.get("max_seq_len", 2048)) // page_size)
+        wire_capacity = int(kv_transport_pages or max(64, 4 * per_seq))
+        wire_addrs = {
+            name: "unix:{}".format(os.path.join(base_dir, name + ".kv.sock"))
+            for name in names
+        }
+    replicas: List[ProcessEngineReplica] = []
+    try:
+        for i, name in enumerate(names):
+            spec = {
+                "name": name,
+                "index": i,
+                "role": roles[i] if roles is not None else "hybrid",
+                "control": control.path,
+                "cohosted_procs": n_replicas,
+                "model": dict(model),
+                "engine": dict(engine_cfg),
+                "devices": (
+                    {"cpu_devices": int(cpu_devices)} if cpu_devices else {}
+                ),
+                "kv_wire": (
+                    {
+                        "bind": wire_addrs[name],
+                        "peers": wire_addrs,
+                        "capacity_pages": wire_capacity,
+                    }
+                    if disaggregated else None
+                ),
+                "env": dict(env or {}),
+            }
+            path = os.path.join(base_dir, name + ".spec.json")
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(spec, fh)
+            spec["spec_path"] = path
+            replicas.append(
+                ProcessEngineReplica(
+                    i, spec, control,
+                    warmup_mode=warmup_mode,
+                    heartbeat_interval=heartbeat_interval,
+                    heartbeat_misses=heartbeat_misses,
+                    max_restarts=max_restarts,
+                    startup_timeout=startup_timeout,
+                )
+            )
+        # all workers boot in parallel; handshakes complete in ring order
+        for replica in replicas:
+            replica.complete_startup()
+    except BaseException:
+        for replica in replicas:
+            replica.engine._stopped = True
+            replica._reap()
+        control.close()
+        shutil.rmtree(base_dir, ignore_errors=True)
+        raise
+    hello = replicas[0].engine._hello
+    role_map = (
+        {name: role for name, role in zip(names, roles)}
+        if roles is not None else None
+    )
+    group = ReplicaGroup.__new__(ReplicaGroup)
+    group._finish_init(
+        replicas,
+        block=int(hello.get("prefix_block") or 64),
+        role_map=role_map,
+        disaggregated=disaggregated,
+        transport=None,  # worker-owned socket endpoints; stats via workers
+        spill_queue_depth=spill_queue_depth,
+        spill_brownout_stage=spill_brownout_stage,
+        fleet_shed_stage=fleet_shed_stage,
+        affinity_blocks=affinity_blocks,
+        replica_backend="process",
+        max_pending_hint=hello.get("max_pending"),
+        runtime=_FleetRuntime(base_dir, control, replicas),
+    )
+    return group
+
+
+# ===========================================================================
+# worker side
+# ===========================================================================
+
+
+def _worker_hello(engine) -> dict:
+    prefix = getattr(engine, "_prefix", None)
+    return {
+        "pid": os.getpid(),
+        "vocab_size": int(engine.bundle.config.get("vocab_size", 0)),
+        "max_seq_len": int(engine.max_seq_len),
+        "max_batch": int(engine.max_batch),
+        "logprobs_k": int(engine.logprobs_k),
+        "max_pending": engine.max_pending,
+        "prefix_block": int(prefix.block) if prefix is not None else None,
+        "paged": engine.paged_cache is not None,
+        "adapters": list(engine.adapter_names),
+        "adapter_index": dict(getattr(engine, "_adapter_index", {})),
+    }
+
+
+def _sync_dispatch(engine, frame: dict, loop) -> dict:
+    """One sync-channel op against the live engine. Loop-affine entry
+    points (admission, validation) are re-dispatched onto the worker's
+    event loop; the rest are the engine's documented any-thread surface
+    (receive_shipment, the scrape-path snapshots)."""
+    op = frame.get("op")
+    if op == "check_admission":
+        request = _req_from_wire(frame["req"])
+
+        async def _admit():
+            engine.check_admission(request, reserve=int(frame.get("reserve", 0)))
+
+        asyncio.run_coroutine_threadsafe(_admit(), loop).result(
+            timeout=_SYNC_CALL_TIMEOUT
+        )
+        return {"ok": 1}
+    if op == "validate":
+        request = _req_from_wire(frame["req"])
+
+        async def _validate():
+            engine.validate(request)
+
+        asyncio.run_coroutine_threadsafe(_validate(), loop).result(
+            timeout=_SYNC_CALL_TIMEOUT
+        )
+        return {"ok": 1}
+    if op == "match_len":
+        prefix = getattr(engine, "_prefix", None)
+        n = 0
+        if prefix is not None:
+            n = prefix.match_len(
+                [int(t) for t in frame.get("ids") or []],
+                int(frame.get("lora", 0)),
+            )
+        return {"n": int(n)}
+    if op == "receive_shipment":
+        res = engine.receive_shipment(
+            [int(t) for t in frame.get("ids") or []],
+            int(frame.get("lora", 0)),
+        )
+        return {"result": _jsonable(res)}
+    if op == "health":
+        return {"health": _jsonable(engine.health())}
+    if op == "lifecycle":
+        return {"stats": _jsonable(engine.lifecycle_stats())}
+    if op == "score_prompt":
+        res = engine.score_prompt(
+            [int(t) for t in frame.get("ids") or []], frame.get("adapter")
+        )
+        return {"result": _jsonable(res)}
+    raise ValueError("unknown sync op {!r}".format(op))
+
+
+def _sync_serve(engine, sock: socket.socket, loop) -> None:
+    while True:
+        frame = _recv_frame_sock(sock)
+        if frame is None:
+            return
+        try:
+            out = _sync_dispatch(engine, frame, loop)
+        except BaseException as ex:  # noqa: BLE001 - every error crosses the wire by name
+            out = {"err": _err_to_dict(ex)}
+        out["id"] = frame.get("id", 0)
+        try:
+            _send_frame_sock(sock, out)
+        except OSError:
+            return
+
+
+async def _recv_frame_stream(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        head = await reader.readexactly(4)
+        (length,) = struct.unpack("<I", head)
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        return None
+
+
+async def _send_frame_stream(writer: asyncio.StreamWriter, wlock: asyncio.Lock,
+                             obj: dict) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    async with wlock:
+        writer.write(struct.pack("<I", len(payload)) + payload)
+        await writer.drain()
+
+
+async def _gen_task(engine, writer, wlock, fid: int, payload: dict,
+                    live: dict) -> None:
+    try:
+        request = _req_from_wire(payload)
+    except Exception as ex:  # noqa: BLE001 - a bad frame is the caller's error, reported on its id
+        await _send_frame_stream(writer, wlock, {"id": fid, "err": _err_to_dict(ex)})
+        return
+    live[fid] = request
+    try:
+        first = True
+        async for token in engine.generate(request):
+            await _send_frame_stream(
+                writer, wlock,
+                {"id": fid, "tok": int(token), "first": first},
+            )
+            first = False
+        end = {"produced": request.produced, "prompt_len": request.prompt_len}
+        if request.logprobs is not None:
+            end["logprob_entries"] = _jsonable(request.logprob_entries)
+        await _send_frame_stream(writer, wlock, {"id": fid, "end": end})
+    except BaseException as ex:  # noqa: BLE001 - stream errors cross the wire by name
+        try:
+            await _send_frame_stream(
+                writer, wlock, {"id": fid, "err": _err_to_dict(ex)}
+            )
+        except (ConnectionError, OSError):
+            pass
+    finally:
+        live.pop(fid, None)
+
+
+async def _warmup_task(engine, writer, wlock, fid: int, full: bool) -> None:
+    from ..llm import compile_sentry
+    from ..llm.warmup import run_warmup
+
+    try:
+        result = await run_warmup(engine, full=full, fence=False)
+        fenced = False
+        if full and compile_sentry.enabled():
+            # each worker fences its OWN process-wide sentry — the group's
+            # single-fence contract, scoped to the process that compiled
+            compile_sentry.get().fence()
+            fenced = True
+        result = dict(result)
+        result["fenced"] = fenced
+        await _send_frame_stream(
+            writer, wlock, {"id": fid, "result": _jsonable(result)}
+        )
+    except BaseException as ex:  # noqa: BLE001 - warmup failures report to the parent's gate, which logs + degrades
+        await _send_frame_stream(
+            writer, wlock, {"id": fid, "err": _err_to_dict(ex)}
+        )
+
+
+async def _drain_task(engine, writer, wlock, fid: int, timeout: float) -> None:
+    try:
+        await engine.wait_drained(timeout=timeout)
+        await _send_frame_stream(writer, wlock, {"id": fid, "ok": 1})
+    except BaseException as ex:  # noqa: BLE001
+        await _send_frame_stream(
+            writer, wlock, {"id": fid, "err": _err_to_dict(ex)}
+        )
+
+
+async def _worker_serve(engine, spec: dict) -> None:
+    loop = asyncio.get_running_loop()
+    control_path = spec["control"]
+    reader, writer = await asyncio.open_unix_connection(control_path)
+    wlock = asyncio.Lock()
+    await _send_frame_stream(
+        writer, wlock,
+        {
+            "channel": "async",
+            "name": spec["name"],
+            "hello": _worker_hello(engine),
+        },
+    )
+    sync_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sync_sock.connect(control_path)
+    _send_frame_sock(sync_sock, {"channel": "sync", "name": spec["name"]})
+    threading.Thread(
+        target=_sync_serve, args=(engine, sync_sock, loop), daemon=True,
+        name="worker-sync-serve",
+    ).start()
+    live: Dict[int, Any] = {}
+    while True:
+        frame = await _recv_frame_stream(reader)
+        if frame is None:
+            break  # parent died: no orphaned decode loops
+        op = frame.get("op")
+        fid = frame.get("id")
+        if op == "ping":
+            snap = engine._brownout_snapshot()
+            pong = {
+                "ready": bool(engine.is_ready),
+                "queue_depth": int(engine._pending.qsize()),
+                "brownout_stage": int((snap or {}).get("stage", 0)),
+                "active_slots": int(engine.active_slots),
+            }
+            await _send_frame_stream(writer, wlock, {"id": fid, "pong": pong})
+        elif op == "generate":
+            asyncio.ensure_future(
+                _gen_task(engine, writer, wlock, fid, frame.get("req") or {}, live)
+            )
+        elif op == "cancel":
+            request = live.get(frame.get("gen"))
+            if request is not None:
+                request.cancel()
+        elif op == "warmup":
+            asyncio.ensure_future(
+                _warmup_task(engine, writer, wlock, fid, bool(frame.get("full")))
+            )
+        elif op == "drain":
+            asyncio.ensure_future(
+                _drain_task(
+                    engine, writer, wlock, fid,
+                    float(frame.get("timeout_s", 30.0)),
+                )
+            )
+        elif op == "exit":
+            break
+        elif fid is not None:
+            await _send_frame_stream(
+                writer, wlock,
+                {"id": fid, "err": {"name": "ValueError",
+                                    "message": "unknown op {!r}".format(op)}},
+            )
+    engine.stop()
+    try:
+        writer.close()
+    except OSError:
+        pass
+
+
+def _worker_main(spec_path: str) -> int:
+    with open(spec_path, "r", encoding="utf-8") as fh:
+        spec = json.load(fh)
+    for key, value in (spec.get("env") or {}).items():
+        os.environ[str(key)] = str(value)
+    # host-tier "auto" sizing divides MemAvailable by the co-hosted worker
+    # count (docs/kv_tiering.md) — the fleet builder knows how many of us
+    # share this host
+    os.environ.setdefault(
+        "TPUSERVE_COHOSTED_PROCS", str(spec.get("cohosted_procs", 1))
+    )
+    # device mesh BEFORE anything touches jax.devices()
+    from ..parallel.multihost import configure_process_devices
+
+    configure_process_devices(spec.get("devices"))
+    import jax
+
+    from .. import models
+    from ..llm.engine import LLMEngineCore
+
+    model = spec["model"]
+    bundle = models.build_model(
+        model.get("arch", "llama"), dict(model.get("config") or {})
+    )
+    params = bundle.init(jax.random.PRNGKey(int(model.get("seed", 0))))
+    engine = LLMEngineCore(
+        bundle, params, replica=spec["name"], **dict(spec.get("engine") or {})
+    )
+    wire = spec.get("kv_wire")
+    role = spec.get("role", "hybrid")
+    if wire:
+        from ..llm.kv_wire import SocketSlabTransport
+
+        endpoint = SocketSlabTransport(
+            spec["name"], wire["bind"], dict(wire["peers"]),
+            capacity_pages=int(wire.get("capacity_pages", 1024)),
+        )
+        engine.attach_kv_transport(endpoint, role=role)
+    elif role != "hybrid":
+        engine.attach_kv_transport(None, role=role)
+    asyncio.run(_worker_serve(engine, spec))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="tpu-serving process-replica worker (internal entry "
+        "point: spawned by ProcessEngineReplica)"
+    )
+    parser.add_argument("--spec", required=True, help="worker spec JSON path")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s worker %(name)s %(levelname)s %(message)s",
+    )
+    return _worker_main(args.spec)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,7 +134,13 @@ class ReplicaRouter:
         # for a prefill-capable one. An empty role class degrades to
         # hybrid routing (any ring member serves) instead of failing.
         roles: Optional[Dict[str, str]] = None,
+        # which replica backend the fleet runs on ("inprocess" = N engines
+        # on this heap, "process" = supervised worker subprocesses —
+        # serving/process_replica.py); exported in stats() for the
+        # router_replica_backend info gauge (docs/replication.md)
+        replica_backend: str = "inprocess",
     ):
+        self.replica_backend = str(replica_backend)
         self._replicas = list(replicas)
         self._names = [r.name for r in self._replicas]
         if len(set(self._names)) != len(self._names):
@@ -359,6 +365,7 @@ class ReplicaRouter:
         stages = {r.name: r.brownout_stage for r in self._replicas}
         return {
             "replicas": len(self._replicas),
+            "replica_backend": self.replica_backend,
             "ring_size": len(self._ring_members),
             "ring": self.ring(),
             "roles": dict(self._roles),

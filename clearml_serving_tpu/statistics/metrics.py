@@ -217,6 +217,11 @@ class PrefixCacheCollector(_KeyedCollector):
         )
         any_pool = False
         for key, (cache, pool, model, replica) in entries.items():
+            if not hasattr(cache, "stats"):
+                # routing-only prefix probes (process-backend proxies)
+                # have no stats surface; a single bad entry must not
+                # poison the whole registry scrape
+                continue
             stats = cache.stats()
             by_tier = stats.get("hits_by_tier") or {
                 "hbm": stats.get("hits", 0)
@@ -482,6 +487,21 @@ class EngineLifecycleCollector(_KeyedCollector):
             "admission found the whole storable prefix resident / all "
             "judged shipped requests (clean-path bound: >= 0.9)",
         )
+        # socket KV-wire backend (llm/kv_wire.py, docs/disaggregation.md):
+        # bytes actually framed onto the wire and the send->ack round trip
+        # — absent entirely on the in-heap shared-slab backend, so the
+        # series' existence also answers "which transport is this fleet on"
+        kv_ship_wire_bytes = CounterMetricFamily(
+            p + "_kv_ship_wire_bytes",
+            "KV shipment bytes crossing the socket transport, by "
+            "direction (out = framed + sent, in = received + decoded); "
+            "only exported by the socket wire backend",
+        )
+        kv_ship_rtt_ms = HistogramMetricFamily(
+            p + "_kv_ship_rtt_ms",
+            "socket KV-wire send round-trip time (ms): frame write to "
+            "receiver ack, per shipment",
+        )
         # compile-surface discipline (docs/static_analysis.md TPU6xx): XLA
         # compilations observed by the compile sentry, split at the warmup
         # fence — phase="serve" must stay 0 on a zero-recompile-certified
@@ -550,6 +570,7 @@ class EngineLifecycleCollector(_KeyedCollector):
         any_kv_pool = False
         any_kv_tier = False
         any_kv_ship = False
+        any_kv_wire = False
         any_slo = False
         any_ragged = False
         any_compile = False
@@ -590,6 +611,16 @@ class EngineLifecycleCollector(_KeyedCollector):
                     hist(kv_ship_ms, key, s, snap, direction="in")
                 if kv_ship.get("hit_rate") is not None:
                     gauge(kv_ship_hit_rate, key, s, kv_ship["hit_rate"])
+                wire = (kv_ship.get("transport") or {}).get("wire") or {}
+                if wire:
+                    any_kv_wire = True
+                    counter(kv_ship_wire_bytes, key, s,
+                            wire.get("bytes_sent", 0), direction="out")
+                    counter(kv_ship_wire_bytes, key, s,
+                            wire.get("bytes_received", 0), direction="in")
+                    snap = wire.get("rtt_ms")
+                    if snap:
+                        hist(kv_ship_rtt_ms, key, s, snap)
             ledger_block = s.get("ledger") or {}
             if ledger_block:
                 any_ledger = True
@@ -723,6 +754,9 @@ class EngineLifecycleCollector(_KeyedCollector):
             yield kv_ship_pages
             yield kv_ship_ms
             yield kv_ship_hit_rate
+        if any_kv_wire:
+            yield kv_ship_wire_bytes
+            yield kv_ship_rtt_ms
         if any_compile:
             yield xla_compiles
             yield xla_compile_ms
@@ -805,6 +839,15 @@ class ReplicaRouterCollector(_KeyedCollector):
             "requests shed at the router door by the fleet-wide brownout, "
             "by priority class", labels=["model", "class"],
         )
+        # info gauge (value always 1): which replica backend the fleet
+        # runs on — "inprocess" (N engines on one heap) or "process"
+        # (supervised worker subprocesses, serving/process_replica.py)
+        replica_backend = GaugeMetricFamily(
+            p + "_replica_backend",
+            "replica backend info gauge: value 1 on the series whose "
+            "backend label names the fleet's backend (inprocess | process)",
+            labels=["model", "backend"],
+        )
         for key, provider in providers.items():
             try:
                 s = provider() or {}
@@ -820,6 +863,10 @@ class ReplicaRouterCollector(_KeyedCollector):
                 ring_size.add_metric([model], s["ring_size"])
             if "replicas" in s:
                 replicas.add_metric([model], s["replicas"])
+            if s.get("replica_backend"):
+                replica_backend.add_metric(
+                    [model, str(s["replica_backend"])], 1
+                )
             for name, routes in (s.get("requests") or {}).items():
                 for route, v in (routes or {}).items():
                     requests.add_metric(
@@ -852,6 +899,7 @@ class ReplicaRouterCollector(_KeyedCollector):
         yield role_members
         yield fleet_stage
         yield fleet_sheds
+        yield replica_backend
 
 
 

@@ -642,12 +642,18 @@ def test_guarded_by_declarations_match_project_registry():
         PagePool,
     )
     from clearml_serving_tpu.llm.kv_transport import SharedSlabTransport
+    from clearml_serving_tpu.llm.kv_wire import SocketSlabTransport
     from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+    from clearml_serving_tpu.serving.process_replica import (
+        ProcessEngineReplica,
+        _SyncChannel,
+    )
     from clearml_serving_tpu.serving.replica_router import ReplicaRouter
 
     for cls in (PagePool, PagedKVCache, RadixPrefixCache,
                 _ClassedPendingQueue, HostKVTier, ReplicaRouter,
-                SharedSlabTransport):
+                SharedSlabTransport, SocketSlabTransport, _SyncChannel,
+                ProcessEngineReplica):
         for lock, attrs in cls.__guarded_by__.items():
             for attr in attrs:
                 entry = rules_locks.PROJECT_REGISTRY.get(attr)
@@ -663,9 +669,13 @@ def test_affine_declarations_match_affinity_registry():
     from clearml_serving_tpu.serving.model_request_processor import (
         ModelRequestProcessor,
     )
+    from clearml_serving_tpu.serving.process_replica import (
+        ProcessEngineReplica,
+    )
     from clearml_serving_tpu.serving.replica_router import ReplicaRouter
 
-    for cls in (LLMEngineCore, ModelRequestProcessor, ReplicaRouter):
+    for cls in (LLMEngineCore, ModelRequestProcessor, ReplicaRouter,
+                ProcessEngineReplica):
         for thread, attrs in cls.__affine_to__.items():
             for attr in attrs:
                 entry = rules_threads.AFFINITY_REGISTRY.get(attr)

@@ -366,10 +366,14 @@ def test_acquires_declarations_match_lifecycle_registry():
     from clearml_serving_tpu.llm.engine import LLMEngineCore
     from clearml_serving_tpu.llm.kv_cache import HostKVTier, PagePool
     from clearml_serving_tpu.llm.kv_transport import SharedSlabTransport
+    from clearml_serving_tpu.llm.kv_wire import SocketSlabTransport
     from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+    from clearml_serving_tpu.serving.process_replica import (
+        ProcessEngineReplica,
+    )
 
     for cls in (PagePool, HostKVTier, RadixPrefixCache, SharedSlabTransport,
-                LLMEngineCore):
+                SocketSlabTransport, ProcessEngineReplica, LLMEngineCore):
         for method, decl in cls.__acquires__.items():
             entries = rules_lifecycle.LIFECYCLE_REGISTRY.get(method)
             assert entries, (

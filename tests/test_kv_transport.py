@@ -323,6 +323,33 @@ def test_auto_host_tier_sizes_clamped_from_meminfo(parts, monkeypatch):
     engine2.stop()
 
 
+def test_auto_host_tier_divides_by_cohosted_worker_count(parts, monkeypatch):
+    """The half-of-MemAvailable heuristic is PER HOST: process-backend
+    workers co-hosted on one machine (TPUSERVE_COHOSTED_PROCS,
+    serving/process_replica.py) must split the budget, or an N-worker
+    fleet over-commits host RAM N times over."""
+    from clearml_serving_tpu.llm.kv_cache import cohosted_worker_processes
+
+    bundle, params = parts
+    monkeypatch.delenv("TPUSERVE_COHOSTED_PROCS", raising=False)
+    assert cohosted_worker_processes() == 1
+    solo = _auto_engine(bundle, params, monkeypatch, 4 << 30)
+    solo_pages = solo.paged_cache.host_tier.num_pages
+    solo.stop()
+
+    monkeypatch.setenv("TPUSERVE_COHOSTED_PROCS", "2")
+    assert cohosted_worker_processes() == 2
+    duo = _auto_engine(bundle, params, monkeypatch, 4 << 30)
+    assert duo.paged_cache.host_tier.num_pages == solo_pages // 2
+    duo.stop()
+
+    # garbage / sub-1 values degrade to the solo divisor, never crash
+    monkeypatch.setenv("TPUSERVE_COHOSTED_PROCS", "banana")
+    assert cohosted_worker_processes() == 1
+    monkeypatch.setenv("TPUSERVE_COHOSTED_PROCS", "0")
+    assert cohosted_worker_processes() == 1
+
+
 def test_auto_host_tier_probe_failure_fails_construction(parts, monkeypatch):
     from clearml_serving_tpu.llm import kv_cache
 
@@ -448,7 +475,8 @@ def test_router_rejects_bad_roles():
 # -- group end-to-end (real engines, int8 paged KV) ---------------------------
 
 
-def _make_group(bundle, params, n=2, roles=None, **overrides):
+def _make_group(bundle, params, n=2, roles=None, kv_backend="shared",
+                **overrides):
     cfg = dict(
         max_batch=2, max_seq_len=128, prefill_buckets=[16, 32, 64],
         eos_token_id=None, decode_steps=1, cache_mode="paged",
@@ -460,7 +488,16 @@ def _make_group(bundle, params, n=2, roles=None, **overrides):
         LLMEngineCore(bundle, params, replica="r{}".format(i), **cfg)
         for i in range(n)
     ]
-    return ReplicaGroup(engines, roles=roles)
+    return ReplicaGroup(engines, roles=roles, kv_transport_backend=kv_backend)
+
+
+# both KV transport backends run the SAME chaos contracts (the socket
+# variants are tier-2: they re-build full engine fleets, so they ride the
+# `slow` lane alongside the process-backend suite)
+BACKENDS = [
+    "shared",
+    pytest.param("socket", marks=pytest.mark.slow),
+]
 
 
 def _conv(seed, n=44):
@@ -517,6 +554,25 @@ def test_group_roles_validation():
     with pytest.raises(ValueError, match="paged"):
         ReplicaGroup(dense, roles=["prefill", "decode"])
     for e in engines + dense:
+        e.stop()
+
+
+def test_group_rejects_unknown_kv_transport_backend(parts):
+    bundle, params = parts
+    engines = [
+        LLMEngineCore(
+            bundle, params, replica="r{}".format(i), max_batch=1,
+            max_seq_len=32, prefill_buckets=[16], cache_mode="paged",
+            page_size=16, prefix_cache=16, prefix_block=16,
+        )
+        for i in range(2)
+    ]
+    with pytest.raises(ValueError, match="kv_transport_backend"):
+        ReplicaGroup(
+            engines, roles=["prefill", "decode"],
+            kv_transport_backend="carrier-pigeon",
+        )
+    for e in engines:
         e.stop()
 
 
@@ -589,10 +645,12 @@ def test_warm_turns_skip_the_ship_leg(parts):
     group.stop()
 
 
-def test_ship_fault_falls_back_to_decode_recompute(parts):
+@pytest.mark.parametrize("kv_backend", BACKENDS)
+def test_ship_fault_falls_back_to_decode_recompute(parts, kv_backend):
     """Chaos: an injected ``engine.kv.ship`` fault at the prefill commit
     drops the shipment leak-free; the stream completes byte-identically
-    via decode-side recompute and the drop is counted."""
+    via decode-side recompute and the drop is counted. Runs identically
+    over the in-process slab and the socket wire."""
     bundle, params = parts
 
     async def scenario():
@@ -603,7 +661,8 @@ def test_ship_fault_falls_back_to_decode_recompute(parts):
         mono.stop()
 
         group = _make_group(
-            bundle, params, n=2, roles=["prefill", "decode"]
+            bundle, params, n=2, roles=["prefill", "decode"],
+            kv_backend=kv_backend,
         )
         faults.configure([
             {"point": "engine.kv.ship", "action": "raise"},
@@ -626,10 +685,12 @@ def test_ship_fault_falls_back_to_decode_recompute(parts):
     group.stop()
 
 
-def test_receive_fault_reroutes_to_hybrid(parts):
+@pytest.mark.parametrize("kv_backend", BACKENDS)
+def test_receive_fault_reroutes_to_hybrid(parts, kv_backend):
     """Chaos: an injected ``engine.kv.receive`` fault on the decode
     replica re-routes the stream to a hybrid-capable sibling (recompute
-    there), leak-free and byte-identical."""
+    there), leak-free and byte-identical. Runs identically over the
+    in-process slab and the socket wire."""
     bundle, params = parts
 
     async def scenario():
@@ -640,7 +701,8 @@ def test_receive_fault_reroutes_to_hybrid(parts):
         mono.stop()
 
         group = _make_group(
-            bundle, params, n=3, roles=["prefill", "decode", "hybrid"]
+            bundle, params, n=3, roles=["prefill", "decode", "hybrid"],
+            kv_backend=kv_backend,
         )
         # route the stream at a DECODE-role member so the receive runs
         # there (a hybrid pick would already be the fallback)
@@ -673,11 +735,15 @@ def test_receive_fault_reroutes_to_hybrid(parts):
     group.stop()
 
 
-def test_kill_prefill_replica_mid_ship_resumes_on_remaining(parts):
+@pytest.mark.parametrize("kv_backend", BACKENDS)
+def test_kill_prefill_replica_mid_ship_resumes_on_remaining(parts, kv_backend):
     """Chaos: the prefill replica dies mid-ship-leg — the stream still
     completes on the decode replica (hybrid degradation: it prefills for
     itself), zero page leaks; once the prefill replica is gone entirely,
-    later requests skip the leg (pick_prefill returns None)."""
+    later requests skip the leg (pick_prefill returns None). Runs
+    identically over the in-process slab and the socket wire; the
+    process-backend variant (real SIGKILL of the worker) lives in
+    tests/test_process_replica.py."""
     bundle, params = parts
 
     async def scenario():
@@ -688,7 +754,8 @@ def test_kill_prefill_replica_mid_ship_resumes_on_remaining(parts):
         mono.stop()
 
         group = _make_group(
-            bundle, params, n=2, roles=["prefill", "decode"]
+            bundle, params, n=2, roles=["prefill", "decode"],
+            kv_backend=kv_backend,
         )
         # leg 1: the prefill replica fails MID-ADMISSION (raise inside
         # its prefill worker); the leg is best-effort so the stream

@@ -1,0 +1,319 @@
+"""Wire-format KV transport tests (llm/kv_wire.py,
+docs/disaggregation.md "process backends"): to_wire/from_wire byte
+round-trips for bf16 and int8+scale-row slabs, every header/geometry/
+dtype/key inconsistency raising the named WireFormatError with a
+leak-free drop, truncated-frame receives mapping to drop-to-recompute,
+and the socket endpoint keeping SharedSlabTransport's bounded-mailbox
+semantics (overflow drops oldest, re-ship replaces, consume-once)."""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from clearml_serving_tpu.llm import faults, lifecycle_ledger
+from clearml_serving_tpu.llm.kv_transport import KVShipment
+from clearml_serving_tpu.llm.kv_wire import (
+    MAGIC,
+    SocketSlabFabric,
+    WireFormatError,
+    shipment_from_wire,
+    shipment_to_wire,
+)
+
+try:
+    import ml_dtypes
+
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - present in the jax image
+    BF16 = None
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    lifecycle_ledger.get().reset(strict=False)
+    yield
+    faults.clear()
+    lifecycle_ledger.get().reset(strict=False)
+    lifecycle_ledger.disarm()
+
+
+def _shipment(pages=2, page_size=4, dtype=np.int8, quantized=False, **kw):
+    shape = (pages, 3, 2, page_size, 8)
+    rng = np.random.default_rng(7)
+    hk = rng.integers(-100, 100, size=shape).astype(dtype)
+    hv = rng.integers(-100, 100, size=shape).astype(dtype)
+    kwargs = dict(
+        key=kw.pop("key", b"k" * 16), src="r0",
+        prefix_len=pages * page_size, page_size=page_size, lora=0,
+        hk=hk, hv=hv,
+    )
+    if quantized:
+        kwargs["hk_scale"] = rng.random(shape[:-1]).astype(np.float32)
+        kwargs["hv_scale"] = rng.random(shape[:-1]).astype(np.float32)
+    kwargs.update(kw)
+    return KVShipment(**kwargs)
+
+
+def _assert_roundtrip(shipment):
+    frame = shipment.to_wire()
+    got = KVShipment.from_wire(frame)
+    assert got.key == shipment.key
+    assert got.src == shipment.src
+    assert got.prefix_len == shipment.prefix_len
+    assert got.page_size == shipment.page_size
+    assert got.lora == shipment.lora
+    # byte-identity, not just value-equality: the slabs re-attach verbatim
+    assert got.hk.dtype == shipment.hk.dtype
+    assert got.hk.tobytes() == shipment.hk.tobytes()
+    assert got.hv.tobytes() == shipment.hv.tobytes()
+    if shipment.quantized:
+        assert got.quantized
+        assert got.hk_scale.tobytes() == shipment.hk_scale.tobytes()
+        assert got.hv_scale.tobytes() == shipment.hv_scale.tobytes()
+    else:
+        assert not got.quantized
+    return got
+
+
+# -- codec round-trips --------------------------------------------------------
+
+
+@pytest.mark.skipif(BF16 is None, reason="ml_dtypes not installed")
+def test_roundtrip_bf16():
+    shape = (2, 3, 2, 4, 8)
+    rng = np.random.default_rng(3)
+    ship = _shipment(
+        hk=rng.standard_normal(shape).astype(BF16),
+        hv=rng.standard_normal(shape).astype(BF16),
+    )
+    got = _assert_roundtrip(ship)
+    assert got.hk.dtype == BF16
+
+
+def test_roundtrip_int8_with_scale_rows():
+    got = _assert_roundtrip(_shipment(quantized=True))
+    assert got.hk_scale.dtype == np.float32
+    assert got.hk_scale.shape == got.hk.shape[:4]
+
+
+def test_roundtrip_survives_non_contiguous_slabs():
+    ship = _shipment(pages=4)
+    view = KVShipment(
+        key=ship.key, src=ship.src, prefix_len=2 * ship.page_size,
+        page_size=ship.page_size, lora=0,
+        hk=ship.hk[::2], hv=ship.hv[::2],
+    )
+    got = _assert_roundtrip(view)
+    assert got.pages == 2
+
+
+def test_unsupported_dtype_rejected_at_encode():
+    with pytest.raises(WireFormatError, match="dtype"):
+        shipment_to_wire(_shipment(dtype=np.float64))
+
+
+# -- header/geometry validation ----------------------------------------------
+
+
+def _tamper(frame, **hdr_changes):
+    """Re-frame with selected header fields overwritten (body verbatim)."""
+    import json
+
+    version, flags, hdr_len = struct.unpack("<BBH", frame[4:8])
+    header = json.loads(frame[8:8 + hdr_len].decode("utf-8"))
+    header.update(hdr_changes)
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return (MAGIC + struct.pack("<BBH", version, flags, len(hdr)) + hdr
+            + bytes(frame[8 + hdr_len:]))
+
+
+def test_truncated_frame_rejected():
+    frame = shipment_to_wire(_shipment())
+    with pytest.raises(WireFormatError, match="truncated"):
+        shipment_from_wire(frame[: len(frame) - 10])
+    with pytest.raises(WireFormatError, match="truncated"):
+        shipment_from_wire(frame[:6])
+
+
+def test_bad_magic_and_version_rejected():
+    frame = shipment_to_wire(_shipment())
+    with pytest.raises(WireFormatError, match="magic"):
+        shipment_from_wire(b"NOPE" + bytes(frame[4:]))
+    with pytest.raises(WireFormatError, match="version"):
+        shipment_from_wire(MAGIC + b"\x63" + bytes(frame[5:]))
+
+
+def test_trailing_garbage_rejected():
+    frame = shipment_to_wire(_shipment())
+    with pytest.raises(WireFormatError, match="trailing"):
+        shipment_from_wire(frame + b"\x00\x01")
+
+
+def test_geometry_lies_rejected():
+    frame = shipment_to_wire(_shipment(page_size=4))
+    # header page_size disagreeing with the slab page dim
+    with pytest.raises(WireFormatError, match="page_size"):
+        shipment_from_wire(_tamper(frame, page_size=8))
+    # prefix_len outside the shipped pages
+    with pytest.raises(WireFormatError, match="prefix_len"):
+        shipment_from_wire(_tamper(frame, prefix_len=999))
+    with pytest.raises(WireFormatError, match="prefix_len"):
+        shipment_from_wire(_tamper(frame, prefix_len=0))
+
+
+def test_dtype_lies_rejected():
+    ship = _shipment()
+    frame = shipment_to_wire(ship)
+    import json
+
+    version, flags, hdr_len = struct.unpack("<BBH", frame[4:8])
+    header = json.loads(frame[8:8 + hdr_len].decode("utf-8"))
+    # unsupported dtype name in a section descriptor
+    header["sections"][0]["dtype"] = "float64"
+    with pytest.raises(WireFormatError, match="dtype"):
+        shipment_from_wire(_tamper(frame, sections=header["sections"]))
+    # hk/hv dtype mismatch (both individually supported)
+    mixed = KVShipment(
+        key=b"k" * 16, src="r0", prefix_len=8, page_size=4, lora=0,
+        hk=ship.hk.astype(np.float16), hv=ship.hv,
+    )
+    with pytest.raises(WireFormatError, match="dtype mismatch"):
+        shipment_from_wire(shipment_to_wire(mixed))
+
+
+def test_key_lies_rejected():
+    frame = shipment_to_wire(_shipment())
+    with pytest.raises(WireFormatError, match="key"):
+        shipment_from_wire(_tamper(frame, key="abcd"))  # 2 bytes, not 16
+    with pytest.raises(WireFormatError, match="header"):
+        shipment_from_wire(_tamper(frame, key="zz" * 16))  # not hex
+
+
+def test_scale_row_lies_rejected():
+    ship = _shipment(quantized=True)
+    bad = KVShipment(
+        key=ship.key, src=ship.src, prefix_len=ship.prefix_len,
+        page_size=ship.page_size, lora=0, hk=ship.hk, hv=ship.hv,
+        hk_scale=ship.hk_scale[:1], hv_scale=ship.hv_scale,
+    )
+    with pytest.raises(WireFormatError, match="hk_scale"):
+        shipment_from_wire(shipment_to_wire(bad))
+    f16 = KVShipment(
+        key=ship.key, src=ship.src, prefix_len=ship.prefix_len,
+        page_size=ship.page_size, lora=0, hk=ship.hk, hv=ship.hv,
+        hk_scale=ship.hk_scale.astype(np.float16), hv_scale=ship.hv_scale,
+    )
+    with pytest.raises(WireFormatError, match="float32"):
+        shipment_from_wire(shipment_to_wire(f16))
+
+
+# -- socket endpoint semantics ------------------------------------------------
+
+
+def _fabric_pair(**kw):
+    fabric = SocketSlabFabric(**kw)
+    return fabric, fabric.register("r0"), fabric.register("r1")
+
+
+def test_socket_send_recv_is_consume_once():
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        assert r1.recv(b"k" * 16) is None
+        assert r0.send("r1", _shipment()) is True
+        got = r1.recv(b"k" * 16)
+        assert got is not None and got.pages == 2
+        assert got.hk.tobytes() == _shipment().hk.tobytes()
+        assert r1.recv(b"k" * 16) is None          # consumed
+        wire = r0.stats()["wire"]
+        assert wire["frames_sent"] == 1 and wire["bytes_sent"] > 0
+        assert wire["rtt_ms"]["count"] == 1
+        rwire = r1.stats()["wire"]
+        assert rwire["frames_received"] == 1 and rwire["bytes_received"] > 0
+    finally:
+        fabric.close()
+
+
+def test_socket_mailbox_overflow_drops_oldest():
+    fabric, r0, r1 = _fabric_pair(capacity_pages=4)
+    try:
+        assert r0.send("r1", _shipment(key=b"a" * 16))
+        assert r0.send("r1", _shipment(key=b"b" * 16))
+        assert r0.send("r1", _shipment(key=b"c" * 16))
+        assert r1.recv(b"a" * 16) is None          # oldest aged out
+        assert r1.recv(b"b" * 16) is not None
+        assert r1.recv(b"c" * 16) is not None
+    finally:
+        fabric.close()
+
+
+def test_socket_send_failure_paths_drop_to_recompute():
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        # unknown peer: counted drop, no raise
+        assert r0.send("rX", _shipment()) is False
+        assert r0.stats()["wire"]["send_failures"] == 1
+        # injected transport.wire.send fault: counted drop
+        faults.configure([
+            {"point": "transport.wire.send", "action": "raise", "times": 1},
+        ])
+        assert r0.send("r1", _shipment()) is False
+        assert r0.stats()["wire"]["send_failures"] == 2
+        # next send succeeds (fault exhausted, connection re-established)
+        assert r0.send("r1", _shipment()) is True
+    finally:
+        fabric.close()
+
+
+def test_socket_recv_fault_nacks_sender_and_drops_leak_free():
+    lifecycle_ledger.arm(strict=False)
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        faults.configure([
+            {"point": "transport.wire.recv", "action": "raise", "times": 1},
+        ])
+        # the receiver drops the decoded frame before any attach and
+        # nacks; the sender maps the nack to a counted drop
+        assert r0.send("r1", _shipment()) is False
+        assert r1.stats()["wire"]["recv_failures"] == 1
+        assert r1.recv(b"k" * 16) is None
+        assert r0.stats()["wire"]["send_failures"] == 1
+        # leak-free: no transport.shipment units outstanding anywhere
+        outstanding = lifecycle_ledger.get().outstanding()
+        assert outstanding.get("transport.shipment", 0) == 0
+        # and the wire recovers on the next send
+        assert r0.send("r1", _shipment()) is True
+        assert r1.recv(b"k" * 16) is not None
+    finally:
+        fabric.close()
+    assert lifecycle_ledger.get().outstanding().get("transport.wire.conn", 0) == 0
+
+
+def test_truncated_frame_on_the_wire_drops_to_recompute():
+    """A sender that dies mid-frame (short body vs its length prefix)
+    must not wedge or corrupt the receiver: the read times out, the
+    partial frame is dropped, and nothing lands in the mailbox."""
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        addr = r1.bind[len("unix:"):]
+        r1.recv_deadline_s = 0.2
+        raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        raw.connect(addr)
+        frame = shipment_to_wire(_shipment())
+        # claim the full frame but ship half, then hang up
+        raw.sendall(struct.pack("<I", len(frame)) + frame[: len(frame) // 2])
+        raw.close()
+        deadline = time.monotonic() + 5.0
+        while (r1.stats()["wire"]["recv_failures"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert r1.stats()["wire"]["recv_failures"] == 1
+        assert r1.recv(b"k" * 16) is None
+        # the endpoint still works for well-formed frames afterwards
+        assert r0.send("r1", _shipment()) is True
+        assert r1.recv(b"k" * 16) is not None
+    finally:
+        fabric.close()

@@ -256,3 +256,102 @@ def test_disagg_artifact_internal_consistency():
     assert head["goodput_tok_s_mono"] == a1["goodput_tok_s"]
     assert head["goodput_tok_s_hybrid"] == a2["goodput_tok_s"]
     assert head["goodput_tok_s_disagg"] == a3["goodput_tok_s"]
+
+
+# -- process-backend fleet artifact (benchmarks/PROCESS_FLEET_cpu.json,
+# docs/replication.md "process backends"; regenerated by
+# `python benchmarks/process_fleet_loadtest.py --smoke`) ---------------------
+
+from benchmarks import process_fleet_loadtest  # noqa: E402
+
+
+def _process_artifact():
+    return json.loads(
+        (REPO / "benchmarks" / "PROCESS_FLEET_cpu.json").read_text()
+    )
+
+
+def test_process_artifact_schema():
+    row = _process_artifact()
+    assert process_fleet_loadtest.SCHEMA_KEYS <= set(row), (
+        "missing top-level keys"
+    )
+    assert row["metric"].startswith("llm_process_fleet_loadtest")
+    assert row["replicas"] == 2
+    assert len(row["arms"]) == 2
+    for arm in row["arms"]:
+        assert process_fleet_loadtest.ARM_KEYS <= set(arm), arm.keys()
+    assert [a["name"] for a in row["arms"]] == ["mono", "proc_disagg"]
+    assert [a["backend"] for a in row["arms"]] == ["inprocess", "process"]
+    assert row["arms"][1]["roles"] == ["prefill", "decode"]
+    assert row["trace"]["seeded_requests"] >= 1
+    assert process_fleet_loadtest.HEADLINE_KEYS <= set(row["headline"])
+
+
+def test_process_artifact_headline_passes():
+    """The committed artifact must carry a PASSING ISSUE-19 headline:
+    ship hit rate >= 0.9 across a REAL socket hop between two worker
+    processes, streams byte-identical to the mono in-process arm (greedy
+    AND seeded), zero sanitizer violations, zero ownership-ledger leaks,
+    zero post-warmup compiles under the strict sentry, and zero implicit
+    transfers — the worker-side certificates read over the health RPC."""
+    row = _process_artifact()
+    head = row["headline"]
+    assert head["ship_ok"] is True
+    assert head["ship_hit_rate"] >= head["ship_hit_bound"] == 0.9
+    assert head["streams_identical"] is True
+    assert head["seeded_identical"] is True
+    assert head["post_warmup_compiles"] == 0
+    assert head["compile_sentry_mode"] == "strict"
+    assert head["sanitizer_checks"] > 0
+    assert head["sanitizer_violations"] == 0
+    assert head["ledger_leaks"] == 0
+    assert head["implicit_transfers"] == 0
+    # the clean-path run restarted nothing: every ship leg crossed a live
+    # socket, and real bytes moved
+    assert head["worker_restarts"] == 0
+    assert head["wire_bytes_total"] > 0
+    assert head["wire_frames_total"] > 0
+
+
+def test_process_artifact_internal_consistency():
+    row = _process_artifact()
+    a1, a2 = row["arms"]
+    head = row["headline"]
+    # both arms replayed the same trace, and nothing was lost
+    assert a1["requests"] == a2["requests"]
+    for arm in row["arms"]:
+        assert arm["completed"] + arm["shed"] + arm["errors"] == arm["requests"]
+        assert arm["completed"] == arm["requests"], "clean path must complete"
+        assert arm["sanitizer_violations"] == 0
+        assert arm["ledger_leaks"] == 0
+        assert arm["implicit_transfers"] == 0
+        assert arm["post_warmup_compiles"] == 0
+    # only the process arm carries socket traffic; the mono baseline has
+    # no transport at all
+    assert a1["wire"] is None
+    assert a2["wire"] is not None
+    assert head["wire_bytes_total"] == a2["wire"]["bytes_total"]
+    assert head["wire_frames_total"] == a2["wire"]["frames_total"]
+    ship = a2["kv_ship"]
+    assert ship is not None
+    assert head["ship_hit_rate"] == ship["hit_rate"]
+    assert ship["hits"] > 0 and ship["receives"] > 0
+    assert ship["ships"] == ship["receives"], "every SENT shipment lands"
+    assert ship["receive_failures"] == 0
+    assert head["ship_legs"] == ship["ships"]
+    assert head["ship_drops"] == ship["ship_drops"]
+    # drop-to-recompute restated: a send-side drop is counted, never
+    # raised, and the leg still completes — so every completed leg
+    # (landed or dropped) is judged exactly once at decode admission
+    assert (
+        ship["hits"] + ship["recomputes"]
+        == ship["ships"] + ship["ship_drops"]
+    )
+    # byte-identity columns restate the arms
+    assert a1["streams_identical_to_mono"] is None
+    assert a2["streams_identical_to_mono"] is True
+    assert a2["seeded_identical_to_mono"] is True
+    assert head["goodput_tok_s_mono"] == a1["goodput_tok_s"]
+    assert head["goodput_tok_s_proc"] == a2["goodput_tok_s"]
+    assert head["worker_restarts"] == a2["restarts"]

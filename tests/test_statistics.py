@@ -190,6 +190,31 @@ def test_prefix_cache_collector_exports_live_counters():
     assert hits_val("m2") == 0
 
 
+def test_prefix_cache_collector_skips_stats_less_probes():
+    """The process backend's routing-only prefix probe has no ``stats``
+    surface (the real cache lives in the worker; its stats come back over
+    the health RPC). A registered stats-less entry must not poison the
+    whole registry scrape — and real entries keep exporting."""
+    from prometheus_client import generate_latest
+
+    from clearml_serving_tpu.llm.prefix_cache import RadixPrefixCache
+    from clearml_serving_tpu.serving.process_replica import _PrefixProbe
+    from clearml_serving_tpu.statistics.metrics import register_prefix_cache
+
+    probe = _PrefixProbe(object(), block=16)
+    assert not hasattr(probe, "stats")  # the premise this test pins
+
+    registry = CollectorRegistry()
+    cache = RadixPrefixCache(block=2)
+    register_prefix_cache(cache, registry=registry, key="real")
+    register_prefix_cache(probe, registry=registry, key="worker@r0",
+                          model="worker", replica="r0")
+
+    blob = generate_latest(registry).decode()  # must not raise
+    assert 'model="real"' in blob
+    assert "worker@r0" not in blob
+
+
 def test_engine_lifecycle_collector_exports_counters_and_gauges():
     """Shed/deadline/watchdog counters and the queue-depth / active-slot
     gauges scrape live from a provider callable (the engine's
@@ -1343,3 +1368,174 @@ def test_prefix_cache_collector_replica_label_split():
     assert val("llm_prefix_cache_misses_total", model="fleet@r0") is None
     # the legacy entry's series identity is untouched
     assert val("llm_prefix_cache_misses_total", model="plain") == 1
+
+
+def test_engine_kv_wire_metrics_exported():
+    """engine_kv_ship_wire_bytes_total{direction} + engine_kv_ship_rtt_ms
+    from a synthetic lifecycle provider whose kv_ship block carries the
+    socket transport's wire sub-block (llm/kv_wire.py); providers on the
+    in-heap backend (no wire block) must not emit the families at all."""
+    from clearml_serving_tpu.statistics.metrics import (
+        register_engine_lifecycle,
+    )
+
+    stats = {
+        "model": "m1",
+        "replica": "r1",
+        "queue_depth": 0,
+        "active_slots": 0,
+        "ready": 1,
+        "kv_ship": {
+            "role": "decode",
+            "ships": 1, "ship_pages": 2, "ship_drops": 0,
+            "receives": 1, "receive_pages": 2,
+            "receive_empty": 0, "receive_failures": 0,
+            "hits": 1, "recomputes": 0, "hit_rate": 1.0,
+            "ship_ms": {"buckets": [1, 5], "counts": [1, 0, 0],
+                        "sum_ms": 0.5},
+            "receive_ms": {"buckets": [1, 5], "counts": [1, 0, 0],
+                           "sum_ms": 0.5},
+            "transport": {
+                "backend": "socket_slab",
+                "wire": {
+                    "bytes_sent": 4096, "bytes_received": 1024,
+                    "frames_sent": 2, "frames_received": 1,
+                    "send_failures": 0, "recv_failures": 0,
+                    "rtt_ms": {"buckets": [1.0, 5.0],
+                               "counts": [1, 1, 0], "sum_ms": 3.5,
+                               "count": 2},
+                },
+            },
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(
+            name, {"model": "m1", "replica": "r1", **labels}
+        )
+
+    assert val("engine_kv_ship_wire_bytes_total", direction="out") == 4096
+    assert val("engine_kv_ship_wire_bytes_total", direction="in") == 1024
+    assert val("engine_kv_ship_rtt_ms_count") == 2
+    assert val("engine_kv_ship_rtt_ms_sum") == 3.5
+    assert val("engine_kv_ship_rtt_ms_bucket", le="1.0") == 1
+    assert val("engine_kv_ship_rtt_ms_bucket", le="5.0") == 2
+    assert val("engine_kv_ship_rtt_ms_bucket", le="+Inf") == 2
+    # counters move on the next scrape
+    stats["kv_ship"]["transport"]["wire"]["bytes_sent"] = 8192
+    assert val("engine_kv_ship_wire_bytes_total", direction="out") == 8192
+    # a shared-slab provider (no wire block) does not emit the families
+    registry2 = CollectorRegistry()
+    shared = dict(stats)
+    shared["kv_ship"] = dict(stats["kv_ship"], transport={"backend": "shared_slab"})
+    register_engine_lifecycle(lambda: shared, registry=registry2, key="m1")
+    assert registry2.get_sample_value(
+        "engine_kv_ship_wire_bytes_total",
+        {"model": "m1", "replica": "r1", "direction": "out"},
+    ) is None
+
+
+def test_router_replica_backend_info_gauge():
+    """router_replica_backend{model,backend} = 1: the info-style gauge a
+    dashboard joins on to tell process fleets from in-process ones
+    (docs/replication.md)."""
+    from clearml_serving_tpu.statistics.metrics import register_replica_router
+
+    stats = {
+        "replicas": 2,
+        "ring_size": 2,
+        "replica_backend": "process",
+        "requests": {},
+    }
+    registry = CollectorRegistry()
+    register_replica_router(lambda: stats, registry=registry, key="m1")
+    assert registry.get_sample_value(
+        "router_replica_backend", {"model": "m1", "backend": "process"}
+    ) == 1
+    assert registry.get_sample_value(
+        "router_replica_backend", {"model": "m1", "backend": "inprocess"}
+    ) is None
+    # live: a (hypothetical) backend change moves the label on next scrape
+    stats["replica_backend"] = "inprocess"
+    assert registry.get_sample_value(
+        "router_replica_backend", {"model": "m1", "backend": "inprocess"}
+    ) == 1
+
+
+@pytest.mark.slow
+def test_socket_fleet_wire_metrics_end_to_end():
+    """End to end against a REAL prefill/decode group on the SOCKET
+    transport backend: after a disaggregated request ships over the wire,
+    the prefill replica exports wire bytes out + an RTT sample, the
+    decode replica exports wire bytes in, and the router carries the
+    backend info gauge."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+    from clearml_serving_tpu.llm.replica import ReplicaGroup
+    from clearml_serving_tpu.statistics.metrics import (
+        register_engine_lifecycle,
+        register_replica_router,
+    )
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engines = [
+        LLMEngineCore(
+            bundle, params, replica="r{}".format(i), max_batch=2,
+            max_seq_len=128, prefill_buckets=[32, 64], eos_token_id=None,
+            cache_mode="paged", page_size=16, prefix_cache=64,
+            prefix_block=16, num_pages=65,
+        )
+        for i in range(2)
+    ]
+    group = ReplicaGroup(
+        engines, roles=["prefill", "decode"], kv_transport_backend="socket"
+    )
+    try:
+        registry = CollectorRegistry()
+        for replica in group.replicas:
+
+            def provider(engine=replica.engine):
+                s = engine.lifecycle_stats()
+                s["model"] = "fleet"
+                return s
+
+            register_engine_lifecycle(
+                provider, registry=registry, key="fleet@" + replica.name
+            )
+        register_replica_router(
+            lambda: dict(group.router.stats(), model="fleet"),
+            registry=registry, key="fleet",
+        )
+
+        async def run():
+            conv = [(5 + i * 3) % 90 + 1 for i in range(40)]
+            request = GenRequest(prompt_ids=conv, max_new_tokens=2)
+            async for _ in group.generate(request):
+                pass
+            await group.wait_drained()
+
+        asyncio.run(run())
+
+        def val(name, **labels):
+            return registry.get_sample_value(
+                name, {"model": "fleet", **labels}
+            )
+
+        assert val("engine_kv_ship_wire_bytes_total", replica="r0",
+                   direction="out") > 0
+        assert val("engine_kv_ship_rtt_ms_count", replica="r0") >= 1
+        assert val("engine_kv_ship_wire_bytes_total", replica="r1",
+                   direction="in") > 0
+        assert val("engine_kv_ship_hit_rate", replica="r1") == 1.0
+        assert val("router_replica_backend", backend="inprocess") == 1
+    finally:
+        group.stop()
