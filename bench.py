@@ -866,6 +866,248 @@ def run_spec_row_ab(
     }
 
 
+def run_spec_tree_ab(
+    cfg: dict,
+    *,
+    spec_k: int = 4,
+    spec_ngram: int = 1,
+    spec_branch: int = 2,
+    batch: int = 3,
+    new_tokens: int = 64,
+    step_token_budget: int = 20,
+    max_seq_len: int = 256,
+    cache_mode: str = "paged",
+    page_size: int = 16,
+) -> dict:
+    """Draft-tree vs draft-chain verify rows at EQUAL verify budget
+    (docs/spec_decode_trees.md, ISSUE 20): three arms of the same greedy
+    workload on the ragged scheduler — no speculation, the n-gram CHAIN
+    proposer at k, and the n-gram FOREST proposer at the same k with up
+    to ``spec_branch`` root branches. Every verify row costs k+1 query
+    positions in both spec arms; the forest only re-shapes WHICH drafts
+    fill them. The headline is accepted decode tokens per ragged launch
+    (ragged_decode_tokens / ragged_steps over the measured pass): the
+    acceptance-rate gap closes exactly insofar as the tree arm commits
+    more tokens from the same launch budget. Streams must be
+    byte-identical across all three arms (greedy acceptance is
+    exact-match; speculation may never change output).
+
+    The workload is ambiguity-rich by construction: each prompt repeats
+    an n-gram context with TWO distinct continuations, so the
+    most-recent-match chain draft is sometimes wrong while an older
+    match carries the answer — the regime the forest's depth-1 siblings
+    exist for (``spec_ngram`` defaults to 1, where generated streams
+    keep re-visiting ambiguous contexts). On unambiguous history the
+    forest dedups to the chain drafts and the arms tie; do not expect a
+    gap on a clean cycling tail."""
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model("llama", cfg)
+    params = bundle.init(jax.random.PRNGKey(0))
+    # probed against llama-tiny's greedy continuations: each prompt's
+    # generated stream re-visits single-token contexts with more than one
+    # continuation in history (replaying both proposers offline over the
+    # streams shows the forest strictly ahead), without collapsing into a
+    # period-1 tail where both arms saturate and tie
+    seeds = [
+        [4, 288, 161, 312, 4, 288, 312, 161, 4, 288, 161, 312, 4, 288],
+        [5, 9, 3, 11, 5, 9, 3, 11, 5, 9, 3, 11, 5, 9],
+        [12, 4, 8, 21, 12, 4, 8, 21, 12, 4, 8, 21, 12, 4],
+    ]
+    prompts = [list(seeds[i % len(seeds)]) for i in range(batch)]
+
+    arm_kwargs = {
+        "none": {},
+        "chain": dict(speculation="ngram", spec_k=spec_k,
+                      spec_ngram=spec_ngram),
+        "tree": dict(speculation="ngram", spec_k=spec_k,
+                     spec_ngram=spec_ngram, spec_tree=True,
+                     spec_branch=spec_branch),
+    }
+
+    def measure(mode: str):
+        from clearml_serving_tpu.llm import compile_sentry
+
+        if compile_sentry.enabled():
+            # the sentry is process-wide: drop the previous arm's fence so
+            # this arm's warmup compiles count as warmup, not serving
+            compile_sentry.get().reset()
+        engine = LLMEngineCore(
+            bundle, params,
+            max_batch=batch, max_seq_len=max_seq_len, prefill_buckets=[16],
+            eos_token_id=None, decode_steps=4, scheduler="ragged",
+            step_token_budget=step_token_budget,
+            cache_mode=cache_mode, page_size=page_size, **arm_kwargs[mode],
+        )
+
+        async def group():
+            async def one(ids):
+                req = GenRequest(
+                    prompt_ids=list(ids), max_new_tokens=new_tokens,
+                    temperature=0.0,
+                )
+                return [t async for t in engine.generate(req)]
+
+            outs = await asyncio.gather(*(one(p) for p in prompts))
+            await engine.wait_drained()
+            return outs
+
+        async def warm():
+            # registry sweep (pins the per-arm compile surface, including
+            # the tree-arg kernel variant for the tree arm); the fence is
+            # set manually AFTER the trace pass below, so the MEASURED
+            # pass is what the strict sentry certifies compile-free
+            from clearml_serving_tpu.llm.warmup import run_warmup
+
+            return await run_warmup(engine, full=True, fence=False)
+
+        asyncio.run(warm())
+        asyncio.run(group())            # warmup pass: compiles every trace
+        if engine._compile_sentry is not None:
+            engine._compile_sentry.fence()
+        base = dict(engine.counters)
+        t0 = time.perf_counter()
+        outs = asyncio.run(group())
+        wall = time.perf_counter() - t0
+        launches = engine.counters["ragged_steps"] - base["ragged_steps"]
+        dec_tokens = (
+            engine.counters["ragged_decode_tokens"]
+            - base["ragged_decode_tokens"]
+        )
+        s = engine.lifecycle_stats()["ragged"]
+        row = {
+            "outs": outs,
+            "tok_s": round(sum(len(o) for o in outs) / wall, 2),
+            "ragged_launches": launches,
+            "ragged_decode_tokens": dec_tokens,
+        }
+        if mode != "none":
+            # pure-decode steps in the no-spec arm bypass the ragged
+            # mixed-launch path, so per-launch accounting only compares
+            # the two spec arms (whose verify rows always ride launches)
+            row["accepted_tokens_per_launch"] = round(
+                dec_tokens / max(1, launches), 3
+            )
+            row["dispatches_per_decode_token"] = round(
+                launches / max(1, dec_tokens), 3
+            )
+            row["spec_verify_rows"] = s["step_rows"]["spec_verify"]
+            snap = s["spec_acceptance"]
+            row["acceptance_mean"] = round(
+                snap["sum_ms"] / max(1, snap["count"]), 3
+            )
+            prop = s["spec_proposer"]
+            row["proposer"] = {
+                k: prop[k] for k in ("name", "proposed", "hit", "branched")
+                if k in prop
+            }
+        if mode == "tree":
+            snap = s["spec_tree_depth"]
+            row["accept_depth_mean"] = round(
+                snap["sum_ms"] / max(1, snap["count"]), 3
+            )
+            row["tree_fallbacks"] = s["spec_tree_fallbacks"]
+        # per-arm certification (the slo_loadtest pattern): the sanitizer
+        # is per-engine; the compile sentry is process-wide but reset at
+        # the top of the arm, so "serve" counts exactly the compiles the
+        # measured pass triggered past this arm's fence. In strict mode a
+        # violation raises mid-run — completing at all is the certificate.
+        sanitizer = engine._sanitizer
+        san = (
+            sanitizer.stats() if sanitizer is not None
+            else {"checks": 0, "failures": -1}
+        )
+        sentry = engine._compile_sentry
+        sen = (
+            sentry.stats_brief() if sentry is not None
+            else {"mode": "off", "serve": -1, "fenced": False}
+        )
+        row["certs"] = {
+            "sanitizer_checks": san.get("checks", 0),
+            "sanitizer_violations": san.get("failures", 0),
+            "post_warmup_compiles": sen.get("serve", -1),
+            "compile_sentry_mode": sen.get("mode", "off"),
+        }
+        engine.stop()
+        return row
+
+    none = measure("none")
+    chain = measure("chain")
+    tree = measure("tree")
+    identical = (
+        none.pop("outs") == chain.pop("outs") == tree.pop("outs")
+    )
+    # process-wide sentries (ownership ledger, sharding sentry) read ONCE
+    # after all three arms — their counts span the whole run, and strict
+    # mode already failed the run on the first violation
+    from clearml_serving_tpu.llm import lifecycle_ledger, sharding_sentry
+
+    ledger = lifecycle_ledger.arm() if lifecycle_ledger.enabled() else None
+    led = (
+        ledger.stats() if ledger is not None
+        else {"strict": False, "leaks": -1, "double_releases": -1}
+    )
+    shard = sharding_sentry.arm() if sharding_sentry.enabled() else None
+    shd = (
+        shard.stats_brief() if shard is not None
+        else {"strict": False, "implicit_transfers": -1,
+              "unplanned_reshards": -1}
+    )
+    arm_certs = [none["certs"], chain["certs"], tree["certs"]]
+    certs = {
+        "sanitizer_checks": sum(c["sanitizer_checks"] for c in arm_certs),
+        "sanitizer_violations": (
+            -1 if any(c["sanitizer_violations"] < 0 for c in arm_certs)
+            else sum(c["sanitizer_violations"] for c in arm_certs)
+        ),
+        "post_warmup_compiles": (
+            -1 if any(c["post_warmup_compiles"] < 0 for c in arm_certs)
+            else sum(c["post_warmup_compiles"] for c in arm_certs)
+        ),
+        "compile_sentry_mode": arm_certs[0]["compile_sentry_mode"],
+        "leaks": (
+            led.get("leaks", -1) + led.get("double_releases", 0)
+            if led.get("leaks", -1) >= 0 else -1
+        ),
+        "ledger_mode": (
+            "strict" if led.get("strict")
+            else ("count" if ledger is not None else "off")
+        ),
+        "implicit_transfers": shd.get("implicit_transfers", -1),
+        "unplanned_reshards": shd.get("unplanned_reshards", -1),
+        "shard_sentry_mode": (
+            "strict" if shd.get("strict")
+            else ("count" if shard is not None else "off")
+        ),
+    }
+    return {
+        "metric": "llm_spec_tree_ab",
+        # headline: the acceptance-gap close — extra committed tokens per
+        # launch the tree buys from the SAME k+1 verify budget
+        "value": round(
+            tree["accepted_tokens_per_launch"]
+            - chain["accepted_tokens_per_launch"], 3
+        ),
+        "unit": "accepted decode tokens per ragged launch, tree minus "
+                "chain at equal k+1 verify budget",
+        "no_spec": none,
+        "chain": chain,
+        "tree": tree,
+        "identical_tokens": identical,
+        "certs": certs,
+        "spec_k": spec_k,
+        "spec_branch": spec_branch,
+        "batch": batch,
+        "cache": cache_mode,
+        "cpus": os.cpu_count() or 1,
+    }
+
+
 def run_kv_tier_ab(
     cfg: dict,
     *,
@@ -1651,6 +1893,49 @@ def _ragged_ab_smoke() -> None:
     print(json.dumps(row))
 
 
+def _spec_tree_ab_smoke() -> None:
+    """CPU smoke for ``--spec-tree-ab`` (acceptance: byte-identical greedy
+    streams across the no-spec / chain / tree arms, and the tree arm's
+    accepted-tokens-per-launch STRICTLY above the chain arm at the same
+    k+1 verify budget — the ISSUE-20 headline). Updates
+    benchmarks/SPEC_TREE_AB_cpu.json (asserted by tier-1). Knobs:
+    BENCH_SPEC_TREE_K / BENCH_SPEC_TREE_BRANCH / BENCH_SPEC_TREE_BATCH /
+    BENCH_SPEC_TREE_TOKENS / BENCH_SPEC_TREE_BUDGET."""
+    # strict-sentry certification (the slo_loadtest pattern, forced not
+    # defaulted): the committed artifact's certs block claims 0 sanitizer
+    # violations / ledger leaks / post-warmup compiles / implicit
+    # transfers, and strict mode FAILS the run on any of them — so the
+    # artifact existing at all is the proof
+    os.environ["TPUSERVE_SANITIZE"] = "1"
+    os.environ["TPUSERVE_COMPILE_SENTRY"] = "strict"
+    os.environ["TPUSERVE_LEDGER"] = "strict"
+    os.environ["TPUSERVE_SHARD_SENTRY"] = "strict"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    row = run_spec_tree_ab(
+        {"preset": "llama-tiny", "dtype": "float32"},
+        spec_k=int(os.environ.get("BENCH_SPEC_TREE_K", 4)),
+        spec_branch=int(os.environ.get("BENCH_SPEC_TREE_BRANCH", 2)),
+        batch=int(os.environ.get("BENCH_SPEC_TREE_BATCH", 3)),
+        new_tokens=int(os.environ.get("BENCH_SPEC_TREE_TOKENS", 64)),
+        step_token_budget=int(os.environ.get("BENCH_SPEC_TREE_BUDGET", 20)),
+    )
+    row["metric"] += "_cpusmoke"
+    row["platform"] = "cpu"
+    artifact = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "SPEC_TREE_AB_cpu.json",
+    )
+    with open(artifact, "w") as f:
+        json.dump(row, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(row))
+
+
 def _kv_tier_ab_smoke() -> None:
     """CPU smoke for ``--kv-tier-ab`` (acceptance: byte-identical streams
     for a demoted-then-promoted run vs the always-resident warm hit under
@@ -1866,6 +2151,10 @@ if __name__ == "__main__":
         os.environ.get("BENCH_SCENARIO") == "ragged_ab"
     ):
         _ragged_ab_smoke()
+    elif "--spec-tree-ab" in sys.argv or (
+        os.environ.get("BENCH_SCENARIO") == "spec_tree_ab"
+    ):
+        _spec_tree_ab_smoke()
     elif "--kv-tier-ab" in sys.argv or (
         os.environ.get("BENCH_SCENARIO") == "kv_tier_ab"
     ):

@@ -327,6 +327,29 @@ def main() -> int:
         emit({"metric": "llm_kv_tier_ab", "error": repr(ex)[:300],
               "wall_s": round(time.time() - t7, 1)})
 
+    # -- phase 10: draft-tree vs draft-chain verify rows (ISSUE 20) ---------
+    # the acceptance-gap close on 8B decode shapes: the n-gram forest
+    # proposer's branched verify rows vs the single chain at the SAME k+1
+    # verify budget — accepted decode tokens per ragged launch is the
+    # headline, and on chip each accepted token amortizes the ~90 ms
+    # tunnel dispatch the verify row already paid for
+    t8 = time.time()
+    try:
+        row = bench.run_spec_tree_ab(
+            {"preset": "llama3-8b", "dtype": "bfloat16", "kv_quant": "int8"},
+            spec_k=4, spec_branch=2, batch=8, new_tokens=96,
+            step_token_budget=64, max_seq_len=1024, cache_mode="paged",
+            page_size=32,
+        )
+        row["platform"] = "tpu"
+        row["backend"] = backend
+        row["wall_s"] = round(time.time() - t8, 1)
+        emit(row)
+        successes += 1
+    except Exception as ex:
+        emit({"metric": "llm_spec_tree_ab", "error": repr(ex)[:300],
+              "wall_s": round(time.time() - t8, 1)})
+
     emit({
         "event": "battery_done",
         "paged_wall_s": paged_wall_s,
@@ -337,6 +360,7 @@ def main() -> int:
         "int4_ab_wall_s": round(time.time() - t5, 1),
         "ragged_ab_wall_s": round(time.time() - t6, 1),
         "kv_tier_ab_wall_s": round(time.time() - t7, 1),
+        "spec_tree_ab_wall_s": round(time.time() - t8, 1),
         "successes": successes,
     })
     # A probe that succeeded but zero completed measurements means the

@@ -49,7 +49,9 @@ FALLBACK_POINTS: FrozenSet[str] = frozenset({
     "engine.kv.demote",
     "engine.kv.promote",
     "engine.kv.ship",
+    "kv.ship.partial",
     "engine.kv.receive",
+    "engine.spec.tree",
     "engine.ledger.leak",
     "engine.compile.bucket",
     "engine.shard.drift",

@@ -88,6 +88,11 @@ PROJECT_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     "_slabs": ("_lock", None),
     "_slab_pages": ("_lock", None),
     "_ship_seq": ("_lock", None),
+    # draft-ahead partial-frame assemblies (docs/spec_decode_trees.md):
+    # unsealed frames accumulate under the same mailbox lock until the
+    # sealing frame fuses them (fusion itself runs OUTSIDE the lock on a
+    # popped list — only the map mutations are guarded)
+    "_assemblies": ("_lock", None),
     # socket KV-wire backend (llm/kv_wire.py): the per-peer connection
     # cache is shared between the sender's loop thread and close()
     "_conns": ("_lock", ("self", "transport", "endpoint", "_kv_transport",

@@ -107,6 +107,13 @@ AFFINITY_REGISTRY: Dict[str, Tuple[str, Optional[Tuple[str, ...]]]] = {
     # host-tier promotion reap counters (docs/kv_tiering.md): bumped only
     # at loop-thread retire boundaries
     "_tier_counters": (LOOP, ("self", "engine")),
+    # draft-tree verify rows (docs/spec_decode_trees.md): proposer hit
+    # counters and the accept-depth histogram are planned/retired on the
+    # loop thread; per-slot draft-ahead shipping watermarks advance at
+    # loop-thread retire chunk boundaries
+    "_spec_proposer": (LOOP, ("self", "engine")),
+    "_hist_spec_tree_depth": (LOOP, ("self", "engine")),
+    "_kv_draft_ahead": (LOOP, ("self", "engine")),
     # device-resident cross-chunk chains: written by the dispatch worker
     # (the only stage that runs device programs); the loop resets them only
     # at protocol-serialized points (annotated at the definition site)

@@ -68,8 +68,10 @@ from ..errors import (
 from .sampling import (
     SamplingExtras,
     SamplingParams,
+    greedy_tree_walk,
     penalize_logits,
     speculative_sample_chain,
+    speculative_sample_tree,
     sample_tokens,
 )
 
@@ -591,6 +593,12 @@ class LLMEngineCore:
             # state is planned and retired on the loop thread only; the
             # dispatch worker reads plan snapshots, never these attrs
             "_step_rows", "_hist_launch_tokens", "_hist_spec_accept",
+            # draft-tree verify rows (docs/spec_decode_trees.md): the
+            # proposer's hit counters and the accept-depth histogram are
+            # planned/retired on the loop thread; draft-ahead shipping
+            # watermarks advance at retire chunk boundaries
+            "_spec_proposer", "_hist_spec_tree_depth",
+            "_kv_draft_ahead",
         ),
         "worker": ("_next_token_dev", "_gstate_dev"),
     }
@@ -679,6 +687,13 @@ class LLMEngineCore:
         spec_k: int = 4,
         spec_ngram: int = 2,
         spec_sampling: bool = True,
+        # draft TREES on the verify rows (docs/spec_decode_trees.md):
+        # the ragged scheduler's q=k+1 verify row becomes a fixed-budget
+        # draft tree from the n-gram FOREST proposer — same verify budget,
+        # higher acceptance. Paged cache only (the dense chunk layers have
+        # no per-token tree mask); spec_branch caps root branching.
+        spec_tree: bool = False,
+        spec_branch: int = 2,
         pipeline_chunk: int = 512,
         lora_adapters: Optional[Dict[str, Any]] = None,
         prefix_cache: Optional[int] = None,
@@ -1158,6 +1173,9 @@ class LLMEngineCore:
             # windows + accepted spec tokens): ragged_steps / this ratio is
             # dispatches-per-decode-token, the bubble-amortization headline
             "ragged_decode_tokens": 0,
+            # rows the engine.spec.tree chaos seam demoted from spec-verify
+            # back to plain decode (docs/spec_decode_trees.md fallback row)
+            "spec_tree_fallbacks": 0,
         }
         # -- SLO-aware scheduling state (docs/slo_scheduling.md) ----------
         # per-(reason, class) shed counters backing engine_sheds_total
@@ -1313,7 +1331,16 @@ class LLMEngineCore:
             "receive_failures": 0, # fault/pool/geometry -> dropped
             "hits": 0,             # shipped request admitted over the
             "recomputes": 0,       # shipped prefix vs. recomputed it
+            "draft_ships": 0,      # draft-ahead partial frames sent at
+            "draft_pages": 0,      # ragged chunk boundaries
+            "draft_aborts": 0,     # kv.ship.partial fault / send failure
         }
+        # draft-ahead shipping state (loop thread): slot -> {offset pages
+        # already shipped unsealed, aborted}. Sealed/cleared at commit
+        # (_maybe_ship), dropped with the slot on every failure path
+        # (_free_ragged_slot) — an unsealed receiver assembly is never
+        # consumable, so dropping the state IS the remote cleanup.
+        self._kv_draft_ahead: Dict[int, dict] = {}
         # ship (export+send, loop thread) / receive (import, group worker)
         # wall-time — engine_kv_ship_ms{direction} in statistics/metrics.py
         self._hist_ship_ms = _MsHistogram()
@@ -1757,6 +1784,45 @@ class LLMEngineCore:
         self._spec_k = max(1, int(spec_k))
         self._spec_ngram = max(1, int(spec_ngram))
         self._spec_slack = self.decode_steps * (self._spec_k + 1)
+        # -- draft-tree verify rows (docs/spec_decode_trees.md) ------------
+        # spec_tree routes the ragged verify rows through the pluggable
+        # proposer's FOREST topology: same k+1 node budget per row, but the
+        # nodes form a tree (ancestor-masked attention, longest-path
+        # acceptance, in-launch KV path compaction). Chain engines keep the
+        # legacy code path byte-for-byte: no tree arrays enter their jit.
+        self._spec_tree = bool(spec_tree)
+        self._spec_proposer = None
+        if self._spec_tree:
+            if not self._speculation:
+                raise ValueError(
+                    "spec_tree needs speculation='ngram' (the tree is a "
+                    "topology over the n-gram proposer's drafts)"
+                )
+            if cache_mode != "paged":
+                raise ValueError(
+                    "spec_tree needs cache_mode='paged': the dense chunk "
+                    "layers apply plain causal masks and cannot express a "
+                    "draft tree's ancestor visibility "
+                    "(docs/spec_decode_trees.md)"
+                )
+        if self._speculation:
+            from .spec_proposer import make_proposer
+
+            self._spec_proposer = (
+                make_proposer(
+                    "ngram-forest",
+                    ngram=self._spec_ngram,
+                    branch=max(1, int(spec_branch)),
+                )
+                if self._spec_tree
+                else make_proposer("ngram-chain", ngram=self._spec_ngram)
+            )
+        # accepted PATH DEPTH per tree verify row (0..k), the tree
+        # headline engine_spec_tree_accept_depth reads — integer-valued,
+        # bucketed at every possible depth for the default k=4
+        self._hist_spec_tree_depth = _MsHistogram(
+            buckets=(0, 1, 2, 3, 4, 8, 16)
+        )
         if self._speculation:
             k_, n_ = self._spec_k, self._spec_ngram
             buf_len = self.max_seq_len + self._spec_slack + 1
@@ -2102,18 +2168,47 @@ class LLMEngineCore:
                 lp = _lp_of(lp_src, sampled, nb) if want_lp else None
                 return sampled, counts, lp, gstate
 
-            def _spec_accept(spec, spec_logits, sampling):
+            def _spec_accept(spec, spec_logits, sampling, tree=None):
                 """In-launch draft acceptance over the spec-verify rows'
                 per-position logits [B, K+1, V]: greedy rows take the
                 argmax-match chain, sampled (sspec) rows the
                 rejection-sampled chain from llm/sampling.py — the same
                 acceptance math the legacy serial scan ran, applied once
-                per launch instead of decode_steps times. Returns
-                (g [B, K+1], acc [B], spec_any [B])."""
+                per launch instead of decode_steps times. With ``tree``
+                (tree_tokens [B, K+1], tree_parents [B, K+1], tree_n [B])
+                the rows are draft TREES and acceptance is the longest
+                root-to-leaf walk (docs/spec_decode_trees.md) — the chain
+                is its degenerate single-branch case, byte-identical
+                (tests/test_spec_tree.py). Returns
+                (g [B, K+1], acc [B], spec_any [B], nodes) where nodes
+                [B, K+1] is the position->node KV compaction map (None on
+                the chain path: accepted positions are already
+                contiguous)."""
                 spec_sel, sspec_sel, drafts, _idx, spec_rng = spec
                 spec_any = spec_sel | sspec_sel
                 sl = spec_logits.astype(jnp.float32)
                 k_ = drafts.shape[1]
+                if tree is not None:
+                    t_tok, t_par, t_n = tree
+                    g_arg = jnp.argmax(sl, axis=-1).astype(jnp.int32)
+                    g_g, acc_g, nodes_g = greedy_tree_walk(
+                        g_arg, t_tok, t_par, t_n
+                    )
+                    g_s, acc_s, nodes_s = speculative_sample_tree(
+                        sl, t_tok, t_par, t_n, sampling, spec_rng
+                    )
+                    g = jnp.where(sspec_sel[:, None], g_s, g_g)
+                    acc = jnp.where(
+                        sspec_sel, acc_s,
+                        jnp.where(spec_sel, acc_g, jnp.zeros_like(acc_g)),
+                    ).astype(jnp.int32)
+                    ident = jnp.broadcast_to(
+                        jnp.arange(t_tok.shape[1], dtype=jnp.int32),
+                        t_tok.shape,
+                    )
+                    nodes = jnp.where(sspec_sel[:, None], nodes_s, nodes_g)
+                    nodes = jnp.where(spec_any[:, None], nodes, ident)
+                    return g, acc, spec_any, nodes
                 g = jnp.argmax(sl, axis=-1).astype(jnp.int32)  # [B, K+1]
                 acc_g = jnp.sum(
                     jnp.cumprod(
@@ -2129,7 +2224,7 @@ class LLMEngineCore:
                     sspec_sel, acc_s,
                     jnp.where(spec_sel, acc_g, jnp.zeros_like(acc_g)),
                 ).astype(jnp.int32)
-                return g, acc, spec_any
+                return g, acc, spec_any, None
 
             def _chain_sample(l, m, step, s_rng, sampling, extras, counts,
                               pmask, guided, gstate, want_lp, nb):
@@ -2189,7 +2284,7 @@ class LLMEngineCore:
                                        lora_idx=None, extras=None,
                                        counts=None, pmask=None, guided=None,
                                        gstate=None, want_lp=False,
-                                       spec=None, chain=None):
+                                       spec=None, chain=None, tree=None):
                     scale_kw = (
                         {"k_scales": k_scales, "v_scales": v_scales}
                         if paged_quant
@@ -2198,6 +2293,11 @@ class LLMEngineCore:
                     logit_kw = (
                         {"row_logit_idx": spec[3]} if spec is not None else {}
                     )
+                    if tree is not None:
+                        # draft-tree verify rows: per-token ancestor lists
+                        # route the attention mask down to the kernel
+                        # (docs/spec_decode_trees.md)
+                        logit_kw["tree_anc"] = tree[3]
                     out = bundle.forward_ragged(
                         params, tokens, tok_pos, tok_row, tok_valid,
                         row_last, k_pools, v_pools, page_table, kv_lens,
@@ -2213,10 +2313,49 @@ class LLMEngineCore:
                     plain_mask = decode_mask
                     if spec is not None:
                         logits, spec_logits = logits
-                        spec_g, spec_acc, spec_any = _spec_accept(
-                            spec, spec_logits, sampling
+                        spec_g, spec_acc, spec_any, spec_nodes = _spec_accept(
+                            spec, spec_logits, sampling,
+                            tree=None if tree is None else tree[:3],
                         )
                         plain_mask = decode_mask & ~spec_any
+                        if tree is not None:
+                            # KV PATH COMPACTION: a tree row's accepted
+                            # root-to-leaf nodes sit at non-contiguous row
+                            # positions in the pools — gather each accepted
+                            # node's just-written K/V and rewrite it at its
+                            # path depth, so the retire-stage truncate to
+                            # pre+1+acc keeps a contiguous prefix exactly
+                            # like a chain row's. Non-moves (and non-tree
+                            # rows) scatter to the null page (page 0), the
+                            # same discard target every pad write uses.
+                            nn = spec_nodes.shape[1]
+                            pos = jnp.arange(1, nn, dtype=jnp.int32)
+                            src = (
+                                row_starts[:, None] + spec_nodes[:, 1:]
+                            ).reshape(-1)
+                            dst = (
+                                row_starts[:, None] + pos[None, :]
+                            ).reshape(-1)
+                            move = (
+                                spec_any[:, None]
+                                & (spec_nodes[:, 1:] != pos[None, :])
+                            ).reshape(-1)
+                            sp, so = write_page[src], write_offset[src]
+                            dp = jnp.where(move, write_page[dst], 0)
+                            do = jnp.where(move, write_offset[dst], 0)
+                            k_pools = k_pools.at[:, :, dp, do].set(
+                                k_pools[:, :, sp, so]
+                            )
+                            v_pools = v_pools.at[:, :, dp, do].set(
+                                v_pools[:, :, sp, so]
+                            )
+                            if paged_quant:
+                                k_scales = k_scales.at[:, :, dp, do].set(
+                                    k_scales[:, :, sp, so]
+                                )
+                                v_scales = v_scales.at[:, :, dp, do].set(
+                                    v_scales[:, :, sp, so]
+                                )
                     raw = logits.astype(jnp.float32)
                     sampled, counts, lp, gstate = _sample_rows(
                         raw, plain_mask, sampling, rng, extras, counts,
@@ -2311,7 +2450,7 @@ class LLMEngineCore:
                     plain_mask = decode_mask
                     if spec is not None:
                         logits, spec_logits = logits
-                        spec_g, spec_acc, spec_any = _spec_accept(
+                        spec_g, spec_acc, spec_any, _ = _spec_accept(
                             spec, spec_logits, sampling
                         )
                         plain_mask = decode_mask & ~spec_any
@@ -3536,18 +3675,106 @@ class LLMEngineCore:
         self._kv_transport = endpoint
         self.replica_role = role
 
-    def _maybe_ship(self, request: GenRequest, slot: int) -> None:
-        """Ship-at-commit (loop thread): export the just-committed
-        admission's block-aligned prefix pages into a KV-transport
-        shipment addressed to ``request._ship_to`` (docs/disaggregation.md).
-        Best-effort by contract — an injected ``engine.kv.ship`` fault or
-        a full receive slab drops the shipment and the decode replica
-        recomputes; nothing here can fail the request."""
+    def _maybe_ship_draft(self, job) -> None:
+        """Draft-ahead KV shipping (loop thread; docs/spec_decode_trees.md):
+        at a ragged prefill chunk boundary, the job's newly-FINAL storable
+        pages export into an unsealed partial shipment — the transport
+        overlaps the remaining prefill compute instead of serializing
+        behind the commit. Always holds back the last storable page so the
+        commit-time seal (:meth:`_maybe_ship`) carries real tail pages.
+        Best-effort by contract: an injected ``kv.ship.partial`` fault, a
+        real export/send failure, or a transport drop ABORTS the job's
+        whole draft-ahead stream and skips the seal — the receiver's
+        unsealed assembly is never consumable, so the decode replica falls
+        back to recompute with zero page leaks on either side."""
+        request = job.request
         dst = request._ship_to
         endpoint = self._kv_transport
         if not dst or endpoint is None or self.paged_cache is None \
                 or self._prefix is None:
             return
+        ids = request.prompt_ids
+        storable = self._prefix.longest_prefix_len(len(ids))
+        if storable < self._prefix.block:
+            return
+        page_size = self.paged_cache.pool.page_size
+        state = self._kv_draft_ahead.get(job.slot)
+        if state is not None and state["aborted"]:
+            return
+        # whole pages the prefilled prefix now covers, minus the held-back
+        # tail page (the seal's payload)
+        n_pages = min(
+            min(job.pos, storable) // page_size,
+            storable // page_size - 1,
+        )
+        offset = state["offset"] if state is not None else 0
+        if n_pages <= offset:
+            return
+        from .kv_transport import KVShipment, shipment_key
+
+        lora = self._slot_lora(request)
+        pages = self.paged_cache.pool.slot_pages(job.slot)[offset:n_pages]
+        if state is None:
+            state = self._kv_draft_ahead[job.slot] = {
+                "offset": 0, "aborted": False,
+            }
+        try:
+            faults.fire("kv.ship.partial", request=request)
+            slabs = self.paged_cache.export_pages(pages)
+            sent = endpoint.send(dst, KVShipment(
+                key=shipment_key(ids, self._prefix.block, lora),
+                src=self.replica_id or "r?",
+                prefix_len=n_pages * page_size,
+                page_size=page_size,
+                lora=lora,
+                hk=slabs["hk"], hv=slabs["hv"],
+                hk_scale=slabs.get("hk_scale"),
+                hv_scale=slabs.get("hv_scale"),
+                page_offset=offset, final=False,
+            ))
+        except faults.InjectedFault:
+            state["aborted"] = True
+            self._kv_ship_stats["draft_aborts"] += 1
+            return
+        except Exception as ex:  # noqa: BLE001 - best-effort by contract
+            state["aborted"] = True
+            self._kv_ship_stats["draft_aborts"] += 1
+            logger.warning(
+                "draft-ahead kv ship to %s aborted (%s: %s); decode-side "
+                "recompute", dst, type(ex).__name__, ex,
+            )
+            return
+        if not sent:
+            state["aborted"] = True
+            self._kv_ship_stats["draft_aborts"] += 1
+            return
+        state["offset"] = n_pages
+        self._kv_ship_stats["draft_ships"] += 1
+        self._kv_ship_stats["draft_pages"] += n_pages - offset
+
+    def _maybe_ship(self, request: GenRequest, slot: int) -> None:
+        """Ship-at-commit (loop thread): export the just-committed
+        admission's block-aligned prefix pages into a KV-transport
+        shipment addressed to ``request._ship_to`` (docs/disaggregation.md).
+        When draft-ahead shipping already streamed the prefix head
+        (:meth:`_maybe_ship_draft`), only the TAIL pages ship here as the
+        sealing final frame; an aborted draft-ahead stream skips the seal
+        outright (the unsealed assembly must stay unconsumable).
+        Best-effort by contract — an injected ``engine.kv.ship`` fault or
+        a full receive slab drops the shipment and the decode replica
+        recomputes; nothing here can fail the request."""
+        state = self._kv_draft_ahead.pop(slot, None)
+        dst = request._ship_to
+        endpoint = self._kv_transport
+        if not dst or endpoint is None or self.paged_cache is None \
+                or self._prefix is None:
+            return
+        if state is not None and state["aborted"]:
+            # the partial stream died mid-flight: sealing now could attach
+            # a prefix we cannot prove contiguous — drop to recompute
+            self._kv_ship_stats["ship_drops"] += 1
+            return
+        offset = state["offset"] if state is not None else 0
         from .kv_transport import KVShipment, shipment_key
 
         ids = request.prompt_ids
@@ -3557,7 +3784,7 @@ class LLMEngineCore:
         t0 = time.perf_counter()
         lora = self._slot_lora(request)
         n_pages = prefix_len // self.paged_cache.pool.page_size
-        pages = self.paged_cache.pool.slot_pages(slot)[:n_pages]
+        pages = self.paged_cache.pool.slot_pages(slot)[offset:n_pages]
         try:
             faults.fire("engine.kv.ship", request=request)
             slabs = self.paged_cache.export_pages(pages)
@@ -3570,6 +3797,7 @@ class LLMEngineCore:
                 hk=slabs["hk"], hv=slabs["hv"],
                 hk_scale=slabs.get("hk_scale"),
                 hv_scale=slabs.get("hv_scale"),
+                page_offset=offset, final=True,
             ))
         except faults.InjectedFault:
             self._kv_ship_stats["ship_drops"] += 1
@@ -3588,6 +3816,9 @@ class LLMEngineCore:
             self._kv_ship_stats["ship_drops"] += 1
             return
         self._kv_ship_stats["ships"] += 1
+        # ship_pages counts the WHOLE prefix (head pages rode the draft
+        # frames): the overlap gauge divides draft_pages by it, and page
+        # accounting stays comparable with the single-frame path
         self._kv_ship_stats["ship_pages"] += n_pages
         self._hist_ship_ms.observe((time.perf_counter() - t0) * 1e3)
 
@@ -3679,6 +3910,16 @@ class LLMEngineCore:
             "recomputes": s["recomputes"],
             "hit_rate": (
                 round(s["hits"] / judged, 4) if judged else None
+            ),
+            "draft_ships": s["draft_ships"],
+            "draft_pages": s["draft_pages"],
+            "draft_aborts": s["draft_aborts"],
+            # share of shipped prefix pages that overlapped the prefill
+            # tail instead of serializing behind the commit
+            # (engine_kv_ship_overlap_ratio; docs/spec_decode_trees.md)
+            "overlap_ratio": (
+                round(s["draft_pages"] / s["ship_pages"], 4)
+                if s["ship_pages"] else 0.0
             ),
             "ship_ms": self._hist_ship_ms.snapshot(),
             "receive_ms": self._hist_receive_ms.snapshot(),
@@ -3815,6 +4056,27 @@ class LLMEngineCore:
                     "decode_tokens": self.counters["ragged_decode_tokens"],
                     "tokens_per_launch": self._hist_launch_tokens.snapshot(),
                     "spec_acceptance": self._hist_spec_accept.snapshot(),
+                    # draft-tree verify rows (docs/spec_decode_trees.md):
+                    # accepted path depth + pluggable-proposer hit counts
+                    # (engine_spec_tree_accept_depth /
+                    # engine_spec_proposer_hits_total in
+                    # statistics/metrics.py)
+                    "spec_tree_depth": (
+                        self._hist_spec_tree_depth.snapshot()
+                        if self._spec_tree
+                        else None
+                    ),
+                    "spec_tree_fallbacks": (
+                        self.counters["spec_tree_fallbacks"]
+                    ),
+                    "spec_proposer": (
+                        dict(
+                            self._spec_proposer.stats(),
+                            name=self._spec_proposer.name,
+                        )
+                        if self._spec_proposer is not None
+                        else None
+                    ),
                 }
                 if self._ragged
                 else None
@@ -5284,6 +5546,9 @@ class LLMEngineCore:
     def _free_ragged_slot(self, slot: int) -> None:
         """Reclaim a ragged job's slot pages (no pipeline barrier applies:
         ragged steps run with the pipeline drained and are synchronous)."""
+        # a failed/cancelled job never seals its draft-ahead stream: the
+        # receiver's unsealed assembly stays unconsumable and ages out
+        self._kv_draft_ahead.pop(slot, None)
         if self.paged_cache is not None:
             self.paged_cache.pool.free(slot)
 
@@ -5362,6 +5627,30 @@ class LLMEngineCore:
         if self._ragged_spec_wanted(decode_mask):
             greedy, sampled_m = self._spec_eligible_mask(decode_mask)
             spec_mask, sspec_mask = greedy.copy(), sampled_m.copy()
+            if faults.active() and (spec_mask.any() or sspec_mask.any()):
+                # chaos seam: a mid-verify proposer/tree-layout failure
+                # falls back to PLAIN DECODE for the poisoned row — it
+                # rides this same launch as an ordinary q=1/multi-step
+                # decode row; nothing was allocated yet, so the fallback
+                # is leak-free by construction (docs/spec_decode_trees.md)
+                try:
+                    faults.fire(
+                        "engine.spec.tree",
+                        requests=[
+                            self._slot_req[int(s)]
+                            for s in np.nonzero(spec_mask | sspec_mask)[0]
+                        ],
+                    )
+                except faults.InjectedFault as ex:
+                    self.counters["spec_tree_fallbacks"] += 1
+                    if ex.request is None:
+                        spec_mask[:] = False
+                        sspec_mask[:] = False
+                    else:
+                        for s in np.nonzero(spec_mask | sspec_mask)[0]:
+                            if self._slot_req[int(s)] is ex.request:
+                                spec_mask[int(s)] = False
+                                sspec_mask[int(s)] = False
             # a verify row costs k extra budget tokens: demote rows
             # (highest slot first) until the baseline fits the budget
             spec_slots = [int(s) for s in np.nonzero(spec_mask | sspec_mask)[0]]
@@ -5425,16 +5714,41 @@ class LLMEngineCore:
                 1, min(launch_steps, remaining_new, remaining_len)
             )
         # drafts for the verify rows, proposed from the host token buffer
-        # (kept warm at every ragged retire)
+        # (kept warm at every ragged retire) through the pluggable
+        # proposer: chain engines get the ngram-chain backend (drafts
+        # byte-identical to the legacy _ngram_draft_rows,
+        # tests/test_spec_tree.py pins it); spec_tree engines get the
+        # ngram-forest topology plus the per-row tree arrays the device
+        # acceptance walk and ancestor mask consume
         drafts = None
+        tree_tokens = tree_parents = tree_depths = tree_n = None
         if n_spec:
             spec_slots = [int(s) for s in np.nonzero(spec_any)[0]]
             hists = [
                 self._slot_req[s].prompt_len + self._slot_req[s].produced
                 for s in spec_slots
             ]
+            forest = self._spec_proposer.propose(
+                spec_slots, hists, self._tokbuf, k_
+            )
             drafts = np.zeros((self.max_batch, k_), np.int32)
-            drafts[spec_slots] = self._ngram_draft_rows(spec_slots, hists)
+            drafts[spec_slots] = forest.tokens[:, 1:]
+            if self._spec_tree:
+                from .spec_proposer import chain_parents
+
+                tree_tokens = np.zeros((self.max_batch, k_ + 1), np.int32)
+                tree_parents = np.broadcast_to(
+                    chain_parents(k_), (self.max_batch, k_ + 1)
+                ).copy()
+                tree_depths = np.broadcast_to(
+                    np.arange(k_ + 1, dtype=np.int32),
+                    (self.max_batch, k_ + 1),
+                ).copy()
+                tree_n = np.full(self.max_batch, k_ + 1, np.int32)
+                tree_tokens[spec_slots] = forest.tokens
+                tree_parents[spec_slots] = forest.parents
+                tree_depths[spec_slots] = forest.depths
+                tree_n[spec_slots] = forest.n_nodes
         want_lp = any(
             self._slot_req[s] is not None
             and self._slot_req[s].logprobs is not None
@@ -5483,6 +5797,15 @@ class LLMEngineCore:
             "sspec_mask": sspec_mask,
             "spec_k": k_,
             "drafts": drafts,
+            # draft-tree verify rows (docs/spec_decode_trees.md): per-row
+            # topology arrays + the flat per-token ancestor lists (filled
+            # by the paged layout below; None on chain engines so their
+            # jit trace is byte-identical to the pre-tree one)
+            "tree_tokens": tree_tokens,
+            "tree_parents": tree_parents,
+            "tree_depths": tree_depths,
+            "tree_n": tree_n,
+            "tree_anc": None,
             "row_steps": row_steps,
             "launch_steps": launch_steps,
             "step_rngs": (
@@ -5559,7 +5882,15 @@ class LLMEngineCore:
                 else:
                     tokens[s] = self._next_token[slot]
                 spans[slot] = (s, n)
-                tok_pos[s : s + n] = pre + np.arange(n, dtype=np.int32)
+                if tree_depths is not None and spec_any[slot]:
+                    # a tree node's ABSOLUTE position is its path depth,
+                    # not its node index: sibling drafts at the same depth
+                    # share a RoPE position, and the accepted path's K/V
+                    # (compacted in-launch to positions pre+1..pre+acc)
+                    # was embedded at exactly those positions
+                    tok_pos[s : s + n] = pre + tree_depths[slot, :n]
+                else:
+                    tok_pos[s : s + n] = pre + np.arange(n, dtype=np.int32)
                 tok_row[s : s + n] = slot
                 # reserved multi-step positions stay invalid in the mixed
                 # pass: their tokens are sampled in-launch and their K/V
@@ -5567,6 +5898,22 @@ class LLMEngineCore:
                 tok_valid[s : s + v] = True
                 row_last[slot] = s + v - 1
                 kv_lens[slot] = pre + v
+            if tree_parents is not None and n_spec:
+                # flat per-token ancestor lists for the kernel's tree mask
+                # (ops.paged_attention.tree_ancestors layout): every
+                # non-tree token keeps the -2 plain-causal sentinel
+                from ..ops.paged_attention import tree_ancestors
+
+                tree_anc = np.full((tpad, k_ + 1), -1, np.int32)
+                tree_anc[:, 0] = -2
+                for slot in np.nonzero(spec_any)[0]:
+                    slot = int(slot)
+                    s = int(starts[slot])
+                    tree_anc[s : s + k_ + 1] = tree_ancestors(
+                        tree_parents[slot], int(tree_n[slot]),
+                        width=k_ + 1,
+                    )
+                plan["tree_anc"] = tree_anc
             if n_spec:
                 row_logit_idx = np.zeros(
                     (self.max_batch, k_ + 1), np.int32
@@ -5676,6 +6023,12 @@ class LLMEngineCore:
             plan["chain_mask"][:, slot] = False
         if plan["row_logit_idx"] is not None:
             plan["row_logit_idx"][slot] = 0
+        if plan.get("tree_anc") is not None:
+            # the dropped verify row's pad tokens revert to plain-causal
+            # sentinels (they are never live queries, but the mask arrays
+            # must not carry a freed row's topology into the launch)
+            plan["tree_anc"][s : s + n] = -1
+            plan["tree_anc"][s : s + n, 0] = -2
         if plan["decode_mask"][slot]:
             plan["decode_mask"][slot] = False
             plan["exhausted"].append(slot)
@@ -5717,6 +6070,19 @@ class LLMEngineCore:
                 jnp.asarray(plan["drafts"]),
                 jnp.asarray(plan["row_logit_idx"]),
                 plan["spec_rng"],
+            )
+
+        def _tree_arrays():
+            # tree topology operands (docs/spec_decode_trees.md), also
+            # post-drop: a dropped verify row's masks are already False
+            # and its ancestor rows reverted to plain-causal sentinels
+            if plan.get("tree_anc") is None or plan["row_logit_idx"] is None:
+                return None
+            return (
+                jnp.asarray(plan["tree_tokens"]),
+                jnp.asarray(plan["tree_parents"]),
+                jnp.asarray(plan["tree_n"]),
+                jnp.asarray(plan["tree_anc"]),
             )
 
         if self.cache_mode == "paged":
@@ -5808,6 +6174,7 @@ class LLMEngineCore:
                     want_lp=want_lp,
                     spec=_spec_arrays(),
                     chain=chain_arrays,
+                    tree=_tree_arrays(),
                 )
                 if self._paged_quant:
                     self.paged_cache.k_scale = new_ks
@@ -6092,6 +6459,10 @@ class LLMEngineCore:
         for slot in spec_slots:
             acc = int(spec_acc[slot])
             accept_fracs.append(acc / max(1, plan["spec_k"]))
+            if self._spec_tree:
+                # accepted PATH DEPTH per tree verify row — the headline
+                # engine_spec_tree_accept_depth reads at scrape time
+                self._hist_spec_tree_depth.observe(acc)
             _window_emit(
                 slot,
                 [int(spec_g[slot, i]) for i in range(acc + 1)],
@@ -6133,6 +6504,10 @@ class LLMEngineCore:
                 continue
             job.pos += take
             if job.pos < len(job.request.prompt_ids):
+                # draft-ahead KV shipping: the chunk boundary just made
+                # whole storable pages final — overlap the transport with
+                # the remaining prefill (docs/spec_decode_trees.md)
+                self._maybe_ship_draft(job)
                 continue
             # final chunk landed: the row's last-token logits are the
             # prompt's prefill logits — first token + slot activation

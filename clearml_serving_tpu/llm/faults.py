@@ -95,6 +95,26 @@ Known points (ctx carried with each):
                          aborts the ship leak-free — nothing reaches the
                          transport, and the decode replica falls back to
                          recomputing the prefix.
+- ``kv.ship.partial``  — on the prefill replica's loop thread as a
+                         DRAFT-AHEAD partial shipment (storable pages of a
+                         still-running prefill, docs/spec_decode_trees.md)
+                         is about to export at a chunk boundary
+                         (``request``); a raise aborts the job's entire
+                         draft-ahead stream AND the commit-time seal — the
+                         receiver's unsealed assembly is never consumable,
+                         so the decode replica falls back to recompute
+                         with zero page leaks on either side.
+- ``engine.spec.tree`` — on the loop thread in the ragged scheduler's
+                         step planner, after spec-verify eligibility is
+                         decided and BEFORE drafts are proposed or any
+                         row laid out (``requests`` = the eligible
+                         slots' GenRequests); ``match_token`` demotes
+                         only the matched request's row to PLAIN DECODE
+                         in the same launch (an unmatched raise demotes
+                         every verify row that step). Nothing was
+                         allocated yet, so the fallback is leak-free by
+                         construction and the stream stays byte-identical
+                         — the row just decodes without drafts.
 - ``engine.kv.receive`` — on the decode replica as a popped shipment is
                          about to import (fresh device pages + the fenced
                          host→device scatter + radix-cache attach;
@@ -216,7 +236,9 @@ KNOWN_POINTS = frozenset({
     "engine.kv.demote",
     "engine.kv.promote",
     "engine.kv.ship",
+    "kv.ship.partial",
     "engine.kv.receive",
+    "engine.spec.tree",
     "engine.ledger.leak",
     "engine.compile.bucket",
     "engine.shard.drift",

@@ -82,7 +82,19 @@ class KVShipment:
     ``hk``/``hv`` are ``[N, L, Hkv, P, D]`` slabs (one row per shipped
     page, the host-tier demote layout); quantized pools add the
     ``[N, L, Hkv, P]`` f32 scale rows. ``prefix_len`` is the block-aligned
-    token count the pages cover (``N * page_size``)."""
+    token count the pages cover (``N * page_size``).
+
+    DRAFT-AHEAD shipping (docs/spec_decode_trees.md) splits one prefix
+    across several frames sharing the same content key: a non-``final``
+    frame carries pages ``[page_offset, page_offset + N)`` of the prefix
+    (``prefix_len`` = tokens covered SO FAR, exactly page-aligned), and
+    the ``final`` frame seals the assembly with the tail pages plus the
+    authoritative full ``prefix_len``. The default ``page_offset=0,
+    final=True`` is the legacy single-frame shipment — the wire codec
+    omits the keys entirely for it, so PR 19 frames are byte-identical.
+    The transport reassembles IN ORDER and only a sealed assembly ever
+    becomes consumable; any gap/duplicate/out-of-order frame drops the
+    whole assembly (drop-to-recompute)."""
 
     key: bytes
     src: str                       # sender replica name
@@ -93,6 +105,8 @@ class KVShipment:
     hv: np.ndarray
     hk_scale: Optional[np.ndarray] = None   # [N, L, Hkv, P] on int8 pools
     hv_scale: Optional[np.ndarray] = None
+    page_offset: int = 0           # first page's index within the prefix
+    final: bool = True             # False = unsealed draft-ahead frame
     seq: int = field(default=0, compare=False)
 
     @property
@@ -165,7 +179,9 @@ class SharedSlabTransport:
     # lock-discipline registry (tpuserve-analyze TPU301): mailbox state is
     # mutated only under self._lock — senders run on their replica's loop
     # thread, receivers pop from the group's receive worker
-    __guarded_by__ = {"_lock": ("_slabs", "_slab_pages", "_ship_seq")}
+    __guarded_by__ = {
+        "_lock": ("_slabs", "_slab_pages", "_ship_seq", "_assemblies"),
+    }
 
     # ownership-discipline registry (tpuserve-analyze TPU7xx): a sent
     # shipment sits in the destination mailbox until the consume-once
@@ -192,6 +208,9 @@ class SharedSlabTransport:
         # dst name -> OrderedDict[key, KVShipment] (arrival order)
         self._slabs: Dict[str, "OrderedDict[bytes, KVShipment]"] = {}
         self._slab_pages: Dict[str, int] = {}
+        # dst name -> {key: [unsealed draft-ahead frames, in page order]}
+        # — invisible to recv() until the final frame seals the assembly
+        self._assemblies: Dict[str, Dict[bytes, list]] = {}
         self._ship_seq = 0
         # observability (GIL-atomic bumps; surfaced through stats())
         self.sent = 0
@@ -200,6 +219,9 @@ class SharedSlabTransport:
         self.received_pages = 0
         self.dropped = 0           # evicted/oversized shipments
         self.dropped_pages = 0
+        self.partial_frames = 0    # draft-ahead frames accepted unsealed
+        self.assembled = 0         # assemblies sealed into the mailbox
+        self.assembly_drops = 0    # gap/dup/out-of-order/oversize drops
 
     def register(self, name: str) -> TransportEndpoint:
         with self._lock:
@@ -215,16 +237,93 @@ class SharedSlabTransport:
         if _ledger.armed():
             _ledger.release("transport.shipment", key=key, domain=self)
 
+    def _assemble(self, dst: str, shipment: KVShipment):
+        """In-order reassembly of one draft-ahead frame. Returns
+        ``(accepted, complete)``: ``complete`` is the fused sealed
+        shipment once the final frame lands (deliver it through the
+        normal mailbox path); until then accepted frames queue unsealed
+        — invisible to ``recv``. ANY ordering violation — a duplicate, a
+        gap, a seal with no assembly, geometry drift between frames —
+        drops the ENTIRE assembly: a prefix that cannot be proven
+        contiguous must never attach (drop-to-recompute)."""
+        key = shipment.key
+        with self._lock:
+            asm_map = self._assemblies.setdefault(dst, {})
+            if shipment.page_offset == 0:
+                # first frame (never final here): replaces a stale start
+                if shipment.pages > self.capacity_pages:
+                    asm_map.pop(key, None)
+                    self.assembly_drops += 1
+                    return False, None
+                asm_map[key] = [shipment]
+                self.partial_frames += 1
+                return True, None
+            parts = asm_map.get(key)
+            have = sum(p.pages for p in parts) if parts else 0
+            head = parts[0] if parts else None
+            if (
+                parts is None
+                or shipment.page_offset != have
+                or shipment.page_size != head.page_size
+                or shipment.quantized != head.quantized
+                or shipment.lora != head.lora
+            ):
+                asm_map.pop(key, None)
+                self.assembly_drops += 1
+                return False, None
+            if have + shipment.pages > self.capacity_pages:
+                asm_map.pop(key, None)
+                self.assembly_drops += 1
+                return False, None
+            parts.append(shipment)
+            if not shipment.final:
+                self.partial_frames += 1
+                return True, None
+            del asm_map[key]
+        # sealed: fuse OUTSIDE the lock (the concatenation is the heavy
+        # part; the assembly is already detached from shared state)
+        total = have + shipment.pages
+        if not (0 < shipment.prefix_len <= total * shipment.page_size):
+            self.assembly_drops += 1
+            return False, None
+        complete = KVShipment(
+            key=key, src=shipment.src, prefix_len=shipment.prefix_len,
+            page_size=shipment.page_size, lora=shipment.lora,
+            hk=np.concatenate([p.hk for p in parts], axis=0),
+            hv=np.concatenate([p.hv for p in parts], axis=0),
+            hk_scale=(
+                np.concatenate([p.hk_scale for p in parts], axis=0)
+                if shipment.quantized else None
+            ),
+            hv_scale=(
+                np.concatenate([p.hv_scale for p in parts], axis=0)
+                if shipment.quantized else None
+            ),
+        )
+        self.assembled += 1
+        return True, complete
+
     def send(self, dst: str, shipment: KVShipment) -> bool:
         """Deliver ``shipment`` into ``dst``'s receive slab. Returns False
         (counted drop) when the shipment exceeds the slab outright;
         otherwise the oldest queued shipments age out until it fits. A
-        re-ship of the same key replaces the stale payload."""
+        re-ship of the same key replaces the stale payload. Draft-ahead
+        frames (``final=False`` or ``page_offset > 0``) reassemble in
+        order and only the SEALED whole enters the mailbox."""
+        if not shipment.final or shipment.page_offset:
+            accepted, complete = self._assemble(dst, shipment)
+            if complete is None:
+                return accepted
+            shipment = complete
         if shipment.pages > self.capacity_pages:
             self.dropped += 1
             self.dropped_pages += shipment.pages
             return False
         with self._lock:
+            # a full legacy re-ship supersedes any unsealed assembly
+            asm_map = self._assemblies.get(dst)
+            if asm_map is not None:
+                asm_map.pop(shipment.key, None)
             slab = self._slabs.get(dst)
             if slab is None:
                 slab = self._slabs[dst] = OrderedDict()
@@ -288,5 +387,8 @@ class SharedSlabTransport:
             "received_pages": self.received_pages,
             "dropped": self.dropped,
             "dropped_pages": self.dropped_pages,
+            "partial_frames": self.partial_frames,
+            "assembled": self.assembled,
+            "assembly_drops": self.assembly_drops,
             "queued": queued,
         }

@@ -17,8 +17,11 @@ Frame layout (the table in docs/disaggregation.md mirrors this)::
     [ hdr_len bytes JSON header ][ body: hk | hv | hk_scale | hv_scale ]
 
 The JSON header carries everything needed to validate BEFORE touching
-the pool: content key, sender, geometry (prefix_len / page_size / lora)
-and one ``{dtype, shape}`` descriptor per body section. The body is the
+the pool: content key, sender, geometry (prefix_len / page_size / lora),
+the optional draft-ahead framing keys (``page_offset`` / ``final`` —
+omitted for whole-prefix shipments, so legacy frames are byte-identical;
+docs/spec_decode_trees.md), and one ``{dtype, shape}`` descriptor per
+body section. The body is the
 raw page slabs exactly as ``PagedKVCache.export_pages`` laid them out —
 page-major ``[N, L, Hkv, P, D]`` int8/bf16 planes plus, on quantized
 pools, the f32 scale rows. Decoding is ZERO-COPY: the receiver's arrays
@@ -127,6 +130,12 @@ def shipment_to_wire(shipment: KVShipment) -> bytes:
             for name, arr in sections
         ],
     }
+    # draft-ahead framing (docs/spec_decode_trees.md): the keys are
+    # OMITTED for the legacy whole-prefix shipment, so PR 19 frames stay
+    # byte-identical and old receivers keep decoding them (version 1)
+    if shipment.page_offset or not shipment.final:
+        header["page_offset"] = int(shipment.page_offset)
+        header["final"] = bool(shipment.final)
     hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
     flags = _FLAG_QUANTIZED if shipment.quantized else 0
     parts = [MAGIC, struct.pack("<BBH", WIRE_VERSION, flags, len(hdr)), hdr]
@@ -173,9 +182,15 @@ def shipment_from_wire(frame) -> KVShipment:
         prefix_len = int(header["prefix_len"])
         page_size = int(header["page_size"])
         lora = int(header["lora"])
+        page_offset = int(header.get("page_offset", 0))
+        final = bool(header.get("final", True))
         sections = list(header["sections"])
     except (KeyError, TypeError, ValueError) as ex:
         raise WireFormatError("malformed kv wire header: {!r}".format(ex))
+    if page_offset < 0:
+        raise WireFormatError(
+            "kv wire page_offset must be >= 0 (got {})".format(page_offset)
+        )
     if len(key) != 16:
         raise WireFormatError(
             "kv wire content key must be 16 bytes (got {})".format(len(key))
@@ -240,10 +255,28 @@ def shipment_from_wire(frame) -> KVShipment:
             "dim {}".format(page_size, hk.shape[3])
         )
     pages = int(hk.shape[0])
-    if not (0 < prefix_len <= pages * page_size):
+    if pages < 1:
         raise WireFormatError(
-            "kv wire geometry mismatch: prefix_len {} outside the {} "
-            "shipped pages x {} tokens".format(prefix_len, pages, page_size)
+            "kv wire frame carries no pages (empty slab)"
+        )
+    if final:
+        # final frame: prefix_len is the AUTHORITATIVE full prefix and
+        # its tail must land inside this frame's pages
+        if not (page_offset * page_size
+                < prefix_len <= (page_offset + pages) * page_size):
+            raise WireFormatError(
+                "kv wire geometry mismatch: prefix_len {} outside pages "
+                "[{}, {}) x {} tokens".format(
+                    prefix_len, page_offset, page_offset + pages, page_size
+                )
+            )
+    elif prefix_len != (page_offset + pages) * page_size:
+        # unsealed draft-ahead frame: covers WHOLE pages exactly
+        raise WireFormatError(
+            "kv wire geometry mismatch: partial frame prefix_len {} != "
+            "({} + {} pages) x {} tokens".format(
+                prefix_len, page_offset, pages, page_size
+            )
         )
     hk_scale = hv_scale = None
     if flags & _FLAG_QUANTIZED:
@@ -262,6 +295,7 @@ def shipment_from_wire(frame) -> KVShipment:
     return KVShipment(
         key=key, src=src, prefix_len=prefix_len, page_size=page_size,
         lora=lora, hk=hk, hv=hv, hk_scale=hk_scale, hv_scale=hv_scale,
+        page_offset=page_offset, final=final,
     )
 
 
@@ -637,7 +671,8 @@ class SocketSlabFabric:
             "endpoints": per,
         }
         for key in ("sent", "sent_pages", "received", "received_pages",
-                    "dropped", "dropped_pages"):
+                    "dropped", "dropped_pages", "partial_frames",
+                    "assembled", "assembly_drops"):
             agg[key] = sum(int(s[key]) for s in per.values())
         for s in per.values():
             agg["queued"].update(s["queued"])

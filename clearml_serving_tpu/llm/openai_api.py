@@ -268,6 +268,13 @@ class LLMEngineRequest(BaseEngineRequest):
             spec_k=int(engine_cfg.get("spec_k", 4)),
             spec_ngram=int(engine_cfg.get("spec_ngram", 2)),
             spec_sampling=bool(engine_cfg.get("spec_sampling", True)),
+            # draft-tree verify rows (docs/spec_decode_trees.md): aux
+            # engine.spec_tree branches each verify row's k-draft budget
+            # across up to engine.spec_branch root continuations (needs
+            # speculation + a paged cache — the constructor validates at
+            # ENDPOINT LOAD; tree rows engage under the ragged scheduler)
+            spec_tree=bool(engine_cfg.get("spec_tree", False)),
+            spec_branch=int(engine_cfg.get("spec_branch", 2)),
             pipeline_chunk=int(engine_cfg.get("pipeline_chunk", 512)),
             # decode-pipeline depth (docs/pipelined_decode.md): None defers
             # to TPUSERVE_PIPELINE_DEPTH (default 2); 1 = serial decode

@@ -187,6 +187,145 @@ def sample_tokens(
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
 
+def greedy_tree_walk(
+    greedy: jnp.ndarray,    # [B, N] int32 argmax token per tree node
+    tokens: jnp.ndarray,    # [B, N] int32 node tokens (node 0 = root)
+    parents: jnp.ndarray,   # [B, N] int32, parents[:, 0] == -1
+    n_nodes: jnp.ndarray,   # [B] int32 live node count (>= 1)
+):
+    """Longest accepted root-to-leaf path under GREEDY acceptance
+    (docs/spec_decode_trees.md): walking from the root, a child node is
+    accepted iff its draft token equals the argmax of its parent's
+    verify logits — at most one child can match, so the walk is
+    deterministic. Returns (path [B, N], acc [B]): path[b, :acc] are the
+    accepted draft tokens in path order and path[b, acc] is the bonus
+    token (the argmax at the last accepted node).
+
+    Nodes are processed in index order; the parent-before-child layout
+    (spec_proposer.DraftForest) makes that a topological order, and the
+    frontier test ``parents[:, j] == cur`` skips every node off the
+    accepted path. On the degenerate chain topology this reproduces the
+    chain rule acc = sum(cumprod(drafts == argmax[:, :k])) exactly.
+
+    The third output ``nodes`` [B, N] maps row POSITION to the tree NODE
+    whose K/V belongs there after acceptance: nodes[b, i] is the node
+    index of the i-th accepted path token (identity for i == 0 and for
+    every position past acc) — the engine's in-launch KV path compaction
+    gathers pool entries at nodes[b, i] and rewrites them at position i,
+    so the kept prefix is contiguous exactly like a chain's.
+    """
+    b, n = tokens.shape
+    rows = jnp.arange(b)
+    col = jnp.arange(n, dtype=jnp.int32)[None, :]
+    cur = jnp.zeros(b, jnp.int32)
+    acc = jnp.zeros(b, jnp.int32)
+    path = jnp.zeros((b, n), jnp.int32)
+    nodes = jnp.broadcast_to(col.astype(jnp.int32), (b, n))
+    for j in range(1, n):
+        tok = tokens[:, j]
+        ok = (
+            (j < n_nodes)
+            & (parents[:, j] == cur)
+            & (tok == greedy[rows, cur])
+        )
+        path = jnp.where((col == acc[:, None]) & ok[:, None],
+                         tok[:, None], path)
+        nodes = jnp.where((col == acc[:, None] + 1) & ok[:, None],
+                          jnp.int32(j), nodes)
+        cur = jnp.where(ok, j, cur)
+        acc = acc + ok.astype(jnp.int32)
+    bonus = greedy[rows, cur]
+    path = jnp.where(col == acc[:, None], bonus[:, None], path)
+    return path, acc, nodes
+
+
+def speculative_sample_tree(
+    logits: jnp.ndarray,    # [B, N, V] verify logits per tree node
+    tokens: jnp.ndarray,    # [B, N] int32 node tokens (node 0 = root)
+    parents: jnp.ndarray,   # [B, N] int32, parents[:, 0] == -1
+    n_nodes: jnp.ndarray,   # [B] int32 live node count
+    params: SamplingParams,
+    rng: jax.Array,
+):
+    """Multi-draft rejection sampling over a draft TREE (the SpecInfer /
+    recursive-rejection scheme specialized to point-mass proposers,
+    docs/spec_decode_trees.md).
+
+    Walking from the root in node-index order, each frontier child with
+    draft token d is accepted with probability P_cur(d) / (1 - R) where
+    P_cur = softmax(warp(logits_cur)) and R is the mass of this node's
+    already-REJECTED sibling drafts (the sequential point-mass residual
+    correction); an accepted child advances the walk and resets R. After
+    all nodes are processed, one token is sampled from the last accepted
+    node's residual (its rejected children masked out, renormalized by
+    the categorical) — or its plain warped distribution when every child
+    was accepted. The emitted path's marginal law is exactly
+    autoregressive sampling from the warped per-position distributions.
+
+    On the degenerate chain topology (parents j-1, one child per node)
+    the sibling correction divides by exactly 1.0 and the residual masks
+    exactly the rejected draft, so the emitted tokens are BYTE-IDENTICAL
+    to :func:`speculative_sample_chain` under the same rng — the shapes
+    of both internal draws (u [B, N-1], categorical over [B, N, V])
+    match the chain's, which tests/test_spec_tree.py pins.
+
+    Returns (path [B, N], acc [B], nodes [B, N]) with the chain
+    function's token contract: path[b, :acc] accepted draft tokens in
+    path order, path[b, acc] the residual/bonus sample, entries past acc
+    meaningless. ``nodes`` maps row position to accepted tree node like
+    :func:`greedy_tree_walk` (identity past acc) for KV path compaction.
+    """
+    b, n, v = logits.shape
+    rep = lambda x: jnp.repeat(x, n)
+    warped = warp_logits(
+        logits.reshape(b * n, v),
+        rep(params.temperature), rep(params.top_k), rep(params.top_p),
+    ).reshape(b, n, v)
+    probs = jax.nn.softmax(warped, axis=-1)
+    r_acc, r_gum = jax.random.split(rng)
+    u = jax.random.uniform(r_acc, (b, n - 1))
+    rows = jnp.arange(b)
+    col = jnp.arange(n, dtype=jnp.int32)[None, :]
+    cur = jnp.zeros(b, jnp.int32)
+    acc = jnp.zeros(b, jnp.int32)
+    path = jnp.zeros((b, n), jnp.int32)
+    nodes = jnp.broadcast_to(col.astype(jnp.int32), (b, n))
+    rej_mass = jnp.zeros(b, jnp.float32)
+    rejected = jnp.zeros((b, n), bool)
+    for j in range(1, n):
+        tok = tokens[:, j]
+        test = (j < n_nodes) & (parents[:, j] == cur)
+        p_tok = probs[rows, cur, tok]
+        p_adj = p_tok / jnp.maximum(1.0 - rej_mass, 1e-9)
+        ok = test & (u[:, j - 1] < p_adj)
+        rej = test & ~ok
+        path = jnp.where((col == acc[:, None]) & ok[:, None],
+                         tok[:, None], path)
+        nodes = jnp.where((col == acc[:, None] + 1) & ok[:, None],
+                          jnp.int32(j), nodes)
+        rejected = rejected.at[:, j].set(rej)
+        rej_mass = jnp.where(
+            ok, 0.0, jnp.where(rej, rej_mass + p_tok, rej_mass)
+        )
+        cur = jnp.where(ok, j, cur)
+        acc = acc + ok.astype(jnp.int32)
+    # residual per NODE: its rejected children's draft tokens masked out.
+    # Only the final node's row is selected, but drawing the categorical
+    # over the full [B, N, V] keeps the gumbel stream aligned with the
+    # chain sampler's w_all draw (byte-identity on chain topologies).
+    par_oh = jax.nn.one_hot(parents[:, 1:], n, dtype=jnp.float32)
+    tok_oh = jax.nn.one_hot(tokens[:, 1:], v, dtype=jnp.float32)
+    rej_w = rejected[:, 1:].astype(jnp.float32)[..., None] * par_oh
+    rej_tokens = jnp.einsum("bjn,bjv->bnv", rej_w, tok_oh) > 0.0
+    w_all = jnp.where(rej_tokens, -jnp.inf, warped)
+    fallback = jax.random.categorical(
+        r_gum, w_all, axis=-1
+    ).astype(jnp.int32)                                        # [B, N]
+    f_at = jnp.take_along_axis(fallback, cur[:, None], axis=1)[:, 0]
+    path = jnp.where(col == acc[:, None], f_at[:, None], path)
+    return path, acc, nodes
+
+
 def speculative_sample_chain(
     logits: jnp.ndarray,   # [B, K+1, V] verify-pass logits (float32)
     drafts: jnp.ndarray,   # [B, K] int32 proposed draft tokens

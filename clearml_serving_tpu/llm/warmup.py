@@ -241,6 +241,25 @@ def warm_ragged_variants(engine) -> int:
         page_table = jnp.asarray(
             np.zeros((b, engine._pages_per_seq), np.int32)
         )
+
+        def tree_args(on):
+            # draft-tree verify variant (docs/spec_decode_trees.md): the
+            # tree arrays are FIXED-SHAPE ([B, k+1] topology + [tpad, k+1]
+            # ancestor lists) so the whole topology space is ONE compile
+            # key — warmed with the plain-causal sentinel (-2), which
+            # drives the tree kernel variant over a null launch
+            if not (on and getattr(engine, "_spec_tree", False)):
+                return None
+            anc = np.full((tpad, k_ + 1), -1, np.int32)
+            anc[:, 0] = -2
+            parents = np.zeros((b, k_ + 1), np.int32)
+            parents[:, 0] = -1
+            return (
+                jnp.asarray(np.zeros((b, k_ + 1), np.int32)),
+                jnp.asarray(parents),
+                jnp.asarray(np.full(b, k_ + 1, np.int32)),
+                jnp.asarray(anc),
+            )
         blocks = (
             jnp.asarray(np.full(nb, -1, np.int32)),
             jnp.asarray(np.zeros(nb, np.int32)),
@@ -280,6 +299,7 @@ def warm_ragged_variants(engine) -> int:
                         want_lp=False,
                         spec=spec_args(spec_on),
                         chain=chain,
+                        tree=tree_args(spec_on),
                     )
                     if engine._paged_quant:
                         cache.k_scale = new_ks

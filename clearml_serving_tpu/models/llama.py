@@ -1472,6 +1472,9 @@ def build(config: dict) -> SimpleNamespace:
         v_scales=None,
         row_logit_idx=None,  # [R, W] int32 flat token indices to read
                              # logits at (None = row_last only)
+        tree_anc=None,       # [T, DMAX] int32 per-token ancestor lists for
+                             # draft-TREE verify rows (None = plain causal;
+                             # ops.paged_attention.tree_ancestors layout)
     ):
         """ONE forward step over a ragged mixed batch: each row is at an
         arbitrary phase — decode rows contribute one query token (plus
@@ -1534,7 +1537,8 @@ def build(config: dict) -> SimpleNamespace:
                 attn = ragged_paged_attention(
                     q_grouped, k_p, v_p, page_table, kv_lens,
                     row_starts, row_lens,
-                    block_rows=block_rows, block_q0=block_q0, **scale_kw,
+                    block_rows=block_rows, block_q0=block_q0,
+                    tree_anc=tree_anc, **scale_kw,
                 )                                                  # [T,Hkv,G,D]
                 return attn.reshape(t, 1, n_heads * head_dim).astype(x.dtype)
 

@@ -479,9 +479,46 @@ def ragged_layout(row_lens, q_block: int = _RAGGED_QB, total: int | None = None)
     return starts, block_rows, block_q0, int(t_pad)
 
 
+def tree_ancestors(parents, n_nodes=None, *, width=None):
+    """Host-side tree-topology mask metadata for a verify row
+    (docs/spec_decode_trees.md): per-node ancestor lists.
+
+    ``parents`` [N] int32 with ``parents[0] == -1`` and
+    ``parents[j] < j`` (spec_proposer.DraftForest layout). Returns
+    ``[N, width]`` int32 where row j lists the in-row indices of node
+    j's root-to-node path INCLUDING itself, -1 padded. ``width``
+    defaults to N (the deepest possible chain). Dead nodes (>=
+    ``n_nodes``) get all -1 rows — they still mask causally but match
+    no ancestor, so their (garbage) outputs attend history only.
+
+    The kernels treat ``anc[t, 0] == -2`` as the PLAIN-CAUSAL sentinel
+    (non-tree rows); this builder never emits it — the engine stamps it
+    on every token outside a tree row."""
+    import numpy as np
+
+    parents = np.asarray(parents, np.int32)
+    n = parents.shape[0]
+    live = n if n_nodes is None else int(n_nodes)
+    w = n if width is None else int(width)
+    out = np.full((n, w), -1, np.int32)
+    for j in range(live):
+        chain = []
+        node = j
+        while node >= 0:
+            chain.append(node)
+            node = int(parents[node])
+        if len(chain) > w:
+            raise ValueError(
+                "tree depth {} exceeds ancestor width {}".format(
+                    len(chain), w))
+        out[j, : len(chain)] = chain[::-1]
+    return out
+
+
 def ragged_paged_attention_xla(q, k_pool, v_pool, page_table, kv_lens,
                                row_starts, row_lens,
-                               k_scale=None, v_scale=None):
+                               k_scale=None, v_scale=None,
+                               tree_anc=None):
     """Reference ragged paged attention in plain XLA ops (CPU fallback).
 
     Shapes per the module's ragged section; returns [T, Hkv, G, D] with
@@ -525,6 +562,18 @@ def ragged_paged_attention_xla(q, k_pool, v_pool, page_table, kv_lens,
         "thgd,htcd->thgc", q, k, preferred_element_type=jnp.float32
     ) * (d ** -0.5)
     valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < bound[:, None]
+    if tree_anc is not None:
+        # tree-topology pruning INSIDE the causal bound
+        # (docs/spec_decode_trees.md): a tree row's query attends its
+        # history plus its own root-to-node ancestor path only. In-row
+        # offsets compare against the per-token ancestor list;
+        # anc[t, 0] == -2 marks plain-causal tokens (mask unchanged).
+        off = jnp.arange(cap, dtype=jnp.int32)[None, :] - base[:, None]
+        anc = jnp.any(
+            off[:, :, None] == tree_anc[:, None, :], axis=-1
+        )                                                   # [T, cap]
+        plain = (tree_anc[:, 0] == -2)[:, None]
+        valid = valid & (plain | (off < 0) | anc)
     scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
     row_max = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), -1e30)
     probs = jnp.exp(scores - row_max)
@@ -537,22 +586,27 @@ def ragged_paged_attention_xla(q, k_pool, v_pool, page_table, kv_lens,
 
 def _ragged_attention_kernel(
     # scalar prefetch (SMEM): block_rows [NB], block_q0 [NB],
-    # page_table [R, PP], kv_lens [R], row_lens [R]
-    block_rows_ref,
-    block_q0_ref,
-    page_table_ref,
-    kv_lens_ref,
-    row_lens_ref,
-    # then positionally: q_ref [QB,1,G,D]; k_hbm/v_hbm [Hkv,N,P,D] (ANY);
-    # quantized only: k_scale_ref/v_scale_ref [1,1,1,cap_pad] (per-ROW
-    # pre-gathered scales, pipelined by the block_rows index map);
-    # out_ref [QB,1,G,D]; scratch k_buf/v_buf [2, PB*P, D], sems [2, PB, 2]
+    # page_table [R, PP], kv_lens [R], row_lens [R],
+    # tree only: tree_anc [T, DMAX] (per flat token: in-row ancestor
+    # indices incl. self, -1 padded; anc[t, 0] == -2 => plain causal)
     *refs,
     page_size: int,
     pages_per_block: int,
     q_block: int,
     quantized: bool = False,
+    tree: bool = False,
 ):
+    # then positionally: q_ref [QB,1,G,D]; k_hbm/v_hbm [Hkv,N,P,D] (ANY);
+    # quantized only: k_scale_ref/v_scale_ref [1,1,1,cap_pad] (per-ROW
+    # pre-gathered scales, pipelined by the block_rows index map);
+    # out_ref [QB,1,G,D]; scratch k_buf/v_buf [2, PB*P, D], sems [2, PB, 2]
+    (block_rows_ref, block_q0_ref, page_table_ref, kv_lens_ref,
+     row_lens_ref) = refs[:5]
+    refs = refs[5:]
+    if tree:
+        tree_anc_ref, refs = refs[0], refs[1:]
+    else:
+        tree_anc_ref = None
     if quantized:
         (q_ref, k_hbm, v_hbm, k_scale_ref, v_scale_ref,
          out_ref, k_buf, v_buf, sems) = refs
@@ -644,6 +698,28 @@ def _ragged_attention_kernel(
             qi = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // g
             q_live = (q0 + qi) < row_len                        # query exists
             valid = (token_ids < base + q0 + qi + 1) & q_live
+            if tree:
+                # tree-topology pruning inside the unchanged causal
+                # bound (docs/spec_decode_trees.md): the DMA plan above
+                # is untouched — parent-before-child node order keeps
+                # base+q0+qi+1 a valid upper bound, so trees only MASK
+                # within the pages already copied. Ancestor lists live
+                # in SMEM (scalar prefetch); the per-query unroll is
+                # static (q_block x DMAX scalar reads, equality
+                # compares only — no i1 minor dims, no vector shifts).
+                tok_off = token_ids - base          # in-row kv offset
+                allow = tok_off < 0                 # history always
+                for qs in range(qb):
+                    t_flat = bi * qb + qs
+                    plain = tree_anc_ref[t_flat, 0] == -2
+                    match = tok_off < 0
+                    for a in range(tree_anc_ref.shape[1]):
+                        av = tree_anc_ref[t_flat, a]
+                        match = match | ((tok_off == av) & (av >= 0))
+                    allow = jnp.where(
+                        qi == qs, jnp.logical_or(plain, match), allow
+                    )
+                valid = valid & allow
             scores = jnp.where(valid, scores, -jnp.inf)
             # rows past the bound were never DMA'd: zero before the matmul
             row_ids = i * block_tokens + jax.lax.broadcasted_iota(
@@ -685,7 +761,7 @@ def _ragged_attention_kernel(
 def ragged_paged_attention(
     q, k_pool, v_pool, page_table, kv_lens, row_starts, row_lens, *,
     block_rows=None, block_q0=None,
-    k_scale=None, v_scale=None,
+    k_scale=None, v_scale=None, tree_anc=None,
     pages_per_block: int = 32, q_block: int = _RAGGED_QB,
     interpret: bool = False,
 ):
@@ -698,7 +774,13 @@ def ragged_paged_attention(
     q-block -> row map (:func:`ragged_layout`); the Pallas path REQUIRES
     them (they cannot be derived from traced row metadata on device) and
     the flat layout must be q_block-aligned per row. Without them every
-    call routes to the XLA reference."""
+    call routes to the XLA reference.
+
+    ``tree_anc`` ([T, DMAX] int32, optional) turns spec-verify rows into
+    draft-TREE rows (docs/spec_decode_trees.md): per flat token, the
+    in-row indices of its root-to-node ancestor path (self included, -1
+    padded); ``tree_anc[t, 0] == -2`` keeps token t plain-causal. Only
+    the mask changes — the page DMA plan is topology-blind."""
     quantized = k_scale is not None
     if jnp.issubdtype(k_pool.dtype, jnp.signedinteger) and not quantized:
         raise ValueError(
@@ -708,7 +790,7 @@ def ragged_paged_attention(
     def _xla():
         return ragged_paged_attention_xla(
             q, k_pool, v_pool, page_table, kv_lens, row_starts, row_lens,
-            k_scale, v_scale,
+            k_scale, v_scale, tree_anc,
         )
 
     if not _PALLAS_OK or block_rows is None or block_q0 is None:
@@ -740,11 +822,14 @@ def ragged_paged_attention(
         pages_per_block=pb,
         q_block=q_block,
         quantized=quantized,
+        tree=tree_anc is not None,
     )
     nb = t // q_block
+    # index maps take *_ for the scalar-prefetch refs: their count is 5
+    # or 6 (tree_anc) and the maps never read beyond block_rows
     in_specs = [
         pl.BlockSpec(
-            (q_block, 1, g, d), lambda b, h, br, bq, pt, kl, rl: (b, h, 0, 0)
+            (q_block, 1, g, d), lambda b, h, *_: (b, h, 0, 0)
         ),
         pl.BlockSpec(memory_space=pl.ANY),   # K pool stays in HBM
         pl.BlockSpec(memory_space=pl.ANY),   # V pool stays in HBM
@@ -765,7 +850,7 @@ def ragged_paged_attention(
             ).reshape(r, hkv, 1, cap)
             return jnp.pad(seq, pad)
 
-        def scale_idx(b, h, br, bq, pt, kl, rl):
+        def scale_idx(b, h, br, *_):
             return (jnp.maximum(br[b], 0), h, 0, 0)
 
         in_specs += [
@@ -773,12 +858,19 @@ def ragged_paged_attention(
             pl.BlockSpec((1, 1, 1, cap_pad), scale_idx),
         ]
         inputs += [gather(k_scale), gather(v_scale)]
+    prefetch = [block_rows, block_q0, page_table, kv_lens, row_lens]
+    if tree_anc is not None:
+        if tree_anc.shape[0] != t:
+            raise ValueError(
+                "tree_anc rows {} != flat token count {}".format(
+                    tree_anc.shape[0], t))
+        prefetch.append(tree_anc.astype(jnp.int32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,  # block_rows, block_q0, page_table, kv/row lens
+        num_scalar_prefetch=len(prefetch),  # block/row map + tables (+ tree)
         grid=(nb, hkv),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (q_block, 1, g, d), lambda b, h, br, bq, pt, kl, rl: (b, h, 0, 0)
+            (q_block, 1, g, d), lambda b, h, *_: (b, h, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((2, pb * page_size, d), k_pool.dtype),
@@ -791,4 +883,4 @@ def ragged_paged_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, hkv, g, d), q.dtype),
         interpret=interpret,
-    )(block_rows, block_q0, page_table, kv_lens, row_lens, *inputs)
+    )(*prefetch, *inputs)

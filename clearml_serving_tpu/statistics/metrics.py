@@ -428,6 +428,21 @@ class EngineLifecycleCollector(_KeyedCollector):
             "per ragged launch: mean accepted-draft fraction over its "
             "spec verify rows (accepted / spec_k)",
         )
+        # tree-draft verify rows (docs/spec_decode_trees.md): committed
+        # root-to-leaf depth per verify row (the acceptance-gap headline
+        # vs the chain baseline at equal verify budget) and how often the
+        # proposer's drafts came from real history matches rather than
+        # the repeat-last fallback
+        spec_tree_depth = HistogramMetricFamily(
+            p + "_spec_tree_accept_depth",
+            "per tree-verify row: accepted root-to-leaf path depth "
+            "(tokens committed from the draft tree in one launch)",
+        )
+        spec_proposer_hits = CounterMetricFamily(
+            p + "_spec_proposer_hits_total",
+            "verify rows whose draft came from a real proposer history "
+            "match (not the repeat-last fallback), by proposer backend",
+        )
         # paged KV pool capacity (docs/paged_kv_quant.md): bytes split by
         # kind (kv = data planes, scale = int8 dequant scale rows) plus an
         # info gauge carrying the pool dtype — the int8 capacity win is a
@@ -486,6 +501,13 @@ class EngineLifecycleCollector(_KeyedCollector):
             "decode-replica ship hit rate: shipped requests whose "
             "admission found the whole storable prefix resident / all "
             "judged shipped requests (clean-path bound: >= 0.9)",
+        )
+        kv_ship_overlap = GaugeMetricFamily(
+            p + "_kv_ship_overlap_ratio",
+            "draft-ahead shipping overlap: pages shipped as unsealed "
+            "partial frames before the prefill commit / all pages "
+            "shipped for committed prefixes (0 = every page waited for "
+            "the seal; -> 1 = the seal carried only the held-back tail)",
         )
         # socket KV-wire backend (llm/kv_wire.py, docs/disaggregation.md):
         # bytes actually framed onto the wire and the send->ack round trip
@@ -611,6 +633,9 @@ class EngineLifecycleCollector(_KeyedCollector):
                     hist(kv_ship_ms, key, s, snap, direction="in")
                 if kv_ship.get("hit_rate") is not None:
                     gauge(kv_ship_hit_rate, key, s, kv_ship["hit_rate"])
+                if kv_ship.get("overlap_ratio") is not None:
+                    gauge(kv_ship_overlap, key, s,
+                          kv_ship["overlap_ratio"])
                 wire = (kv_ship.get("transport") or {}).get("wire") or {}
                 if wire:
                     any_kv_wire = True
@@ -671,6 +696,14 @@ class EngineLifecycleCollector(_KeyedCollector):
                 snap = ragged.get("spec_acceptance")
                 if snap:
                     hist(spec_accept, key, s, snap)
+                snap = ragged.get("spec_tree_depth")
+                if snap:
+                    hist(spec_tree_depth, key, s, snap)
+                prop = ragged.get("spec_proposer")
+                if prop:
+                    counter(spec_proposer_hits, key, s,
+                            prop.get("hit", 0),
+                            proposer=prop.get("name", "unknown"))
             pipe = s.get("pipeline") or {}
             if pipe:
                 any_pipeline = True
@@ -742,6 +775,8 @@ class EngineLifecycleCollector(_KeyedCollector):
             yield ragged_budget
             yield tokens_per_launch
             yield spec_accept
+            yield spec_tree_depth
+            yield spec_proposer_hits
         if any_kv_pool:
             yield kv_pool_bytes
             yield kv_pool_dtype
@@ -754,6 +789,7 @@ class EngineLifecycleCollector(_KeyedCollector):
             yield kv_ship_pages
             yield kv_ship_ms
             yield kv_ship_hit_rate
+            yield kv_ship_overlap
         if any_kv_wire:
             yield kv_ship_wire_bytes
             yield kv_ship_rtt_ms

@@ -785,3 +785,97 @@ def test_kill_prefill_replica_mid_ship_resumes_on_remaining(parts, kv_backend):
         sanitizer = replica.engine._sanitizer
         assert sanitizer is not None and sanitizer.stats()["failures"] == 0
     group.stop()
+
+
+# -- draft-ahead KV shipping (docs/spec_decode_trees.md) ----------------------
+
+
+def test_draft_ahead_overlaps_ragged_prefill_over_socket(parts):
+    """The draft-ahead certificate's clean path, over the REAL wire: a
+    ragged prefill replica ships storable pages at chunk boundaries
+    (unsealed partial frames overlapping the prefill tail) and seals at
+    commit; the decode replica's admission hits the shipped prefix, the
+    stream is byte-identical to a monolithic replica's, and the overlap
+    gauge is live (> 0)."""
+    bundle, params = parts
+    ragged = dict(scheduler="ragged", step_token_budget=16)
+
+    async def scenario():
+        ids = _conv(21, n=60)       # spans several 16-token ragged chunks
+        mono = _make_group(bundle, params, n=1, **ragged)
+        expected = (await _collect(mono, ids))[0]
+        await mono.wait_drained()
+        mono.stop()
+
+        group = _make_group(
+            bundle, params, n=2, roles=["prefill", "decode"],
+            kv_backend="socket", **ragged,
+        )
+        got = (await _collect(group, ids))[0]
+        assert got == expected
+        prefill = group.replicas[0].engine._kv_ship_snapshot()
+        decode = group.replicas[1].engine._kv_ship_snapshot()
+        # the prefix head rode unsealed frames ahead of the commit seal
+        assert prefill["draft_ships"] >= 1
+        assert prefill["draft_pages"] >= 1
+        assert prefill["draft_aborts"] == 0
+        assert prefill["overlap_ratio"] > 0
+        assert prefill["ships"] >= 1
+        assert prefill["ship_pages"] > prefill["draft_pages"]  # seal pages
+        # transport saw the assembly seal exactly once per ship
+        transport = decode["transport"]
+        assert transport["partial_frames"] >= 1
+        assert transport["assembled"] == prefill["ships"]
+        assert transport["assembly_drops"] == 0
+        # decode replica recomputed none of the shipped prefix
+        assert decode["receives"] >= 1 and decode["hits"] >= 1
+        assert decode["recomputes"] == 0 and decode["hit_rate"] == 1.0
+        await group.wait_drained()
+        return group
+
+    group = asyncio.run(scenario())
+    _drained_clean(group)
+    group.stop()
+
+
+@pytest.mark.chaos
+def test_partial_ship_fault_drops_to_recompute(parts):
+    """Chaos: an injected ``kv.ship.partial`` fault mid-draft-ahead
+    aborts the job's whole partial stream AND the commit seal — the
+    receiver's unsealed assembly is never consumable, the decode replica
+    recomputes, the stream stays byte-identical, and nothing leaks on
+    either side."""
+    bundle, params = parts
+    ragged = dict(scheduler="ragged", step_token_budget=16)
+
+    async def scenario():
+        ids = _conv(23, n=60)
+        mono = _make_group(bundle, params, n=1, **ragged)
+        expected = (await _collect(mono, ids))[0]
+        await mono.wait_drained()
+        mono.stop()
+
+        group = _make_group(
+            bundle, params, n=2, roles=["prefill", "decode"], **ragged,
+        )
+        faults.configure([
+            {"point": "kv.ship.partial", "action": "raise", "times": 1},
+        ])
+        try:
+            got, _ = await _collect(group, ids)
+        finally:
+            faults.clear()
+        assert got == expected
+        prefill = group.replicas[0].engine._kv_ship_snapshot()
+        decode = group.replicas[1].engine._kv_ship_snapshot()
+        assert prefill["draft_aborts"] >= 1
+        assert prefill["ships"] == 0            # the seal was skipped
+        assert prefill["ship_drops"] >= 1
+        assert decode["receives"] == 0
+        assert decode["recomputes"] >= 1 and decode["hits"] == 0
+        await group.wait_drained()
+        return group
+
+    group = asyncio.run(scenario())
+    _drained_clean(group)
+    group.stop()

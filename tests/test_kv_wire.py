@@ -317,3 +317,144 @@ def test_truncated_frame_on_the_wire_drops_to_recompute():
         assert r1.recv(b"k" * 16) is not None
     finally:
         fabric.close()
+
+
+# -- draft-ahead partial frames (docs/spec_decode_trees.md) -------------------
+
+
+def _split_frames(pages=4, page_size=4, split=2, key=b"k" * 16):
+    """(whole, head, tail): the same prefix as one legacy shipment and as
+    an unsealed head frame + sealing tail frame."""
+    whole = _shipment(pages=pages, page_size=page_size, key=key)
+    head = KVShipment(
+        key=key, src="r0", prefix_len=split * page_size,
+        page_size=page_size, lora=0,
+        hk=whole.hk[:split], hv=whole.hv[:split],
+        page_offset=0, final=False,
+    )
+    tail = KVShipment(
+        key=key, src="r0", prefix_len=pages * page_size,
+        page_size=page_size, lora=0,
+        hk=whole.hk[split:], hv=whole.hv[split:],
+        page_offset=split, final=True,
+    )
+    return whole, head, tail
+
+
+def test_partial_wire_roundtrip_preserves_framing():
+    """page_offset/final survive the wire; a legacy whole-prefix frame
+    OMITS the keys entirely (byte-compatible with PR 19 receivers)."""
+    import json
+
+    whole, head, tail = _split_frames()
+    got = shipment_from_wire(shipment_to_wire(head))
+    assert got.page_offset == 0 and got.final is False
+    assert got.hk.tobytes() == head.hk.tobytes()
+    got = shipment_from_wire(shipment_to_wire(tail))
+    assert got.page_offset == 2 and got.final is True
+    frame = shipment_to_wire(whole)
+    _, _, hdr_len = struct.unpack("<BBH", frame[4:8])
+    header = json.loads(frame[8:8 + hdr_len].decode("utf-8"))
+    assert "page_offset" not in header and "final" not in header
+    got = shipment_from_wire(frame)
+    assert got.page_offset == 0 and got.final is True
+
+
+def test_partial_frame_geometry_validated():
+    _, head, tail = _split_frames()
+    # unsealed frames must cover whole pages exactly
+    with pytest.raises(WireFormatError, match="partial frame"):
+        shipment_from_wire(_tamper(shipment_to_wire(head), prefix_len=7))
+    # a negative page offset is a header lie
+    with pytest.raises(WireFormatError, match="page_offset"):
+        shipment_from_wire(_tamper(shipment_to_wire(head), page_offset=-1))
+    # the sealing frame's prefix tail must land inside ITS pages
+    with pytest.raises(WireFormatError, match="prefix_len"):
+        shipment_from_wire(
+            _tamper(shipment_to_wire(tail), prefix_len=2 * 4)
+        )
+
+
+def test_partial_frames_reassemble_and_seal_over_socket():
+    """The draft-ahead happy path over the real wire: head frame queues
+    UNSEALED (recv misses — an unsealed assembly is never consumable),
+    the sealing tail frame fuses the assembly into the mailbox, and the
+    received shipment is byte-identical to the single-frame legacy
+    equivalent."""
+    whole, head, tail = _split_frames()
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        assert r0.send("r1", head) is True
+        assert r1.recv(whole.key) is None          # unsealed: invisible
+        assert r0.send("r1", tail) is True
+        got = r1.recv(whole.key)
+        assert got is not None and got.final and got.page_offset == 0
+        assert got.pages == whole.pages
+        assert got.prefix_len == whole.prefix_len
+        assert got.hk.tobytes() == whole.hk.tobytes()
+        assert got.hv.tobytes() == whole.hv.tobytes()
+        stats = r1.stats()
+        assert stats["partial_frames"] == 1
+        assert stats["assembled"] == 1
+        assert stats["assembly_drops"] == 0
+    finally:
+        fabric.close()
+
+
+def test_partial_duplicate_and_gap_frames_drop_whole_assembly():
+    """Ordering violations reject the ENTIRE assembly, not just the bad
+    frame: a duplicated middle frame, a gapped seal, and a seal with no
+    assembly all leave nothing consumable (drop-to-recompute)."""
+    pages, page_size = 4, 4
+    whole = _shipment(pages=pages, page_size=page_size)
+    frame = lambda lo, hi, final: KVShipment(
+        key=whole.key, src="r0",
+        prefix_len=(pages if final else hi) * page_size,
+        page_size=page_size, lora=0,
+        hk=whole.hk[lo:hi], hv=whole.hv[lo:hi],
+        page_offset=lo, final=final,
+    )
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        # duplicate middle frame: offset 1 twice
+        assert r0.send("r1", frame(0, 1, False)) is True
+        assert r0.send("r1", frame(1, 2, False)) is True
+        assert r0.send("r1", frame(1, 2, False)) is False   # dup -> drop all
+        assert r0.send("r1", frame(2, 4, True)) is False    # assembly gone
+        assert r1.recv(whole.key) is None
+        assert r1.stats()["assembly_drops"] == 2
+        # gap: head then a seal that skips a page
+        assert r0.send("r1", frame(0, 1, False)) is True
+        assert r0.send("r1", frame(2, 4, True)) is False
+        assert r1.recv(whole.key) is None
+        # seal with no assembly at all
+        assert r0.send("r1", frame(2, 4, True)) is False
+        assert r1.recv(whole.key) is None
+        assert r1.stats()["assembled"] == 0
+        # the endpoint still works for a fresh, in-order stream
+        assert r0.send("r1", frame(0, 2, False)) is True
+        assert r0.send("r1", frame(2, 4, True)) is True
+        got = r1.recv(whole.key)
+        assert got is not None and got.hk.tobytes() == whole.hk.tobytes()
+    finally:
+        fabric.close()
+
+
+def test_legacy_reship_supersedes_unsealed_assembly():
+    """A whole-prefix re-ship of the same key (e.g. the sender restarted
+    and took the single-frame path) replaces the dangling assembly — the
+    received payload is the legacy shipment, not a half-fused hybrid."""
+    whole, head, _ = _split_frames()
+    fabric, r0, r1 = _fabric_pair(capacity_pages=8)
+    try:
+        assert r0.send("r1", head) is True
+        assert r0.send("r1", whole) is True
+        got = r1.recv(whole.key)
+        assert got is not None and got.pages == whole.pages
+        assert got.hk.tobytes() == whole.hk.tobytes()
+        # the stale head can no longer seal into anything
+        _, _, tail = _split_frames()
+        assert r0.send("r1", tail) is False
+        assert r1.recv(whole.key) is None
+    finally:
+        fabric.close()

@@ -1539,3 +1539,122 @@ def test_socket_fleet_wire_metrics_end_to_end():
         assert val("router_replica_backend", backend="inprocess") == 1
     finally:
         group.stop()
+
+
+def test_engine_spec_tree_and_draft_ahead_metrics_exported():
+    """Tree-draft + draft-ahead observability (docs/spec_decode_trees.md):
+    engine_spec_tree_accept_depth histogram,
+    engine_spec_proposer_hits_total{proposer} counter and the
+    engine_kv_ship_overlap_ratio gauge — from a synthetic lifecycle
+    provider AND end to end against a real tree-spec engine."""
+    from clearml_serving_tpu.statistics.metrics import register_engine_lifecycle
+
+    stats = {
+        "queue_depth": 0,
+        "ragged": {
+            "step_token_budget": 16,
+            "effective_budget": 16,
+            "prefill_jobs": 0,
+            "steps": 3,
+            "step_rows": {"spec_verify": 3},
+            "spec_tree_depth": {
+                "buckets": [0, 1, 2, 3, 4],
+                "counts": [1, 0, 2, 1, 0, 0],
+                "sum_ms": 7.0,
+                "count": 4,
+            },
+            "spec_tree_fallbacks": 0,
+            "spec_proposer": {
+                "name": "ngram-forest", "proposed": 9, "hit": 6,
+                "branched": 4,
+            },
+        },
+        "kv_ship": {
+            "ships": 2, "ship_pages": 8, "ship_drops": 0,
+            "draft_ships": 3, "draft_pages": 6, "draft_aborts": 0,
+            "overlap_ratio": 0.75,
+        },
+    }
+    registry = CollectorRegistry()
+    register_engine_lifecycle(lambda: stats, registry=registry, key="m1")
+
+    def val(name, **labels):
+        return registry.get_sample_value(name, {"model": "m1", **labels})
+
+    # accepted-depth histogram: cumulative buckets + count/sum
+    assert val("engine_spec_tree_accept_depth_count") == 4
+    assert val("engine_spec_tree_accept_depth_sum") == 7.0
+    assert val("engine_spec_tree_accept_depth_bucket", le="2") == 3
+    assert val("engine_spec_tree_accept_depth_bucket", le="+Inf") == 4
+    # proposer hits carry the backend label
+    assert val(
+        "engine_spec_proposer_hits_total", proposer="ngram-forest"
+    ) == 6
+    # draft-ahead overlap: shipped-before-commit / all shipped pages
+    assert val("engine_kv_ship_overlap_ratio") == 0.75
+
+    # chain / non-tree providers (spec_tree_depth None, no proposer dict)
+    # skip the tree families without breaking the ragged block
+    registry2 = CollectorRegistry()
+    register_engine_lifecycle(
+        lambda: {
+            "queue_depth": 0,
+            "ragged": {"spec_tree_depth": None, "spec_proposer": None,
+                       "step_rows": {"decode": 2}},
+        },
+        registry=registry2, key="m2",
+    )
+    assert registry2.get_sample_value(
+        "engine_spec_tree_accept_depth_count", {"model": "m2"}
+    ) is None
+    assert registry2.get_sample_value(
+        "engine_step_rows_total", {"model": "m2", "phase": "decode"}
+    ) == 2
+
+    # end to end: a real tree-spec engine feeds the same families
+    import asyncio
+
+    import jax
+
+    from clearml_serving_tpu import models
+    from clearml_serving_tpu.llm.engine import GenRequest, LLMEngineCore
+
+    bundle = models.build_model(
+        "llama", {"preset": "llama-tiny", "dtype": "float32"}
+    )
+    params = bundle.init(jax.random.PRNGKey(0))
+    engine = LLMEngineCore(
+        bundle, params, max_batch=2, max_seq_len=64, prefill_buckets=[16],
+        eos_token_id=None, scheduler="ragged", step_token_budget=12,
+        cache_mode="paged", speculation="ngram", spec_k=4, spec_ngram=2,
+        spec_tree=True, spec_branch=2,
+    )
+    try:
+        registry3 = CollectorRegistry()
+        register_engine_lifecycle(
+            engine.lifecycle_stats, registry=registry3, key="llm"
+        )
+
+        async def run():
+            req = GenRequest(
+                prompt_ids=[5, 9, 2, 17, 5, 9, 2], max_new_tokens=8
+            )
+            out = [t async for t in engine.generate(req)]
+            await engine.wait_drained()
+            return out
+
+        out = asyncio.run(run())
+        assert len(out) == 8
+
+        def rval(name, **labels):
+            return registry3.get_sample_value(
+                name, {"model": "llm", **labels}
+            )
+
+        assert rval("engine_step_rows_total", phase="spec_verify") >= 1
+        assert rval("engine_spec_tree_accept_depth_count") >= 1
+        assert rval(
+            "engine_spec_proposer_hits_total", proposer="ngram-forest"
+        ) is not None
+    finally:
+        engine.stop()
